@@ -1,0 +1,14 @@
+"""modalities-tpu: a TPU-native (JAX/XLA/Pallas) framework for distributed LLM training.
+
+Re-imagines the capabilities of the reference `modalities` framework
+(PyTorch/CUDA/NCCL) on top of JAX: GSPMD sharding over a named device mesh
+replaces FSDP/DTensor/pipelining wrappers, one jitted ``train_step`` replaces
+the eager micro-batch loop internals, Orbax replaces torch DCP, and Pallas
+kernels replace flash-attn CUDA kernels.
+
+The YAML config + registry + component-factory dependency-injection system is
+preserved as the user-facing API (reference: src/modalities/config/component_factory.py,
+src/modalities/registry/components.py).
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,167 @@
+"""Recursive component factory (reference: src/modalities/config/component_factory.py:12-228).
+
+Semantics preserved exactly:
+
+* a dict node containing ``component_key`` + ``variant_key`` is a *component config*:
+  its ``config`` sub-node is built first (recursively), validated against the variant's
+  pydantic config class (extra keys forbidden, alias-aware error messages), then the
+  component type is instantiated with the validated fields.
+* a dict node with exactly ``{instance_key, pass_type}`` is a *reference config*: the
+  referenced top-level component is built on demand (once) and shared by reference.
+* top-level components (traversal depth 1) are memoized so multiple references resolve
+  to the same instance.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Type, TypeVar
+
+from pydantic import AliasChoices, BaseModel
+from pydantic.fields import FieldInfo
+
+from modalities_tpu.registry.registry import Registry
+from modalities_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+BaseModelChild = TypeVar("BaseModelChild", bound=BaseModel)
+
+
+class ComponentFactory:
+    def __init__(self, registry: Registry) -> None:
+        self.registry = registry
+
+    def build_components(self, config_dict: dict, components_model_type: Type[BaseModelChild]) -> BaseModelChild:
+        """Build every component the instantiation model requires (optional fields only
+        if present in the config) and validate the result against the model."""
+        required = [n for n, f in components_model_type.model_fields.items() if f.is_required()]
+        optional = [n for n, f in components_model_type.model_fields.items() if not f.is_required()]
+        component_dict = self._build_config(config_dict, required, optional)
+        return components_model_type(**component_dict)
+
+    def _build_config(self, config_dict: dict, required: list[str], optional: list[str]) -> dict[str, Any]:
+        filtered = {name: config_dict[name] for name in required}
+        for name in optional:
+            if name in config_dict:
+                filtered[name] = config_dict[name]
+        components, _ = self._build_component(filtered, config_dict, top_level_components={}, traversal_path=[])
+        return components
+
+    def _build_component(
+        self,
+        current: dict | list | Any,
+        full_config: dict,
+        top_level_components: dict[str, Any],
+        traversal_path: list[str],
+    ) -> tuple[Any, dict[str, Any]]:
+        if len(traversal_path) == 1 and traversal_path[0] in top_level_components:
+            return top_level_components[traversal_path[0]], top_level_components
+
+        if isinstance(current, dict):
+            materialized: dict[str, Any] = {}
+            for key, sub in current.items():
+                materialized[key], top_level_components = self._build_component(
+                    sub, full_config, top_level_components, traversal_path + [key]
+                )
+
+            if self._is_component_config(current):
+                component_key = current["component_key"]
+                variant_key = current["variant_key"]
+                validated = self._instantiate_component_config(
+                    component_key, variant_key, materialized.get("config", {})
+                )
+                component = self._instantiate_component(component_key, variant_key, validated)
+                logger.debug("Instantiated %s: %s", type(component).__name__, " -> ".join(traversal_path))
+                if len(traversal_path) == 1:
+                    top_level_components[traversal_path[-1]] = component
+                return component, top_level_components
+
+            if self._is_reference_config(current):
+                referenced = current["instance_key"]
+                if referenced not in top_level_components:
+                    if referenced not in full_config:
+                        raise ValueError(
+                            f"Reference to unknown top-level component {referenced!r} "
+                            f"(at {' -> '.join(traversal_path)})"
+                        )
+                    built, top_level_components = self._build_component(
+                        full_config[referenced], full_config, top_level_components, [referenced]
+                    )
+                    top_level_components[referenced] = built
+                return top_level_components[referenced], top_level_components
+
+            return materialized, top_level_components
+
+        if isinstance(current, list):
+            out = []
+            for i, sub in enumerate(current):
+                built, top_level_components = self._build_component(
+                    sub, full_config, top_level_components, traversal_path + [str(i)]
+                )
+                out.append(built)
+            return out, top_level_components
+
+        return current, top_level_components
+
+    @staticmethod
+    def _is_component_config(config_dict: dict) -> bool:
+        return "component_key" in config_dict.keys()
+
+    @staticmethod
+    def _is_reference_config(config_dict: dict) -> bool:
+        return {"instance_key", "pass_type"} == config_dict.keys()
+
+    def _instantiate_component_config(self, component_key: str, variant_key: str, config_dict: dict) -> BaseModel:
+        config_type = self.registry.get_config(component_key, variant_key)
+        if config_type is None:
+            if config_dict:
+                raise ValueError(
+                    f"Component `{component_key}.{variant_key}` takes no config, got: {config_dict}"
+                )
+            return BaseModel()
+        self._assert_valid_config_keys(component_key, variant_key, config_dict, config_type)
+        return config_type.model_validate(config_dict)
+
+    def _assert_valid_config_keys(
+        self, component_key: str, variant_key: str, config_dict: dict, config_type: Type[BaseModel]
+    ) -> None:
+        required_keys: list[str] = []
+        optional_keys: list[str] = []
+        alias_to_field: dict[str, str] = {}
+        for field_name, field in config_type.model_fields.items():
+            names = self._field_names_with_aliases(alias_to_field, field_name, field)
+            (required_keys if field.is_required() else optional_keys).extend(names)
+        valid = set(required_keys) | set(optional_keys)
+        invalid = [k for k in config_dict if k not in valid]
+        if invalid:
+            message = (
+                f"Invalid keys {invalid} for config `{component_key}.{variant_key}` "
+                f"of type {config_type}:\n{config_dict}\n"
+            )
+            if alias_to_field:
+                message += f"Alias to field mapping: {alias_to_field}\n"
+            message += f"Required keys (including aliases): {required_keys}\n"
+            message += f"Optional keys (including aliases): {optional_keys}\n"
+            raise ValueError(message)
+
+    @staticmethod
+    def _field_names_with_aliases(alias_to_field: dict[str, str], field_name: str, field: FieldInfo) -> set[str]:
+        names = {field_name}
+        if field.alias and field.alias != field_name:
+            names.add(field.alias)
+            alias_to_field[field.alias] = field_name
+        if field.validation_alias and field.validation_alias != field_name:
+            if isinstance(field.validation_alias, str):
+                names.add(field.validation_alias)
+                alias_to_field[field.validation_alias] = field_name
+            elif isinstance(field.validation_alias, AliasChoices):
+                for alias in field.validation_alias.choices:
+                    if isinstance(alias, str):
+                        names.add(alias)
+                        alias_to_field[alias] = field_name
+        return names
+
+    def _instantiate_component(self, component_key: str, variant_key: str, component_config: BaseModel) -> Any:
+        component_type = self.registry.get_component(component_key, variant_key)
+        kwargs = {name: getattr(component_config, name) for name in type(component_config).model_fields}
+        return component_type(**kwargs)

@@ -1,0 +1,88 @@
+"""Pydantic `Annotated` wrappers so config fields can hold live component instances
+(reference: src/modalities/config/pydantic_if_types.py).
+
+The component factory builds sub-components bottom-up and passes the live objects into
+parent configs; these types validate "is an instance of X" without serialization.
+"""
+
+from __future__ import annotations
+
+from typing import Annotated, Any, Type
+
+from pydantic import GetCoreSchemaHandler
+from pydantic_core import core_schema
+
+
+class PydanticThirdPartyTypeIF:
+    def __init__(self, third_party_type: Type | tuple[Type, ...]):
+        self.third_party_type = third_party_type
+
+    def __get_pydantic_core_schema__(self, source_type: Any, handler: GetCoreSchemaHandler) -> core_schema.CoreSchema:
+        return core_schema.no_info_plain_validator_function(self._validate)
+
+    def _validate(self, value: Any) -> Any:
+        if not isinstance(value, self.third_party_type):
+            raise ValueError(f"Expected instance of {self.third_party_type}, got {type(value)}")
+        return value
+
+
+def instance_of(tp: Type | tuple[Type, ...]):
+    """Build an Annotated pydantic type validating `isinstance(value, tp)`."""
+    return Annotated[Any, PydanticThirdPartyTypeIF(tp)]
+
+
+def _lazy(import_path: str, attr: str):
+    """Deferred isinstance target to avoid import cycles at module load."""
+
+    class _LazyIF(PydanticThirdPartyTypeIF):
+        def __init__(self):
+            self._import_path = import_path
+            self._attr = attr
+
+        @property
+        def third_party_type(self):
+            import importlib
+
+            return getattr(importlib.import_module(self._import_path), self._attr)
+
+        @third_party_type.setter
+        def third_party_type(self, v):  # pragma: no cover - property has no setter use
+            pass
+
+    return Annotated[Any, _LazyIF()]
+
+
+# Live-object field types used across config schemas. Names kept close to the
+# reference's so configs/docs translate directly.
+PydanticModelIFType = _lazy("modalities_tpu.models.model", "NNModel")
+PydanticLossIFType = _lazy("modalities_tpu.loss_functions", "Loss")
+PydanticOptimizerIFType = _lazy("modalities_tpu.optimizers.optimizer_factory", "OptimizerSpec")
+PydanticLRSchedulerIFType = _lazy("modalities_tpu.optimizers.scheduler_factory", "SchedulerSpec")
+PydanticDeviceMeshIFType = _lazy("modalities_tpu.running_env.device_mesh", "DeviceMeshHandle")
+PydanticDatasetIFType = _lazy("modalities_tpu.dataloader.dataset", "Dataset")
+PydanticSamplerIFType = _lazy("modalities_tpu.dataloader.samplers", "SamplerIF")
+PydanticBatchSamplerIFType = _lazy("modalities_tpu.dataloader.samplers", "BatchSamplerIF")
+PydanticCollateFnIFType = _lazy("modalities_tpu.dataloader.collate_fns.collate_if", "CollateFnIF")
+PydanticLLMDataLoaderIFType = _lazy("modalities_tpu.dataloader.dataloader", "LLMDataLoader")
+PydanticTokenizerIFType = _lazy("modalities_tpu.tokenization.tokenizer_wrapper", "TokenizerWrapper")
+PydanticAppStateType = _lazy("modalities_tpu.checkpointing.stateful.app_state", "AppState")
+PydanticCheckpointSavingIFType = _lazy("modalities_tpu.checkpointing.checkpoint_saving", "CheckpointSaving")
+PydanticCheckpointSavingStrategyIFType = _lazy(
+    "modalities_tpu.checkpointing.checkpoint_saving_strategies", "CheckpointSavingStrategyIF"
+)
+PydanticCheckpointSavingExecutionIFType = _lazy(
+    "modalities_tpu.checkpointing.checkpoint_saving_execution", "CheckpointSavingExecutionIF"
+)
+PydanticCheckpointLoadingIFType = _lazy(
+    "modalities_tpu.checkpointing.checkpoint_loading", "CheckpointLoadingIF"
+)
+PydanticMessageSubscriberIFType = _lazy("modalities_tpu.logging_broker.subscriber", "MessageSubscriberIF")
+PydanticGradientClipperIFType = _lazy("modalities_tpu.training.gradient_clipping", "GradientClipperIF")
+PydanticMFUCalculatorIFType = _lazy("modalities_tpu.utils.mfu", "MFUCalculatorIF")
+PydanticProfilerIFType = _lazy("modalities_tpu.utils.profilers.profilers", "SteppableProfilerIF")
+PydanticPipelineIFType = _lazy("modalities_tpu.parallel.pipeline", "Pipeline")
+PydanticStagesGeneratorIFType = _lazy("modalities_tpu.parallel.stages_generator", "StagesGeneratorIF")
+PydanticModelInitializationIFType = _lazy(
+    "modalities_tpu.nn.model_initialization.initialization_if", "ModelInitializationIF"
+)
+PydanticTextInferenceIFType = _lazy("modalities_tpu.inference.text.inference_component", "TextInferenceComponent")

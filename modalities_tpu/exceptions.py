@@ -1,0 +1,37 @@
+"""Framework exceptions (reference: src/modalities/exceptions.py)."""
+
+
+class ModalitiesTpuError(Exception):
+    """Base class for all framework errors."""
+
+
+class ConfigError(ModalitiesTpuError):
+    pass
+
+
+class CheckpointingError(ModalitiesTpuError):
+    pass
+
+
+class ModelStateError(ModalitiesTpuError):
+    pass
+
+
+class OptimizerError(ModalitiesTpuError):
+    pass
+
+
+class BatchStateError(ModalitiesTpuError):
+    pass
+
+
+class DatasetNotFoundError(ModalitiesTpuError):
+    pass
+
+
+class RunningEnvError(ModalitiesTpuError):
+    pass
+
+
+class TimeRecorderStateError(ModalitiesTpuError):
+    pass

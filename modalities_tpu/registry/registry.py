@@ -1,0 +1,57 @@
+"""Two-level component registry (reference: src/modalities/registry/registry.py:11).
+
+Maps ``component_key -> variant_key -> (component type, pydantic config type)``.
+``add_entity`` is the public library-extension hook (used by
+``Main.add_custom_component``).
+"""
+
+from dataclasses import dataclass
+from typing import Optional, Type
+
+from pydantic import BaseModel
+
+
+@dataclass(frozen=True)
+class ComponentEntity:
+    component_key: str
+    variant_key: str
+    component_type: Type
+    component_config_type: Optional[Type[BaseModel]] = None
+
+
+class Registry:
+    def __init__(self, components: Optional[list[ComponentEntity]] = None) -> None:
+        self._registry_dict: dict[str, dict[str, tuple[Type, Optional[Type[BaseModel]]]]] = {}
+        for entity in components or []:
+            self.add_entity(entity)
+
+    def add_entity(self, entity: ComponentEntity) -> None:
+        self._registry_dict.setdefault(entity.component_key, {})[entity.variant_key] = (
+            entity.component_type,
+            entity.component_config_type,
+        )
+
+    def get_component(self, component_key: str, variant_key: str):
+        return self._get(component_key, variant_key)[0]
+
+    def get_config(self, component_key: str, variant_key: str) -> Optional[Type[BaseModel]]:
+        return self._get(component_key, variant_key)[1]
+
+    def _get(self, component_key: str, variant_key: str):
+        try:
+            variants = self._registry_dict[component_key]
+        except KeyError:
+            raise ValueError(
+                f"Unknown component_key {component_key!r}. Known keys: {sorted(self._registry_dict)}"
+            ) from None
+        try:
+            return variants[variant_key]
+        except KeyError:
+            raise ValueError(
+                f"Unknown variant_key {variant_key!r} for component {component_key!r}. "
+                f"Known variants: {sorted(variants)}"
+            ) from None
+
+    @property
+    def entries(self) -> dict[str, dict[str, tuple[Type, Optional[Type[BaseModel]]]]]:
+        return self._registry_dict

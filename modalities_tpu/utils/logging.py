@@ -1,0 +1,44 @@
+"""Logging helpers (reference: src/modalities/utils/logger_utils.py, util.py:26-35)."""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+
+_FORMAT = "%(asctime)s %(levelname)s %(name)s: %(message)s"
+
+
+def get_logger(name: str = "modalities_tpu") -> logging.Logger:
+    logger = logging.getLogger(name)
+    if not logging.getLogger("modalities_tpu").handlers:
+        root = logging.getLogger("modalities_tpu")
+        handler = logging.StreamHandler(sys.stderr)
+        handler.setFormatter(logging.Formatter(_FORMAT))
+        root.addHandler(handler)
+        root.setLevel(os.environ.get("MODALITIES_TPU_LOG_LEVEL", "INFO").upper())
+        root.propagate = False
+    return logger
+
+
+def _process_index() -> int:
+    env_rank = os.environ.get("RANK")
+    if env_rank is not None:
+        return int(env_rank)
+    try:
+        import jax
+
+        return jax.process_index()
+    except Exception:
+        return 0
+
+
+def print_rank_0(message: str) -> None:
+    """Print only on the first host process (reference: util.py:26)."""
+    if _process_index() == 0:
+        print(message)
+
+
+def warn_rank_0(message: str) -> None:
+    if _process_index() == 0:
+        get_logger().warning(message)

@@ -1,0 +1,233 @@
+"""Config-time arithmetic between steps, tokens, samples and batches.
+
+These helpers make YAML configs self-consistent and drive warmstart auto-wiring
+(reference: src/modalities/utils/number_conversion.py). Checkpoint folder names act as
+the metadata store — seen/target steps+tokens are parsed back out via regex
+(reference :215-286).
+"""
+
+from __future__ import annotations
+
+import re
+from pathlib import Path
+
+from pydantic import BaseModel, Field
+from typing_extensions import Annotated
+
+
+def _extract_single_int(pattern: str, string: str) -> int:
+    matches = re.findall(pattern, string)
+    if len(matches) == 1:
+        return int(matches[0])
+    if len(matches) > 1:
+        raise ValueError(
+            f"Expected a single group in the match. Got {len(matches)} matches: {matches}. "
+            f"Pattern: {pattern}, String: {string}"
+        )
+    raise ValueError(f"No match found for pattern {pattern} in {string}")
+
+
+class NumberConversion:
+    @staticmethod
+    def get_local_num_batches_from_num_samples(
+        num_ranks: int, global_num_samples: int, local_micro_batch_size: int
+    ) -> int:
+        return global_num_samples // num_ranks // local_micro_batch_size
+
+    @staticmethod
+    def get_num_samples_from_num_tokens(num_tokens: int, sequence_length: int) -> int:
+        return num_tokens // sequence_length
+
+    @staticmethod
+    def get_local_num_batches_from_num_tokens(
+        num_ranks: int, global_num_tokens: int, sequence_length: int, local_micro_batch_size: int
+    ) -> int:
+        global_num_samples = global_num_tokens // sequence_length
+        return NumberConversion.get_local_num_batches_from_num_samples(
+            num_ranks=num_ranks,
+            global_num_samples=global_num_samples,
+            local_micro_batch_size=local_micro_batch_size,
+        )
+
+    @staticmethod
+    def get_num_steps_from_num_samples(
+        dp_degree: int, local_micro_batch_size: int, global_num_samples: int, gradient_accumulation_steps: int
+    ) -> int:
+        return global_num_samples // dp_degree // local_micro_batch_size // gradient_accumulation_steps
+
+    @staticmethod
+    def get_num_steps_from_num_tokens(
+        dp_degree: int,
+        local_micro_batch_size: int,
+        global_num_tokens: int,
+        sequence_length: int,
+        gradient_accumulation_steps: int,
+    ) -> int:
+        global_num_samples = global_num_tokens // sequence_length
+        return NumberConversion.get_num_steps_from_num_samples(
+            dp_degree=dp_degree,
+            local_micro_batch_size=local_micro_batch_size,
+            global_num_samples=global_num_samples,
+            gradient_accumulation_steps=gradient_accumulation_steps,
+        )
+
+    @staticmethod
+    def get_num_tokens_from_num_steps(
+        num_steps: int,
+        dp_degree: int,
+        local_micro_batch_size: int,
+        sequence_length: int,
+        gradient_accumulation_steps: int,
+    ) -> int:
+        return num_steps * dp_degree * local_micro_batch_size * sequence_length * gradient_accumulation_steps
+
+    @staticmethod
+    def get_last_step_from_checkpoint_path(checkpoint_path: Path) -> int:
+        return _extract_single_int(r"seen_steps_(\d+)", str(checkpoint_path)) - 1
+
+    @staticmethod
+    def get_num_seen_steps_from_checkpoint_path(checkpoint_path: Path) -> int:
+        return _extract_single_int(r"seen_steps_(\d+)", str(checkpoint_path))
+
+    @staticmethod
+    def get_global_num_seen_tokens_from_checkpoint_path(checkpoint_path: Path) -> int:
+        return _extract_single_int(r"seen_tokens_(\d+)", str(checkpoint_path))
+
+    @staticmethod
+    def get_global_num_target_tokens_from_checkpoint_path(checkpoint_path: Path) -> int:
+        return _extract_single_int(r"target_tokens_(\d+)", str(checkpoint_path))
+
+    @staticmethod
+    def get_num_target_steps_from_checkpoint_path(checkpoint_path: Path) -> int:
+        tokens_per_step = NumberConversion.get_global_num_seen_tokens_from_checkpoint_path(checkpoint_path) / (
+            NumberConversion.get_last_step_from_checkpoint_path(checkpoint_path) + 1
+        )
+        global_num_target_tokens = NumberConversion.get_global_num_target_tokens_from_checkpoint_path(checkpoint_path)
+        num_target_steps = global_num_target_tokens // tokens_per_step
+        if isinstance(num_target_steps, float) and not num_target_steps.is_integer():
+            raise ValueError(f"Number of steps calculated is not an integer. {num_target_steps}")
+        return int(num_target_steps)
+
+    @staticmethod
+    def get_num_tokens_from_packed_mem_map_dataset_continuous(
+        dataset_path: Path,
+        sequence_length: int,
+        dp_degree: int,
+        local_micro_batch_size: int,
+        gradient_accumulation_steps: int,
+        sample_key: str,
+        reuse_last_target: bool = True,
+    ) -> int:
+        """Effective trainable tokens of a .pbin dataset: the dataset's token count rounded
+        down to a whole number of optimizer steps (reference :288-341)."""
+        from modalities_tpu.dataloader.dataset_factory import DatasetFactory
+
+        dataset = DatasetFactory.get_packed_mem_map_dataset_continuous(
+            raw_data_path=Path(dataset_path),
+            sequence_length=sequence_length,
+            sample_key=sample_key,
+            reuse_last_target=reuse_last_target,
+        )
+        global_num_tokens_dataset = len(dataset) * sequence_length
+        num_steps = NumberConversion.get_num_steps_from_num_tokens(
+            dp_degree=dp_degree,
+            local_micro_batch_size=local_micro_batch_size,
+            global_num_tokens=global_num_tokens_dataset,
+            sequence_length=sequence_length,
+            gradient_accumulation_steps=gradient_accumulation_steps,
+        )
+        return NumberConversion.get_num_tokens_from_num_steps(
+            num_steps=num_steps,
+            dp_degree=dp_degree,
+            local_micro_batch_size=local_micro_batch_size,
+            sequence_length=sequence_length,
+            gradient_accumulation_steps=gradient_accumulation_steps,
+        )
+
+    @staticmethod
+    def get_num_steps_from_raw_dataset_index(
+        raw_index_path: Path,
+        num_ranks: int,
+        local_micro_batch_size: int,
+        gradient_accumulation_steps: int,
+    ) -> int:
+        from modalities_tpu.dataloader.dataset_factory import DatasetFactory
+
+        index = DatasetFactory.get_raw_index(raw_index_path=Path(raw_index_path))
+        return NumberConversion.get_num_steps_from_num_samples(
+            dp_degree=num_ranks,
+            local_micro_batch_size=local_micro_batch_size,
+            global_num_samples=len(index),
+            gradient_accumulation_steps=gradient_accumulation_steps,
+        )
+
+
+# ---------------------------------------------------------------------------
+# Pydantic configs for the registry's 13 `number_conversion` variants
+# (reference: number_conversion.py:10-70, registry/components.py)
+# ---------------------------------------------------------------------------
+
+PositiveInt = Annotated[int, Field(gt=0)]
+NonNegativeInt = Annotated[int, Field(ge=0)]
+
+
+class LocalNumBatchesFromNumSamplesConfig(BaseModel):
+    num_ranks: PositiveInt
+    global_num_samples: NonNegativeInt
+    local_micro_batch_size: PositiveInt
+
+
+class LocalNumBatchesFromNumTokensConfig(BaseModel):
+    num_ranks: PositiveInt
+    global_num_tokens: NonNegativeInt
+    sequence_length: PositiveInt
+    local_micro_batch_size: PositiveInt
+
+
+class NumSamplesFromNumTokensConfig(BaseModel):
+    num_tokens: NonNegativeInt
+    sequence_length: PositiveInt
+
+
+class NumStepsFromNumSamplesConfig(BaseModel):
+    dp_degree: PositiveInt
+    local_micro_batch_size: PositiveInt
+    global_num_samples: NonNegativeInt
+    gradient_accumulation_steps: PositiveInt
+
+
+class NumStepsFromNumTokensConfig(BaseModel):
+    dp_degree: PositiveInt
+    local_micro_batch_size: PositiveInt
+    global_num_tokens: NonNegativeInt
+    sequence_length: PositiveInt
+    gradient_accumulation_steps: PositiveInt
+
+
+class NumTokensFromNumStepsConfig(BaseModel):
+    num_steps: NonNegativeInt
+    dp_degree: PositiveInt
+    local_micro_batch_size: PositiveInt
+    sequence_length: PositiveInt
+    gradient_accumulation_steps: PositiveInt
+
+
+class NumberConversionFromCheckpointPathConfig(BaseModel):
+    checkpoint_path: Path
+
+
+class NumTokensFromPackedMemMapDatasetContinuousConfig(BaseModel):
+    dataset_path: Path
+    sequence_length: PositiveInt
+    dp_degree: PositiveInt
+    local_micro_batch_size: PositiveInt
+    gradient_accumulation_steps: PositiveInt
+    sample_key: str
+    reuse_last_target: bool = True
+
+
+class NumStepsFromRawDatasetIndexConfig(BaseModel):
+    raw_index_path: Path
+    num_ranks: PositiveInt
+    local_micro_batch_size: PositiveInt
+    gradient_accumulation_steps: PositiveInt

@@ -1,0 +1,186 @@
+"""Mirrors the reference's tests/config/test_component_factory.py behaviorally:
+component builds, by-reference singletons, invalid-key errors, alias handling."""
+
+import pytest
+from pydantic import BaseModel, Field
+
+from modalities_tpu.config.component_factory import ComponentFactory
+from modalities_tpu.registry.registry import ComponentEntity, Registry
+
+
+class _Tokenizer:
+    def __init__(self, vocab_size: int):
+        self.vocab_size = vocab_size
+
+
+class _TokenizerConfig(BaseModel):
+    vocab_size: int
+
+
+class _Dataset:
+    def __init__(self, tokenizer, path: str):
+        self.tokenizer = tokenizer
+        self.path = path
+
+
+class _DatasetConfig(BaseModel):
+    tokenizer: object
+    path: str
+
+
+class _Loader:
+    def __init__(self, dataset, batch_size: int = 2):
+        self.dataset = dataset
+        self.batch_size = batch_size
+
+
+class _LoaderConfig(BaseModel):
+    dataset: object
+    batch_size: int = 2
+
+
+class _AliasedComp:
+    def __init__(self, model_parts):
+        self.model_parts = model_parts
+
+
+class _AliasedConfig(BaseModel):
+    model_parts: object = Field(validation_alias="wrapped_model")
+
+    model_config = {"populate_by_name": True}
+
+
+@pytest.fixture
+def registry():
+    return Registry(
+        [
+            ComponentEntity("tokenizer", "simple", _Tokenizer, _TokenizerConfig),
+            ComponentEntity("dataset", "simple", _Dataset, _DatasetConfig),
+            ComponentEntity("loader", "simple", _Loader, _LoaderConfig),
+            ComponentEntity("aliased", "simple", _AliasedComp, _AliasedConfig),
+        ]
+    )
+
+
+class _TwoLoaderModel(BaseModel):
+    train_loader: object
+    val_loader: object
+
+
+class _OneLoaderModel(BaseModel):
+    train_loader: object
+    optional_thing: object = None
+
+
+def test_nested_build_and_reference_sharing(registry):
+    config = {
+        "tok": {"component_key": "tokenizer", "variant_key": "simple", "config": {"vocab_size": 100}},
+        "train_loader": {
+            "component_key": "loader",
+            "variant_key": "simple",
+            "config": {
+                "dataset": {
+                    "component_key": "dataset",
+                    "variant_key": "simple",
+                    "config": {
+                        "tokenizer": {"instance_key": "tok", "pass_type": "BY_REFERENCE"},
+                        "path": "/data/a",
+                    },
+                },
+            },
+        },
+        "val_loader": {
+            "component_key": "loader",
+            "variant_key": "simple",
+            "config": {
+                "dataset": {
+                    "component_key": "dataset",
+                    "variant_key": "simple",
+                    "config": {
+                        "tokenizer": {"instance_key": "tok", "pass_type": "BY_REFERENCE"},
+                        "path": "/data/b",
+                    },
+                },
+                "batch_size": 4,
+            },
+        },
+    }
+    factory = ComponentFactory(registry)
+    built = factory.build_components(config, _TwoLoaderModel)
+    assert isinstance(built.train_loader, _Loader)
+    assert built.train_loader.dataset.path == "/data/a"
+    assert built.val_loader.batch_size == 4
+    # by-reference: both datasets share the SAME tokenizer instance
+    assert built.train_loader.dataset.tokenizer is built.val_loader.dataset.tokenizer
+    assert built.train_loader.dataset.tokenizer.vocab_size == 100
+
+
+def test_only_requested_components_built(registry):
+    config = {
+        "train_loader": {
+            "component_key": "loader",
+            "variant_key": "simple",
+            "config": {
+                "dataset": {
+                    "component_key": "dataset",
+                    "variant_key": "simple",
+                    "config": {
+                        "tokenizer": {
+                            "component_key": "tokenizer",
+                            "variant_key": "simple",
+                            "config": {"vocab_size": 10},
+                        },
+                        "path": "/p",
+                    },
+                }
+            },
+        },
+        "unused": {"component_key": "tokenizer", "variant_key": "simple", "config": {"vocab_size": -1}},
+    }
+    built = ComponentFactory(registry).build_components(config, _OneLoaderModel)
+    assert built.train_loader.dataset.tokenizer.vocab_size == 10
+    assert built.optional_thing is None
+
+
+def test_invalid_config_key_raises(registry):
+    config = {
+        "train_loader": {
+            "component_key": "tokenizer",
+            "variant_key": "simple",
+            "config": {"vocab_size": 1, "bogus_key": 2},
+        }
+    }
+    with pytest.raises(ValueError, match="bogus_key"):
+        ComponentFactory(registry).build_components(config, _OneLoaderModel)
+
+
+def test_unknown_component_raises(registry):
+    config = {"train_loader": {"component_key": "nope", "variant_key": "simple", "config": {}}}
+    with pytest.raises(ValueError, match="Unknown component_key"):
+        ComponentFactory(registry).build_components(config, _OneLoaderModel)
+
+
+def test_unknown_variant_raises(registry):
+    config = {"train_loader": {"component_key": "loader", "variant_key": "nope", "config": {}}}
+    with pytest.raises(ValueError, match="Unknown variant_key"):
+        ComponentFactory(registry).build_components(config, _OneLoaderModel)
+
+
+def test_alias_accepted(registry):
+    config = {
+        "train_loader": {
+            "component_key": "aliased",
+            "variant_key": "simple",
+            "config": {"wrapped_model": "m"},
+        }
+    }
+    built = ComponentFactory(registry).build_components(config, _OneLoaderModel)
+    assert built.train_loader.model_parts == "m"
+
+
+def test_reference_to_unknown_top_level_raises(registry):
+    config = {
+        "train_loader": {"instance_key": "missing_component", "pass_type": "BY_REFERENCE"},
+    }
+    with pytest.raises(ValueError, match="missing_component"):
+        ComponentFactory(registry).build_components(config, _OneLoaderModel)

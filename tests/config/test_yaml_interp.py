@@ -1,0 +1,106 @@
+import pytest
+
+from modalities_tpu.config.yaml_interp import (
+    default_resolvers,
+    load_app_config_dict,
+    resolve_config_dict,
+)
+from modalities_tpu.exceptions import ConfigError
+
+
+def test_plain_dict_passthrough():
+    cfg = {"a": 1, "b": {"c": [1, 2, 3]}, "d": "hello"}
+    assert resolve_config_dict(cfg) == cfg
+
+
+def test_node_reference_keeps_type():
+    cfg = {"settings": {"seq_len": 4096}, "model": {"block_size": "${settings.seq_len}"}}
+    out = resolve_config_dict(cfg)
+    assert out["model"]["block_size"] == 4096
+    assert isinstance(out["model"]["block_size"], int)
+
+
+def test_string_embedding_interpolation():
+    cfg = {"eid": "exp42", "path": "/tmp/${eid}/ckpt"}
+    assert resolve_config_dict(cfg)["path"] == "/tmp/exp42/ckpt"
+
+
+def test_chained_references():
+    cfg = {"a": 7, "b": "${a}", "c": "${b}"}
+    out = resolve_config_dict(cfg)
+    assert out["c"] == 7
+
+
+def test_nested_path_reference():
+    cfg = {"x": {"y": {"z": "deep"}}, "got": "${x.y.z}"}
+    assert resolve_config_dict(cfg)["got"] == "deep"
+
+
+def test_resolver_call_with_args():
+    resolvers = {"add": lambda a, b: a + b}
+    cfg = {"v": "${add:2,3}"}
+    assert resolve_config_dict(cfg, resolvers)["v"] == 5
+
+
+def test_resolver_arg_can_be_interpolation():
+    resolvers = {"double": lambda x: 2 * x}
+    cfg = {"n": 21, "v": "${double:${n}}"}
+    assert resolve_config_dict(cfg, resolvers)["v"] == 42
+
+
+def test_unknown_resolver_raises():
+    with pytest.raises(ConfigError, match="Unknown resolver"):
+        resolve_config_dict({"v": "${nope:1}"})
+
+
+def test_missing_key_raises():
+    with pytest.raises(ConfigError, match="not found"):
+        resolve_config_dict({"v": "${a.b}"})
+
+
+def test_cycle_detection():
+    cfg = {"a": "${b}", "b": "${a}"}
+    with pytest.raises(ConfigError, match="Circular"):
+        resolve_config_dict(cfg)
+
+
+def test_list_indexing_and_lists_resolved():
+    cfg = {"xs": [10, "${ys.0}"], "ys": [99]}
+    out = resolve_config_dict(cfg)
+    assert out["xs"] == [10, 99]
+
+
+def test_dist_env_resolver(monkeypatch):
+    monkeypatch.setenv("RANK", "3")
+    monkeypatch.setenv("WORLD_SIZE", "8")
+    res = default_resolvers()
+    assert res["cuda_env"]("RANK") == 3
+    assert res["dist_env"]("WORLD_SIZE") == 8
+
+
+def test_load_app_config_dict(tmp_path, monkeypatch):
+    monkeypatch.setenv("RANK", "0")
+    cfg_file = tmp_path / "config.yaml"
+    cfg_file.write_text(
+        """
+settings:
+  experiment_id: ${modalities_env:experiment_id}
+  rank: ${cuda_env:RANK}
+  seq: 128
+model:
+  block_size: ${settings.seq}
+"""
+    )
+    out = load_app_config_dict(cfg_file, experiment_id="eid123")
+    assert out["settings"]["experiment_id"] == "eid123"
+    assert out["settings"]["rank"] == 0
+    assert out["model"]["block_size"] == 128
+
+
+def test_additional_resolver_injection(tmp_path):
+    cfg_file = tmp_path / "c.yaml"
+    cfg_file.write_text("ckpt: ${warmstart_env:checkpoint_path}\n")
+    out = load_app_config_dict(
+        cfg_file, additional_resolver_funs={"warmstart_env": lambda k: {"checkpoint_path": "/x/y"}[k]}
+    )
+    assert out["ckpt"] == "/x/y"

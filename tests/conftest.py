@@ -1,0 +1,18 @@
+"""Test harness: run everything on CPU with 8 virtual devices so mesh/sharding logic
+(dp/tp/pp/cp) is exercised without TPU hardware (SURVEY.md §4 TPU translation)."""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (xla_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def tmp_experiment_dir(tmp_path):
+    d = tmp_path / "experiments"
+    d.mkdir()
+    return d

@@ -1,0 +1,61 @@
+import pytest
+
+from modalities_tpu.utils.number_conversion import NumberConversion as NC
+
+
+def test_local_num_batches_from_num_samples():
+    assert NC.get_local_num_batches_from_num_samples(num_ranks=2, global_num_samples=100, local_micro_batch_size=5) == 10
+    assert NC.get_local_num_batches_from_num_samples(num_ranks=3, global_num_samples=100, local_micro_batch_size=5) == 6
+
+
+def test_num_samples_from_num_tokens():
+    assert NC.get_num_samples_from_num_tokens(num_tokens=1000, sequence_length=100) == 10
+    assert NC.get_num_samples_from_num_tokens(num_tokens=1099, sequence_length=100) == 10
+
+
+def test_local_num_batches_from_num_tokens():
+    assert (
+        NC.get_local_num_batches_from_num_tokens(
+            num_ranks=2, global_num_tokens=4000, sequence_length=100, local_micro_batch_size=5
+        )
+        == 4
+    )
+
+
+def test_num_steps_from_num_samples():
+    assert (
+        NC.get_num_steps_from_num_samples(
+            dp_degree=2, local_micro_batch_size=4, global_num_samples=64, gradient_accumulation_steps=2
+        )
+        == 4
+    )
+
+
+def test_num_steps_tokens_roundtrip():
+    steps = NC.get_num_steps_from_num_tokens(
+        dp_degree=2, local_micro_batch_size=4, global_num_tokens=8192, sequence_length=128, gradient_accumulation_steps=1
+    )
+    tokens = NC.get_num_tokens_from_num_steps(
+        num_steps=steps, dp_degree=2, local_micro_batch_size=4, sequence_length=128, gradient_accumulation_steps=1
+    )
+    assert tokens <= 8192
+    assert steps == 8
+
+
+def test_checkpoint_path_parsing():
+    p = "/exp/eid-2026/seen_steps_64-seen_tokens_524288-target_steps_128-target_tokens_1048576"
+    assert NC.get_num_seen_steps_from_checkpoint_path(p) == 64
+    assert NC.get_last_step_from_checkpoint_path(p) == 63
+    assert NC.get_global_num_seen_tokens_from_checkpoint_path(p) == 524288
+    assert NC.get_global_num_target_tokens_from_checkpoint_path(p) == 1048576
+    assert NC.get_num_target_steps_from_checkpoint_path(p) == 128
+
+
+def test_checkpoint_path_parsing_no_match_raises():
+    with pytest.raises(ValueError, match="No match"):
+        NC.get_num_seen_steps_from_checkpoint_path("/tmp/nothing_here")
+
+
+def test_checkpoint_path_parsing_multiple_matches_raises():
+    with pytest.raises(ValueError, match="single group"):
+        NC.get_num_seen_steps_from_checkpoint_path("/x/seen_steps_1/seen_steps_2")
