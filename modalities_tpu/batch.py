@@ -1,0 +1,87 @@
+"""Batch containers (reference: src/modalities/batch.py:32-131).
+
+Host-side batches are dicts of numpy arrays; they cross the jit boundary as device
+arrays. ``DatasetBatch`` mirrors the reference's samples/targets split so collators
+and losses keep the same shape contract.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import Any, Optional
+
+import numpy as np
+
+
+@dataclass
+class DatasetBatch:
+    """A batch of samples and its targets, keyed by modality (reference: batch.py:32)."""
+
+    samples: dict[str, np.ndarray]
+    targets: dict[str, np.ndarray]
+    batch_dim: int = 0
+
+    def __len__(self) -> int:
+        return next(iter(self.samples.values())).shape[self.batch_dim]
+
+
+@dataclass
+class InferenceResultBatch:
+    """Prediction outputs next to the ground truth (reference: batch.py:58)."""
+
+    targets: dict[str, Any]
+    predictions: dict[str, Any]
+    batch_dim: int = 0
+
+    def get_predictions(self, key: str):
+        if key not in self.predictions:
+            raise ValueError(f"Key {key} not present in predictions!")
+        return self.predictions[key]
+
+    def get_targets(self, key: str):
+        if key not in self.targets:
+            raise ValueError(f"Key {key} not present in targets!")
+        return self.targets[key]
+
+    def __len__(self) -> int:
+        return next(iter(self.predictions.values())).shape[self.batch_dim]
+
+
+class ResultItem:
+    """One logged metric with optional decimal rounding (reference: batch.py:103)."""
+
+    def __init__(self, value, decimal_places: Optional[int] = None):
+        self.value = value
+        self.decimal_places = decimal_places
+
+    def __repr__(self) -> str:
+        v = float(np.asarray(self.value))
+        if self.decimal_places is not None:
+            return f"{round(v, self.decimal_places)}"
+        return str(v)
+
+
+@dataclass
+class EvaluationResultBatch:
+    """Aggregated metrics of an eval/train interval (reference: batch.py:~103)."""
+
+    dataloader_tag: str
+    num_train_steps_done: int
+    losses: dict[str, ResultItem] = field(default_factory=dict)
+    metrics: dict[str, ResultItem] = field(default_factory=dict)
+    throughput_metrics: dict[str, ResultItem] = field(default_factory=dict)
+
+    def __str__(self) -> str:
+        def fmt(d: dict[str, ResultItem]) -> str:
+            return " ".join(f"{k}: {v}" for k, v in d.items())
+
+        return (
+            f"Evaluation result on dataset tag {self.dataloader_tag} after {self.num_train_steps_done} steps:\n"
+            f"losses: {fmt(self.losses)}\nmetrics: {fmt(self.metrics)}\nthroughput: {fmt(self.throughput_metrics)}"
+        )
+
+
+class EvaluationResultTag(str, Enum):
+    TRAIN = "train"
+    EVAL = "eval"

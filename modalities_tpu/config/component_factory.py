@@ -40,6 +40,12 @@ class ComponentFactory:
         return components_model_type(**component_dict)
 
     def _build_config(self, config_dict: dict, required: list[str], optional: list[str]) -> dict[str, Any]:
+        missing = [name for name in required if name not in config_dict]
+        if missing:
+            raise ValueError(
+                f"Config is missing required top-level components {missing}. "
+                f"Present keys: {sorted(config_dict)}; also optional: {optional}"
+            )
         filtered = {name: config_dict[name] for name in required}
         for name in optional:
             if name in config_dict:

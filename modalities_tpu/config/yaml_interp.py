@@ -86,8 +86,10 @@ class _DocumentResolver:
     def __init__(self, root: Any, resolvers: dict[str, Resolver]):
         self._root = root
         self._resolvers = resolvers
-        # memo of fully-resolved absolute paths -> value; also used for cycle detection
-        self._in_progress: set[str] = set()
+        self._in_progress: set[str] = set()  # cycle detection
+        # memo: each absolute dot-path resolves exactly once, so multiple references to
+        # the same node see one value even if a resolver is impure
+        self._memo: dict[str, Any] = {}
 
     def resolve(self) -> Any:
         return self._resolve_node(self._root, path="")
@@ -137,6 +139,8 @@ class _DocumentResolver:
         return _parse_scalar(arg)
 
     def _lookup(self, dot_path: str, from_path: str) -> Any:
+        if dot_path in self._memo:
+            return self._memo[dot_path]
         if dot_path in self._in_progress:
             raise ConfigError(f"Circular interpolation detected at '{dot_path}' (referenced from {from_path})")
         node: Any = self._root
@@ -154,9 +158,11 @@ class _DocumentResolver:
                 raise ConfigError(f"Cannot resolve '${{{dot_path}}}': {key!r} is not indexable (from {from_path})")
         self._in_progress.add(dot_path)
         try:
-            return self._resolve_node(node, dot_path)
+            value = self._resolve_node(node, dot_path)
         finally:
             self._in_progress.discard(dot_path)
+        self._memo[dot_path] = value
+        return value
 
 
 def resolve_config_dict(config: Any, resolvers: Optional[dict[str, Resolver]] = None) -> Any:
@@ -180,13 +186,16 @@ def default_resolvers(
         if var_name in os.environ:
             int_vars = {"LOCAL_RANK", "WORLD_SIZE", "RANK"}
             return int(os.environ[var_name]) if var_name in int_vars else os.environ[var_name]
-        if var_name in ("RANK", "LOCAL_RANK", "WORLD_SIZE"):
+        if var_name == "LOCAL_RANK":
+            # one JAX process per host: the node-local rank is always 0
+            return 0
+        if var_name in ("RANK", "WORLD_SIZE"):
             try:
                 import jax
 
-                return jax.process_index() if var_name in ("RANK", "LOCAL_RANK") else jax.process_count()
+                return jax.process_index() if var_name == "RANK" else jax.process_count()
             except Exception:
-                return 0 if var_name in ("RANK", "LOCAL_RANK") else 1
+                return 0 if var_name == "RANK" else 1
         return os.getenv(var_name)
 
     env_kwargs: dict[str, Any] = {}

@@ -1,0 +1,10 @@
+"""Collate-function interface (reference: src/modalities/dataloader/collate_fns/collate_if.py)."""
+
+from __future__ import annotations
+
+from modalities_tpu.batch import DatasetBatch
+
+
+class CollateFnIF:
+    def __call__(self, batch: list[dict]) -> DatasetBatch:  # pragma: no cover - abstract
+        raise NotImplementedError

@@ -1,0 +1,85 @@
+"""SFT loss masking: mask targets outside [b_include, e_include] spans to
+``loss_ignore_index`` (reference: collator_fn_wrapper_for_loss_masking.py:26-171).
+
+Vectorized with the same shifted-cumsum trick as the reference: +1 at the position
+*after* each begin token, -1 at each end token; cumsum marks the span, excluding both
+marker tokens from the loss.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+from pydantic import BaseModel
+
+from modalities_tpu.batch import DatasetBatch
+from modalities_tpu.dataloader.collate_fns.collate_if import CollateFnIF
+from modalities_tpu.utils.logging import warn_rank_0
+
+
+class LossMaskingTokenConfig(BaseModel):
+    b_include_to_loss_token: str
+    e_include_to_loss_token: str
+
+
+class LossMaskingCollateFnWrapper(CollateFnIF):
+    def __init__(
+        self,
+        wrapped_collate_fn: CollateFnIF,
+        target_keys_to_mask: list[str],
+        loss_ignore_index: int,
+        mask_tokens: LossMaskingTokenConfig,
+        tokenizer,
+    ):
+        if isinstance(mask_tokens, dict):
+            mask_tokens = LossMaskingTokenConfig(**mask_tokens)
+        self.wrapped_collate_fn = wrapped_collate_fn
+        self.target_keys_to_mask = target_keys_to_mask
+        self.loss_ignore_index = loss_ignore_index
+        self.tokenizer = tokenizer
+        self.b_mask_token_id = tokenizer.get_token_id(mask_tokens.b_include_to_loss_token)
+        self.e_mask_token_id = tokenizer.get_token_id(mask_tokens.e_include_to_loss_token)
+        if self.b_mask_token_id == self.e_mask_token_id:
+            raise ValueError(
+                "b_mask_token_id and e_mask_token_id of the LossMaskingCollateFnWrapper must be different!"
+            )
+
+    def __call__(self, batch: list[dict]) -> DatasetBatch:
+        dataset_batch = self.wrapped_collate_fn(batch)
+        for key in self.target_keys_to_mask:
+            dataset_batch.targets[key] = self._mask_target(
+                target=dataset_batch.targets[key],
+                b_mask_token_id=self.b_mask_token_id,
+                e_mask_token_id=self.e_mask_token_id,
+                loss_ignore_index=self.loss_ignore_index,
+            )
+        return dataset_batch
+
+    def _mask_target(
+        self, target: np.ndarray, b_mask_token_id: int, e_mask_token_id: int, loss_ignore_index: int
+    ) -> np.ndarray:
+        if b_mask_token_id not in target:
+            warn_rank_0(
+                "During masking tokens for loss computation, b_mask_token_id not found in target. "
+                "Make sure the tokenizer tokenizes as expected (watch for leading-space token variants). "
+                "We skip this sample."
+            )
+            return np.full_like(target, loss_ignore_index)
+        if e_mask_token_id not in target:
+            warn_rank_0(
+                "During masking tokens for loss computation, e_mask_token_id not found in target. "
+                "We skip this sample."
+            )
+            return np.full_like(target, loss_ignore_index)
+
+        mask = np.zeros_like(target)
+        # shift begin-marker effect one to the right so the begin token itself is excluded
+        mask[:, 1:] += np.where(target != b_mask_token_id, 0, 1)[:, :-1]
+        mask += np.where(target != e_mask_token_id, 0, -1)
+        include_to_loss_mask = mask.cumsum(-1)
+        if not ((0 <= include_to_loss_mask).all() and (include_to_loss_mask <= 1).all()):
+            raise ValueError(
+                "end mask token indicator is before begin mask token indicator in the target. "
+                "This is not supported by the LossMaskingCollateFnWrapper. "
+                "Make sure to use padding and truncation with the tokenizer for PackedMemMapDatasetContinuous"
+            )
+        return np.where(include_to_loss_mask.astype(bool), target, loss_ignore_index)

@@ -1,0 +1,33 @@
+"""Builds the ``.idx`` sidecar for a raw JSONL file
+(reference: src/modalities/dataloader/create_index.py:12).
+
+Scans the file once, recording the byte offset and length of every line. Runs on the
+host only; no accelerator involvement.
+"""
+
+from __future__ import annotations
+
+import pickle
+from pathlib import Path
+
+
+class IndexGenerator:
+    def __init__(self, src_file: Path, drop_faulty_entries: bool = False):
+        self.src_file = Path(src_file)
+        self.drop_faulty_entries = drop_faulty_entries
+
+    def create_index(self, target_path_for_index_file: Path) -> None:
+        target = Path(target_path_for_index_file)
+        if target.exists():
+            raise FileExistsError(f"Index file already exists at {target}")
+        index: list[tuple[int, int]] = []
+        with self.src_file.open("rb") as f:
+            offset = 0
+            for line in f:
+                length = len(line)
+                content = line.rstrip(b"\n")
+                if content:  # skip empty lines but keep offsets correct
+                    index.append((offset, len(content)))
+                offset += length
+        with target.open("wb") as f:
+            pickle.dump(index, f)

@@ -1,0 +1,91 @@
+"""LLMDataLoader — batch-sampler-driven loader with background prefetch
+(reference: src/modalities/dataloader/dataloader.py:12).
+
+The reference subclasses torch DataLoader (worker subprocesses). Here batches are
+assembled from memmap-backed datasets with numpy — cheap enough that a single
+prefetch thread (double-buffering ahead of the device) replaces the worker pool;
+the accelerator never waits on Python in steady state because batches are strictly
+host-side numpy until the jit boundary.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Iterator, Optional
+
+from modalities_tpu.batch import DatasetBatch
+from modalities_tpu.dataloader.collate_fns.collate_if import CollateFnIF
+from modalities_tpu.dataloader.samplers import BatchSamplerIF
+
+
+class LLMDataLoader:
+    def __init__(
+        self,
+        dataloader_tag: str,
+        dataset,
+        batch_sampler: BatchSamplerIF,
+        collate_fn: Optional[CollateFnIF] = None,
+        num_prefetch_batches: int = 2,
+    ):
+        if batch_sampler is None:
+            raise ValueError("LLMDataLoader requires a batch_sampler")
+        self._dataloader_tag = dataloader_tag
+        self.dataset = dataset
+        self.batch_sampler = batch_sampler
+        self.collate_fn = collate_fn
+        self.num_prefetch_batches = num_prefetch_batches
+
+    @property
+    def dataloader_tag(self) -> str:
+        return self._dataloader_tag
+
+    @property
+    def batch_size(self) -> int:
+        return getattr(self.batch_sampler, "batch_size", -1)
+
+    def __len__(self) -> int:
+        return len(self.batch_sampler)
+
+    def _load_batch(self, indices: list[int]) -> DatasetBatch | list:
+        items = [self.dataset[i] for i in indices]
+        if self.collate_fn is not None:
+            return self.collate_fn(items)
+        return items
+
+    def __iter__(self) -> Iterator[DatasetBatch]:
+        if self.num_prefetch_batches <= 0:
+            for indices in self.batch_sampler:
+                yield self._load_batch(indices)
+            return
+
+        q: queue.Queue = queue.Queue(maxsize=self.num_prefetch_batches)
+        _SENTINEL = object()
+        error: list[BaseException] = []
+
+        def producer() -> None:
+            try:
+                for indices in self.batch_sampler:
+                    q.put(self._load_batch(indices))
+            except BaseException as e:  # propagate into the consumer
+                error.append(e)
+            finally:
+                q.put(_SENTINEL)
+
+        thread = threading.Thread(target=producer, daemon=True)
+        thread.start()
+        try:
+            while True:
+                item = q.get()
+                if item is _SENTINEL:
+                    if error:
+                        raise error[0]
+                    return
+                yield item
+        finally:
+            # unblock the producer if the consumer bails early
+            while thread.is_alive():
+                try:
+                    q.get_nowait()
+                except queue.Empty:
+                    break

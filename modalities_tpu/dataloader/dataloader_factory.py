@@ -1,0 +1,27 @@
+"""Dataloader factory (reference: src/modalities/dataloader/dataloader_factory.py:9)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from modalities_tpu.dataloader.collate_fns.collate_if import CollateFnIF
+from modalities_tpu.dataloader.dataloader import LLMDataLoader
+from modalities_tpu.dataloader.samplers import BatchSamplerIF
+
+
+class DataloaderFactory:
+    @staticmethod
+    def get_dataloader(
+        dataloader_tag: str,
+        dataset,
+        batch_sampler: BatchSamplerIF,
+        collate_fn: Optional[CollateFnIF] = None,
+        num_prefetch_batches: int = 2,
+    ) -> LLMDataLoader:
+        return LLMDataLoader(
+            dataloader_tag=dataloader_tag,
+            dataset=dataset,
+            batch_sampler=batch_sampler,
+            collate_fn=collate_fn,
+            num_prefetch_batches=num_prefetch_batches,
+        )

@@ -1,0 +1,72 @@
+"""Dataset factory (reference: src/modalities/dataloader/dataset_factory.py:18)."""
+
+from __future__ import annotations
+
+import pickle
+from pathlib import Path
+from typing import Optional
+
+from modalities_tpu.dataloader.dataset import (
+    CombinedDataset,
+    Dataset,
+    DummyDataset,
+    DummySampleConfig,
+    MemMapDataset,
+    PackedMemMapDatasetContinuous,
+    PackedMemMapDatasetMegatron,
+)
+
+
+class DatasetFactory:
+    @staticmethod
+    def get_raw_index(raw_index_path: Path) -> list[tuple[int, int]]:
+        with Path(raw_index_path).open("rb") as f:
+            return pickle.load(f)
+
+    @staticmethod
+    def get_dummy_dataset(num_samples: int, sample_definition: list[DummySampleConfig]) -> DummyDataset:
+        return DummyDataset(num_samples=num_samples, sample_definition=sample_definition)
+
+    @staticmethod
+    def get_mem_map_dataset(
+        raw_data_path: Path,
+        tokenizer,
+        sample_key: str,
+        index_path: Optional[Path] = None,
+        jq_pattern: str = ".text",
+    ) -> MemMapDataset:
+        return MemMapDataset(
+            raw_data_path=Path(raw_data_path),
+            tokenizer=tokenizer,
+            sample_key=sample_key,
+            index_path=index_path,
+            jq_pattern=jq_pattern,
+        )
+
+    @staticmethod
+    def get_packed_mem_map_dataset_continuous(
+        raw_data_path: Path,
+        sequence_length: int,
+        sample_key: str,
+        reuse_last_target: bool = True,
+    ) -> PackedMemMapDatasetContinuous:
+        # pretraining (reuse_last_target): block covers sequence_length inputs plus the
+        # shifted target token; SFT blocks are disjoint (reference dataset_factory.py:103)
+        return PackedMemMapDatasetContinuous(
+            raw_data_path=Path(raw_data_path),
+            block_size=(sequence_length + 1) if reuse_last_target else sequence_length,
+            sample_key=sample_key,
+            reuse_last_target=reuse_last_target,
+        )
+
+    @staticmethod
+    def get_packed_mem_map_dataset_megatron(
+        raw_data_path: Path, sequence_length: int, sample_key: str
+    ) -> PackedMemMapDatasetMegatron:
+        return PackedMemMapDatasetMegatron(
+            raw_data_path=Path(raw_data_path), block_size=sequence_length + 1, sample_key=sample_key
+        )
+
+    @staticmethod
+    def get_combined_dataset(datasets: list[Dataset]) -> CombinedDataset:
+        return CombinedDataset(datasets=datasets)

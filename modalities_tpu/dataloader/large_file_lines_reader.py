@@ -1,0 +1,55 @@
+"""Random access into large JSONL files via a sidecar ``.idx`` file
+(reference: src/modalities/dataloader/large_file_lines_reader.py:18).
+
+The ``.idx`` file is a pickled ``list[tuple[offset, length]]`` of byte spans, one per
+line, so any line can be read with a single seek — the basis for both raw-index
+creation and the multiprocessing pack pipeline.
+"""
+
+from __future__ import annotations
+
+import pickle
+from pathlib import Path
+from typing import Optional
+
+
+class LargeFileLinesReader:
+    def __init__(self, raw_data_path: Path, index_path: Optional[Path] = None, encoding: str = "utf-8"):
+        self.raw_data_path = Path(raw_data_path)
+        self.index_path = self.default_index_path(self.raw_data_path, index_path)
+        self.encoding = encoding
+        if not self.raw_data_path.is_file():
+            raise FileNotFoundError(f"Raw data file not found: {self.raw_data_path}")
+        if not self.index_path.is_file():
+            raise FileNotFoundError(
+                f"Index file not found: {self.index_path}. Create one with `modalities-tpu data create_raw_index`."
+            )
+        with self.index_path.open("rb") as f:
+            self.index: list[tuple[int, int]] = pickle.load(f)
+        self._fd = self.raw_data_path.open("rb")
+
+    @staticmethod
+    def default_index_path(raw_data_path: Path, index_path: Optional[Path] = None) -> Path:
+        if index_path is None:
+            return raw_data_path.with_suffix(".idx")
+        return Path(index_path)
+
+    def __len__(self) -> int:
+        return len(self.index)
+
+    def __getitem__(self, key: int) -> str:
+        if isinstance(key, slice):
+            return [self._read_span(*self.index[i]) for i in range(*key.indices(len(self)))]
+        return self._read_span(*self.index[key])
+
+    def _read_span(self, offset: int, length: int) -> str:
+        self._fd.seek(offset)
+        data = self._fd.read(length)
+        return data.decode(self.encoding).rstrip("\n")
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    def close(self) -> None:
+        self._fd.close()

@@ -1,0 +1,311 @@
+"""The ``.pbin`` packed-token container and the tokenize-and-pack pipeline.
+
+Byte format (byte-identical to the reference so its pbin files load unchanged;
+reference: src/modalities/dataloader/create_packed_data.py:346-405):
+
+    [ 8 bytes little-endian : data-section length in bytes ]
+    [ 4 bytes little-endian : token size in bytes (1|2|4)  ]
+    [ data section          : little-endian token ids       ]
+    [ pickled index         : list[(offset, length)] byte spans, data-section-relative ]
+
+The pack pipeline mirrors the reference's process topology (reader proc -> N tokenizer
+workers -> writer proc over mp queues, create_packed_data.py:172-180) — this is
+host-side work and stays identical on TPU-VM hosts.
+
+Note: the reference contains two divergent offset conventions (its Megatron index
+starts at HEADER_SIZE while the writer emits data-section-relative offsets, and
+`join_embedded_stream_data` shifts by data_len - header). This implementation uses
+data-section-relative offsets *everywhere*, matching what the writer produces and what
+`PackedMemMapDatasetBase.__getitem__` consumes.
+"""
+
+from __future__ import annotations
+
+import math
+import multiprocessing
+import pickle
+import warnings
+from pathlib import Path
+from typing import Iterator, Optional
+
+import numpy as np
+
+from modalities_tpu.dataloader.large_file_lines_reader import LargeFileLinesReader
+from modalities_tpu.utils.jsonpath import compile_pattern
+from modalities_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+class EmptySampleError(RuntimeError):
+    pass
+
+
+class EmbeddedStreamData:
+    DATA_SECTION_LENGTH_IN_BYTES = 8
+    TOKEN_SIZE_DESCRIPTOR_LENGTH_IN_BYTES = 4
+    HEADER_SIZE_IN_BYTES = DATA_SECTION_LENGTH_IN_BYTES + TOKEN_SIZE_DESCRIPTOR_LENGTH_IN_BYTES
+
+    def __init__(self, data_path: Path, load_index: bool = True):
+        self._data_path = Path(data_path)
+        if not self._data_path.is_file():
+            raise FileNotFoundError(
+                f"Packed data was not found at {self._data_path.absolute()}. "
+                f"Create one with `modalities-tpu data pack_encoded_data`."
+            )
+        with self._data_path.open("rb") as f:
+            self.data_len = int.from_bytes(f.read(self.DATA_SECTION_LENGTH_IN_BYTES), byteorder="little")
+            f.seek(self.DATA_SECTION_LENGTH_IN_BYTES)
+            self.token_size_in_bytes = int.from_bytes(
+                f.read(self.TOKEN_SIZE_DESCRIPTOR_LENGTH_IN_BYTES), byteorder="little", signed=False
+            )
+            if load_index:
+                f.seek(self.HEADER_SIZE_IN_BYTES + self.data_len)
+                self._index_base: Optional[list[tuple[int, int]]] = pickle.loads(f.read())
+            else:
+                self._index_base = None
+        self._data = np.memmap(self._data_path, mode="r", offset=self.HEADER_SIZE_IN_BYTES, shape=(self.data_len,))
+
+    @property
+    def index_base(self) -> list[tuple[int, int]]:
+        if self._index_base is None:
+            raise ValueError("Index was not loaded. Set `load_index=True` during initialization.")
+        return self._index_base
+
+    @property
+    def data(self) -> np.ndarray:
+        return self._data
+
+
+def token_size_in_bytes_for_vocab(vocab_size: int) -> int:
+    """1/2/4-byte token encoding chosen by vocab size (reference :77-98)."""
+    num_bytes = math.ceil(math.log2(vocab_size) / 8)
+    if num_bytes == 1:
+        return 1
+    if num_bytes == 2:
+        return 2
+    if num_bytes <= 4:
+        return 4
+    raise ValueError("Currently only support token byte sizes of 1, 2, and 4.")
+
+
+def _np_dtype_for_token_size(token_size_in_bytes: int) -> np.dtype:
+    return {
+        1: np.dtype(np.uint8).newbyteorder("<"),
+        2: np.dtype(np.uint16).newbyteorder("<"),
+        4: np.dtype(np.uint32).newbyteorder("<"),
+    }[token_size_in_bytes]
+
+
+def write_pbin_file(
+    dst_path: Path,
+    token_arrays: Iterator[np.ndarray],
+    token_size_in_bytes: int,
+) -> int:
+    """Write a pbin from an iterator of per-document token-id arrays. Returns doc count.
+
+    Used by the shuffle/chunk/filter tools (reference: tokenized_file_writer.py:13).
+    """
+    dst_path = Path(dst_path)
+    dtype = _np_dtype_for_token_size(token_size_in_bytes)
+    index: list[tuple[int, int]] = []
+    with dst_path.open("wb") as f:
+        f.write((0).to_bytes(EmbeddedStreamData.DATA_SECTION_LENGTH_IN_BYTES, byteorder="little"))
+        f.write(token_size_in_bytes.to_bytes(EmbeddedStreamData.TOKEN_SIZE_DESCRIPTOR_LENGTH_IN_BYTES, "little"))
+        offset = 0
+        for arr in token_arrays:
+            data = np.asarray(arr).astype(dtype).tobytes()
+            f.write(data)
+            index.append((offset, len(data)))
+            offset += len(data)
+        f.write(pickle.dumps(index))
+    _backfill_data_section_length(dst_path, index)
+    return len(index)
+
+
+def _backfill_data_section_length(dst_path: Path, index_list: list[tuple[int, int]]) -> None:
+    if index_list:
+        length = index_list[-1][0] + index_list[-1][1]
+    else:
+        length = 0
+        logger.warning("No data was written to %s (empty input or all samples filtered).", dst_path)
+    with Path(dst_path).open("rb+") as f:
+        f.seek(0)
+        f.write(length.to_bytes(EmbeddedStreamData.DATA_SECTION_LENGTH_IN_BYTES, byteorder="little"))
+
+
+class PackedDataGenerator:
+    """Multiprocessing tokenize-and-pack pipeline (reference: create_packed_data.py:27).
+
+    Topology: reader process -> N tokenizer worker processes -> in-order writer, all
+    connected via bounded mp queues. Output documents each end with the EOD token.
+    """
+
+    def __init__(
+        self,
+        src_path: Path,
+        tokenizer,
+        eod_token: str,
+        number_of_processes: int,
+        jq_pattern: str,
+        processing_batch_size: int,
+        raw_samples_queue_size: int,
+        processed_samples_queue_size: int,
+        index_path: Optional[Path] = None,
+    ):
+        self.src_path = Path(src_path)
+        self.tokenizer = tokenizer
+        self.eod_token = eod_token
+        self._token_size_in_bytes = token_size_in_bytes_for_vocab(tokenizer.vocab_size)
+        eod_token_id = tokenizer.get_token_id(eod_token)
+        self._encoded_eod_token_as_bytes = self._token_to_bytes(eod_token_id)
+        self._extract = compile_pattern(jq_pattern)
+        self._number_of_processes = max(1, number_of_processes)
+        self._reader = LargeFileLinesReader(self.src_path, index_path=index_path)
+        self.processing_batch_size = processing_batch_size
+        self._raw_samples_queue: multiprocessing.Queue = multiprocessing.Queue(maxsize=raw_samples_queue_size)
+        self._processed_samples_queue: multiprocessing.Queue = multiprocessing.Queue(
+            maxsize=processed_samples_queue_size
+        )
+
+    def _token_to_bytes(self, token_id: int) -> bytes:
+        try:
+            return int(token_id).to_bytes(self._token_size_in_bytes, byteorder="little", signed=False)
+        except OverflowError as e:
+            raise ValueError(
+                f"Token {token_id} cannot be represented by {self._token_size_in_bytes} bytes."
+            ) from e
+
+    def _default_destination_path(self, destination_path: Optional[Path] = None) -> Path:
+        if destination_path is None:
+            return Path(self.src_path.parent, f"{self.src_path.stem}.pbin")
+        return Path(destination_path)
+
+    def _process_line(self, line: str) -> bytes:
+        text = self._extract(line)
+        if text is None:
+            raise ValueError("jq pattern did not match anything in the line")
+        tokens = self.tokenizer.tokenize(text)
+        if len(tokens) == 0:
+            raise EmptySampleError("Received empty sample...")
+        token_bytes = b"".join(map(self._token_to_bytes, tokens))
+        if not token_bytes.endswith(self._encoded_eod_token_as_bytes):
+            token_bytes += self._encoded_eod_token_as_bytes
+        return token_bytes
+
+    def _reader_proc(self) -> None:
+        batch = []
+        for line_id, line in enumerate(self._reader):
+            batch.append((line_id, line))
+            if len(batch) == self.processing_batch_size:
+                self._raw_samples_queue.put(batch)
+                batch = []
+        if batch:
+            self._raw_samples_queue.put(batch)
+        for _ in range(self._number_of_processes):
+            self._raw_samples_queue.put(None)
+
+    def _worker_proc(self) -> None:
+        while True:
+            batch = self._raw_samples_queue.get()
+            if batch is None:
+                self._processed_samples_queue.put(None)
+                return
+            processed = []
+            for line_id, line in batch:
+                try:
+                    processed.append((line_id, self._process_line(line)))
+                except EmptySampleError:
+                    warnings.warn(f"Encountered empty sample in line {line_id} of file {self.src_path}")
+                    processed.append((line_id, b""))
+                except Exception as e:
+                    warnings.warn(f"Could not process line {line_id} in {self.src_path}: {e!r}")
+                    processed.append((line_id, b""))
+            self._processed_samples_queue.put(processed)
+
+    def run(self, dst_path: Optional[Path] = None) -> Path:
+        dst_path = self._default_destination_path(dst_path)
+        if dst_path.exists():
+            raise ValueError(f"Destination path {dst_path} already exists.")
+        dst_path.parent.mkdir(parents=True, exist_ok=True)
+
+        reader = multiprocessing.Process(target=self._reader_proc, daemon=True)
+        workers = [
+            multiprocessing.Process(target=self._worker_proc, daemon=True)
+            for _ in range(self._number_of_processes)
+        ]
+        reader.start()
+        for w in workers:
+            w.start()
+
+        index_list: list[tuple[int, int]] = []
+        try:
+            with dst_path.open("wb") as f:
+                f.write((0).to_bytes(EmbeddedStreamData.DATA_SECTION_LENGTH_IN_BYTES, byteorder="little"))
+                f.write(
+                    self._token_size_in_bytes.to_bytes(
+                        EmbeddedStreamData.TOKEN_SIZE_DESCRIPTOR_LENGTH_IN_BYTES, byteorder="little"
+                    )
+                )
+                # in-order write: buffer out-of-order batches until their turn
+                curr_offset = 0
+                prev_line_id = -1
+                pending: dict[int, bytes] = {}
+                finished_workers = 0
+                num_lines = len(self._reader)
+                while finished_workers < self._number_of_processes:
+                    batch = self._processed_samples_queue.get()
+                    if batch is None:
+                        finished_workers += 1
+                        continue
+                    for line_id, token_bytes in batch:
+                        pending[line_id] = token_bytes
+                    while prev_line_id + 1 in pending:
+                        token_bytes = pending.pop(prev_line_id + 1)
+                        if token_bytes:
+                            f.write(token_bytes)
+                            index_list.append((curr_offset, len(token_bytes)))
+                            curr_offset += len(token_bytes)
+                        prev_line_id += 1
+                if prev_line_id + 1 != num_lines:
+                    warnings.warn(f"Only wrote {prev_line_id + 1} of {num_lines} lines")
+                f.write(pickle.dumps(index_list))
+        finally:
+            reader.join(timeout=5)
+            for w in workers:
+                w.join(timeout=5)
+        _backfill_data_section_length(dst_path, index_list)
+        return dst_path
+
+
+def join_embedded_stream_data(
+    stream_data: list[EmbeddedStreamData], target_file: Path, chunk_size: int = 2048
+) -> None:
+    """Merge multiple pbin files into one (reference: create_packed_data.py:409)."""
+    target_file = Path(target_file)
+    if target_file.exists():
+        raise FileExistsError(f'Target File at "{target_file}" exists!')
+    token_sizes = {d.token_size_in_bytes for d in stream_data}
+    if len(token_sizes) != 1:
+        raise ValueError(
+            "Found different token representation sizes. This could indicate the usage of "
+            "different tokenizers. Not supported!"
+        )
+    data_len = sum(d.data_len for d in stream_data)
+    with target_file.open("wb") as fout:
+        fout.write(data_len.to_bytes(EmbeddedStreamData.DATA_SECTION_LENGTH_IN_BYTES, byteorder="little"))
+        fout.write(
+            stream_data[0].token_size_in_bytes.to_bytes(
+                EmbeddedStreamData.TOKEN_SIZE_DESCRIPTOR_LENGTH_IN_BYTES, byteorder="little"
+            )
+        )
+        for d in stream_data:
+            for i in range(0, d.data_len, chunk_size):
+                fout.write(d.data[i : i + chunk_size])
+        joint_index: list[tuple[int, int]] = []
+        curr_offset = 0
+        for d in stream_data:
+            for entry_offset, segment_length in d.index_base:
+                joint_index.append((entry_offset + curr_offset, segment_length))
+            curr_offset += d.data_len
+        fout.write(pickle.dumps(joint_index))

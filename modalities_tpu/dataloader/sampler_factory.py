@@ -1,0 +1,69 @@
+"""Sampler factory wiring the device mesh's data-parallel split into the sampler
+(reference: src/modalities/dataloader/sampler_factory.py:29-52).
+
+On TPU the replica count/rank comes from the per-host data-loading split
+(`get_data_loading_info`) rather than a torch process-group rank: every host feeds
+exactly the batch rows its addressable devices own; tp/pp/cp ranks inside one dp
+group automatically read identical data because the dp block is the only partitioner
+of the batch dimension.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from modalities_tpu.dataloader.samplers import BatchSampler, ResumableDistributedSampler
+from modalities_tpu.running_env.device_mesh import DeviceMeshHandle, get_data_loading_info
+
+
+class SamplerFactory:
+    @staticmethod
+    def create_resumable_distributed_multi_dim_sampler(
+        dataset,
+        device_mesh: DeviceMeshHandle,
+        data_parallel_key: str = "dp_shard",
+        epoch: int = 0,
+        shuffle: bool = False,
+        seed: int = 0,
+        drop_last: bool = True,
+        skip_num_global_samples: int = 0,
+    ) -> ResumableDistributedSampler:
+        num_replicas, rank = get_data_loading_info(device_mesh)
+        return ResumableDistributedSampler(
+            dataset=dataset,
+            rank=rank,
+            num_replicas=num_replicas,
+            epoch=epoch,
+            shuffle=shuffle,
+            seed=seed,
+            drop_last=drop_last,
+            skip_num_global_samples=skip_num_global_samples,
+        )
+
+    @staticmethod
+    def create_resumable_sampler(
+        dataset,
+        rank: int,
+        num_replicas: int,
+        epoch: int = 0,
+        shuffle: bool = False,
+        seed: int = 0,
+        drop_last: bool = False,
+        skip_num_global_samples: int = 0,
+    ) -> ResumableDistributedSampler:
+        return ResumableDistributedSampler(
+            dataset=dataset,
+            rank=rank,
+            num_replicas=num_replicas,
+            epoch=epoch,
+            shuffle=shuffle,
+            seed=seed,
+            drop_last=drop_last,
+            skip_num_global_samples=skip_num_global_samples,
+        )
+
+
+class BatchSamplerFactory:
+    @staticmethod
+    def create_batch_sampler(sampler, batch_size: int, drop_last: bool = True) -> BatchSampler:
+        return BatchSampler(sampler=sampler, batch_size=batch_size, drop_last=drop_last)

@@ -1,0 +1,151 @@
+"""Distributed, resumable sampling (reference: src/modalities/dataloader/samplers.py:11).
+
+On TPU the "rank" here is a *data-parallel group index* derived from the device mesh
+(dp_replicate x dp_shard coordinates), not a process rank: every process feeds the
+global batch for its addressable devices and GSPMD handles placement. TP/PP/CP ranks
+within one dp group read identical data (reference: sampler_factory.py:29-52).
+
+Shuffling is epoch-seeded and deterministic (numpy PCG64) so a warmstart reproduces
+the exact stream; ``skip_num_global_samples`` implements the fast-skip resume.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Iterator, Optional
+
+import numpy as np
+
+
+class SamplerIF:
+    """Iterable over dataset indices for one data-parallel rank."""
+
+    def __iter__(self) -> Iterator[int]:  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def __len__(self) -> int:  # pragma: no cover - abstract
+        raise NotImplementedError
+
+
+class ResumableDistributedSampler(SamplerIF):
+    def __init__(
+        self,
+        dataset,
+        rank: int,
+        num_replicas: Optional[int] = None,
+        epoch: int = 0,
+        shuffle: bool = False,
+        seed: int = 0,
+        drop_last: bool = False,
+        skip_num_global_samples: int = 0,
+    ) -> None:
+        if num_replicas is None:
+            num_replicas = 1
+        if rank >= num_replicas or rank < 0:
+            raise ValueError(f"Invalid rank {rank}, rank should be in the interval [0, {num_replicas - 1}]")
+        self.dataset = dataset
+        self.rank = rank
+        self.num_replicas = num_replicas
+        self.epoch = epoch
+        self.drop_last = drop_last
+        self.skip_num_global_samples = skip_num_global_samples
+
+        self.global_num_samples = len(self.dataset) - self.skip_num_global_samples
+        if self.drop_last and self.global_num_samples % self.num_replicas != 0:
+            self.local_num_samples = math.ceil((self.global_num_samples - self.num_replicas) / self.num_replicas)
+        else:
+            self.local_num_samples = math.ceil(self.global_num_samples / self.num_replicas)
+        self.global_num_samples_effective = self.local_num_samples * self.num_replicas
+        self.shuffle = shuffle
+        self.seed = seed
+
+    def __iter__(self) -> Iterator[int]:
+        if self.shuffle:
+            rng = np.random.Generator(np.random.PCG64(self.seed + self.epoch))
+            indices_full = rng.permutation(len(self.dataset)).tolist()
+        else:
+            indices_full = list(range(len(self.dataset)))
+
+        indices = indices_full[self.skip_num_global_samples :]
+
+        if not self.drop_last:
+            padding_size = self.global_num_samples_effective - len(indices)
+            if padding_size <= len(indices_full):
+                indices += indices_full[:padding_size]
+            else:
+                indices += (indices_full * math.ceil(padding_size / len(indices_full)))[:padding_size]
+        else:
+            indices = indices[: self.global_num_samples_effective]
+
+        if len(indices) != self.global_num_samples_effective:
+            raise ValueError(
+                f"global_num_samples_effective ({self.global_num_samples_effective}) does not match the "
+                f"actual number of samples ({len(indices)})"
+            )
+
+        indices = indices[self.rank : self.global_num_samples_effective : self.num_replicas]
+        if len(indices) != self.local_num_samples:
+            raise ValueError(
+                f"local_num_samples ({self.local_num_samples}) does not match the actual "
+                f"number of samples ({len(indices)})"
+            )
+        return iter(indices)
+
+    def __len__(self) -> int:
+        return self.local_num_samples
+
+
+class SequentialSampler(SamplerIF):
+    def __init__(self, dataset):
+        self.dataset = dataset
+
+    def __iter__(self) -> Iterator[int]:
+        return iter(range(len(self.dataset)))
+
+    def __len__(self) -> int:
+        return len(self.dataset)
+
+
+class RandomSampler(SamplerIF):
+    def __init__(self, dataset, seed: int = 0):
+        self.dataset = dataset
+        self.seed = seed
+
+    def __iter__(self) -> Iterator[int]:
+        rng = np.random.Generator(np.random.PCG64(self.seed))
+        return iter(rng.permutation(len(self.dataset)).tolist())
+
+    def __len__(self) -> int:
+        return len(self.dataset)
+
+
+class BatchSamplerIF:
+    def __iter__(self) -> Iterator[list[int]]:  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def __len__(self) -> int:  # pragma: no cover - abstract
+        raise NotImplementedError
+
+
+class BatchSampler(BatchSamplerIF):
+    """Groups sampler indices into micro-batches (torch.utils.data.BatchSampler semantics)."""
+
+    def __init__(self, sampler: SamplerIF, batch_size: int, drop_last: bool = False):
+        self.sampler = sampler
+        self.batch_size = batch_size
+        self.drop_last = drop_last
+
+    def __iter__(self) -> Iterator[list[int]]:
+        batch: list[int] = []
+        for idx in self.sampler:
+            batch.append(idx)
+            if len(batch) == self.batch_size:
+                yield batch
+                batch = []
+        if batch and not self.drop_last:
+            yield batch
+
+    def __len__(self) -> int:
+        if self.drop_last:
+            return len(self.sampler) // self.batch_size
+        return math.ceil(len(self.sampler) / self.batch_size)

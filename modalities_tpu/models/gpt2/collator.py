@@ -1,0 +1,21 @@
+"""CLM collator: inputs = tokens[:-1], targets = tokens[1:]
+(reference: src/modalities/models/gpt2/collator.py:7-36)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from modalities_tpu.batch import DatasetBatch
+from modalities_tpu.dataloader.collate_fns.collate_if import CollateFnIF
+
+
+class GPT2LLMCollateFn(CollateFnIF):
+    def __init__(self, sample_key: str, target_key: str):
+        self.sample_key = sample_key
+        self.target_key = target_key
+
+    def __call__(self, batch: list[dict]) -> DatasetBatch:
+        sample_array = np.stack([np.asarray(d[self.sample_key]) for d in batch])
+        samples = {self.sample_key: sample_array[:, :-1]}
+        targets = {self.target_key: sample_array[:, 1:]}
+        return DatasetBatch(targets=targets, samples=samples)

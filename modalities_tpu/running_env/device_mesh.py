@@ -1,0 +1,239 @@
+"""5-D named device mesh over TPU chips (reference: src/modalities/running_env/fsdp/device_mesh.py).
+
+The reference builds a torch DeviceMesh consumed by FSDP2/DTensor/pipelining wrappers.
+Here the mesh is a ``jax.sharding.Mesh`` and parallelism is expressed *declaratively*:
+parameters/activations carry ``PartitionSpec``s over the named axes and XLA's GSPMD
+partitioner inserts the collectives (all_gather/reduce_scatter ride ICI; dp_replicate
+is the DCN-crossing axis for multi-slice HSDP — reference model_factory.py:205-211).
+
+Axis order is [pp, dp_replicate, dp_shard, cp, tp] (reference device_mesh.py:118-140);
+an axis is materialized only if its degree > 1, except dp_shard which always exists.
+"""
+
+from __future__ import annotations
+
+from enum import Enum
+from typing import Annotated, Optional
+
+import numpy as np
+from pydantic import BaseModel, Field, model_validator
+
+from modalities_tpu.exceptions import ConfigError
+from modalities_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+class ParallelismDegrees(Enum):
+    DP_REPLICATE = "dp_replicate"
+    DP_SHARD = "dp_shard"
+    CP = "cp"
+    TP = "tp"
+    PP = "pp"
+
+
+# canonical mesh-axis order; outer axes change slowest across the device grid so that
+# dp_replicate maps onto DCN (across slices) and inner axes (cp/tp) onto ICI neighbors
+CANONICAL_AXIS_ORDER = (
+    ParallelismDegrees.PP.value,
+    ParallelismDegrees.DP_REPLICATE.value,
+    ParallelismDegrees.DP_SHARD.value,
+    ParallelismDegrees.CP.value,
+    ParallelismDegrees.TP.value,
+)
+
+
+class DeviceMeshConfig(BaseModel):
+    """Validates parallelism degrees; -1 auto-infers dp_shard or dp_replicate from the
+    world size (reference: device_mesh.py:48-78)."""
+
+    device_type: str = "tpu"
+    data_parallel_replicate_degree: Annotated[int, Field(strict=True, ge=-1)] = 1
+    data_parallel_shard_degree: Annotated[int, Field(strict=True, ge=-1)]
+    tensor_parallel_degree: Annotated[int, Field(strict=True, gt=0)] = 1
+    pipeline_parallel_degree: Annotated[int, Field(strict=True, gt=0)] = 1
+    context_parallel_degree: Annotated[int, Field(strict=True, gt=0)] = 1
+    enable_loss_parallel: Optional[bool] = False
+    world_size: Annotated[int, Field(strict=True, gt=0)]
+
+    @model_validator(mode="after")
+    def _validate(self):
+        if not (self.data_parallel_shard_degree == -1 or self.data_parallel_shard_degree >= 1):
+            raise ConfigError("data_parallel_shard_degree must be -1 or >= 1")
+        if not (self.data_parallel_replicate_degree == -1 or self.data_parallel_replicate_degree >= 1):
+            raise ConfigError("data_parallel_replicate_degree must be -1 or >= 1")
+        if self.data_parallel_replicate_degree == -1 and self.data_parallel_shard_degree == -1:
+            raise ConfigError(
+                "At most one of data_parallel_replicate_degree and data_parallel_shard_degree can be -1"
+            )
+        other = self.context_parallel_degree * self.tensor_parallel_degree * self.pipeline_parallel_degree
+        if self.data_parallel_shard_degree == -1:
+            self.data_parallel_shard_degree = self.world_size // (self.data_parallel_replicate_degree * other)
+        if self.data_parallel_replicate_degree == -1:
+            self.data_parallel_replicate_degree = self.world_size // (self.data_parallel_shard_degree * other)
+        if (
+            self.data_parallel_shard_degree
+            * self.data_parallel_replicate_degree
+            * other
+            != self.world_size
+        ):
+            raise ConfigError(
+                f"Invalid parallel dims: data_parallel_shard_degree({self.data_parallel_shard_degree}) * "
+                f"data_parallel_replicate_degree({self.data_parallel_replicate_degree}) * "
+                f"tensor_parallel_degree({self.tensor_parallel_degree}) * "
+                f"pipeline_parallel_degree({self.pipeline_parallel_degree}) * "
+                f"context_parallel_degree({self.context_parallel_degree}) != WORLD_SIZE({self.world_size})"
+            )
+        if self.enable_loss_parallel and self.tensor_parallel_degree <= 1:
+            raise ConfigError(f"enable_loss_parallel={self.enable_loss_parallel} requires tensor_parallel_degree > 1")
+        return self
+
+
+class DeviceMeshHandle:
+    """A jax Mesh plus the full degree table (including non-materialized size-1 axes)."""
+
+    def __init__(self, mesh, degrees: dict[str, int], enable_loss_parallel: bool = False):
+        self.mesh = mesh
+        self.degrees = degrees
+        self.enable_loss_parallel = enable_loss_parallel
+
+    @property
+    def axis_names(self) -> tuple[str, ...]:
+        return tuple(self.mesh.axis_names)
+
+    def get_parallel_degree(self, method: ParallelismDegrees | str) -> int:
+        key = method.value if isinstance(method, ParallelismDegrees) else method
+        return self.degrees.get(key, 1)
+
+    def has_parallelism_method(self, method: ParallelismDegrees | str) -> bool:
+        key = method.value if isinstance(method, ParallelismDegrees) else method
+        return key in self.axis_names and self.degrees.get(key, 1) >= 1
+
+    @property
+    def dp_degree(self) -> int:
+        return self.degrees["dp_replicate"] * self.degrees["dp_shard"]
+
+    @property
+    def dp_axis_names(self) -> tuple[str, ...]:
+        """The mesh axes the batch dimension is sharded over."""
+        return tuple(n for n in ("dp_replicate", "dp_shard") if n in self.axis_names)
+
+    def __repr__(self) -> str:
+        return f"DeviceMeshHandle(axes={dict(zip(self.axis_names, self.mesh.shape.values()))}, degrees={self.degrees})"
+
+
+def get_device_mesh(
+    device_type: str = "tpu",
+    data_parallel_replicate_degree: int = 1,
+    data_parallel_shard_degree: int = -1,
+    tensor_parallel_degree: int = 1,
+    pipeline_parallel_degree: int = 1,
+    context_parallel_degree: int = 1,
+    enable_loss_parallel: bool = False,
+    world_size: Optional[int] = None,
+    devices=None,
+) -> DeviceMeshHandle:
+    """Build the named mesh (reference: device_mesh.py:92-215 -> jax.sharding.Mesh).
+
+    `devices` overrides the device list (testing with virtual CPU devices).
+    """
+    import jax
+
+    if devices is None:
+        devices = jax.devices()
+    if world_size is None:
+        world_size = len(devices)
+    cfg = DeviceMeshConfig(
+        device_type=device_type,
+        data_parallel_replicate_degree=data_parallel_replicate_degree,
+        data_parallel_shard_degree=data_parallel_shard_degree,
+        tensor_parallel_degree=tensor_parallel_degree,
+        pipeline_parallel_degree=pipeline_parallel_degree,
+        context_parallel_degree=context_parallel_degree,
+        enable_loss_parallel=enable_loss_parallel,
+        world_size=world_size,
+    )
+    if world_size != len(devices):
+        raise ConfigError(f"world_size ({world_size}) != number of devices ({len(devices)})")
+
+    degrees = {
+        "pp": cfg.pipeline_parallel_degree,
+        "dp_replicate": cfg.data_parallel_replicate_degree,
+        "dp_shard": cfg.data_parallel_shard_degree,
+        "cp": cfg.context_parallel_degree,
+        "tp": cfg.tensor_parallel_degree,
+    }
+    dims, names = [], []
+    for name in CANONICAL_AXIS_ORDER:
+        if degrees[name] > 1 or name == ParallelismDegrees.DP_SHARD.value:
+            dims.append(degrees[name])
+            names.append(name)
+    device_grid = np.asarray(devices).reshape(dims)
+    mesh = jax.sharding.Mesh(device_grid, tuple(names))
+    logger.info("device mesh: %s | world_size=%d | loss_parallel=%s", dict(zip(names, dims)), world_size, enable_loss_parallel)
+    return DeviceMeshHandle(mesh, degrees, enable_loss_parallel=cfg.enable_loss_parallel)
+
+
+def get_parallel_degree(mesh_handle: DeviceMeshHandle, method: ParallelismDegrees | str) -> int:
+    return mesh_handle.get_parallel_degree(method)
+
+
+def get_parallel_rank(mesh_handle: DeviceMeshHandle, method: ParallelismDegrees | str) -> int:
+    """Coordinate of *this process's first addressable device* along the given axis.
+
+    Under single-controller GSPMD there is no per-process rank in the torch sense; the
+    data layer uses this to decide which slice of the global batch this host feeds
+    (reference sampler_factory.py:29-52 uses the torch mesh rank the same way).
+    """
+    key = method.value if isinstance(method, ParallelismDegrees) else method
+    mesh = mesh_handle.mesh
+    if key not in mesh.axis_names:
+        return 0
+    import jax
+
+    local = jax.local_devices()[0]
+    coords = np.argwhere(mesh.devices == local)
+    if len(coords) == 0:  # process owns no mesh device (should not happen)
+        return 0
+    return int(coords[0][list(mesh.axis_names).index(key)])
+
+
+def get_data_loading_info(mesh_handle: DeviceMeshHandle) -> tuple[int, int]:
+    """(num_loading_ranks, this_process_loading_rank) for the data-parallel batch split.
+
+    Each process must feed the batch rows its addressable devices own under the batch
+    sharding P((dp_replicate, dp_shard)). The dp coordinates owned by one process form
+    a contiguous equal-size block for canonical mesh layouts; we compute the block
+    directly from device coordinates and verify contiguity.
+    """
+    import jax
+
+    mesh = mesh_handle.mesh
+    axis_names = list(mesh.axis_names)
+    dp_axes = [n for n in ("dp_replicate", "dp_shard") if n in axis_names]
+    if not dp_axes:
+        return 1, 0
+    dp_sizes = [mesh.shape[n] for n in dp_axes]
+    dp_total = int(np.prod(dp_sizes))
+
+    local_devices = set(jax.local_devices())
+    owned: set[int] = set()
+    for coord in np.ndindex(*mesh.devices.shape):
+        if mesh.devices[coord] in local_devices:
+            dp_coord = [coord[axis_names.index(n)] for n in dp_axes]
+            flat = 0
+            for c, s in zip(dp_coord, dp_sizes):
+                flat = flat * s + c
+            owned.add(flat)
+    if not owned:
+        return 1, 0
+    lo, hi = min(owned), max(owned)
+    if owned != set(range(lo, hi + 1)):
+        raise ConfigError(
+            "Non-contiguous data-parallel ownership for this process; this mesh layout is "
+            "not supported by the per-host data loader. Reorder mesh axes so dp is outermost."
+        )
+    block = hi - lo + 1
+    if dp_total % block != 0:
+        raise ConfigError("Uneven data-parallel ownership across processes.")
+    return dp_total // block, lo // block

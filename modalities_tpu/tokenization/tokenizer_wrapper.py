@@ -1,0 +1,128 @@
+"""Tokenizer wrappers (reference: src/modalities/tokenization/tokenizer_wrapper.py:9-285).
+
+Tokenization is host-side and TPU-agnostic — the HF (Rust) backend is used as-is.
+sentencepiece is not in the TPU image, so the SP wrapper degrades to a clear import
+error only when actually instantiated.
+"""
+
+from __future__ import annotations
+
+import warnings
+from abc import ABC, abstractmethod
+from typing import Optional
+
+
+class TokenizerWrapper(ABC):
+    @abstractmethod
+    def tokenize(self, text: str) -> list[int]: ...
+
+    @abstractmethod
+    def decode(self, input_ids: list[int]) -> str: ...
+
+    @property
+    @abstractmethod
+    def vocab_size(self) -> int: ...
+
+    @abstractmethod
+    def get_token_id(self, token: str) -> int: ...
+
+    def is_special_token_id(self, token_id: int) -> bool:
+        raise NotImplementedError
+
+
+class PreTrainedHFTokenizer(TokenizerWrapper):
+    """AutoTokenizer wrapper with padding/truncation/max_length and special-token ids."""
+
+    def __init__(
+        self,
+        pretrained_model_name_or_path: str,
+        truncation: Optional[bool] = False,
+        padding: Optional[bool | str] = False,
+        max_length: Optional[int] = None,
+        special_tokens: Optional[dict[str, str]] = None,
+    ) -> None:
+        from transformers import AutoTokenizer
+
+        self.tokenizer = AutoTokenizer.from_pretrained(pretrained_model_name_or_path=pretrained_model_name_or_path)
+        if special_tokens is not None:
+            old_vocab_size = len(self.tokenizer.get_vocab())
+            self.tokenizer.add_special_tokens(
+                special_tokens_dict=special_tokens,
+                replace_additional_special_tokens=False,
+            )
+            if len(self.tokenizer.get_vocab()) > old_vocab_size:
+                raise NotImplementedError(
+                    "Currently only tokens already known to the tokenizer's vocabulary can be added, "
+                    "as resizing the embedding matrix is not yet supported! "
+                    f"Before: {old_vocab_size}, after: {len(self.tokenizer.get_vocab())}"
+                )
+        self.max_length = max_length
+        self.truncation = truncation
+        self.padding = padding
+        self.special_token_ids = set(self.tokenizer.all_special_ids)
+
+    @property
+    def vocab_size(self) -> int:
+        return self.tokenizer.vocab_size
+
+    @property
+    def special_tokens(self) -> dict[str, str | list[str]]:
+        return self.tokenizer.special_tokens_map
+
+    def tokenize(self, text: str) -> list[int]:
+        return self.tokenizer(
+            text,
+            max_length=self.max_length,
+            padding=self.padding,
+            truncation=self.truncation,
+        )["input_ids"]
+
+    def decode(self, token_ids: list[int]) -> str:
+        return self.tokenizer.decode(token_ids)
+
+    def get_token_id(self, token: str) -> int:
+        token_id = self.tokenizer.convert_tokens_to_ids(token)
+        if token_id is None or not isinstance(token_id, int):
+            raise ValueError("Token is not represented by a single token id!")
+        if token_id == self.tokenizer.unk_token_id:
+            warnings.warn(f"The provided token {token} has the same token id ({token_id}) as the unk token")
+        return token_id
+
+    def is_special_token_id(self, token_id: int) -> bool:
+        return token_id in self.special_token_ids
+
+
+class PreTrainedSPTokenizer(TokenizerWrapper):
+    """SentencePiece wrapper; requires the optional `sentencepiece` package."""
+
+    def __init__(self, tokenizer_model_file: str):
+        try:
+            import sentencepiece as spm
+        except ImportError as e:  # pragma: no cover - environment dependent
+            raise ImportError(
+                "sentencepiece is not installed in this environment. "
+                "Install it or use tokenizer.pretrained_hf_tokenizer."
+            ) from e
+        self.tokenizer = spm.SentencePieceProcessor()
+        self.tokenizer.Load(tokenizer_model_file)
+
+    def tokenize(self, text: str) -> list[int]:
+        return self.tokenizer.Encode(text)
+
+    def decode(self, token_ids: list[int]) -> str:
+        return self.tokenizer.Decode(token_ids)
+
+    @property
+    def vocab_size(self) -> int:
+        return self.tokenizer.vocab_size()
+
+    def get_token_id(self, token: str) -> int:
+        piece_id = self.tokenizer.PieceToId(token)
+        if not isinstance(piece_id, int):
+            raise ValueError("Token cannot be represented by a single token ID!")
+        if piece_id == self.tokenizer.unk_id():
+            raise ValueError("Token cannot be represented by a single token id!")
+        return piece_id
+
+    def is_special_token_id(self, token_id: int) -> bool:
+        return self.tokenizer.IsControl(token_id)

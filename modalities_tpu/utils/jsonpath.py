@@ -1,0 +1,58 @@
+"""Minimal jq-pattern subset for JSONL field extraction.
+
+The reference depends on the C `jq` bindings for patterns like ``.text`` or
+``.meta.content`` (reference: create_packed_data.py:68, dataset.py:161). jq is not in
+the TPU image; the patterns actually used by configs/tutorials are simple dot-paths
+with optional array indices, which this native implementation covers:
+
+    .text          ->  obj["text"]
+    .meta.content  ->  obj["meta"]["content"]
+    .choices[0].t  ->  obj["choices"][0]["t"]
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from typing import Any, Callable
+
+_TOKEN_RE = re.compile(r"\.([A-Za-z_][A-Za-z0-9_-]*)|\[(\d+)\]|\[\"([^\"]+)\"\]")
+
+
+class JQPatternError(ValueError):
+    pass
+
+
+def compile_pattern(pattern: str) -> Callable[[str], Any]:
+    """Compile a jq-style dot-path into an extractor over a JSON line."""
+    pattern = pattern.strip()
+    if pattern == ".":
+        steps: list[Any] = []
+    else:
+        steps = []
+        pos = 0
+        while pos < len(pattern):
+            m = _TOKEN_RE.match(pattern, pos)
+            if not m:
+                raise JQPatternError(
+                    f"Unsupported jq pattern {pattern!r} (supported: dot-paths like '.a.b[0].c')"
+                )
+            key, idx, quoted = m.groups()
+            if key is not None:
+                steps.append(key)
+            elif idx is not None:
+                steps.append(int(idx))
+            else:
+                steps.append(quoted)
+            pos = m.end()
+
+    def extract(line: str) -> Any:
+        obj = json.loads(line)
+        for step in steps:
+            try:
+                obj = obj[step]
+            except (KeyError, IndexError, TypeError):
+                return None
+        return obj
+
+    return extract

@@ -1,0 +1,67 @@
+import numpy as np
+import pytest
+
+from modalities_tpu.batch import DatasetBatch
+from modalities_tpu.dataloader.collate_fns.collate_if import CollateFnIF
+from modalities_tpu.dataloader.collate_fns.collator_fn_wrapper_for_loss_masking import (
+    LossMaskingCollateFnWrapper,
+    LossMaskingTokenConfig,
+)
+
+
+class _PassThroughCollate(CollateFnIF):
+    def __call__(self, batch):
+        arr = np.stack([np.asarray(d["x"]) for d in batch])
+        return DatasetBatch(samples={"x": arr[:, :-1]}, targets={"y": arr[:, 1:]})
+
+
+class _Tok:
+    vocab_size = 10
+
+    def get_token_id(self, token):
+        return {"<b>": 3, "<e>": 4}[token]
+
+
+def _make(target_keys=("y",)):
+    return LossMaskingCollateFnWrapper(
+        wrapped_collate_fn=_PassThroughCollate(),
+        target_keys_to_mask=list(target_keys),
+        loss_ignore_index=-100,
+        mask_tokens=LossMaskingTokenConfig(b_include_to_loss_token="<b>", e_include_to_loss_token="<e>"),
+        tokenizer=_Tok(),
+    )
+
+
+def test_masks_outside_span():
+    # reference docstring example: tokens between <b>(3) and <e>(4), both exclusive, kept
+    batch = [{"x": [2, 2, 3, 2, 2, 4, 2, 2, 2]}]
+    out = _make()([{"x": batch[0]["x"]}])
+    # target = [2,3,2,2,4,2,2,2]; kept positions are the two 2s between 3 and 4 (incl. span logic)
+    assert out.targets["y"].tolist() == [[-100, -100, 2, 2, -100, -100, -100, -100]]
+
+
+def test_missing_begin_token_skips_sample():
+    out = _make()([{"x": [2, 2, 2, 2, 4, 2]}])
+    assert (out.targets["y"] == -100).all()
+
+
+def test_same_mask_tokens_raises():
+    class TokSame:
+        vocab_size = 10
+
+        def get_token_id(self, token):
+            return 3
+
+    with pytest.raises(ValueError, match="must be different"):
+        LossMaskingCollateFnWrapper(
+            wrapped_collate_fn=_PassThroughCollate(),
+            target_keys_to_mask=["y"],
+            loss_ignore_index=-100,
+            mask_tokens=LossMaskingTokenConfig(b_include_to_loss_token="<b>", e_include_to_loss_token="<e>"),
+            tokenizer=TokSame(),
+        )
+
+
+def test_unbalanced_end_before_begin_raises():
+    with pytest.raises(ValueError, match="end mask token indicator is before"):
+        _make()([{"x": [2, 4, 2, 3, 2, 2]}])

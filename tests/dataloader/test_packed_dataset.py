@@ -1,0 +1,240 @@
+"""Data-layer tests mirroring the reference's tests/dataloader suite: pbin byte-format
+round-trips, continuous/megatron packing arithmetic, samplers, collators."""
+
+import pickle
+
+import numpy as np
+import pytest
+
+from modalities_tpu.batch import DatasetBatch
+from modalities_tpu.dataloader.create_index import IndexGenerator
+from modalities_tpu.dataloader.dataloader import LLMDataLoader
+from modalities_tpu.dataloader.dataset import (
+    CombinedDataset,
+    PackedMemMapDatasetContinuous,
+    PackedMemMapDatasetMegatron,
+)
+from modalities_tpu.dataloader.large_file_lines_reader import LargeFileLinesReader
+from modalities_tpu.dataloader.packed_data import (
+    EmbeddedStreamData,
+    PackedDataGenerator,
+    join_embedded_stream_data,
+    write_pbin_file,
+)
+from modalities_tpu.dataloader.samplers import BatchSampler, ResumableDistributedSampler
+from modalities_tpu.models.gpt2.collator import GPT2LLMCollateFn
+
+
+def make_pbin(path, docs, token_size=2):
+    """Hand-build a pbin file (reference conftest.py:33-47 builds synthetic bytes)."""
+    write_pbin_file(path, (np.asarray(d) for d in docs), token_size)
+    return path
+
+
+def test_pbin_byte_layout(tmp_path):
+    p = tmp_path / "d.pbin"
+    make_pbin(p, [[1, 2, 3], [4, 5]], token_size=2)
+    raw = p.read_bytes()
+    data_len = int.from_bytes(raw[:8], "little")
+    token_size = int.from_bytes(raw[8:12], "little")
+    assert data_len == 10  # 5 tokens * 2 bytes
+    assert token_size == 2
+    data = np.frombuffer(raw[12 : 12 + data_len], dtype="<u2")
+    assert data.tolist() == [1, 2, 3, 4, 5]
+    index = pickle.loads(raw[12 + data_len :])
+    assert index == [(0, 6), (6, 4)]
+
+
+def test_embedded_stream_data_roundtrip(tmp_path):
+    p = make_pbin(tmp_path / "d.pbin", [[10, 20, 30], [40, 50]], token_size=4)
+    esd = EmbeddedStreamData(p)
+    assert esd.token_size_in_bytes == 4
+    assert esd.data_len == 20
+    assert esd.index_base == [(0, 12), (12, 8)]
+
+
+def test_base_dataset_getitem(tmp_path):
+    from modalities_tpu.dataloader.dataset import PackedMemMapDatasetBase
+
+    p = make_pbin(tmp_path / "d.pbin", [[10, 20, 30], [40, 50]], token_size=2)
+    ds = PackedMemMapDatasetBase(p, sample_key="input_ids")
+    assert len(ds) == 2
+    assert ds[0]["input_ids"].tolist() == [10, 20, 30]
+    assert ds[1]["input_ids"].tolist() == [40, 50]
+    sliced = ds[0:2]["input_ids"]
+    assert [d.tolist() for d in sliced] == [[10, 20, 30], [40, 50]]
+
+
+@pytest.mark.parametrize("reuse_last_target", [True, False])
+def test_continuous_packing(tmp_path, reuse_last_target):
+    tokens = list(range(100))
+    p = make_pbin(tmp_path / "d.pbin", [tokens], token_size=2)
+    block_size = 10
+    ds = PackedMemMapDatasetContinuous(
+        p, sample_key="x", block_size=block_size, reuse_last_target=reuse_last_target
+    )
+    if reuse_last_target:
+        # windows overlap by 1: starts at 0, 9, 18, ...
+        assert len(ds) == (100 - block_size) // (block_size - 1) + 1
+        assert ds[0]["x"].tolist() == list(range(0, 10))
+        assert ds[1]["x"].tolist() == list(range(9, 19))
+    else:
+        assert len(ds) == 10
+        assert ds[0]["x"].tolist() == list(range(0, 10))
+        assert ds[1]["x"].tolist() == list(range(10, 20))
+
+
+def test_continuous_block_size_too_large_raises(tmp_path):
+    p = make_pbin(tmp_path / "d.pbin", [[1, 2, 3]], token_size=2)
+    with pytest.raises(ValueError, match="Block size"):
+        PackedMemMapDatasetContinuous(p, sample_key="x", block_size=10, reuse_last_target=True)
+
+
+def test_megatron_packing_no_mid_doc_starts(tmp_path):
+    docs = [[1] * 4, [2] * 4, [3] * 10, [4] * 2]
+    p = make_pbin(tmp_path / "d.pbin", docs, token_size=2)
+    ds = PackedMemMapDatasetMegatron(p, sample_key="x", block_size=8)
+    samples = [ds[i]["x"].tolist() for i in range(len(ds))]
+    # first block: doc0+doc1 exactly fill 8 tokens; big doc3 split at block boundary
+    assert samples[0] == [1] * 4 + [2] * 4
+    assert samples[1] == [3] * 8
+
+
+def test_join_embedded_stream_data(tmp_path):
+    p1 = make_pbin(tmp_path / "a.pbin", [[1, 2], [3]], token_size=2)
+    p2 = make_pbin(tmp_path / "b.pbin", [[4, 5, 6]], token_size=2)
+    target = tmp_path / "joined.pbin"
+    join_embedded_stream_data([EmbeddedStreamData(p1), EmbeddedStreamData(p2)], target)
+    joined = EmbeddedStreamData(target)
+    assert joined.data_len == 12
+    from modalities_tpu.dataloader.dataset import PackedMemMapDatasetBase
+
+    ds = PackedMemMapDatasetBase(target, sample_key="x")
+    assert [ds[i]["x"].tolist() for i in range(3)] == [[1, 2], [3], [4, 5, 6]]
+
+
+def test_join_mixed_token_sizes_raises(tmp_path):
+    p1 = make_pbin(tmp_path / "a.pbin", [[1]], token_size=2)
+    p2 = make_pbin(tmp_path / "b.pbin", [[1]], token_size=4)
+    with pytest.raises(ValueError, match="token representation sizes"):
+        join_embedded_stream_data(
+            [EmbeddedStreamData(p1), EmbeddedStreamData(p2)], tmp_path / "j.pbin"
+        )
+
+
+def test_index_generator_and_reader(tmp_path):
+    src = tmp_path / "data.jsonl"
+    lines = ['{"text": "hello world"}', '{"text": "goodbye"}', '{"text": "unicode äöü"}']
+    src.write_text("\n".join(lines) + "\n", encoding="utf-8")
+    idx_path = tmp_path / "data.idx"
+    IndexGenerator(src).create_index(idx_path)
+    reader = LargeFileLinesReader(src, idx_path)
+    assert len(reader) == 3
+    assert reader[0] == lines[0]
+    assert reader[2] == lines[2]
+    assert list(reader) == lines
+
+
+class _FakeTokenizer:
+    vocab_size = 300  # -> 2-byte tokens
+
+    def tokenize(self, text):
+        return [ord(c) % 250 for c in text]
+
+    def get_token_id(self, token):
+        assert token == "<eod>"
+        return 255
+
+    def decode(self, ids):
+        return "".join(chr(i) for i in ids)
+
+
+def test_packed_data_generator_end_to_end(tmp_path):
+    src = tmp_path / "data.jsonl"
+    texts = ["hello world", "packing pipeline", "third document here"]
+    src.write_text("\n".join('{"text": "%s"}' % t for t in texts) + "\n")
+    IndexGenerator(src).create_index(tmp_path / "data.idx")
+    tokenizer = _FakeTokenizer()
+    gen = PackedDataGenerator(
+        src_path=src,
+        tokenizer=tokenizer,
+        eod_token="<eod>",
+        number_of_processes=2,
+        jq_pattern=".text",
+        processing_batch_size=1,
+        raw_samples_queue_size=4,
+        processed_samples_queue_size=4,
+    )
+    out = gen.run(tmp_path / "data.pbin")
+    from modalities_tpu.dataloader.dataset import PackedMemMapDatasetBase
+
+    ds = PackedMemMapDatasetBase(out, sample_key="x")
+    assert len(ds) == 3
+    for i, t in enumerate(texts):
+        expected = [ord(c) % 250 for c in t] + [255]  # EOD appended
+        assert ds[i]["x"].tolist() == expected
+
+
+def test_resumable_sampler_skip_and_distribution():
+    dataset = list(range(20))
+    s0 = ResumableDistributedSampler(dataset, rank=0, num_replicas=2, drop_last=True)
+    s1 = ResumableDistributedSampler(dataset, rank=1, num_replicas=2, drop_last=True)
+    i0, i1 = list(s0), list(s1)
+    assert sorted(i0 + i1) == dataset
+    assert i0 == list(range(0, 20, 2))
+    # skip: resume after 10 global samples
+    s0r = ResumableDistributedSampler(dataset, rank=0, num_replicas=2, drop_last=True, skip_num_global_samples=10)
+    assert list(s0r) == list(range(10, 20, 2))
+    assert len(s0r) == 5
+
+
+def test_resumable_sampler_shuffle_deterministic():
+    dataset = list(range(100))
+    a = list(ResumableDistributedSampler(dataset, rank=0, num_replicas=4, shuffle=True, seed=7, epoch=3))
+    b = list(ResumableDistributedSampler(dataset, rank=0, num_replicas=4, shuffle=True, seed=7, epoch=3))
+    c = list(ResumableDistributedSampler(dataset, rank=0, num_replicas=4, shuffle=True, seed=7, epoch=4))
+    assert a == b
+    assert a != c
+
+
+def test_resumable_sampler_full_skip_consistency():
+    """Skipping k samples yields the same remaining stream as consuming k (warmstart oracle)."""
+    dataset = list(range(64))
+    full = list(ResumableDistributedSampler(dataset, rank=1, num_replicas=2, shuffle=True, seed=3, drop_last=True))
+    resumed = list(
+        ResumableDistributedSampler(
+            dataset, rank=1, num_replicas=2, shuffle=True, seed=3, drop_last=True, skip_num_global_samples=32
+        )
+    )
+    assert full[16:] == resumed
+
+
+def test_gpt2_collator_and_dataloader(tmp_path):
+    tokens = list(range(100))
+    p = make_pbin(tmp_path / "d.pbin", [tokens], token_size=2)
+    ds = PackedMemMapDatasetContinuous(p, sample_key="input_ids", block_size=11, reuse_last_target=True)
+    sampler = ResumableDistributedSampler(ds, rank=0, num_replicas=1)
+    loader = LLMDataLoader(
+        dataloader_tag="train",
+        dataset=ds,
+        batch_sampler=BatchSampler(sampler, batch_size=2, drop_last=True),
+        collate_fn=GPT2LLMCollateFn(sample_key="input_ids", target_key="target_ids"),
+    )
+    batches = list(loader)
+    assert len(batches) == len(loader)
+    b = batches[0]
+    assert isinstance(b, DatasetBatch)
+    assert b.samples["input_ids"].shape == (2, 10)
+    assert b.targets["target_ids"].shape == (2, 10)
+    # CLM shift: target is input shifted by one
+    np.testing.assert_array_equal(b.samples["input_ids"][0][1:], b.targets["target_ids"][0][:-1])
+
+
+def test_combined_dataset(tmp_path):
+    p1 = make_pbin(tmp_path / "a.pbin", [[1, 2], [3, 4]], token_size=2)
+    p2 = make_pbin(tmp_path / "b.pbin", [[5, 6]], token_size=2)
+    from modalities_tpu.dataloader.dataset import PackedMemMapDatasetBase
+
+    combined = CombinedDataset([PackedMemMapDatasetBase(p1, "x"), PackedMemMapDatasetBase(p2, "x")])
+    assert len(combined) == 3
+    assert combined[2]["x"].tolist() == [5, 6]
