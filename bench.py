@@ -1,0 +1,157 @@
+"""Benchmark: GPT pretraining throughput on the available TPU chip.
+
+Prints ONE JSON line: {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}.
+
+Metric: model FLOPs utilization (MFU) of a GPT2 train step (fwd+bwd+optimizer, bf16
+compute) at the largest model that fits the chip. vs_baseline compares against the
+reference's strongest published MFU, 0.6867 (6.7B on 8xA100, reference README.md:339;
+see BASELINE.md) — the number to beat on TPU.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def peak_flops_per_chip() -> float:
+    """bf16 peak FLOP/s by TPU generation (BASELINE.md: v5p 459e12)."""
+    import jax
+
+    kind = jax.devices()[0].device_kind.lower()
+    table = {
+        "v6e": 918e12,
+        "v6": 918e12,
+        "v5p": 459e12,
+        "v5e": 197e12,  # TPU v5 lite
+        "v5 lite": 197e12,
+        "v4": 275e12,
+        "cpu": 1e12,  # nominal, CI only
+    }
+    for key, val in table.items():
+        if key in kind:
+            return val
+    return 197e12
+
+
+def main() -> None:
+    import jax
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform == "tpu"
+
+    from modalities_tpu.loss_functions import CLMCrossEntropyLoss
+    from modalities_tpu.models.gpt2.gpt2_model import AttentionConfig, GPT2LLM
+    from modalities_tpu.optimizers.optimizer_factory import OptimizerFactory
+    from modalities_tpu.running_env.device_mesh import get_device_mesh
+    from modalities_tpu.training.train_step import TrainStepBuilder
+
+    # single-chip benchmark config (160M-class GPT so it fits v5e comfortably)
+    if on_tpu:
+        n_layer, n_embd, n_head, seq, mb = 12, 768, 12, 2048, 8
+    else:
+        n_layer, n_embd, n_head, seq, mb = 2, 256, 4, 256, 4
+    vocab = 50304
+
+    model = GPT2LLM(
+        sample_key="input_ids",
+        prediction_key="logits",
+        poe_type="NOPE",
+        sequence_length=seq,
+        vocab_size=vocab,
+        n_layer=n_layer,
+        n_head_q=n_head,
+        n_head_kv=n_head,
+        n_embd=n_embd,
+        ffn_hidden=4 * n_embd,
+        dropout=0.0,
+        bias=False,
+        attention_config=AttentionConfig(
+            qkv_transforms=[
+                {
+                    "type_hint": "RotaryTransform",
+                    "config": {"n_embd": n_embd, "n_head": n_head, "base_freq": 10000},
+                }
+            ]
+        ),
+        attention_implementation="dao_flash" if on_tpu else "pytorch_flash",
+        activation_type="swiglu",
+        attention_norm_config={"norm_type": "rms_norm", "config": {"ndim": n_embd, "bias": False}},
+        ffn_norm_config={"norm_type": "rms_norm", "config": {"ndim": n_embd, "bias": False}},
+        lm_head_norm_config={"norm_type": "rms_norm", "config": {"ndim": n_embd, "bias": False}},
+        use_weight_tying=True,
+        seed=0,
+    )
+    mesh = get_device_mesh(
+        device_type=dev.platform, data_parallel_shard_degree=1, world_size=1, devices=jax.devices()[:1]
+    )
+    opt = OptimizerFactory.get_adam_w(
+        lr=3e-4,
+        betas=(0.9, 0.95),
+        eps=1e-8,
+        weight_decay=0.1,
+        weight_decay_groups_excluded=["norm", "embedding"],
+        wrapped_model=model,
+    )
+    fns = TrainStepBuilder(
+        model=model,
+        loss_fn=CLMCrossEntropyLoss(target_key="target_ids", prediction_key="logits"),
+        optimizer_spec=opt,
+        mesh_handle=mesh,
+        gradient_acc_steps=1,
+        grad_clip_norm=1.0,
+    ).build(seed=0)
+
+    rng = np.random.default_rng(0)
+    tokens = rng.integers(0, vocab, size=(1, mb, seq + 1))
+    batch = fns.put_batch(
+        {
+            "samples": {"input_ids": tokens[:, :, :-1].astype(np.int32)},
+            "targets": {"target_ids": tokens[:, :, 1:].astype(np.int32)},
+        }
+    )
+    state = fns.app_state_handle.state
+
+    # warmup/compile
+    state, metrics = fns.train_step(state, batch)
+    jax.block_until_ready(metrics["loss"])
+
+    iters = 20 if on_tpu else 3
+    start = time.perf_counter()
+    for _ in range(iters):
+        state, metrics = fns.train_step(state, batch)
+    jax.block_until_ready(metrics["loss"])
+    elapsed = time.perf_counter() - start
+
+    tokens_per_step = mb * seq
+    tokens_per_sec = tokens_per_step * iters / elapsed
+
+    n_params = sum(int(np.prod(x.shape)) for x in jax.tree.leaves(state.params))
+    # train FLOPs/token ~ 6N + 12*L*s*h (reference mfu.py:178-180 formula)
+    flops_per_token = 6 * n_params + 12 * n_layer * seq * n_embd
+    mfu = tokens_per_sec * flops_per_token / peak_flops_per_chip()
+
+    baseline_mfu = 0.6867  # reference best (6.7B, 8xA100, README.md:339)
+    print(
+        json.dumps(
+            {
+                "metric": "gpt_train_mfu_single_chip",
+                "value": round(mfu, 4),
+                "unit": "MFU (fraction of bf16 peak)",
+                "vs_baseline": round(mfu / baseline_mfu, 4),
+                "detail": {
+                    "tokens_per_sec": round(tokens_per_sec, 1),
+                    "params": n_params,
+                    "device": dev.device_kind,
+                    "seq": seq,
+                    "micro_batch": mb,
+                },
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

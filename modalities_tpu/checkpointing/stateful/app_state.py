@@ -1,0 +1,48 @@
+"""AppState: the (params, opt_state, step) triple that is trained and checkpointed
+(reference: src/modalities/checkpointing/stateful/app_state.py:27).
+
+The reference wraps torch (model, optimizer, lr_scheduler) with Stateful
+state_dict/load_state_dict plumbing. In JAX the whole training state *is* a pytree,
+so AppState is a flax struct: checkpointing serializes it directly (Orbax), and the
+jitted train step consumes/donates it. The lr schedule is a pure function of `step`,
+so no scheduler state needs saving beyond the step counter.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+from flax import struct
+
+
+class AppState(struct.PyTreeNode):
+    params: Any
+    opt_state: Any
+    step: jax.Array  # int32 scalar, number of optimizer steps done
+
+    @property
+    def step_count(self) -> int:
+        return int(self.step)
+
+
+class AppStateHandle:
+    """Host-side companion of AppState: binds the pytree to its shardings and the
+    optimizer/schedule that produced it (needed for resume and for the trainer)."""
+
+    def __init__(self, state: AppState, state_shardings: AppState, tx, lr_fn, model):
+        self.state = state
+        self.state_shardings = state_shardings
+        self.tx = tx
+        self.lr_fn = lr_fn
+        self.model = model
+        self._loaded = False
+
+    def mark_loaded(self) -> None:
+        if self._loaded:
+            raise RuntimeError("AppState was already loaded from checkpoint; refusing double-load.")
+        self._loaded = True
+
+    @property
+    def is_loaded(self) -> bool:
+        return self._loaded

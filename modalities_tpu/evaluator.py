@@ -1,0 +1,57 @@
+"""Evaluation over N dataloaders (reference: src/modalities/evaluator.py:88)."""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from modalities_tpu.batch import EvaluationResultBatch, ResultItem
+from modalities_tpu.logging_broker.messages import ExperimentStatus, MessageTypes, ProgressUpdate
+from modalities_tpu.logging_broker.publisher import MessagePublisher
+from modalities_tpu.training.train_step import StepFunctions
+
+
+class Evaluator:
+    def __init__(
+        self,
+        progress_publisher: MessagePublisher,
+        evaluation_result_publisher: MessagePublisher,
+    ) -> None:
+        self.progress_publisher = progress_publisher
+        self.evaluation_result_publisher = evaluation_result_publisher
+
+    def evaluate(
+        self,
+        step_functions: StepFunctions,
+        data_loaders: list,
+        num_train_steps_done: int,
+    ) -> dict[str, EvaluationResultBatch]:
+        result_dict: dict[str, EvaluationResultBatch] = {}
+        state = step_functions.app_state_handle.state
+        for data_loader in data_loaders:
+            start = time.perf_counter()
+            losses = []
+            num_samples = 0
+            for batch_id, batch in enumerate(data_loader):
+                device_batch = step_functions.put_batch(
+                    {"samples": batch.samples, "targets": batch.targets}
+                )
+                metrics = step_functions.eval_step(state, device_batch)
+                losses.append(metrics["loss"])
+                num_samples += len(batch)
+                self.progress_publisher.publish_message(
+                    ProgressUpdate(batch_id + 1, ExperimentStatus.EVALUATION, data_loader.dataloader_tag),
+                    MessageTypes.BATCH_PROGRESS_UPDATE,
+                )
+            elapsed = max(time.perf_counter() - start, 1e-9)
+            losses_np = np.asarray([np.asarray(loss) for loss in losses], dtype=np.float64)
+            result = EvaluationResultBatch(
+                dataloader_tag=data_loader.dataloader_tag,
+                num_train_steps_done=num_train_steps_done,
+                losses={"loss avg": ResultItem(losses_np.mean() if len(losses_np) else np.nan, 5)},
+                throughput_metrics={"eval samples/s": ResultItem(num_samples / elapsed, 2)},
+            )
+            self.evaluation_result_publisher.publish_message(result, MessageTypes.EVALUATION_RESULT)
+            result_dict[data_loader.dataloader_tag] = result
+        return result_dict

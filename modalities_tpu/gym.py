@@ -1,0 +1,61 @@
+"""Gym: composes Trainer + Evaluator + checkpoint callbacks (reference: src/modalities/gym.py:35)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from modalities_tpu.evaluator import Evaluator
+from modalities_tpu.trainer import Trainer
+from modalities_tpu.training.train_step import StepFunctions
+from modalities_tpu.training.training_progress import TrainingProgress
+
+
+class Gym:
+    def __init__(self, trainer: Trainer, evaluator: Evaluator, loss_fun=None) -> None:
+        self.trainer = trainer
+        self.evaluator = evaluator
+        self.loss_fun = loss_fun
+
+    def run(
+        self,
+        step_functions: StepFunctions,
+        train_data_loader,
+        evaluation_data_loaders: list,
+        checkpoint_saving=None,
+        training_progress: Optional[TrainingProgress] = None,
+        evaluation_interval_in_steps: int = 0,
+        checkpointing_interval_in_steps: int = 0,
+    ) -> None:
+        if training_progress is None:
+            training_progress = TrainingProgress(0, 0, len(train_data_loader), 0)
+
+        def evaluation_callback(num_train_steps_done: int) -> None:
+            if (
+                evaluation_interval_in_steps > 0
+                and num_train_steps_done % evaluation_interval_in_steps == 0
+                and evaluation_data_loaders
+            ):
+                self.evaluator.evaluate(
+                    step_functions=step_functions,
+                    data_loaders=evaluation_data_loaders,
+                    num_train_steps_done=num_train_steps_done,
+                )
+
+        def checkpointing_callback(progress: TrainingProgress) -> None:
+            if (
+                checkpoint_saving is not None
+                and checkpointing_interval_in_steps > 0
+                and progress.num_seen_steps_total % checkpointing_interval_in_steps == 0
+            ):
+                checkpoint_saving.save_checkpoint(
+                    training_progress=progress,
+                    app_state_handle=step_functions.app_state_handle,
+                )
+
+        self.trainer.train(
+            step_functions=step_functions,
+            train_loader=train_data_loader,
+            training_progress=training_progress,
+            evaluation_callback=evaluation_callback,
+            checkpointing_callback=checkpointing_callback,
+        )

@@ -1,0 +1,28 @@
+"""In-process pub/sub broker (reference: src/modalities/logging_broker/message_broker.py:20)."""
+
+from __future__ import annotations
+
+from collections import defaultdict
+
+from modalities_tpu.logging_broker.messages import Message, MessageTypes
+from modalities_tpu.logging_broker.subscriber import MessageSubscriberIF
+
+
+class MessageBrokerIF:
+    def add_subscriber(self, subscription: MessageTypes, subscriber: MessageSubscriberIF) -> None:
+        raise NotImplementedError
+
+    def distribute_message(self, message: Message) -> None:
+        raise NotImplementedError
+
+
+class MessageBroker(MessageBrokerIF):
+    def __init__(self) -> None:
+        self.subscriptions: dict[MessageTypes, list[MessageSubscriberIF]] = defaultdict(list)
+
+    def add_subscriber(self, subscription: MessageTypes, subscriber: MessageSubscriberIF) -> None:
+        self.subscriptions[subscription].append(subscriber)
+
+    def distribute_message(self, message: Message) -> None:
+        for subscriber in self.subscriptions[message.message_type]:
+            subscriber.consume_message(message)

@@ -1,0 +1,37 @@
+"""Message types for the pub/sub broker (reference: src/modalities/logging_broker/messages.py:6)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from enum import Enum
+from typing import Generic, TypeVar
+
+T = TypeVar("T")
+
+
+class MessageTypes(Enum):
+    BATCH_PROGRESS_UPDATE = "BATCH_PROGRESS_UPDATE"
+    EVALUATION_RESULT = "EVALUATION_RESULT"
+    ERROR_MESSAGE = "ERROR_MESSAGE"
+
+
+@dataclass
+class Message(Generic[T]):
+    message_type: MessageTypes
+    payload: T
+    global_rank: int = 0
+    local_rank: int = 0
+
+
+class ExperimentStatus(Enum):
+    TRAIN = "TRAIN"
+    EVALUATION = "EVALUATION"
+
+
+@dataclass
+class ProgressUpdate:
+    """Training/eval progress of one step (reference messages.py BatchProgressUpdate)."""
+
+    num_steps_done: int
+    experiment_status: ExperimentStatus
+    dataloader_tag: str

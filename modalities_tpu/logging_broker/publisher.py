@@ -1,0 +1,27 @@
+"""Message publisher (reference: src/modalities/logging_broker/publisher.py)."""
+
+from __future__ import annotations
+
+from typing import Generic, TypeVar
+
+from modalities_tpu.logging_broker.message_broker import MessageBrokerIF
+from modalities_tpu.logging_broker.messages import Message, MessageTypes
+
+T = TypeVar("T")
+
+
+class MessagePublisher(Generic[T]):
+    def __init__(self, message_broker: MessageBrokerIF, global_rank: int = 0, local_rank: int = 0):
+        self.message_broker = message_broker
+        self.global_rank = global_rank
+        self.local_rank = local_rank
+
+    def publish_message(self, payload: T, message_type: MessageTypes) -> None:
+        self.message_broker.distribute_message(
+            Message(
+                message_type=message_type,
+                payload=payload,
+                global_rank=self.global_rank,
+                local_rank=self.local_rank,
+            )
+        )

@@ -1,0 +1,18 @@
+"""Subscriber interface (reference: src/modalities/logging_broker/subscriber.py)."""
+
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+from typing import Generic, TypeVar
+
+from modalities_tpu.logging_broker.messages import Message
+
+T = TypeVar("T")
+
+
+class MessageSubscriberIF(ABC, Generic[T]):
+    @abstractmethod
+    def consume_message(self, message: Message[T]) -> None: ...
+
+    def consume_dict(self, message_dict: dict) -> None:
+        pass

@@ -1,0 +1,49 @@
+"""Progress subscribers (reference: logging_broker/subscriber_impl/progress_subscriber.py:21)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from modalities_tpu.logging_broker.messages import Message, ProgressUpdate
+from modalities_tpu.logging_broker.subscriber import MessageSubscriberIF
+
+
+class DummyProgressSubscriber(MessageSubscriberIF[ProgressUpdate]):
+    def consume_message(self, message: Message[ProgressUpdate]) -> None:
+        pass
+
+
+class RichProgressSubscriber(MessageSubscriberIF[ProgressUpdate]):
+    """Live progress bars keyed by dataloader tag."""
+
+    def __init__(
+        self,
+        train_split_num_steps: Optional[dict[str, tuple[int, int]]] = None,
+        eval_splits_num_steps: Optional[dict[str, int]] = None,
+    ):
+        from rich.progress import BarColumn, MofNCompleteColumn, Progress, TextColumn, TimeRemainingColumn
+
+        self._progress = Progress(
+            TextColumn("[progress.description]{task.description}"),
+            BarColumn(),
+            MofNCompleteColumn(),
+            TimeRemainingColumn(),
+            auto_refresh=False,
+        )
+        self._task_ids: dict[str, int] = {}
+        for tag, (total, completed) in (train_split_num_steps or {}).items():
+            self._task_ids[tag] = self._progress.add_task(f"[cyan]{tag}", total=total, completed=completed)
+        for tag, total in (eval_splits_num_steps or {}).items():
+            self._task_ids[tag] = self._progress.add_task(f"[magenta]{tag}", total=total)
+        self._started = False
+
+    def consume_message(self, message: Message[ProgressUpdate]) -> None:
+        if not self._started:
+            self._progress.start()
+            self._started = True
+        update = message.payload
+        tag = update.dataloader_tag
+        if tag not in self._task_ids:
+            self._task_ids[tag] = self._progress.add_task(f"[cyan]{tag}", total=None)
+        self._progress.update(self._task_ids[tag], completed=update.num_steps_done)
+        self._progress.refresh()

@@ -1,0 +1,109 @@
+"""Evaluation-result subscribers: rich console panel, jsonl-to-disc, wandb
+(reference: logging_broker/subscriber_impl/results_subscriber.py).
+
+The to-disc jsonl stream (`evaluation_results.jsonl`) is load-bearing: the benchmark
+sweep status checker counts its lines to classify runs (reference
+benchmarking_utils.py:110-150)."""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Optional
+
+from modalities_tpu.batch import EvaluationResultBatch
+from modalities_tpu.logging_broker.messages import Message
+from modalities_tpu.logging_broker.subscriber import MessageSubscriberIF
+
+
+class DummyResultSubscriber(MessageSubscriberIF[EvaluationResultBatch]):
+    def consume_message(self, message: Message[EvaluationResultBatch]) -> None:
+        pass
+
+
+class RichResultSubscriber(MessageSubscriberIF[EvaluationResultBatch]):
+    def __init__(self, num_ranks: int = 1, global_rank: int = 0):
+        self.num_ranks = num_ranks
+        self.global_rank = global_rank
+
+    def consume_message(self, message: Message[EvaluationResultBatch]) -> None:
+        if self.global_rank != 0:
+            return
+        from rich.console import Console
+        from rich.panel import Panel
+
+        result = message.payload
+        lines = []
+        for name, item in {**result.losses, **result.metrics, **result.throughput_metrics}.items():
+            lines.append(f"{name}: {item}")
+        Console().print(
+            Panel(
+                "\n".join(lines),
+                title=f"[{result.dataloader_tag}] step {result.num_train_steps_done}",
+            )
+        )
+
+
+class EvaluationResultToDiscSubscriber(MessageSubscriberIF[EvaluationResultBatch]):
+    def __init__(self, output_folder_path: Path):
+        self.output_folder_path = Path(output_folder_path)
+        self.output_folder_path.mkdir(parents=True, exist_ok=True)
+        self._out_file = self.output_folder_path / "evaluation_results.jsonl"
+
+    @staticmethod
+    def _serialize(result: EvaluationResultBatch) -> dict:
+        def items_to_float(d):
+            return {k: float(str(v)) for k, v in d.items()}
+
+        return {
+            "dataloader_tag": result.dataloader_tag,
+            "num_train_steps_done": result.num_train_steps_done,
+            "losses": items_to_float(result.losses),
+            "metrics": items_to_float(result.metrics),
+            "throughput_metrics": items_to_float(result.throughput_metrics),
+        }
+
+    def consume_message(self, message: Message[EvaluationResultBatch]) -> None:
+        with self._out_file.open("a") as f:
+            f.write(json.dumps(self._serialize(message.payload)) + "\n")
+
+
+class WandBEvaluationResultSubscriber(MessageSubscriberIF[EvaluationResultBatch]):
+    """wandb logger; degrades to a warning when wandb is not installed."""
+
+    def __init__(
+        self,
+        project: str,
+        experiment_id: str,
+        mode: str = "offline",
+        experiment_path: Optional[Path] = None,
+        config_file_path: Optional[Path] = None,
+    ):
+        try:
+            import wandb
+
+            self._wandb = wandb
+            self._run = wandb.init(
+                project=project, name=experiment_id, mode=mode.lower(), dir=experiment_path
+            )
+            if config_file_path is not None and Path(config_file_path).exists():
+                artifact = wandb.Artifact(name=f"config-{experiment_id}", type="config")
+                artifact.add_file(str(config_file_path))
+                self._run.log_artifact(artifact)
+        except ImportError:
+            from modalities_tpu.utils.logging import warn_rank_0
+
+            warn_rank_0("wandb is not installed; WandB subscriber is a no-op.")
+            self._wandb = None
+            self._run = None
+
+    def consume_message(self, message: Message[EvaluationResultBatch]) -> None:
+        if self._run is None:
+            return
+        result = message.payload
+        prefix = result.dataloader_tag
+        logs = {}
+        for group in (result.losses, result.metrics, result.throughput_metrics):
+            for name, item in group.items():
+                logs[f"{prefix}/{name}"] = float(str(item))
+        self._run.log(data=logs, step=result.num_train_steps_done)

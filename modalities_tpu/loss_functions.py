@@ -1,0 +1,83 @@
+"""Loss functions (reference: src/modalities/loss_functions.py:10-167).
+
+Losses are pure jax functions over an InferenceResultBatch-shaped dict pair; they run
+*inside* the jitted train step, so reduction across the mesh is a plain mean that
+GSPMD turns into the right collectives.
+"""
+
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+class Loss(ABC):
+    def __init__(self, tag: str = "loss"):
+        self._tag = tag
+
+    @property
+    def tag(self) -> str:
+        return self._tag
+
+    @abstractmethod
+    def __call__(self, predictions: dict, targets: dict):
+        """Compute the scalar loss from prediction/target dicts of jax arrays."""
+
+
+class CLMCrossEntropyLoss(Loss):
+    """Mean causal-LM cross entropy over non-ignored target positions
+    (reference: loss_functions.py:27-87)."""
+
+    def __init__(self, target_key: str, prediction_key: str, tag: str = "CLMCrossEntropyLoss",
+                 ignore_index: int = -100):
+        super().__init__(tag)
+        self.target_key = target_key
+        self.prediction_key = prediction_key
+        self.ignore_index = ignore_index
+
+    def __call__(self, predictions: dict, targets: dict):
+        logits = predictions[self.prediction_key]
+        labels = targets[self.target_key]
+        mask = (labels != self.ignore_index).astype(jnp.float32)
+        safe_labels = jnp.where(labels == self.ignore_index, 0, labels)
+        token_losses = optax.softmax_cross_entropy_with_integer_labels(
+            logits.astype(jnp.float32), safe_labels
+        )
+        total = (token_losses * mask).sum()
+        count = jnp.maximum(mask.sum(), 1.0)
+        return total / count
+
+
+class NCELoss(Loss):
+    """Symmetric InfoNCE contrastive loss for CoCa (reference: loss_functions.py:90-167)."""
+
+    def __init__(
+        self,
+        prediction_key1: str,
+        prediction_key2: str,
+        is_asymmetric: bool = True,
+        temperature: float = 1.0,
+        tag: str = "NCELoss",
+    ):
+        super().__init__(tag)
+        self.prediction_key1 = prediction_key1
+        self.prediction_key2 = prediction_key2
+        self.is_asymmetric = is_asymmetric
+        self.temperature = temperature
+
+    def __call__(self, predictions: dict, targets: dict):
+        e1 = predictions[self.prediction_key1].astype(jnp.float32)
+        e2 = predictions[self.prediction_key2].astype(jnp.float32)
+        e1 = e1 / jnp.maximum(jnp.linalg.norm(e1, axis=-1, keepdims=True), 1e-8)
+        e2 = e2 / jnp.maximum(jnp.linalg.norm(e2, axis=-1, keepdims=True), 1e-8)
+        sim = e1 @ e2.T / self.temperature
+        n = sim.shape[0]
+        labels = jnp.arange(n)
+        loss_12 = optax.softmax_cross_entropy_with_integer_labels(sim, labels).mean()
+        if self.is_asymmetric:
+            return loss_12
+        loss_21 = optax.softmax_cross_entropy_with_integer_labels(sim.T, labels).mean()
+        return 0.5 * (loss_12 + loss_21)

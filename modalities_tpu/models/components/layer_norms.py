@@ -1,0 +1,116 @@
+"""Layer norm variants and their configs (reference: src/modalities/models/components/layer_norms.py:9).
+
+All three reference variants (custom RMSNorm, nn.LayerNorm, nn.RMSNorm) map onto flax
+linen norms; the distinction kept is bias/epsilon handling so configs translate 1:1.
+"""
+
+from __future__ import annotations
+
+from enum import Enum
+from typing import Optional
+
+from pydantic import BaseModel, Field
+from typing_extensions import Annotated
+
+
+class LayerNorms(Enum):
+    rms_norm = "rms_norm"
+    layer_norm = "layer_norm"
+    pytorch_rms_norm = "pytorch_rms_norm"  # config-compat alias; identical on TPU
+
+
+class LayerNormConfig(BaseModel):
+    normalized_shape: Annotated[int, Field(strict=True, ge=1)]
+    eps: Annotated[float, Field(gt=0)] = 1e-5
+    elementwise_affine: bool = True
+    bias: bool = True
+
+
+class RMSLayerNormConfig(BaseModel):
+    ndim: Annotated[int, Field(strict=True, ge=1)]
+    epsilon: Annotated[float, Field(gt=0)] = 1e-6
+    bias: bool = True
+
+
+class PytorchRMSLayerNormConfig(BaseModel):
+    normalized_shape: Annotated[int, Field(strict=True, ge=1)]
+    eps: Annotated[float, Field(gt=0)] = 1e-6
+
+
+class LayerNormWrapperConfig(BaseModel):
+    norm_type: LayerNorms
+    config: dict
+
+
+class NormSpec(BaseModel):
+    """Resolved norm description consumed by linen modules (frozen => hashable, so it
+    can live inside the static GPT2ModelSpec)."""
+
+    model_config = {"frozen": True}
+
+    kind: LayerNorms
+    dim: int
+    eps: float
+    use_bias: bool
+    use_scale: bool = True
+
+    @staticmethod
+    def from_wrapper_config(wrapper: Optional[LayerNormWrapperConfig | dict], default_dim: int) -> "NormSpec":
+        if wrapper is None:
+            return NormSpec(kind=LayerNorms.rms_norm, dim=default_dim, eps=1e-6, use_bias=False)
+        if isinstance(wrapper, dict):
+            wrapper = LayerNormWrapperConfig(**wrapper)
+        cfg = wrapper.config
+        if wrapper.norm_type == LayerNorms.layer_norm:
+            parsed = LayerNormConfig(**cfg)
+            return NormSpec(
+                kind=wrapper.norm_type,
+                dim=parsed.normalized_shape,
+                eps=parsed.eps,
+                use_bias=parsed.bias and parsed.elementwise_affine,
+                use_scale=parsed.elementwise_affine,
+            )
+        if wrapper.norm_type == LayerNorms.rms_norm:
+            parsed = RMSLayerNormConfig(**cfg)
+            return NormSpec(kind=wrapper.norm_type, dim=parsed.ndim, eps=parsed.epsilon, use_bias=parsed.bias)
+        parsed = PytorchRMSLayerNormConfig(**cfg)
+        return NormSpec(kind=wrapper.norm_type, dim=parsed.normalized_shape, eps=parsed.eps, use_bias=False)
+
+
+def build_norm(spec: NormSpec, name: str, dtype=None):
+    """Instantiate the linen norm module for a NormSpec.
+
+    `dtype` is the *output/compute* dtype (internals always reduce in fp32); pass the
+    block compute dtype (bf16) to keep residual streams stable under lax.scan."""
+    import flax.linen as nn
+
+    if spec.kind == LayerNorms.layer_norm:
+        return nn.LayerNorm(
+            epsilon=spec.eps, use_bias=spec.use_bias, use_scale=spec.use_scale, name=name, dtype=dtype
+        )
+    if spec.use_bias:
+        return RMSNormWithBias(epsilon=spec.eps, name=name)
+    return nn.RMSNorm(epsilon=spec.eps, use_scale=spec.use_scale, name=name, dtype=dtype)
+
+
+try:  # define lazily-importable module class at module scope
+    import flax.linen as _nn
+    import jax.numpy as _jnp
+    from jax import lax as _lax
+
+    class RMSNormWithBias(_nn.Module):
+        """RMS norm with a learned bias (reference layer_norms.py:9 supports bias)."""
+
+        epsilon: float = 1e-6
+
+        @_nn.compact
+        def __call__(self, x):
+            dtype = x.dtype
+            x32 = x.astype(_jnp.float32)
+            scale = self.param("scale", _nn.initializers.ones, (x.shape[-1],))
+            bias = self.param("bias", _nn.initializers.zeros, (x.shape[-1],))
+            y = x32 * _lax.rsqrt((x32 * x32).mean(-1, keepdims=True) + self.epsilon)
+            return (y * scale + bias).astype(dtype)
+
+except ImportError:  # pragma: no cover
+    RMSNormWithBias = None

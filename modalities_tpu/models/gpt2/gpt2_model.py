@@ -1,0 +1,514 @@
+"""GPT2-family decoder LLM, TPU-first (reference: src/modalities/models/gpt2/gpt2_model.py).
+
+Capability parity with the reference model (:816): separate q/k/v projections with GQA
+(:447-461), RoPE or identity qkv transforms (:114-229), optional QK-norm (:487-502),
+three attention tiers (manual / fused SDPA / flash kernel, :595-658), GELU-MLP or
+SwiGLU blocks (:780-788), pre-norm residual blocks (:801-813), ABSOLUTE vs NOPE
+positions (:888-896), weight tying (:940-943), dict-in/dict-out forward keyed by
+sample/prediction keys (:973-1020).
+
+TPU-first design choices (not translations):
+- flax.linen with **logical partitioning axes** on every param; the 5-D mesh rules in
+  parallel/sharding.py map ("embed", "vocab", "heads", "mlp", ...) onto (dp_shard, tp)
+  so FSDP/TP/SP are sharding annotations, not wrapper modules.
+- ``nn.scan`` over stacked transformer blocks ("layers" axis): O(1) compile time in
+  depth, and the stacked params split naturally across pipeline stages.
+- attention tiers: manual einsum softmax (oracle), ``jax.nn.dot_product_attention``
+  (XLA-fused), and a Pallas flash kernel (ops/) as the dao_flash equivalent.
+- embeddings/logits kept fp32, block compute in bf16 (MXU-native), loss-side logits
+  fp32 for a stable softmax.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from enum import Enum
+from typing import Annotated, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from pydantic import BaseModel, Field, model_validator
+
+from modalities_tpu.models.components.layer_norms import (
+    LayerNormWrapperConfig,
+    NormSpec,
+    build_norm,
+)
+from modalities_tpu.models.model import NNModel
+
+
+def with_logical_constraint(x, axes):
+    """Sharding hint over logical axis names; resolved by parallel/sharding.py rules."""
+    from flax.linen import partitioning as nn_partitioning
+
+    return nn_partitioning.with_sharding_constraint(x, axes)
+
+
+class PositionTypes(str, Enum):
+    ABSOLUTE = "ABSOLUTE"
+    NOPE = "NOPE"
+
+
+class ActivationType(str, Enum):
+    GELU = "gelu"
+    SWIGLU = "swiglu"
+    FUSED_SWIGLU = "fused_swiglu"  # config-compat: XLA fuses SwiGLU on TPU anyway
+
+
+class AttentionImplementation(str, Enum):
+    MANUAL = "manual"
+    PYTORCH_FLASH = "pytorch_flash"  # config-compat alias for the XLA-fused SDPA tier
+    DAO_FLASH = "dao_flash"  # Pallas flash-attention kernel tier
+
+
+class QueryKeyValueTransformType(Enum):
+    IdentityTransform = "IdentityTransform"
+    RotaryTransform = "RotaryTransform"
+
+
+class AttentionConfig(BaseModel):
+    class QueryKeyValueTransformConfig(BaseModel):
+        class IdentityTransformConfig(BaseModel):
+            pass
+
+        class RotaryTransformConfig(BaseModel):
+            n_embd: Annotated[int, Field(strict=True, ge=0)]
+            n_head: Annotated[int, Field(strict=True, ge=0)]
+            seq_length_dim: Annotated[int, Field(strict=True)] = -2
+            base_freq: Annotated[int, Field(strict=True, ge=10000)] = 10000
+
+        type_hint: QueryKeyValueTransformType
+        config: RotaryTransformConfig | IdentityTransformConfig
+
+    qkv_transforms: list[QueryKeyValueTransformConfig] = []
+    qk_norm_config: Optional[LayerNormWrapperConfig] = None
+
+
+class GPT2LLMConfig(BaseModel):
+    """Config surface kept 1:1 with the reference (gpt2_model.py:320-408)."""
+
+    sample_key: str
+    prediction_key: str
+    use_meta_device: Optional[bool] = False  # no-op: JAX initializes abstractly by default
+    poe_type: PositionTypes
+    sequence_length: Annotated[int, Field(strict=True, ge=1)]
+    vocab_size: Annotated[int, Field(strict=True, ge=1)]
+    n_layer: Annotated[int, Field(strict=True, ge=1)]
+    n_head_q: Annotated[int, Field(strict=True, ge=1)]
+    n_head_kv: Annotated[int, Field(strict=True, ge=1)]
+    n_embd: Annotated[int, Field(strict=True, ge=1)]
+    ffn_hidden: Annotated[int, Field(strict=True, ge=1)]
+    dropout: Annotated[float, Field(ge=0.0)]
+    bias: bool
+    attention_config: AttentionConfig
+    attention_implementation: AttentionImplementation
+    activation_type: ActivationType
+    attention_norm_config: LayerNormWrapperConfig
+    ffn_norm_config: LayerNormWrapperConfig
+    lm_head_norm_config: LayerNormWrapperConfig
+    use_weight_tying: bool
+    seed: Optional[int] = None
+    enforce_swiglu_hidden_dim_multiple_of: int = 256
+
+    @model_validator(mode="after")
+    def check_divisibility(self) -> "GPT2LLMConfig":
+        if self.n_head_q % self.n_head_kv != 0:
+            raise ValueError("n_head_q must be divisible by n_head_kv")
+        return self
+
+    @model_validator(mode="after")
+    def validate_sizes(self) -> "GPT2LLMConfig":
+        for param, name in zip(
+            [self.ffn_hidden, self.vocab_size, self.n_embd], ["ffn_hidden", "vocab_size", "n_embd"]
+        ):
+            if param % 128 != 0:
+                # MXU tiles are 128-wide; unaligned dims waste systolic-array cycles
+                raise ValueError(f"{name} with value {param} should be divisible by 128 for efficient training.")
+        return self
+
+
+def swiglu_hidden_dim(ffn_hidden: int, multiple_of: int = 256) -> int:
+    """2/3 scale-down + round up to a TP-shardable multiple (reference model.py:116-141)."""
+    adjusted = int(2 * ffn_hidden / 3)
+    return ((adjusted + multiple_of - 1) // multiple_of) * multiple_of
+
+
+@dataclass(frozen=True)
+class GPT2ModelSpec:
+    """Static (hashable) hyperparameters consumed by the linen modules."""
+
+    vocab_size: int
+    sequence_length: int
+    n_layer: int
+    n_head_q: int
+    n_head_kv: int
+    n_embd: int
+    ffn_hidden: int
+    dropout: float
+    bias: bool
+    poe_type: str
+    activation: str
+    attention_impl: str
+    use_rope: bool
+    rope_base_freq: int
+    use_qk_norm: bool
+    use_weight_tying: bool
+    swiglu_hidden: int
+    attn_norm: NormSpec
+    ffn_norm: NormSpec
+    lm_head_norm: NormSpec
+    qk_norm: Optional[NormSpec]
+    scan_layers: bool = True
+    remat_variant: Optional[str] = None
+    remat_freq: int = 1
+    remat_save_list: tuple[str, ...] = ()
+
+    @property
+    def head_dim(self) -> int:
+        return self.n_embd // self.n_head_q
+
+    def __hash__(self):
+        return hash((self.vocab_size, self.n_layer, self.n_embd, self.n_head_q, self.n_head_kv, id(self)))
+
+
+def _rope_tables(head_dim: int, seq_len: int, base_freq: int, dtype=jnp.float32):
+    """cos/sin tables, rotate-half convention matching the reference RotaryTransform
+    (gpt2_model.py:114-229)."""
+    inv_freq = 1.0 / (base_freq ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    t = jnp.arange(seq_len, dtype=jnp.float32)
+    freqs = jnp.einsum("i,j->ij", t, inv_freq)
+    emb = jnp.concatenate([freqs, freqs], axis=-1)
+    return jnp.cos(emb).astype(dtype), jnp.sin(emb).astype(dtype)
+
+
+def _rotate_half(x):
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    return jnp.concatenate([-x2, x1], axis=-1)
+
+
+def apply_rope(x, cos, sin):
+    """x: [B, S, H, D]; cos/sin: [S, D]."""
+    cos = cos[None, :, None, :]
+    sin = sin[None, :, None, :]
+    return x * cos + _rotate_half(x) * sin
+
+
+def manual_attention(q, k, v):
+    """Oracle attention: einsum + fp32 softmax with causal mask.
+    q: [B,S,Hq,D], k/v: [B,S,Hkv,D]; GQA convention: q head h uses kv head h // group."""
+    b, s, hq, d = q.shape
+    hkv = k.shape[2]
+    group = hq // hkv
+    qg = q.reshape(b, s, hkv, group, d)
+    logits = jnp.einsum("bshgd,bthd->bhgst", qg, k).astype(jnp.float32) / math.sqrt(d)
+    causal = jnp.tril(jnp.ones((s, s), dtype=bool))
+    logits = jnp.where(causal[None, None, None, :, :], logits, jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
+    out = jnp.einsum("bhgst,bthd->bshgd", probs, v)
+    return out.reshape(b, s, hq, d)
+
+
+def sdpa_attention(q, k, v):
+    """XLA-fused scaled dot product attention with native GQA support."""
+    return jax.nn.dot_product_attention(q, k, v, is_causal=True)
+
+
+def flash_attention(q, k, v):
+    """Pallas flash-attention tier; falls back to SDPA off-TPU."""
+    from modalities_tpu.ops.attention import flash_attention_or_fallback
+
+    return flash_attention_or_fallback(q, k, v, causal=True)
+
+
+def _dense_general(spec, features, name, kernel_axes, dtype):
+    bias_axes = kernel_axes[1:] if isinstance(features, tuple) else (kernel_axes[-1],)
+    return nn.DenseGeneral(
+        features=features,
+        use_bias=spec.bias,
+        name=name,
+        kernel_init=nn.with_logical_partitioning(nn.initializers.normal(0.02), kernel_axes),
+        bias_init=nn.with_logical_partitioning(nn.initializers.zeros, bias_axes),
+        dtype=dtype,
+    )
+
+
+class CausalSelfAttention(nn.Module):
+    """GQA causal attention with separate q/k/v projections (reference :447-502)."""
+
+    spec: GPT2ModelSpec
+    deterministic: bool = True
+
+    @nn.compact
+    def __call__(self, x):
+        spec = self.spec
+        head_dim = spec.head_dim
+        q = _dense_general(spec, (spec.n_head_q, head_dim), "q_attn", ("embed", "heads", "head_dim"), x.dtype)(x)
+        k = _dense_general(spec, (spec.n_head_kv, head_dim), "k_attn", ("embed", "kv_heads", "head_dim"), x.dtype)(x)
+        v = _dense_general(spec, (spec.n_head_kv, head_dim), "v_attn", ("embed", "kv_heads", "head_dim"), x.dtype)(x)
+
+        if spec.use_qk_norm and spec.qk_norm is not None:
+            q = build_norm(spec.qk_norm, "q_norm", dtype=x.dtype)(q)
+            k = build_norm(spec.qk_norm, "k_norm", dtype=x.dtype)(k)
+
+        if spec.use_rope:
+            cos, sin = _rope_tables(head_dim, x.shape[1], spec.rope_base_freq, dtype=x.dtype)
+            q = apply_rope(q, cos, sin)
+            k = apply_rope(k, cos, sin)
+
+        q = with_logical_constraint(q, ("batch", "seq", "heads", "head_dim"))
+        k = with_logical_constraint(k, ("batch", "seq", "kv_heads", "head_dim"))
+
+        impl = spec.attention_impl
+        if impl == AttentionImplementation.MANUAL.value:
+            y = manual_attention(q, k, v)
+        elif impl == AttentionImplementation.DAO_FLASH.value:
+            y = flash_attention(q, k, v)
+        else:
+            y = sdpa_attention(q, k, v)
+
+        y = nn.Dropout(rate=spec.dropout)(y, deterministic=self.deterministic or spec.dropout == 0.0)
+        out = nn.DenseGeneral(
+            features=spec.n_embd,
+            axis=(-2, -1),
+            use_bias=spec.bias,
+            name="c_proj",
+            kernel_init=nn.with_logical_partitioning(
+                nn.initializers.normal(0.02), ("heads", "head_dim", "embed")
+            ),
+            bias_init=nn.with_logical_partitioning(nn.initializers.zeros, ("embed",)),
+            dtype=x.dtype,
+        )(y)
+        return nn.Dropout(rate=spec.dropout)(out, deterministic=self.deterministic or spec.dropout == 0.0)
+
+
+class MLP(nn.Module):
+    """GELU MLP (reference nn/mlp.py:6) or SwiGLU (reference models/model.py:75-153)."""
+
+    spec: GPT2ModelSpec
+    deterministic: bool = True
+
+    @nn.compact
+    def __call__(self, x):
+        spec = self.spec
+        if spec.activation == ActivationType.GELU.value:
+            h = _dense_general(spec, spec.ffn_hidden, "c_fc", ("embed", "mlp"), x.dtype)(x)
+            h = with_logical_constraint(h, ("batch", "seq", "mlp"))
+            out = _dense_general(spec, spec.n_embd, "c_proj", ("mlp", "embed"), x.dtype)(nn.gelu(h))
+        else:  # swiglu / fused_swiglu
+            hidden = spec.swiglu_hidden
+            w = _dense_general(spec, hidden, "W", ("embed", "mlp"), x.dtype)(x)
+            v = _dense_general(spec, hidden, "V", ("embed", "mlp"), x.dtype)(x)
+            h = nn.silu(w) * v
+            h = with_logical_constraint(h, ("batch", "seq", "mlp"))
+            out = _dense_general(spec, spec.n_embd, "W_2", ("mlp", "embed"), x.dtype)(h)
+        return nn.Dropout(rate=spec.dropout)(out, deterministic=self.deterministic or spec.dropout == 0.0)
+
+
+class GPT2Block(nn.Module):
+    """Pre-norm residual block (reference :801-813)."""
+
+    spec: GPT2ModelSpec
+    deterministic: bool = True
+
+    @nn.compact
+    def __call__(self, x):
+        spec = self.spec
+        x = with_logical_constraint(x, ("batch", "seq", "embed"))
+        h = build_norm(spec.attn_norm, "attention_norm", dtype=x.dtype)(x)
+        x = x + CausalSelfAttention(spec, self.deterministic, name="attn")(h)
+        h2 = build_norm(spec.ffn_norm, "ffn_norm", dtype=x.dtype)(x)
+        x = x + MLP(spec, self.deterministic, name="mlp")(h2)
+        return x
+
+
+class _BlockScanBody(nn.Module):
+    """scan body: carry = activations; applies (optionally remat-wrapped) block."""
+
+    spec: GPT2ModelSpec
+    deterministic: bool = True
+
+    @nn.compact
+    def __call__(self, carry, _):
+        spec = self.spec
+        block_cls = GPT2Block
+        if spec.remat_variant in ("full", "selective_layer", "selective_op"):
+            policy = None
+            if spec.remat_variant == "selective_op":
+                from modalities_tpu.training.activation_checkpointing import save_list_policy
+
+                policy = save_list_policy(spec.remat_save_list)
+            block_cls = nn.remat(GPT2Block, prevent_cse=False, policy=policy)
+        x = block_cls(spec, self.deterministic, name="block")(carry)
+        return x, None
+
+
+class GPT2Module(nn.Module):
+    """The linen module behind GPT2LLM: wte/wpe -> blocks -> lm_head_norm -> lm_head."""
+
+    spec: GPT2ModelSpec
+    deterministic: bool = True
+
+    @nn.compact
+    def __call__(self, input_ids):
+        spec = self.spec
+        compute_dtype = jnp.bfloat16
+        wte = self.param(
+            "wte",
+            nn.with_logical_partitioning(nn.initializers.normal(0.02), ("vocab", "embed")),
+            (spec.vocab_size, spec.n_embd),
+            jnp.float32,
+        )
+        x = jnp.take(wte, input_ids, axis=0).astype(compute_dtype)
+        if spec.poe_type == PositionTypes.ABSOLUTE.value:
+            wpe = self.param(
+                "wpe",
+                nn.with_logical_partitioning(nn.initializers.normal(0.02), ("seq_param", "embed")),
+                (spec.sequence_length, spec.n_embd),
+                jnp.float32,
+            )
+            x = x + wpe[None, : input_ids.shape[1], :].astype(compute_dtype)
+        x = nn.Dropout(rate=spec.dropout)(x, deterministic=self.deterministic or spec.dropout == 0.0)
+        x = with_logical_constraint(x, ("batch", "seq", "embed"))
+
+        if spec.scan_layers:
+            scanned = nn.scan(
+                _BlockScanBody,
+                variable_axes={"params": 0},
+                split_rngs={"params": True, "dropout": True},
+                length=spec.n_layer,
+                metadata_params={nn.meta.PARTITION_NAME: "layers"},
+            )
+            x, _ = scanned(spec, self.deterministic, name="blocks")(x, None)
+        else:
+            for i in range(spec.n_layer):
+                x = GPT2Block(spec, self.deterministic, name=f"h_{i}")(x)
+
+        x = build_norm(spec.lm_head_norm, "lm_head_norm")(x)
+        x = with_logical_constraint(x, ("batch", "seq", "embed"))
+        if spec.use_weight_tying:
+            logits = jnp.einsum("bse,ve->bsv", x.astype(jnp.float32), wte.astype(jnp.float32))
+        else:
+            logits = nn.Dense(
+                spec.vocab_size,
+                use_bias=False,
+                name="lm_head",
+                kernel_init=nn.with_logical_partitioning(nn.initializers.normal(0.02), ("embed", "vocab")),
+                dtype=jnp.float32,
+                param_dtype=jnp.float32,
+            )(x.astype(jnp.float32))
+        return with_logical_constraint(logits, ("batch", "seq", "vocab"))
+
+
+class GPT2LLM(NNModel):
+    """Framework-level GPT2 model (reference: gpt2_model.py:816)."""
+
+    def __init__(
+        self,
+        sample_key: str,
+        prediction_key: str,
+        poe_type: PositionTypes,
+        sequence_length: int,
+        vocab_size: int,
+        n_layer: int,
+        n_head_q: int,
+        n_head_kv: int,
+        n_embd: int,
+        ffn_hidden: int,
+        dropout: float,
+        bias: bool,
+        attention_config: AttentionConfig,
+        attention_implementation: AttentionImplementation,
+        activation_type: ActivationType,
+        attention_norm_config,
+        ffn_norm_config,
+        lm_head_norm_config,
+        use_weight_tying: bool,
+        use_meta_device: bool = False,
+        seed: Optional[int] = None,
+        enforce_swiglu_hidden_dim_multiple_of: int = 256,
+    ):
+        super().__init__(
+            sample_key=sample_key,
+            prediction_key=prediction_key,
+            seed=seed,
+            weight_decay_groups={
+                "linear": [r".*(q_attn|k_attn|v_attn|c_proj|c_fc|W|V|W_2|lm_head).*kernel.*"],
+                "embedding": [r".*(wte|wpe).*"],
+                "norm": [r".*(norm).*"],
+            },
+        )
+        if n_head_q % n_head_kv != 0:
+            raise ValueError("n_head_q must be divisible by n_head_kv")
+        if n_embd % n_head_q != 0:
+            raise ValueError("n_embd must be divisible by n_head_q")
+        if isinstance(attention_config, dict):
+            attention_config = AttentionConfig(**attention_config)
+        use_rope = any(
+            t.type_hint == QueryKeyValueTransformType.RotaryTransform for t in attention_config.qkv_transforms
+        )
+        rope_base = 10000
+        for t in attention_config.qkv_transforms:
+            if t.type_hint == QueryKeyValueTransformType.RotaryTransform:
+                rope_base = t.config.base_freq
+
+        poe_value = poe_type.value if isinstance(poe_type, PositionTypes) else str(poe_type)
+        act_value = activation_type.value if isinstance(activation_type, ActivationType) else str(activation_type)
+        impl_value = (
+            attention_implementation.value
+            if isinstance(attention_implementation, AttentionImplementation)
+            else str(attention_implementation)
+        )
+        self.config_spec = GPT2ModelSpec(
+            vocab_size=vocab_size,
+            sequence_length=sequence_length,
+            n_layer=n_layer,
+            n_head_q=n_head_q,
+            n_head_kv=n_head_kv,
+            n_embd=n_embd,
+            ffn_hidden=ffn_hidden,
+            dropout=dropout,
+            bias=bias,
+            poe_type=poe_value,
+            activation=act_value,
+            attention_impl=impl_value,
+            use_rope=use_rope,
+            rope_base_freq=rope_base,
+            use_qk_norm=attention_config.qk_norm_config is not None,
+            use_weight_tying=use_weight_tying,
+            swiglu_hidden=swiglu_hidden_dim(ffn_hidden, enforce_swiglu_hidden_dim_multiple_of),
+            attn_norm=NormSpec.from_wrapper_config(attention_norm_config, n_embd),
+            ffn_norm=NormSpec.from_wrapper_config(ffn_norm_config, n_embd),
+            lm_head_norm=NormSpec.from_wrapper_config(lm_head_norm_config, n_embd),
+            qk_norm=(
+                NormSpec.from_wrapper_config(attention_config.qk_norm_config, n_embd // n_head_q)
+                if attention_config.qk_norm_config is not None
+                else None
+            ),
+        )
+        self.sequence_length = sequence_length
+        self.vocab_size = vocab_size
+
+    @property
+    def module(self) -> GPT2Module:
+        return GPT2Module(self.config_spec, deterministic=True)
+
+    def train_module(self) -> GPT2Module:
+        return GPT2Module(self.config_spec, deterministic=False)
+
+    def with_spec_updates(self, **changes) -> "GPT2LLM":
+        """Rebuild with updated static spec fields (remat variant, attention impl, ...)."""
+        from dataclasses import replace
+
+        self.config_spec = replace(self.config_spec, **changes)
+        return self
+
+    def init_params(self, rng):
+        dummy = jnp.zeros((1, min(8, self.sequence_length)), dtype=jnp.int32)
+        return self.module.init(rng, dummy)
+
+    def apply(self, params, inputs: dict, train: bool = False, rngs=None) -> dict:
+        module = self.train_module() if train else self.module
+        logits = module.apply(params, inputs[self.sample_key], rngs=rngs)
+        return {self.prediction_key: logits}

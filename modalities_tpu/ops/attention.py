@@ -1,0 +1,39 @@
+"""Attention kernel dispatch — the framework's `dao_flash` tier
+(reference: flash-attn CUDA kernels used via gpt2_model.py:22-25, :643-655).
+
+Dispatch order on TPU: custom Pallas flash kernel (ops/pallas/flash_attention.py)
+-> XLA-fused SDPA. On CPU (tests) the SDPA path is used so numerics stay exact.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from modalities_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+_warned = False
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:
+        return False
+
+
+def flash_attention_or_fallback(q, k, v, causal: bool = True, sm_scale: float | None = None):
+    """q: [B,S,Hq,D], k/v: [B,S,Hkv,D] -> [B,S,Hq,D]."""
+    global _warned
+    if _on_tpu():
+        try:
+            from modalities_tpu.ops.pallas.flash_attention import pallas_flash_attention
+
+            return pallas_flash_attention(q, k, v, causal=causal, sm_scale=sm_scale)
+        except Exception as e:  # pragma: no cover - TPU only
+            if not _warned:
+                logger.warning("Pallas flash attention unavailable (%s); using XLA SDPA.", e)
+                _warned = True
+    return jax.nn.dot_product_attention(q, k, v, is_causal=causal, scale=sm_scale)

@@ -1,0 +1,133 @@
+"""Optimizers as optax transformation specs (reference: src/modalities/optimizers/optimizer_factory.py).
+
+The reference builds torch optimizers over parameter groups derived from the model's
+regex ``weight_decay_groups``; here the same regex groups become an optax weight-decay
+*mask*, and the optimizer is a declarative ``OptimizerSpec`` the train-step builder
+turns into a ``GradientTransformation`` chained behind grad clipping and the LR
+schedule. Per-param-group state lives in the same pytree as the params — sharded by
+GSPMD exactly like them (the FSDP2 optimizer-state sharding for free).
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+import optax
+
+from modalities_tpu.models.model import NNModel
+
+
+def _flatten_param_names(params) -> list[tuple[tuple, str]]:
+    import jax
+
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+    out = []
+    for path, _ in flat:
+        name = "/".join(str(getattr(p, "key", getattr(p, "idx", p))) for p in path)
+        out.append((path, name))
+    return out
+
+
+def build_weight_decay_mask(params, model: NNModel, weight_decay_groups_excluded: list[str]):
+    """True = apply weight decay. Group regexes come from the model
+    (reference: models/model.py:26-72 weight_decay_groups + optimizer_factory.py:76-131)."""
+    import jax
+
+    if not weight_decay_groups_excluded:
+        return jax.tree.map(lambda _: True, params)
+
+    groups = model.weight_decay_groups
+    for g in weight_decay_groups_excluded:
+        if g not in groups:
+            raise ValueError(
+                f"weight decay group {g!r} not in model's weight_decay_groups {sorted(groups)}"
+            )
+    excluded_patterns = [re.compile(p) for g in weight_decay_groups_excluded for p in groups[g]]
+
+    def decide(path, _):
+        name = "/".join(str(getattr(p, "key", getattr(p, "idx", p))) for p in path)
+        return not any(pat.search(name) for pat in excluded_patterns)
+
+    return jax.tree_util.tree_map_with_path(decide, params)
+
+
+@dataclass
+class OptimizerSpec:
+    """Declarative optimizer description resolved against params at train-step build."""
+
+    kind: str
+    lr: float
+    betas: tuple[float, float] = (0.9, 0.95)
+    eps: float = 1e-8
+    weight_decay: float = 0.0
+    weight_decay_groups_excluded: list[str] = field(default_factory=list)
+    model: Optional[NNModel] = None
+
+    def build(self, params, schedule) -> optax.GradientTransformation:
+        mask = (
+            build_weight_decay_mask(params, self.model, self.weight_decay_groups_excluded)
+            if self.model is not None
+            else None
+        )
+        lr = schedule if schedule is not None else self.lr
+        if self.kind == "adam_w":
+            return optax.adamw(
+                learning_rate=lr,
+                b1=self.betas[0],
+                b2=self.betas[1],
+                eps=self.eps,
+                weight_decay=self.weight_decay,
+                mask=mask,
+            )
+        if self.kind == "adam":
+            # torch Adam applies weight decay as L2 into the gradient
+            chain = [optax.add_decayed_weights(self.weight_decay, mask=mask)] if self.weight_decay else []
+            chain.append(optax.adam(learning_rate=lr, b1=self.betas[0], b2=self.betas[1], eps=self.eps))
+            return optax.chain(*chain)
+        raise ValueError(f"Unknown optimizer kind {self.kind!r}")
+
+
+class OptimizerFactory:
+    @staticmethod
+    def get_adam(
+        lr: float,
+        betas: tuple[float, float],
+        eps: float,
+        weight_decay: float,
+        weight_decay_groups_excluded: list[str],
+        wrapped_model: NNModel,
+        foreach: Optional[bool] = None,  # torch-only knobs kept for config parity
+        fused: Optional[bool] = None,
+    ) -> OptimizerSpec:
+        return OptimizerSpec(
+            kind="adam",
+            lr=lr,
+            betas=tuple(betas),
+            eps=eps,
+            weight_decay=weight_decay,
+            weight_decay_groups_excluded=list(weight_decay_groups_excluded),
+            model=wrapped_model,
+        )
+
+    @staticmethod
+    def get_adam_w(
+        lr: float,
+        betas: tuple[float, float],
+        eps: float,
+        weight_decay: float,
+        weight_decay_groups_excluded: list[str],
+        wrapped_model: NNModel,
+        foreach: Optional[bool] = None,
+        fused: Optional[bool] = None,
+    ) -> OptimizerSpec:
+        return OptimizerSpec(
+            kind="adam_w",
+            lr=lr,
+            betas=tuple(betas),
+            eps=eps,
+            weight_decay=weight_decay,
+            weight_decay_groups_excluded=list(weight_decay_groups_excluded),
+            model=wrapped_model,
+        )

@@ -1,0 +1,177 @@
+"""LR schedules (reference: torch lr_scheduler variants wired in registry/components.py:269-300
+plus the custom DummyLRScheduler, optimizers/lr_schedulers.py).
+
+Each variant resolves to a pure ``schedule(step) -> multiplier-or-lr`` function; the
+optimizer folds it in, so "scheduler.step()" from the reference's loop disappears into
+the jitted update. Config fields mirror the torch schedulers 1:1.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+from modalities_tpu.optimizers.optimizer_factory import OptimizerSpec
+
+
+@dataclass
+class SchedulerSpec:
+    name: str
+    optimizer: OptimizerSpec
+
+    def schedule(self) -> Callable[[int], float]:  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def absolute_lr_schedule(self) -> Callable[[int], float]:
+        """lr(step) including the optimizer's base lr."""
+        base = self.optimizer.lr
+        fn = self.schedule()
+        return lambda step: base * fn(step)
+
+
+@dataclass
+class DummyLRScheduler(SchedulerSpec):
+    def schedule(self):
+        return lambda step: 1.0
+
+
+@dataclass
+class StepLRScheduler(SchedulerSpec):
+    step_size: int = 1
+    gamma: float = 0.1
+    last_epoch: int = -1
+
+    def schedule(self):
+        import jax.numpy as jnp
+
+        return lambda step: self.gamma ** (jnp.asarray(step) // self.step_size)
+
+
+@dataclass
+class ConstantLRScheduler(SchedulerSpec):
+    factor: float = 1.0
+    total_iters: int = 1
+    last_epoch: int = -1
+
+    def schedule(self):
+        import jax.numpy as jnp
+
+        def fn(step):
+            step = jnp.asarray(step)
+            return jnp.where(step < self.total_iters, self.factor, 1.0)
+
+        return fn
+
+
+@dataclass
+class LinearLRScheduler(SchedulerSpec):
+    start_factor: float = 1.0 / 3
+    end_factor: float = 1.0
+    total_iters: int = 5
+    last_epoch: int = -1
+
+    def schedule(self):
+        import jax.numpy as jnp
+
+        def fn(step):
+            step = jnp.clip(jnp.asarray(step), 0, self.total_iters)
+            return self.start_factor + (self.end_factor - self.start_factor) * step / self.total_iters
+
+        return fn
+
+
+@dataclass
+class CosineAnnealingLRScheduler(SchedulerSpec):
+    t_max: int = 1
+    eta_min: float = 0.0
+    last_epoch: int = -1
+
+    def schedule(self):
+        import jax.numpy as jnp
+
+        base = self.optimizer.lr
+
+        def fn(step):
+            step = jnp.asarray(step)
+            cos = 0.5 * (1 + jnp.cos(jnp.pi * step / self.t_max))
+            lr = self.eta_min + (base - self.eta_min) * cos
+            return lr / base
+
+        return fn
+
+
+@dataclass
+class OneCycleLRScheduler(SchedulerSpec):
+    """torch OneCycleLR semantics: warmup to max_lr over pct_start, anneal to
+    max_lr/final_div_factor (reference config fields, config.py:181-205)."""
+
+    max_lr: float = 1e-3
+    total_steps: Optional[int] = None
+    epochs: Optional[int] = None
+    steps_per_epoch: Optional[int] = None
+    pct_start: float = 0.3
+    anneal_strategy: str = "cos"
+    cycle_momentum: bool = False
+    base_momentum: float = 0.85
+    max_momentum: float = 0.95
+    div_factor: float = 25.0
+    final_div_factor: float = 1e4
+    last_epoch: int = -1
+
+    def _total(self) -> int:
+        if self.total_steps is not None:
+            return self.total_steps
+        if self.epochs is not None and self.steps_per_epoch is not None:
+            return self.epochs * self.steps_per_epoch
+        raise ValueError("OneCycleLR requires total_steps or (epochs and steps_per_epoch)")
+
+    def schedule(self):
+        import jax.numpy as jnp
+
+        total = self._total()
+        up = max(1, int(self.pct_start * total))
+        down = max(1, total - up)
+        initial = self.max_lr / self.div_factor
+        final = initial / self.final_div_factor
+        base = self.optimizer.lr
+        use_cos = self.anneal_strategy == "cos"
+
+        def anneal(frac, start, end):
+            if use_cos:
+                return end + (start - end) * 0.5 * (1 + jnp.cos(jnp.pi * frac))
+            return start + (end - start) * frac
+
+        def fn(step):
+            step = jnp.asarray(step, dtype=jnp.float32)
+            lr_up = anneal(jnp.clip(step / up, 0, 1), initial, self.max_lr)
+            lr_down = anneal(jnp.clip((step - up) / down, 0, 1), self.max_lr, final)
+            lr = jnp.where(step <= up, lr_up, lr_down)
+            return lr / base
+
+        return fn
+
+
+@dataclass
+class LinearWarmupCosineAnnealingLRScheduler(SchedulerSpec):
+    warmup_steps: int = 1
+    total_steps: int = 2
+    initial_lr: float = 0.0
+    final_lr: float = 0.0
+    max_lr: float = 1e-3
+    last_epoch: int = -1
+
+    def schedule(self):
+        import jax.numpy as jnp
+
+        base = self.optimizer.lr
+
+        def fn(step):
+            step = jnp.asarray(step, dtype=jnp.float32)
+            warm = self.initial_lr + (self.max_lr - self.initial_lr) * step / max(1, self.warmup_steps)
+            frac = jnp.clip((step - self.warmup_steps) / max(1, self.total_steps - self.warmup_steps), 0, 1)
+            cos = self.final_lr + (self.max_lr - self.final_lr) * 0.5 * (1 + jnp.cos(jnp.pi * frac))
+            lr = jnp.where(step < self.warmup_steps, warm, cos)
+            return lr / base
+
+        return fn

@@ -1,0 +1,110 @@
+"""GSPMD sharding rules: logical model axes -> 5-D mesh axes.
+
+This module *is* the TPU replacement for the reference's FSDP2 wrapping
+(model_factory.py:168-246) and DTensor TP plan (model_factory.py:657-766): instead of
+wrapper modules that intercept forwards, every parameter/activation carries a logical
+axis name and these rules lower them to mesh PartitionSpecs. XLA then inserts the
+all-gathers/reduce-scatters FSDP2 does manually, and the all-reduces of the rowwise/
+colwise TP plan.
+
+Default rule set (reference parity):
+- FSDP (dp_shard): every parameter's largest non-TP dim sharded over dp_shard —
+  expressed by mapping "embed" (for 2D+ weights) onto dp_shard when tp is unused, or
+  combined (dp_shard,) with tp on separate axes.
+- TP: q/k/v + W/V/c_fc colwise => "heads"/"kv_heads"/"mlp" on tp; c_proj/W_2 rowwise
+  (input sharded) — same effective layout as the reference plan; embedding/lm_head on
+  "vocab" over tp (vocab-parallel lookup + XLA-inserted psum).
+- SP: activations sharded on "seq" over tp between blocks (norm inputs), matching
+  SequenceParallel in the reference plan; batch is sharded over (dp_replicate,
+  dp_shard) and "seq" additionally over cp for context parallelism.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from modalities_tpu.running_env.device_mesh import DeviceMeshHandle
+
+LogicalRules = tuple[tuple[str, Optional[str | tuple[str, ...]]], ...]
+
+
+def default_logical_axis_rules(mesh_handle: DeviceMeshHandle, sequence_parallel: bool = True) -> LogicalRules:
+    axis_names = mesh_handle.axis_names
+    has = lambda n: n in axis_names and mesh_handle.degrees.get(n, 1) > 1  # noqa: E731
+
+    tp = "tp" if has("tp") else None
+    dp_shard = "dp_shard" if "dp_shard" in axis_names else None
+    cp = "cp" if has("cp") else None
+
+    batch_axes = tuple(n for n in ("dp_replicate", "dp_shard") if n in axis_names)
+
+    rules: list[tuple[str, Optional[str | tuple[str, ...]]]] = [
+        ("batch", batch_axes if batch_axes else None),
+        # sequence dim of activations: context parallelism shards it over cp; with TP
+        # sequence-parallel regions use "seq_sp"
+        ("seq", cp),
+        ("seq_sp", tuple(a for a in (cp, tp) if a) or None),
+        # parameters: FSDP over dp_shard on the "embed" dim, TP on head/mlp/vocab dims
+        ("embed", dp_shard),
+        ("heads", tp),
+        ("kv_heads", tp),
+        ("head_dim", None),
+        ("mlp", tp),
+        ("vocab", tp),
+        ("seq_param", None),
+        ("layers", None),  # scan axis; pp splits it at stage boundaries, not via sharding
+    ]
+    return tuple(rules)
+
+
+def logical_to_mesh_spec(logical_axes, rules: LogicalRules) -> P:
+    """Map a tuple of logical axis names to a PartitionSpec via the rule list."""
+    table = dict(rules)
+    spec = []
+    used: set[str] = set()
+    for ax in logical_axes:
+        target = table.get(ax)
+        if target is None:
+            spec.append(None)
+            continue
+        targets = target if isinstance(target, tuple) else (target,)
+        free = tuple(t for t in targets if t not in used)
+        used.update(free)
+        if not free:
+            spec.append(None)
+        elif len(free) == 1:
+            spec.append(free[0])
+        else:
+            spec.append(free)
+    return P(*spec)
+
+
+def params_shardings(abstract_params, rules: LogicalRules, mesh: Mesh):
+    """NamedShardings for a pytree of flax Partitioned leaves (from module.init metadata)."""
+    import flax
+
+    logical_specs = flax.linen.get_partition_spec(abstract_params)
+
+    def to_named(spec):
+        if isinstance(spec, P):
+            mesh_spec = logical_to_mesh_spec(tuple(spec), rules)
+        else:
+            mesh_spec = P()
+        return NamedSharding(mesh, mesh_spec)
+
+    return jax.tree.map(to_named, logical_specs, is_leaf=lambda x: isinstance(x, P))
+
+
+def batch_sharding(mesh_handle: DeviceMeshHandle) -> NamedSharding:
+    """Global batch: batch dim over (dp_replicate, dp_shard), seq dim over cp."""
+    axis_names = mesh_handle.axis_names
+    batch_axes = tuple(n for n in ("dp_replicate", "dp_shard") if n in axis_names)
+    cp = "cp" if "cp" in axis_names and mesh_handle.degrees.get("cp", 1) > 1 else None
+    return NamedSharding(mesh_handle.mesh, P(batch_axes if batch_axes else None, cp))
+
+
+def replicated(mesh_handle: DeviceMeshHandle) -> NamedSharding:
+    return NamedSharding(mesh_handle.mesh, P())

@@ -1,0 +1,189 @@
+"""The training loop (reference: src/modalities/trainer.py:201).
+
+Differences from the reference, by design:
+- forward/backward/clip/optimizer/schedule live inside ONE donated jit step
+  (training/train_step.py); the Python loop only feeds batches and reads metrics.
+- gradient accumulation happens inside the step (lax.scan), so the loop advances one
+  *optimizer* step per iteration over stacked microbatches.
+- metrics are fetched from device only at the log interval — no per-step host sync;
+  the explicit loss `Reducer` all-reduce (reference trainer.py:307) is unnecessary
+  because the in-jit mean already spans the mesh.
+- Python GC is disabled during the loop and collected every `gc_frequency` steps
+  (reference trainer.py:30 GarbageCollection) to avoid jitter.
+"""
+
+from __future__ import annotations
+
+import gc
+import time
+from typing import Callable, Optional
+
+import numpy as np
+
+from modalities_tpu.batch import EvaluationResultBatch, ResultItem
+from modalities_tpu.logging_broker.messages import ExperimentStatus, MessageTypes, ProgressUpdate
+from modalities_tpu.logging_broker.publisher import MessagePublisher
+from modalities_tpu.training.train_step import StepFunctions
+from modalities_tpu.training.training_progress import TrainingProgress
+from modalities_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+class Trainer:
+    def __init__(
+        self,
+        progress_publisher: MessagePublisher,
+        evaluation_result_publisher: MessagePublisher,
+        gradient_acc_steps: int = 1,
+        global_num_tokens_per_train_step: int = 0,
+        num_seen_train_steps: int = 0,
+        global_num_seen_tokens: int = 0,
+        training_log_interval_in_steps: int = 1,
+        mfu_calculator=None,
+        profiler=None,
+        gc_frequency: int = 10,
+    ) -> None:
+        self.progress_publisher = progress_publisher
+        self.evaluation_result_publisher = evaluation_result_publisher
+        self.gradient_acc_steps = gradient_acc_steps
+        self.global_num_tokens_per_train_step = global_num_tokens_per_train_step
+        self.num_seen_train_steps = num_seen_train_steps
+        self.global_num_seen_tokens = global_num_seen_tokens
+        self.training_log_interval_in_steps = training_log_interval_in_steps
+        self.mfu_calculator = mfu_calculator
+        self.profiler = profiler
+        self.gc_frequency = gc_frequency
+
+    def train(
+        self,
+        step_functions: StepFunctions,
+        train_loader,
+        training_progress: TrainingProgress,
+        evaluation_callback: Callable[[int], None],
+        checkpointing_callback: Callable[[TrainingProgress], None],
+    ) -> None:
+        state = step_functions.app_state_handle.state
+        put_batch = step_functions.put_batch
+        train_step = step_functions.train_step
+
+        # initial callbacks at "step -1" semantics (reference trainer.py:250-259)
+        evaluation_callback(self.num_seen_train_steps)
+
+        if self.gc_frequency > 0:
+            gc.disable()
+            gc.collect(1)
+
+        micro_stack_samples: list[dict] = []
+        micro_stack_targets: list[dict] = []
+        pending_metrics: list[dict] = []
+        interval_start = time.perf_counter()
+        step_id = self.num_seen_train_steps
+        target_steps = training_progress.num_target_steps
+
+        profiler_cm = self.profiler
+        if profiler_cm is not None:
+            profiler_cm.__enter__()
+        try:
+            for batch in train_loader:
+                micro_stack_samples.append(batch.samples)
+                micro_stack_targets.append(batch.targets)
+                if len(micro_stack_samples) < self.gradient_acc_steps:
+                    continue
+
+                stacked = {
+                    "samples": {
+                        k: np.stack([m[k] for m in micro_stack_samples]) for k in micro_stack_samples[0]
+                    },
+                    "targets": {
+                        k: np.stack([m[k] for m in micro_stack_targets]) for k in micro_stack_targets[0]
+                    },
+                }
+                micro_stack_samples, micro_stack_targets = [], []
+
+                device_batch = put_batch(stacked)
+                state, metrics = train_step(state, device_batch)
+                pending_metrics.append(metrics)
+                step_id += 1
+                training_progress.num_seen_steps_current_run += 1
+                training_progress.num_seen_tokens_current_run += self.global_num_tokens_per_train_step
+
+                self.progress_publisher.publish_message(
+                    ProgressUpdate(step_id, ExperimentStatus.TRAIN, train_loader.dataloader_tag),
+                    MessageTypes.BATCH_PROGRESS_UPDATE,
+                )
+
+                if step_id % self.training_log_interval_in_steps == 0:
+                    self._publish_interval(
+                        pending_metrics, step_id, train_loader.dataloader_tag, interval_start, training_progress
+                    )
+                    pending_metrics = []
+                    interval_start = time.perf_counter()
+
+                if self.gc_frequency > 0 and step_id % self.gc_frequency == 0:
+                    gc.collect(1)
+
+                step_functions.app_state_handle.state = state
+                evaluation_callback(step_id)
+                checkpointing_callback(training_progress)
+
+                if profiler_cm is not None:
+                    profiler_cm.step()
+
+                if step_id >= target_steps:
+                    break
+        finally:
+            if profiler_cm is not None:
+                profiler_cm.__exit__(None, None, None)
+            if self.gc_frequency > 0:
+                gc.enable()
+
+        step_functions.app_state_handle.state = state
+
+    def _publish_interval(
+        self,
+        pending_metrics: list[dict],
+        step_id: int,
+        dataloader_tag: str,
+        interval_start: float,
+        training_progress: TrainingProgress,
+    ) -> None:
+        # single host sync point per interval: fetch the accumulated device metrics
+        losses = np.asarray([m["loss"] for m in pending_metrics], dtype=np.float64)
+        grad_norms = np.asarray([m["grad_norm"] for m in pending_metrics], dtype=np.float64)
+        lrs = np.asarray([m["lr"] for m in pending_metrics], dtype=np.float64)
+        elapsed = max(time.perf_counter() - interval_start, 1e-9)
+        num_steps = len(pending_metrics)
+        tokens_per_second = num_steps * self.global_num_tokens_per_train_step / elapsed
+
+        throughput = {
+            "train steps/s": ResultItem(num_steps / elapsed, 2),
+            "tokens/s": ResultItem(tokens_per_second, 1),
+        }
+        if self.mfu_calculator is not None:
+            throughput["MFU"] = ResultItem(self.mfu_calculator.compute(tokens_per_second), 4)
+        try:
+            import jax
+
+            mem_stats = jax.local_devices()[0].memory_stats() or {}
+            if "peak_bytes_in_use" in mem_stats:
+                throughput["peak memory [MB]"] = ResultItem(mem_stats["peak_bytes_in_use"] / 2**20, 1)
+        except Exception:
+            pass
+
+        result = EvaluationResultBatch(
+            dataloader_tag=dataloader_tag,
+            num_train_steps_done=step_id,
+            losses={
+                "train loss avg": ResultItem(losses.mean(), 5),
+                "train loss last": ResultItem(losses[-1], 5),
+            },
+            metrics={
+                "grad norm avg": ResultItem(grad_norms.mean(), 5),
+                "grad norm last": ResultItem(grad_norms[-1], 5),
+                "lr mean": ResultItem(lrs.mean(), 8),
+                "consumed tokens": ResultItem(training_progress.num_seen_tokens_total, 0),
+            },
+            throughput_metrics=throughput,
+        )
+        self.evaluation_result_publisher.publish_message(result, MessageTypes.EVALUATION_RESULT)

@@ -1,0 +1,71 @@
+"""Activation checkpointing variants mapped onto jax.checkpoint policies
+(reference: src/modalities/training/activation_checkpointing/activation_checkpointing.py).
+
+Reference variants -> TPU equivalents:
+- FULL: remat every transformer block (``nn.remat`` around the scanned block).
+- SELECTIVE_LAYER (every ac_freq-th block): remat wrapper applied inside the scan with
+  a static block-index predicate.
+- SELECTIVE_OP (save-list over ops: mm/SDPA/max/reduce_scatter): a jax.checkpoint
+  policy built from `save_only_these_names` / `dots_with_no_batch_dims_saveable`.
+"""
+
+from __future__ import annotations
+
+from enum import Enum
+
+import jax
+
+
+class ActivationCheckpointingVariants(str, Enum):
+    FULL_ACTIVATION_CHECKPOINTING = "full_activation_checkpointing"
+    SELECTIVE_LAYER_ACTIVATION_CHECKPOINTING = "selective_layer_activation_checkpointing"
+    SELECTIVE_OP_ACTIVATION_CHECKPOINTING = "selective_op_activation_checkpointing"
+
+
+_NAMED_POLICIES = {
+    "matmul": jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+    "everything": jax.checkpoint_policies.everything_saveable,
+    "nothing": jax.checkpoint_policies.nothing_saveable,
+}
+
+
+def save_list_policy(save_list: tuple[str, ...]):
+    """Build a checkpoint policy from op-name hints (reference SAVE_DICT :67-83).
+
+    The reference lists aten ops (mm every 2nd, SDPA, reduce_scatter, max); the closest
+    XLA-level notion is 'save dot-product results, recompute elementwise', which
+    `dots_with_no_batch_dims_saveable` expresses. Named checkpoints from
+    ``jax.ad_checkpoint.checkpoint_name`` are honored via save_only_these_names.
+    """
+    names = tuple(n for n in save_list if n not in _NAMED_POLICIES)
+    base = None
+    for n in save_list:
+        if n in _NAMED_POLICIES:
+            base = _NAMED_POLICIES[n]
+    if names and base is not None:
+        named = jax.checkpoint_policies.save_only_these_names(*names)
+        return jax.checkpoint_policies.save_from_both_policies(base, named)
+    if names:
+        return jax.checkpoint_policies.save_only_these_names(*names)
+    if base is not None:
+        return base
+    return jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+
+
+class ActivationCheckpointing:
+    """Registry-facing component: records the remat variant on the model's spec
+    (applied when the jitted train step is built)."""
+
+    @staticmethod
+    def apply(model, variant: str | ActivationCheckpointingVariants, ac_freq: int = 1, save_list: tuple[str, ...] = ()):
+        v = variant.value if isinstance(variant, ActivationCheckpointingVariants) else str(variant)
+        mapping = {
+            ActivationCheckpointingVariants.FULL_ACTIVATION_CHECKPOINTING.value: "full",
+            ActivationCheckpointingVariants.SELECTIVE_LAYER_ACTIVATION_CHECKPOINTING.value: "selective_layer",
+            ActivationCheckpointingVariants.SELECTIVE_OP_ACTIVATION_CHECKPOINTING.value: "selective_op",
+        }
+        if v not in mapping:
+            raise ValueError(f"Unknown activation checkpointing variant {v!r}")
+        return model.with_spec_updates(
+            remat_variant=mapping[v], remat_freq=ac_freq, remat_save_list=tuple(save_list)
+        )

@@ -1,0 +1,265 @@
+"""The jitted train/eval step builder — the execution core of the framework.
+
+This replaces the reference's eager micro-batch loop internals (trainer.py:129-189):
+forward, backward, grad clip, optimizer and schedule all fuse into ONE donated
+``jax.jit`` program. Gradient accumulation runs as a ``lax.scan`` over microbatches
+*inside* the step (one dispatch per optimizer step instead of one per microbatch).
+GSPMD lowers the logical-axis shardings (parallel/sharding.py) into FSDP-style
+all-gather/reduce-scatter and TP all-reduces; the loss all-reduce that the reference
+does explicitly via `Reducer` (running_env/fsdp/reducer.py:7) is just the mean here.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from flax import linen as nn
+from flax.core import meta as nn_meta
+
+from modalities_tpu.checkpointing.stateful.app_state import AppState, AppStateHandle
+from modalities_tpu.loss_functions import Loss
+from modalities_tpu.models.model import NNModel
+from modalities_tpu.parallel.sharding import (
+    batch_sharding,
+    default_logical_axis_rules,
+    logical_to_mesh_spec,
+    replicated,
+)
+from modalities_tpu.running_env.device_mesh import DeviceMeshHandle
+from modalities_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+def _unbox(tree):
+    return nn_meta.unbox(tree)
+
+
+def _substitute_param_subtrees(node, param_treedef, param_shardings, replicated_sharding):
+    """Map an abstract optax state to shardings: any subtree structurally equal to the
+    param tree (mu/nu) gets the param shardings; everything else is replicated."""
+    try:
+        if jax.tree.structure(node) == param_treedef:
+            return param_shardings
+    except Exception:
+        pass
+    if isinstance(node, tuple) and hasattr(node, "_fields"):  # NamedTuple state
+        return type(node)(*[
+            _substitute_param_subtrees(c, param_treedef, param_shardings, replicated_sharding) for c in node
+        ])
+    if isinstance(node, (list, tuple)):
+        return type(node)(
+            _substitute_param_subtrees(c, param_treedef, param_shardings, replicated_sharding) for c in node
+        )
+    if isinstance(node, dict):
+        return {
+            k: _substitute_param_subtrees(v, param_treedef, param_shardings, replicated_sharding)
+            for k, v in node.items()
+        }
+    return replicated_sharding
+
+
+@dataclass
+class StepFunctions:
+    """The compiled training surface handed to Trainer/Evaluator."""
+
+    train_step: Callable[[AppState, Any], tuple[AppState, dict]]
+    eval_step: Callable[[AppState, Any], dict]
+    put_batch: Callable[[dict], dict]
+    app_state_handle: AppStateHandle
+    mesh_handle: DeviceMeshHandle
+
+
+class TrainStepBuilder:
+    """Assembles model + loss + optimizer + schedule + mesh into jitted step functions.
+
+    This is where the registry's model-transform descriptors (sharding, remat, mixed
+    precision) are applied — the JAX counterpart of the reference's in-place wrapper
+    chain fsdp2_wrapped -> activation_checkpointed -> compiled (model_factory.py).
+    """
+
+    def __init__(
+        self,
+        model: NNModel,
+        loss_fn: Loss,
+        optimizer_spec,
+        scheduler_spec=None,
+        mesh_handle: Optional[DeviceMeshHandle] = None,
+        gradient_acc_steps: int = 1,
+        grad_clip_norm: Optional[float] = None,
+        sequence_parallel: bool = True,
+    ):
+        self.model = model
+        self.loss_fn = loss_fn
+        self.optimizer_spec = optimizer_spec
+        self.scheduler_spec = scheduler_spec
+        self.mesh_handle = mesh_handle
+        self.gradient_acc_steps = gradient_acc_steps
+        self.grad_clip_norm = grad_clip_norm
+        self.rules = (
+            default_logical_axis_rules(mesh_handle, sequence_parallel) if mesh_handle is not None else ()
+        )
+
+    # ------------------------------------------------------------------ build
+    def build(self, seed: Optional[int] = None) -> StepFunctions:
+        model = self.model
+        mesh_handle = self.mesh_handle
+        seed = seed if seed is not None else model.seed
+        rng = jax.random.PRNGKey(seed)
+
+        init_fn = lambda r: model.init_params(r)  # noqa: E731
+
+        # --- shardings from flax logical-axis metadata
+        boxed_abstract = jax.eval_shape(init_fn, rng)
+        logical_specs = nn.get_partition_spec(boxed_abstract)
+
+        if mesh_handle is not None:
+            mesh = mesh_handle.mesh
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            def to_sharding(spec):
+                return NamedSharding(mesh, logical_to_mesh_spec(tuple(spec), self.rules))
+
+            param_shardings = jax.tree.map(
+                to_sharding, logical_specs, is_leaf=lambda x: isinstance(x, P)
+            )
+            replicated_sharding = replicated(mesh_handle)
+            data_sharding = batch_sharding(mesh_handle)
+        else:
+            param_shardings = None
+            replicated_sharding = None
+            data_sharding = None
+
+        # --- optimizer over unboxed abstract params
+        abstract_params = _unbox(boxed_abstract)
+        schedule = self.scheduler_spec.absolute_lr_schedule() if self.scheduler_spec is not None else None
+        tx = self.optimizer_spec.build(abstract_params, schedule)
+        if self.grad_clip_norm is not None:
+            tx = optax.chain(optax.clip_by_global_norm(self.grad_clip_norm), tx)
+        lr_fn = schedule if schedule is not None else (lambda step: self.optimizer_spec.lr)
+
+        def init_state(r) -> AppState:
+            params = _unbox(init_fn(r))
+            return AppState(params=params, opt_state=tx.init(params), step=jnp.zeros((), jnp.int32))
+
+        if mesh_handle is not None:
+            abstract_state = jax.eval_shape(init_state, rng)
+            param_treedef = jax.tree.structure(abstract_state.params)
+            opt_shardings = _substitute_param_subtrees(
+                abstract_state.opt_state, param_treedef, param_shardings, replicated_sharding
+            )
+            state_shardings = AppState(
+                params=param_shardings, opt_state=opt_shardings, step=replicated_sharding
+            )
+            with mesh:
+                state = jax.jit(init_state, out_shardings=state_shardings)(rng)
+        else:
+            state_shardings = None
+            state = jax.jit(init_state)(rng)
+
+        logger.info(
+            "initialized AppState: %d params",
+            sum(int(np.prod(x.shape)) for x in jax.tree.leaves(state.params)),
+        )
+
+        # --- step functions
+        loss_fn = self.loss_fn
+        sample_key = model.sample_key
+        acc_steps = self.gradient_acc_steps
+
+        def compute_loss(params, samples, targets, dropout_rng):
+            predictions = model.apply(
+                params, samples, train=True, rngs={"dropout": dropout_rng} if dropout_rng is not None else None
+            )
+            return loss_fn(predictions, targets)
+
+        def train_step(state: AppState, batch: dict) -> tuple[AppState, dict]:
+            """batch: {"samples": {k: [acc, mb, ...]}, "targets": {k: [acc, mb, ...]}}"""
+            samples, targets = batch["samples"], batch["targets"]
+            dropout_rng = jax.random.fold_in(jax.random.PRNGKey(0), state.step)
+
+            def micro(acc, xs):
+                s, t = xs
+                loss, grads = jax.value_and_grad(compute_loss)(state.params, s, t, dropout_rng)
+                g_acc, l_acc = acc
+                return (jax.tree.map(jnp.add, g_acc, grads), l_acc + loss), None
+
+            zero_grads = jax.tree.map(jnp.zeros_like, state.params)
+            (grads, loss_sum), _ = jax.lax.scan(micro, (zero_grads, 0.0), (samples, targets))
+            grads = jax.tree.map(lambda g: g / acc_steps, grads)
+            loss = loss_sum / acc_steps
+
+            grad_norm = optax.global_norm(grads)
+            updates, new_opt_state = tx.update(grads, state.opt_state, state.params)
+            new_params = optax.apply_updates(state.params, updates)
+            new_state = AppState(params=new_params, opt_state=new_opt_state, step=state.step + 1)
+            metrics = {
+                "loss": loss,
+                "grad_norm": grad_norm,
+                "lr": jnp.asarray(lr_fn(state.step), jnp.float32),
+            }
+            return new_state, metrics
+
+        def eval_step(state: AppState, batch: dict) -> dict:
+            predictions = model.apply(state.params, batch["samples"], train=False)
+            return {"loss": loss_fn(predictions, batch["targets"])}
+
+        if mesh_handle is not None:
+            with mesh_handle.mesh:
+                train_step_c = jax.jit(
+                    train_step,
+                    donate_argnums=(0,),
+                    in_shardings=(state_shardings, None),
+                    out_shardings=(state_shardings, replicated_sharding),
+                )
+                eval_step_c = jax.jit(eval_step, in_shardings=(state_shardings, None))
+        else:
+            train_step_c = jax.jit(train_step, donate_argnums=(0,))
+            eval_step_c = jax.jit(eval_step)
+
+        put_batch = self._make_put_batch(data_sharding)
+
+        handle = AppStateHandle(state, state_shardings, tx, lr_fn, model)
+        return StepFunctions(
+            train_step=train_step_c,
+            eval_step=eval_step_c,
+            put_batch=put_batch,
+            app_state_handle=handle,
+            mesh_handle=mesh_handle,
+        )
+
+    # ------------------------------------------------------------------ data
+    def _make_put_batch(self, data_sharding):
+        """Host numpy batch -> global sharded device arrays.
+
+        Single-process: device_put with the batch sharding. Multi-host: each process
+        contributes the rows its devices own (jax.make_array_from_process_local_data).
+        Leading accumulation dim (if any) is replicated; batch dim is sharded.
+        """
+
+        def put(batch_dict: dict) -> dict:
+            if data_sharding is None:
+                return jax.tree.map(jnp.asarray, batch_dict)
+
+            import jax.sharding as js
+
+            def put_leaf(x):
+                x = np.asarray(x)
+                # sharding spec is for (batch, seq); with accumulation dim prepend None
+                spec = data_sharding.spec
+                if x.ndim == 3:  # (acc, batch, seq)
+                    full = js.NamedSharding(data_sharding.mesh, js.PartitionSpec(None, *spec))
+                else:
+                    full = data_sharding
+                if jax.process_count() == 1:
+                    return jax.device_put(x, full)
+                return jax.make_array_from_process_local_data(full, x)
+
+            return jax.tree.map(put_leaf, batch_dict)
+
+        return put

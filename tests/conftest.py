@@ -3,10 +3,19 @@
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU even when the session env points at a TPU: unit tests must be fast and
+# deterministic; sharding logic runs on 8 virtual CPU devices. The TPU plugin may have
+# been registered by a sitecustomize at interpreter startup (locking jax_platforms
+# before this file runs), so the env var alone is not enough — override the live
+# config after import too.
+os.environ["JAX_PLATFORMS"] = "cpu"
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (xla_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

@@ -1,0 +1,147 @@
+"""End-to-end train-step tests on the virtual 8-device CPU mesh: loss decreases,
+sharding works across dp/tp layouts, grad accumulation invariance
+(mirrors the reference's fsdp2_parallelization equivalence suite, SURVEY.md §4)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from modalities_tpu.loss_functions import CLMCrossEntropyLoss
+from modalities_tpu.optimizers.optimizer_factory import OptimizerFactory
+from modalities_tpu.optimizers.scheduler_factory import DummyLRScheduler
+from modalities_tpu.running_env.device_mesh import get_device_mesh
+from modalities_tpu.training.train_step import TrainStepBuilder
+from tests.models.test_gpt2_model import tiny_gpt2
+
+
+def _builder(model, mesh_handle, acc=1, clip=None):
+    opt = OptimizerFactory.get_adam_w(
+        lr=1e-3,
+        betas=(0.9, 0.95),
+        eps=1e-8,
+        weight_decay=0.1,
+        weight_decay_groups_excluded=["norm", "embedding"],
+        wrapped_model=model,
+    )
+    sched = DummyLRScheduler(name="dummy", optimizer=opt)
+    return TrainStepBuilder(
+        model=model,
+        loss_fn=CLMCrossEntropyLoss(target_key="target_ids", prediction_key="logits"),
+        optimizer_spec=opt,
+        scheduler_spec=sched,
+        mesh_handle=mesh_handle,
+        gradient_acc_steps=acc,
+        grad_clip_norm=clip,
+    )
+
+
+def _batch(rng, acc, mb, seq, vocab=128):
+    tokens = rng.integers(0, vocab, size=(acc, mb, seq + 1))
+    return {
+        "samples": {"input_ids": tokens[:, :, :-1].astype(np.int32)},
+        "targets": {"target_ids": tokens[:, :, 1:].astype(np.int32)},
+    }
+
+
+def test_loss_decreases_dp():
+    mesh = get_device_mesh(device_type="cpu", data_parallel_shard_degree=8, world_size=8)
+    model = tiny_gpt2("pytorch_flash")
+    fns = _builder(model, mesh, clip=1.0).build(seed=0)
+    rng = np.random.default_rng(0)
+    batch = fns.put_batch(_batch(rng, 1, 8, 16))
+    state = fns.app_state_handle.state
+    losses = []
+    for _ in range(20):
+        state, metrics = fns.train_step(state, batch)
+        losses.append(float(metrics["loss"]))
+    assert losses[-1] < losses[0] - 1.0, f"loss did not decrease: {losses[0]} -> {losses[-1]}"
+    assert int(state.step) == 20
+    assert float(metrics["lr"]) == pytest.approx(1e-3)
+    assert float(metrics["grad_norm"]) > 0
+
+
+def test_dp_tp_equivalence():
+    """Same seed + same data must give identical losses under pure-DP vs DP x TP —
+    the TP-correctness oracle (reference test_tensor_parallelism.py:42-120)."""
+    model = tiny_gpt2("pytorch_flash")
+    mesh_dp = get_device_mesh(device_type="cpu", data_parallel_shard_degree=8, world_size=8)
+    mesh_tp = get_device_mesh(
+        device_type="cpu", data_parallel_shard_degree=4, tensor_parallel_degree=2, world_size=8
+    )
+    rng = np.random.default_rng(1)
+    raw = _batch(rng, 1, 8, 16)
+
+    losses = {}
+    for name, mesh in [("dp", mesh_dp), ("dp_tp", mesh_tp)]:
+        fns = _builder(model, mesh, clip=1.0).build(seed=0)
+        state = fns.app_state_handle.state
+        batch = fns.put_batch(raw)
+        ls = []
+        for _ in range(3):
+            state, metrics = fns.train_step(state, batch)
+            ls.append(float(metrics["loss"]))
+        losses[name] = ls
+    np.testing.assert_allclose(losses["dp"], losses["dp_tp"], rtol=2e-4, atol=2e-4)
+
+
+def test_grad_accumulation_equivalence():
+    """acc=2 over half-size microbatches == acc=1 over the full batch."""
+    model = tiny_gpt2("pytorch_flash")
+    mesh = get_device_mesh(device_type="cpu", data_parallel_shard_degree=4, world_size=8,
+                           tensor_parallel_degree=2)
+    rng = np.random.default_rng(2)
+    full = _batch(rng, 1, 8, 16)
+
+    halves = {
+        "samples": {"input_ids": full["samples"]["input_ids"].reshape(2, 4, 16)},
+        "targets": {"target_ids": full["targets"]["target_ids"].reshape(2, 4, 16)},
+    }
+
+    losses = {}
+    for name, acc, raw in [("full", 1, full), ("acc", 2, halves)]:
+        fns = _builder(model, mesh, acc=acc).build(seed=0)
+        state = fns.app_state_handle.state
+        state, metrics = fns.train_step(state, fns.put_batch(raw))
+        losses[name] = float(metrics["loss"])
+    assert losses["full"] == pytest.approx(losses["acc"], rel=2e-5)
+
+
+def test_params_actually_sharded():
+    mesh = get_device_mesh(device_type="cpu", data_parallel_shard_degree=8, world_size=8)
+    model = tiny_gpt2("pytorch_flash")
+    fns = _builder(model, mesh).build(seed=0)
+    params = fns.app_state_handle.state.params
+    leaves = jax.tree.leaves(params)
+    sharded = [x for x in leaves if len(x.sharding.device_set) == 8 and not x.sharding.is_fully_replicated]
+    assert len(sharded) > 0, "no parameter is sharded over the mesh"
+    # optimizer momentum must be sharded identically to params (FSDP optimizer-state sharding)
+    opt_leaves = jax.tree.leaves(fns.app_state_handle.state.opt_state)
+    big = [x for x in opt_leaves if hasattr(x, "sharding") and x.ndim >= 2]
+    assert big and any(not x.sharding.is_fully_replicated for x in big)
+
+
+def test_weight_decay_mask():
+    from modalities_tpu.optimizers.optimizer_factory import build_weight_decay_mask
+
+    model = tiny_gpt2()
+    params = model.init_params(jax.random.PRNGKey(0))
+    from flax.core import meta
+
+    params = meta.unbox(params)
+    mask = build_weight_decay_mask(params, model, ["norm", "embedding"])
+    flat = jax.tree_util.tree_flatten_with_path(mask)[0]
+    named = {"/".join(str(getattr(p, "key", p)) for p in path): v for path, v in flat}
+    assert any(("wte" in n and v is False) for n, v in named.items())
+    assert any(("norm" in n and v is False) for n, v in named.items())
+    assert any((("attn" in n or "W" in n) and v is True) for n, v in named.items())
+
+
+def test_unknown_weight_decay_group_raises():
+    from modalities_tpu.optimizers.optimizer_factory import build_weight_decay_mask
+    from flax.core import meta
+
+    model = tiny_gpt2()
+    params = meta.unbox(model.init_params(jax.random.PRNGKey(0)))
+    with pytest.raises(ValueError, match="not in model's weight_decay_groups"):
+        build_weight_decay_mask(params, model, ["bogus"])
