@@ -1,0 +1,310 @@
+"""CLI (reference: src/modalities/__main__.py — click command tree with run, warmstart,
+generate_text, data tools, benchmark sweeps, profiling, plus per-rank structured JSON
+error logs, :726-749)."""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import sys
+import traceback
+from datetime import datetime
+from pathlib import Path
+from typing import Optional
+
+import functools
+
+import click
+
+from modalities_tpu.api import FileExistencePolicy
+from modalities_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+def _exception_handling(func):
+    """Write a per-rank structured JSON error log next to stderr (reference :736)."""
+
+    @functools.wraps(func)
+    def wrapper(*args, **kwargs):
+        try:
+            return func(*args, **kwargs)
+        except Exception as e:
+            rank = int(os.environ.get("RANK", 0))
+            error_record = {
+                "rank": rank,
+                "hostname": socket.gethostname(),
+                "timestamp": datetime.now().isoformat(),
+                "error": repr(e),
+                "stacktrace": traceback.format_exc(),
+            }
+            error_dir = Path(os.environ.get("MODALITIES_TPU_ERROR_LOG_DIR", "."))
+            error_dir.mkdir(parents=True, exist_ok=True)
+            error_file = error_dir / f"error_rank_{rank}.json"
+            with open(error_file, "w") as f:
+                json.dump(error_record, f, indent=2)
+            logger.error("Run failed; error log written to %s", error_file)
+            raise
+
+    return wrapper
+
+
+@click.group()
+def main() -> None:
+    """modalities-tpu: TPU-native distributed LLM training."""
+
+
+@main.command(name="run")
+@click.option("--config_file_path", type=click.Path(exists=True, path_type=Path), required=True)
+@click.option("--experiments_root_path", type=click.Path(path_type=Path), default=None)
+@click.option("--test_comm", is_flag=True, default=False, help="Run a pre-flight collective check.")
+@_exception_handling
+def entry_point_run(config_file_path: Path, experiments_root_path: Optional[Path], test_comm: bool) -> None:
+    """Train from a YAML config."""
+    from modalities_tpu.main import Main
+    from modalities_tpu.running_env.env import TpuEnv
+    from modalities_tpu.utils.communication_test import run_communication_test
+
+    with TpuEnv():
+        if test_comm:
+            run_communication_test()
+        main_obj = Main(config_file_path, experiments_root_path=experiments_root_path)
+        components = main_obj.build_components()
+        main_obj.run(components)
+
+
+@main.command(name="warmstart")
+@click.option("--config_file_path", type=click.Path(exists=True, path_type=Path), required=True)
+@click.option(
+    "--last_checkpoint_info_file_path", type=click.Path(exists=True, path_type=Path), required=True
+)
+@click.option("--experiments_root_path", type=click.Path(path_type=Path), default=None)
+@_exception_handling
+def entry_point_warmstart(
+    config_file_path: Path, last_checkpoint_info_file_path: Path, experiments_root_path: Optional[Path]
+) -> None:
+    """Resume from the last checkpoint (reference __main__.py:112-163: injects the
+    ${warmstart_env:checkpoint_paths} resolver from last_checkpoint_info.json)."""
+    from modalities_tpu.main import Main
+    from modalities_tpu.running_env.env import TpuEnv
+
+    with open(last_checkpoint_info_file_path) as f:
+        info = json.load(f)
+
+    def warmstart_env(key: str):
+        if key in ("checkpoint_paths", "checkpoint_folder_path"):
+            return info["checkpoint_folder_path"]
+        raise ValueError(f"Unknown warmstart_env variable {key!r}")
+
+    with TpuEnv():
+        main_obj = Main(
+            config_file_path,
+            experiments_root_path=experiments_root_path,
+            additional_resolver_funs={"warmstart_env": warmstart_env},
+        )
+        components = main_obj.build_components()
+        main_obj.run(components)
+
+
+@main.command(name="generate_text")
+@click.option("--config_file_path", type=click.Path(exists=True, path_type=Path), required=True)
+@_exception_handling
+def entry_point_generate_text(config_file_path: Path) -> None:
+    from modalities_tpu.api import generate_text
+
+    generate_text(config_file_path)
+
+
+@main.command(name="convert_checkpoint_to_hf")
+@click.option("--config_file_path", type=click.Path(exists=True, path_type=Path), required=True)
+@click.option("--output_hf_checkpoint_dir", type=click.Path(path_type=Path), required=True)
+@_exception_handling
+def entry_point_convert_checkpoint(config_file_path: Path, output_hf_checkpoint_dir: Path) -> None:
+    """Export a checkpoint to HuggingFace format (reference convert_pytorch_to_hf_checkpoint)."""
+    from modalities_tpu.conversion.gpt2.convert_gpt2 import convert_gpt2
+
+    convert_gpt2(config_file_path, output_hf_checkpoint_dir)
+
+
+# --------------------------------------------------------------------------- data
+
+
+@main.group(name="data")
+def data() -> None:
+    """Data preprocessing tools."""
+
+
+def _policy(value: str) -> FileExistencePolicy:
+    return FileExistencePolicy(value)
+
+
+@data.command(name="create_raw_index")
+@click.argument("src_path", type=click.Path(exists=True, path_type=Path))
+@click.option("--index_path", type=click.Path(path_type=Path), default=None)
+@click.option("--file_existence_policy", type=click.Choice([p.value for p in FileExistencePolicy]), default="error")
+@_exception_handling
+def entry_point_create_raw_index(src_path: Path, index_path: Optional[Path], file_existence_policy: str) -> None:
+    from modalities_tpu.api import create_raw_data_index
+
+    create_raw_data_index(src_path, index_path, _policy(file_existence_policy))
+
+
+@data.command(name="pack_encoded_data")
+@click.argument("config_path", type=click.Path(exists=True, path_type=Path))
+@click.option("--file_existence_policy", type=click.Choice([p.value for p in FileExistencePolicy]), default="error")
+@_exception_handling
+def entry_point_pack_encoded_data(config_path: Path, file_existence_policy: str) -> None:
+    from modalities_tpu.api import pack_encoded_data
+    from modalities_tpu.config.yaml_interp import load_app_config_dict
+
+    config_dict = load_app_config_dict(config_path)
+    pack_encoded_data(config_dict, _policy(file_existence_policy))
+
+
+@data.command(name="merge_packed_data")
+@click.argument("src_paths", type=click.Path(exists=True, path_type=Path), nargs=-1)
+@click.argument("target_path", type=click.Path(path_type=Path))
+@_exception_handling
+def entry_point_merge_packed_data(src_paths: tuple[Path, ...], target_path: Path) -> None:
+    from modalities_tpu.api import merge_packed_data_files
+
+    merge_packed_data_files(list(src_paths), target_path)
+
+
+@data.command(name="shuffle_tokenized_data")
+@click.option("--input_data_path", type=click.Path(exists=True, path_type=Path), required=True)
+@click.option("--output_data_path", type=click.Path(path_type=Path), required=True)
+@click.option("--batch_size", type=int, default=1024)
+@click.option("--file_existence_policy", type=click.Choice([p.value for p in FileExistencePolicy]), default="error")
+@click.option("--seed", type=int, default=None)
+@_exception_handling
+def entry_point_shuffle_tokenized_data(
+    input_data_path: Path, output_data_path: Path, batch_size: int, file_existence_policy: str, seed: Optional[int]
+) -> None:
+    from modalities_tpu.api import shuffle_tokenized_data
+
+    shuffle_tokenized_data(input_data_path, output_data_path, batch_size, _policy(file_existence_policy), seed)
+
+
+@data.command(name="shuffle_jsonl_data")
+@click.option("--input_data_path", type=click.Path(exists=True, path_type=Path), required=True)
+@click.option("--output_data_path", type=click.Path(path_type=Path), required=True)
+@click.option("--file_existence_policy", type=click.Choice([p.value for p in FileExistencePolicy]), default="error")
+@click.option("--seed", type=int, default=None)
+@_exception_handling
+def entry_point_shuffle_jsonl_data(
+    input_data_path: Path, output_data_path: Path, file_existence_policy: str, seed: Optional[int]
+) -> None:
+    from modalities_tpu.api import shuffle_jsonl_data
+
+    shuffle_jsonl_data(input_data_path, output_data_path, _policy(file_existence_policy), seed)
+
+
+@data.command(name="create_shuffled_dataset_chunk")
+@click.option("--input_file_list_path", type=click.Path(exists=True, path_type=Path), required=True)
+@click.option("--output_chunk_file_path", type=click.Path(path_type=Path), required=True)
+@click.option("--chunk_id", type=int, required=True)
+@click.option("--num_chunks", type=int, required=True)
+@click.option("--file_existence_policy", type=click.Choice([p.value for p in FileExistencePolicy]), default="error")
+@click.option("--global_seed", type=int, default=None)
+@_exception_handling
+def entry_point_create_shuffled_dataset_chunk(
+    input_file_list_path: Path,
+    output_chunk_file_path: Path,
+    chunk_id: int,
+    num_chunks: int,
+    file_existence_policy: str,
+    global_seed: Optional[int],
+) -> None:
+    from modalities_tpu.api import create_shuffled_dataset_chunk
+
+    file_list = [Path(line.strip()) for line in input_file_list_path.read_text().splitlines() if line.strip()]
+    create_shuffled_dataset_chunk(
+        file_list, output_chunk_file_path, chunk_id, num_chunks, _policy(file_existence_policy), global_seed
+    )
+
+
+@data.command(name="create_shuffled_jsonl_chunk")
+@click.option("--input_file_list_path", type=click.Path(exists=True, path_type=Path), required=True)
+@click.option("--output_chunk_file_path", type=click.Path(path_type=Path), required=True)
+@click.option("--chunk_id", type=int, required=True)
+@click.option("--num_chunks", type=int, required=True)
+@click.option("--file_existence_policy", type=click.Choice([p.value for p in FileExistencePolicy]), default="error")
+@click.option("--global_seed", type=int, default=None)
+@_exception_handling
+def entry_point_create_shuffled_jsonl_chunk(
+    input_file_list_path: Path,
+    output_chunk_file_path: Path,
+    chunk_id: int,
+    num_chunks: int,
+    file_existence_policy: str,
+    global_seed: Optional[int],
+) -> None:
+    from modalities_tpu.api import create_shuffled_jsonl_dataset_chunk
+
+    file_list = [Path(line.strip()) for line in input_file_list_path.read_text().splitlines() if line.strip()]
+    create_shuffled_jsonl_dataset_chunk(
+        file_list, output_chunk_file_path, chunk_id, num_chunks, _policy(file_existence_policy), global_seed
+    )
+
+
+@data.command(name="prepare_instruction_tuning_data")
+@click.option("--config_file_path", type=click.Path(exists=True, path_type=Path), required=True)
+@_exception_handling
+def entry_point_prepare_instruction_tuning_data(config_file_path: Path) -> None:
+    from modalities_tpu.dataloader.instruction_tuning.create_instruction_tuning_data import (
+        create_instruction_tuning_data,
+    )
+
+    create_instruction_tuning_data(config_file_path)
+
+
+# ---------------------------------------------------------------------- benchmark
+
+
+@main.group(name="benchmark")
+def benchmark() -> None:
+    """Benchmark sweep tools."""
+
+
+@benchmark.command(name="prepare_sweep_configs")
+@click.option("--sweep_config_path", type=click.Path(exists=True, path_type=Path), required=True)
+@click.option("--output_dir", type=click.Path(path_type=Path), required=True)
+@_exception_handling
+def entry_point_prepare_sweep_configs(sweep_config_path: Path, output_dir: Path) -> None:
+    from modalities_tpu.utils.benchmarking.sweep_utils import SweepGenerator
+
+    SweepGenerator.generate_sweep_configs(sweep_config_path, output_dir)
+
+
+@benchmark.command(name="list_remaining_runs")
+@click.option("--sweep_dir", type=click.Path(exists=True, path_type=Path), required=True)
+@click.option("--skip_oom_configs", is_flag=True, default=False)
+@_exception_handling
+def entry_point_list_remaining_runs(sweep_dir: Path, skip_oom_configs: bool) -> None:
+    from modalities_tpu.utils.benchmarking.benchmarking_utils import get_updated_sweep_status
+
+    status = get_updated_sweep_status(sweep_dir, skip_oom_configs=skip_oom_configs)
+    click.echo(json.dumps(status, indent=2, default=str))
+
+
+# ------------------------------------------------------------------------ profile
+
+
+@main.group(name="profile")
+def profile() -> None:
+    """Profiling harness."""
+
+
+@profile.command(name="distributed")
+@click.option("--config_file_path", type=click.Path(exists=True, path_type=Path), required=True)
+@_exception_handling
+def entry_point_profile_distributed(config_file_path: Path) -> None:
+    from modalities_tpu.utils.profilers.modalities_profiler import ModalitiesProfilerStarter
+
+    ModalitiesProfilerStarter.run_distributed(config_file_path)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,214 @@
+"""Programmatic API: index creation, pack/merge/shuffle/chunk/filter of tokenized
+data, text generation (reference: src/modalities/api.py:31-402)."""
+
+from __future__ import annotations
+
+import shutil
+from enum import Enum
+from pathlib import Path
+from typing import Callable, Optional
+
+import numpy as np
+
+from modalities_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+class FileExistencePolicy(Enum):
+    SKIP = "skip"
+    ERROR = "error"
+    OVERRIDE = "override"
+
+
+def enforce_file_existence_policy(file_path: Path, policy: FileExistencePolicy) -> bool:
+    """True => caller should stop (skip)."""
+    file_path = Path(file_path)
+    if not file_path.exists():
+        return False
+    if policy == FileExistencePolicy.SKIP:
+        logger.warning("File already exists at %s. Skipping.", file_path)
+        return True
+    if policy == FileExistencePolicy.OVERRIDE:
+        logger.warning("File already exists at %s. Overriding it.", file_path)
+        if file_path.is_dir():
+            shutil.rmtree(file_path)
+        else:
+            file_path.unlink()
+        return False
+    raise ValueError(f"File already exists at {file_path}. Delete it or set file_existence_policy.")
+
+
+def create_raw_data_index(
+    src_path: Path,
+    index_path: Path,
+    file_existence_policy: FileExistencePolicy = FileExistencePolicy.ERROR,
+) -> None:
+    """Build the .idx sidecar of a JSONL (reference api.py:63)."""
+    from modalities_tpu.dataloader.create_index import IndexGenerator
+    from modalities_tpu.dataloader.large_file_lines_reader import LargeFileLinesReader
+
+    src_path = Path(src_path)
+    index_path = LargeFileLinesReader.default_index_path(src_path, index_path)
+    if enforce_file_existence_policy(index_path, file_existence_policy):
+        return
+    if not src_path.exists():
+        raise FileNotFoundError(f"Source file {src_path} does not exist.")
+    IndexGenerator(src_path).create_index(index_path)
+
+
+def pack_encoded_data(
+    config_dict: dict,
+    file_existence_policy: FileExistencePolicy = FileExistencePolicy.ERROR,
+) -> None:
+    """Tokenize + pack a JSONL into a .pbin via the component factory
+    (reference api.py:337)."""
+    from modalities_tpu.config.component_factory import ComponentFactory
+    from modalities_tpu.config.instantiation_models import PackedDatasetComponentsInstantiationModel
+    from modalities_tpu.dataloader.packed_data import PackedDataGenerator
+    from modalities_tpu.registry.components import COMPONENTS
+    from modalities_tpu.registry.registry import Registry
+
+    components = ComponentFactory(Registry(COMPONENTS)).build_components(
+        config_dict, PackedDatasetComponentsInstantiationModel
+    )
+    settings = components.settings
+    dst_path = Path(settings.dst_path) if settings.dst_path else None
+    if dst_path is not None and enforce_file_existence_policy(dst_path, file_existence_policy):
+        return
+    generator = PackedDataGenerator(
+        src_path=settings.src_path,
+        tokenizer=components.tokenizer,
+        eod_token=settings.eod_token,
+        number_of_processes=settings.num_cpus,
+        jq_pattern=settings.jq_pattern,
+        processing_batch_size=settings.processing_batch_size,
+        raw_samples_queue_size=settings.raw_samples_queue_size,
+        processed_samples_queue_size=settings.processed_samples_queue_size,
+        index_path=settings.index_path,
+    )
+    generator.run(dst_path)
+
+
+def merge_packed_data_files(src_paths: list[Path], target_path: Path) -> None:
+    """Merge pbin files (reference api.py:382)."""
+    from modalities_tpu.dataloader.packed_data import EmbeddedStreamData, join_embedded_stream_data
+
+    join_embedded_stream_data(
+        [EmbeddedStreamData(Path(p)) for p in src_paths], Path(target_path)
+    )
+
+
+def shuffle_tokenized_data(
+    input_data_path: Path,
+    output_data_path: Path,
+    batch_size: int = 1024,
+    file_existence_policy: FileExistencePolicy = FileExistencePolicy.ERROR,
+    seed: Optional[int] = None,
+) -> None:
+    from modalities_tpu.dataloader.preprocessing.shuffle_data import DataShuffler
+
+    if enforce_file_existence_policy(Path(output_data_path), file_existence_policy):
+        return
+    DataShuffler.shuffle_tokenized_data(
+        input_data_path=Path(input_data_path), output_data_path=Path(output_data_path),
+        batch_size=batch_size, seed=seed
+    )
+
+
+def shuffle_jsonl_data(
+    input_data_path: Path,
+    output_data_path: Path,
+    file_existence_policy: FileExistencePolicy = FileExistencePolicy.ERROR,
+    seed: Optional[int] = None,
+) -> None:
+    from modalities_tpu.dataloader.preprocessing.shuffle_data import DataShuffler
+
+    if enforce_file_existence_policy(Path(output_data_path), file_existence_policy):
+        return
+    DataShuffler.shuffle_jsonl_data(
+        input_data_path=Path(input_data_path), output_data_path=Path(output_data_path), seed=seed
+    )
+
+
+def create_shuffled_dataset_chunk(
+    file_path_list: list[Path],
+    output_chunk_file_path: Path,
+    chunk_id: int,
+    num_chunks: int,
+    file_existence_policy: FileExistencePolicy = FileExistencePolicy.ERROR,
+    global_seed: Optional[int] = None,
+) -> None:
+    """One shuffled chunk from many pbin files (reference api.py:213)."""
+    from modalities_tpu.dataloader.packed_data import EmbeddedStreamData, write_pbin_file
+    from modalities_tpu.dataloader.preprocessing.create_chunks import Chunking
+
+    if enforce_file_existence_policy(Path(output_chunk_file_path), file_existence_policy):
+        return
+    all_docs = []
+    token_size = None
+    for file_path in file_path_list:
+        esd = EmbeddedStreamData(Path(file_path))
+        if token_size is None:
+            token_size = esd.token_size_in_bytes
+        elif token_size != esd.token_size_in_bytes:
+            raise ValueError("Mixed token sizes across chunk inputs are not supported.")
+        all_docs.extend(Chunking.get_tokenized_file_chunk(esd, num_chunks, chunk_id))
+    if not all_docs:
+        raise ValueError(f"Chunk {chunk_id} contains no samples.")
+    rng = np.random.default_rng(None if global_seed is None else global_seed + chunk_id)
+    permutation = rng.permutation(len(all_docs))
+    write_pbin_file(Path(output_chunk_file_path), (all_docs[i] for i in permutation), token_size)
+
+
+def create_shuffled_jsonl_dataset_chunk(
+    file_path_list: list[Path],
+    output_chunk_file_path: Path,
+    chunk_id: int,
+    num_chunks: int,
+    file_existence_policy: FileExistencePolicy = FileExistencePolicy.ERROR,
+    global_seed: Optional[int] = None,
+) -> None:
+    from modalities_tpu.dataloader.large_file_lines_reader import LargeFileLinesReader
+    from modalities_tpu.dataloader.preprocessing.create_chunks import Chunking
+
+    if enforce_file_existence_policy(Path(output_chunk_file_path), file_existence_policy):
+        return
+    lines: list[str] = []
+    for file_path in file_path_list:
+        reader = LargeFileLinesReader(Path(file_path))
+        lines.extend(Chunking.get_jsonl_file_chunk(reader, num_chunks, chunk_id))
+    if not lines:
+        raise ValueError(f"Chunk {chunk_id} contains no samples.")
+    rng = np.random.default_rng(None if global_seed is None else global_seed + chunk_id)
+    shuffled = [lines[i] for i in rng.permutation(len(lines))]
+    Path(output_chunk_file_path).write_text("\n".join(shuffled) + "\n")
+
+
+def filter_tokenized_dataset(
+    input_data_path: Path,
+    output_data_path: Path,
+    filter_routine: Callable[[int], bool],
+    file_existence_policy: FileExistencePolicy = FileExistencePolicy.ERROR,
+) -> None:
+    """Keep documents whose index passes filter_routine (reference filter_packed_data.py:13)."""
+    from modalities_tpu.dataloader.packed_data import EmbeddedStreamData, write_pbin_file
+
+    if enforce_file_existence_policy(Path(output_data_path), file_existence_policy):
+        return
+    esd = EmbeddedStreamData(Path(input_data_path))
+    dtype = {1: "<u1", 2: "<u2", 4: "<u4"}[esd.token_size_in_bytes]
+
+    def docs():
+        for doc_id, (offset, length) in enumerate(esd.index_base):
+            if filter_routine(doc_id):
+                yield np.frombuffer(esd.data, dtype=dtype, count=length // esd.token_size_in_bytes, offset=offset)
+
+    write_pbin_file(Path(output_data_path), docs(), esd.token_size_in_bytes)
+
+
+def generate_text(config_file_path: Path) -> None:
+    """Config-driven interactive generation (reference api.py / inference/inference.py:18)."""
+    from modalities_tpu.inference.inference import generate_text as _generate_text
+
+    _generate_text(Path(config_file_path))

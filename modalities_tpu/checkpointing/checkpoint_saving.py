@@ -1,0 +1,29 @@
+"""Checkpoint saving facade: strategy decides, execution performs
+(reference: src/modalities/checkpointing/checkpoint_saving.py:8)."""
+
+from __future__ import annotations
+
+from modalities_tpu.checkpointing.checkpoint_saving_execution import CheckpointSavingExecutionABC
+from modalities_tpu.checkpointing.checkpoint_saving_strategies import CheckpointSavingStrategyIF
+from modalities_tpu.checkpointing.stateful.app_state import AppStateHandle
+from modalities_tpu.training.training_progress import TrainingProgress
+
+
+class CheckpointSaving:
+    def __init__(
+        self,
+        checkpoint_saving_strategy: CheckpointSavingStrategyIF,
+        checkpoint_saving_execution: CheckpointSavingExecutionABC,
+    ):
+        self.checkpoint_saving_strategy = checkpoint_saving_strategy
+        self.checkpoint_saving_execution = checkpoint_saving_execution
+
+    def save_checkpoint(self, training_progress: TrainingProgress, app_state_handle: AppStateHandle) -> None:
+        instruction = self.checkpoint_saving_strategy.get_checkpoint_instruction(
+            training_progress=training_progress
+        )
+        self.checkpoint_saving_execution.run_checkpoint_instruction(
+            checkpointing_instruction=instruction,
+            training_progress=training_progress,
+            app_state_handle=app_state_handle,
+        )

@@ -1,0 +1,28 @@
+"""Checkpoint execution ABC (reference: src/modalities/checkpointing/checkpoint_saving_execution.py:8)."""
+
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+
+from modalities_tpu.checkpointing.checkpoint_saving_instruction import CheckpointingInstruction
+from modalities_tpu.checkpointing.stateful.app_state import AppStateHandle
+from modalities_tpu.training.training_progress import TrainingProgress
+
+
+class CheckpointSavingExecutionABC(ABC):
+    @abstractmethod
+    def _save_checkpoint(self, app_state_handle: AppStateHandle, training_progress: TrainingProgress) -> None: ...
+
+    @abstractmethod
+    def _delete_checkpoint(self, training_progress: TrainingProgress) -> None: ...
+
+    def run_checkpoint_instruction(
+        self,
+        checkpointing_instruction: CheckpointingInstruction,
+        training_progress: TrainingProgress,
+        app_state_handle: AppStateHandle,
+    ) -> None:
+        if checkpointing_instruction.savable:
+            self._save_checkpoint(app_state_handle=app_state_handle, training_progress=training_progress)
+        for progress_to_delete in checkpointing_instruction.checkpoints_to_delete:
+            self._delete_checkpoint(training_progress=progress_to_delete)

@@ -1,0 +1,15 @@
+"""Checkpointing instruction (reference: src/modalities/checkpointing/checkpoint_saving_instruction.py)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from modalities_tpu.training.training_progress import TrainingProgress
+
+
+@dataclass
+class CheckpointingInstruction:
+    """What to save and which old checkpoints to delete."""
+
+    savable: bool = False
+    checkpoints_to_delete: list[TrainingProgress] = field(default_factory=list)

@@ -1,0 +1,47 @@
+"""Checkpoint retention strategies (reference: src/modalities/checkpointing/checkpoint_saving_strategies.py:36-121)."""
+
+from __future__ import annotations
+
+import copy
+from abc import ABC, abstractmethod
+
+from modalities_tpu.checkpointing.checkpoint_saving_instruction import CheckpointingInstruction
+from modalities_tpu.training.training_progress import TrainingProgress
+
+
+class CheckpointSavingStrategyIF(ABC):
+    @abstractmethod
+    def get_checkpoint_instruction(
+        self,
+        training_progress: TrainingProgress,
+    ) -> CheckpointingInstruction: ...
+
+
+class SaveKMostRecentCheckpointsStrategy(CheckpointSavingStrategyIF):
+    """Ring buffer of the k most recent checkpoints: k=-1 keeps all, k=0 keeps none,
+    k>0 keeps k (reference :36-88)."""
+
+    def __init__(self, k: int = -1):
+        self.k = k
+        self.saved_step_checkpoints: list[TrainingProgress] = []
+
+    def get_checkpoint_instruction(self, training_progress: TrainingProgress) -> CheckpointingInstruction:
+        checkpoints_to_delete: list[TrainingProgress] = []
+        savable = self.k != 0
+        if savable:
+            self.saved_step_checkpoints = [copy.deepcopy(training_progress)] + self.saved_step_checkpoints
+            if self.k > 0 and len(self.saved_step_checkpoints) > self.k:
+                checkpoints_to_delete = [self.saved_step_checkpoints[-1]]
+                self.saved_step_checkpoints = self.saved_step_checkpoints[: self.k]
+        return CheckpointingInstruction(savable=savable, checkpoints_to_delete=checkpoints_to_delete)
+
+
+class SaveEveryKStepsCheckpointingStrategy(CheckpointSavingStrategyIF):
+    """Save whenever the total seen steps is a multiple of k (reference :90-121)."""
+
+    def __init__(self, k: int):
+        self.k = k
+
+    def get_checkpoint_instruction(self, training_progress: TrainingProgress) -> CheckpointingInstruction:
+        savable = self.k > 0 and training_progress.num_seen_steps_total % self.k == 0
+        return CheckpointingInstruction(savable=savable, checkpoints_to_delete=[])

@@ -1,0 +1,58 @@
+"""Orbax sharded checkpoint loading with topology-change resharding
+(reference: src/modalities/checkpointing/fsdp/fsdp_checkpoint_loading.py:103).
+
+The torch DCP loader restores into an already-sharded AppState in place. Here the
+restore target is the *abstract* AppState (shapes + dtypes + NamedShardings of the
+CURRENT mesh), so resuming on a different topology — the reference's strongest
+warmstart guarantee (tests/end2end_tests/test_fsdp2_warmstart_pp_tp.py) — is native:
+Orbax reads each shard and lays it out for the new mesh.
+"""
+
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+from pathlib import Path
+
+import jax
+
+from modalities_tpu.checkpointing.stateful.app_state import AppState, AppStateHandle
+from modalities_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+class CheckpointLoadingIF(ABC):
+    @abstractmethod
+    def load_app_state(self, app_state_handle: AppStateHandle, checkpoint_dir_path: Path) -> AppState: ...
+
+
+class OrbaxCheckpointLoading(CheckpointLoadingIF):
+    def __init__(self, global_rank: int = 0):
+        self.global_rank = global_rank
+
+    def load_app_state(self, app_state_handle: AppStateHandle, checkpoint_dir_path: Path) -> AppState:
+        import orbax.checkpoint as ocp
+
+        checkpoint_dir_path = Path(checkpoint_dir_path)
+        if not checkpoint_dir_path.exists():
+            raise FileNotFoundError(f"Checkpoint directory {checkpoint_dir_path} does not exist.")
+
+        state = app_state_handle.state
+        shardings = app_state_handle.state_shardings
+
+        def make_abstract(x, s):
+            return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=s)
+
+        if shardings is not None:
+            abstract = jax.tree.map(make_abstract, state, shardings)
+        else:
+            abstract = jax.tree.map(lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), state)
+
+        logger.info("Restoring sharded checkpoint from %s ...", checkpoint_dir_path)
+        restored: AppState = ocp.StandardCheckpointer().restore(
+            checkpoint_dir_path.absolute(), abstract
+        )
+        app_state_handle.mark_loaded()  # only after a successful restore
+        app_state_handle.state = restored
+        logger.info("Checkpoint restored at step %d.", int(restored.step))
+        return restored

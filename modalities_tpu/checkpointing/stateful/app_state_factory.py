@@ -1,0 +1,37 @@
+"""AppState specs: fresh vs checkpoint-loaded (reference: src/modalities/checkpointing/stateful/app_state_factory.py:13).
+
+A spec bundles (model, optimizer, scheduler, optional checkpoint path); `Main` builds
+the jitted step + sharded AppState from it and then applies the restore — the JAX
+counterpart of raw vs dcp app_state variants.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Optional
+
+from modalities_tpu.models.model import NNModel
+
+
+@dataclass
+class AppStateSpec:
+    model: NNModel
+    optimizer: object  # OptimizerSpec
+    lr_scheduler: Optional[object] = None  # SchedulerSpec
+    checkpoint_dir_path: Optional[Path] = None  # set => restore after build
+    checkpoint_loading: Optional[object] = None
+
+
+class AppStateFactory:
+    @staticmethod
+    def get_raw_app_state(model: NNModel, optimizer, lr_scheduler=None) -> AppStateSpec:
+        return AppStateSpec(model=model, optimizer=optimizer, lr_scheduler=lr_scheduler)
+
+    @staticmethod
+    def get_dcp_checkpointed_app_state_(
+        raw_app_state: AppStateSpec, checkpoint_dir_path: Path, checkpoint_loading=None
+    ) -> AppStateSpec:
+        raw_app_state.checkpoint_dir_path = Path(checkpoint_dir_path)
+        raw_app_state.checkpoint_loading = checkpoint_loading
+        return raw_app_state

@@ -27,6 +27,10 @@ logger = get_logger(__name__)
 BaseModelChild = TypeVar("BaseModelChild", bound=BaseModel)
 
 
+class _EmptyConfig(BaseModel):
+    """Stand-in for components registered without a config class."""
+
+
 class ComponentFactory:
     def __init__(self, registry: Registry) -> None:
         self.registry = registry
@@ -124,7 +128,7 @@ class ComponentFactory:
                 raise ValueError(
                     f"Component `{component_key}.{variant_key}` takes no config, got: {config_dict}"
                 )
-            return BaseModel()
+            return _EmptyConfig()
         self._assert_valid_config_keys(component_key, variant_key, config_dict, config_type)
         return config_type.model_validate(config_dict)
 

@@ -1,0 +1,473 @@
+"""Pydantic config schemas for every registry component variant
+(reference: src/modalities/config/config.py — ~60 models).
+
+Field names mirror the reference so its YAML configs translate directly; torch-only
+knobs (foreach/fused, block_names, ...) are accepted and ignored by the TPU
+implementations, documented per-field.
+"""
+
+from __future__ import annotations
+
+from enum import Enum
+from pathlib import Path
+from typing import Annotated, Any, Literal, Optional
+
+from pydantic import BaseModel, Field
+
+from modalities_tpu.config.pydantic_if_types import (
+    PydanticAppStateType,
+    PydanticBatchSamplerIFType,
+    PydanticCheckpointLoadingIFType,
+    PydanticCheckpointSavingExecutionIFType,
+    PydanticCheckpointSavingStrategyIFType,
+    PydanticCollateFnIFType,
+    PydanticDatasetIFType,
+    PydanticDeviceMeshIFType,
+    PydanticLLMDataLoaderIFType,
+    PydanticModelIFType,
+    PydanticModelInitializationIFType,
+    PydanticOptimizerIFType,
+    PydanticSamplerIFType,
+    PydanticTokenizerIFType,
+)
+
+# ---------------------------------------------------------------------------- misc
+
+
+class ProcessGroupBackendType(str, Enum):
+    nccl = "nccl"  # accepted for config compat; TPU uses XLA collectives
+    xla = "xla"
+
+
+class PassType(str, Enum):
+    BY_REFERENCE = "BY_REFERENCE"
+    BY_VALUE = "BY_VALUE"
+
+
+class ReferenceConfig(BaseModel):
+    instance_key: str
+    pass_type: PassType
+
+
+class MixedPrecisionSettings(str, Enum):
+    """Reference env_utils.py:72-88 mixed-precision enums; on TPU these select the
+    param/compute dtype pair for the train step."""
+
+    BF_16 = "BF_16"
+    BF_16_WORKING = "BF_16_WORKING"
+    FP_16 = "FP_16"
+    FP_32 = "FP_32"
+    MIXED_PRECISION_MEGATRON = "MIXED_PRECISION_MEGATRON"
+
+
+# ---------------------------------------------------------------------- device mesh
+
+
+class DeviceMeshConfig(BaseModel):
+    device_type: str = "tpu"
+    data_parallel_replicate_degree: Annotated[int, Field(strict=True, ge=-1)] = 1
+    data_parallel_shard_degree: Annotated[int, Field(strict=True, ge=-1)] = -1
+    tensor_parallel_degree: Annotated[int, Field(strict=True, gt=0)] = 1
+    pipeline_parallel_degree: Annotated[int, Field(strict=True, gt=0)] = 1
+    context_parallel_degree: Annotated[int, Field(strict=True, gt=0)] = 1
+    enable_loss_parallel: Optional[bool] = False
+    world_size: Annotated[int, Field(strict=True, gt=0)]
+
+
+# -------------------------------------------------------------------------- models
+
+
+class FSDP2WrappedModelConfig(BaseModel):
+    model: PydanticModelIFType
+    device_mesh: Optional[PydanticDeviceMeshIFType] = None
+    mixed_precision_settings: Optional[dict | str] = None
+    block_names: Optional[list[str]] = None  # torch knob; sharding is rule-based here
+    layers_per_fsdp_unit: Optional[int] = None  # torch knob
+    reshard_after_forward: bool = True  # torch knob; XLA schedules resharding
+
+
+class CompiledModelConfig(BaseModel):
+    model: PydanticModelIFType
+    block_names: Optional[list[str]] = None
+    fullgraph: Optional[bool] = None
+    debug: Optional[bool] = None
+
+
+class ActivationCheckpointedModelConfig(BaseModel):
+    model: PydanticModelIFType
+    activation_checkpointing_variant: str = "full_activation_checkpointing"
+    layers_fqn: Optional[str] = None
+    ac_freq: Annotated[int, Field(strict=True, ge=1)] = 1
+    save_list: Optional[list[str]] = None
+    device_mesh: Optional[PydanticDeviceMeshIFType] = None
+
+
+class WeightInitializedModelConfig(BaseModel):
+    model: PydanticModelIFType
+    model_initializer: PydanticModelInitializationIFType
+
+
+class GPT2TPModelConfig(BaseModel):
+    """TP variant: under GSPMD the TP plan is the sharding rule set; this variant just
+    asserts the mesh has a tp axis (reference model_factory.py:657-766)."""
+
+    model: PydanticModelIFType
+    device_mesh: PydanticDeviceMeshIFType
+
+
+class DebuggingEnrichedModelConfig(BaseModel):
+    model: PydanticModelIFType
+    logging_dir_path: Optional[Path] = None
+    tracked_ranks: Optional[list[int]] = None
+    log_interval_steps: Annotated[int, Field(strict=True, ge=1)] = 1
+
+
+class HuggingFacePretrainedModelConfig(BaseModel):
+    model_type: str
+    model_name: str
+    sample_key: str
+    prediction_key: str
+    huggingface_prediction_subscription_key: Optional[str] = None
+    kwargs: Optional[dict] = None
+
+
+# ----------------------------------------------------------------- initialization
+
+
+class ComposedInitializationConfig(BaseModel):
+    model_type: str
+    weight_init_type: str
+    mean: float = 0.0
+    std: float | str = 0.02
+    num_layers: Optional[int] = None
+    hidden_dim: Optional[int] = None
+
+
+# ---------------------------------------------------------------------- optimizers
+
+
+class AdamOptimizerConfig(BaseModel):
+    lr: float
+    wrapped_model: PydanticModelIFType
+    betas: tuple[float, float]
+    eps: float
+    weight_decay: float
+    weight_decay_groups_excluded: list[str]
+    foreach: Optional[bool] = None  # torch knob
+    fused: Optional[bool] = None  # torch knob
+
+
+class AdamWOptimizerConfig(AdamOptimizerConfig):
+    pass
+
+
+# ---------------------------------------------------------------------- schedulers
+
+
+class DummyLRSchedulerConfig(BaseModel):
+    optimizer: PydanticOptimizerIFType
+
+
+class StepLRSchedulerConfig(BaseModel):
+    optimizer: PydanticOptimizerIFType
+    step_size: Annotated[int, Field(strict=True, gt=0)]
+    gamma: Annotated[float, Field(ge=0.0)]
+    last_epoch: Annotated[int, Field(strict=True, ge=-1)] = -1
+
+
+class ConstantLRSchedulerConfig(BaseModel):
+    optimizer: PydanticOptimizerIFType
+    factor: Annotated[float, Field(ge=0.0, le=1.0)]
+    total_iters: Annotated[int, Field(strict=True, gt=0)]
+    last_epoch: Annotated[int, Field(strict=True, ge=-1)] = -1
+
+
+class LinearLRSchedulerConfig(BaseModel):
+    optimizer: PydanticOptimizerIFType
+    start_factor: Annotated[float, Field(gt=0.0, le=1.0)]
+    end_factor: Annotated[float, Field(ge=0.0, le=1.0)]
+    total_iters: Annotated[int, Field(strict=True, gt=0)]
+    last_epoch: Annotated[int, Field(strict=True, ge=-1)] = -1
+
+
+class OneCycleLRSchedulerConfig(BaseModel):
+    optimizer: PydanticOptimizerIFType
+    max_lr: float | list[float]
+    total_steps: Optional[int] = None
+    epochs: Optional[int] = None
+    steps_per_epoch: Optional[int] = None
+    pct_start: Annotated[float, Field(gt=0.0, le=1.0)] = 0.3
+    anneal_strategy: str = "cos"
+    cycle_momentum: bool = False
+    base_momentum: float | list[float] = 0.85
+    max_momentum: float | list[float] = 0.95
+    div_factor: float = 25.0
+    final_div_factor: float = 1e4
+    last_epoch: Annotated[int, Field(strict=True, ge=-1)] = -1
+
+
+class CosineAnnealingLRSchedulerConfig(BaseModel):
+    optimizer: PydanticOptimizerIFType
+    t_max: Annotated[int, Field(strict=True, gt=0)]
+    eta_min: Annotated[float, Field(ge=0.0)]
+    last_epoch: Annotated[int, Field(strict=True, ge=-1)] = -1
+
+
+class LinearWarmupCosineAnnealingLRSchedulerConfig(BaseModel):
+    optimizer: PydanticOptimizerIFType
+    warmup_steps: Annotated[int, Field(strict=True, gt=0)]
+    total_steps: Annotated[int, Field(strict=True, gt=0)]
+    initial_lr: Annotated[float, Field(ge=0.0)]
+    final_lr: Annotated[float, Field(ge=0.0)]
+    max_lr: Annotated[float, Field(ge=0.0)]
+    last_epoch: Annotated[int, Field(strict=True, ge=-1)] = -1
+
+
+# -------------------------------------------------------------------------- losses
+
+
+class CLMCrossEntropyLossConfig(BaseModel):
+    target_key: str
+    prediction_key: str
+    tag: str = "CLMCrossEntropyLoss"
+    ignore_index: int = -100
+
+
+class NCELossConfig(BaseModel):
+    prediction_key1: str
+    prediction_key2: str
+    is_asymmetric: bool = True
+    temperature: float = 1.0
+    tag: str = "NCELoss"
+
+
+# ------------------------------------------------------------------------ datasets
+
+
+class MemMapDatasetConfig(BaseModel):
+    raw_data_path: Path
+    tokenizer: PydanticTokenizerIFType
+    sample_key: str
+    index_path: Optional[Path] = None
+    jq_pattern: str = ".text"
+
+
+class PackedMemMapDatasetContinuousConfig(BaseModel):
+    raw_data_path: Path
+    sequence_length: Annotated[int, Field(strict=True, gt=1)]
+    sample_key: str
+    reuse_last_target: bool = True
+
+
+class PackedMemMapDatasetMegatronConfig(BaseModel):
+    raw_data_path: Path
+    sequence_length: Annotated[int, Field(strict=True, gt=1)]
+    sample_key: str
+
+
+class CombinedDatasetConfig(BaseModel):
+    datasets: list[PydanticDatasetIFType]
+
+
+# ------------------------------------------------------------------------ samplers
+
+
+class ResumableDistributedSamplerConfig(BaseModel):
+    dataset: PydanticDatasetIFType
+    rank: Annotated[int, Field(strict=True, ge=0)]
+    num_replicas: Annotated[int, Field(strict=True, ge=1)]
+    epoch: Annotated[int, Field(strict=True, ge=0)] = 0
+    shuffle: Optional[bool] = False
+    seed: Optional[int] = 0
+    drop_last: Optional[bool] = False
+    skip_num_global_samples: Annotated[int, Field(strict=True, ge=0)] = 0
+
+
+class ResumableDistributedMultiDimSamplerConfig(BaseModel):
+    dataset: PydanticDatasetIFType
+    device_mesh: PydanticDeviceMeshIFType
+    data_parallel_key: str = "dp_shard"
+    epoch: Annotated[int, Field(strict=True, ge=0)] = 0
+    shuffle: Optional[bool] = False
+    seed: Optional[int] = 0
+    drop_last: Literal[True] = True
+    skip_num_global_samples: Annotated[int, Field(strict=True, ge=0)] = 0
+
+
+class SequentialSamplerConfig(BaseModel):
+    dataset: PydanticDatasetIFType
+
+
+class RandomSamplerConfig(BaseModel):
+    dataset: PydanticDatasetIFType
+    seed: int = 0
+
+
+class BatchSamplerConfig(BaseModel):
+    sampler: PydanticSamplerIFType
+    batch_size: Annotated[int, Field(strict=True, gt=0)]  # per-dp-rank micro batch size
+    drop_last: Literal[True] = True
+    device_mesh: Optional[PydanticDeviceMeshIFType] = None  # scales to the process batch
+
+
+# ----------------------------------------------------------------------- collators
+
+
+class GPT2LLMCollateFnConfig(BaseModel):
+    sample_key: str
+    target_key: str
+
+
+class LossMaskingCollateFnWrapperConfig(BaseModel):
+    wrapped_collate_fn: PydanticCollateFnIFType
+    target_keys_to_mask: list[str]
+    loss_ignore_index: int
+    mask_tokens: dict
+    tokenizer: PydanticTokenizerIFType
+
+
+# ---------------------------------------------------------------------- dataloader
+
+
+class LLMDataLoaderConfig(BaseModel):
+    dataloader_tag: str
+    dataset: PydanticDatasetIFType
+    batch_sampler: PydanticBatchSamplerIFType
+    collate_fn: Optional[PydanticCollateFnIFType] = None
+    num_prefetch_batches: int = 2
+    # torch DataLoader knobs accepted + ignored (host prefetch thread instead)
+    num_workers: Optional[int] = None
+    pin_memory: Optional[bool] = None
+
+
+class RepeatingDataLoaderConfig(BaseModel):
+    dataloader: PydanticLLMDataLoaderIFType
+    reshuffle_after_epoch: Optional[bool] = False
+
+
+# ---------------------------------------------------------------------- tokenizers
+
+
+class PreTrainedHFTokenizerConfig(BaseModel):
+    pretrained_model_name_or_path: str
+    truncation: Optional[bool] = False
+    padding: Optional[bool | str] = False
+    max_length: Optional[int] = None
+    special_tokens: Optional[dict[str, str]] = None
+
+
+class PreTrainedSPTokenizerConfig(BaseModel):
+    tokenizer_model_file: str
+
+
+# ------------------------------------------------------------------- checkpointing
+
+
+class SaveEveryKStepsCheckpointingStrategyConfig(BaseModel):
+    k: Annotated[int, Field(strict=True, gt=0)]
+
+
+class SaveKMostRecentCheckpointsStrategyConfig(BaseModel):
+    k: Annotated[int, Field(strict=True, ge=-1)]
+
+
+class OrbaxCheckpointSavingConfig(BaseModel):
+    checkpoint_path: Path
+    experiment_id: str
+    global_rank: Annotated[int, Field(strict=True, ge=0)] = 0
+    use_async: bool = False
+
+
+class CheckpointSavingConfig(BaseModel):
+    checkpoint_saving_strategy: PydanticCheckpointSavingStrategyIFType
+    checkpoint_saving_execution: PydanticCheckpointSavingExecutionIFType
+
+
+class OrbaxCheckpointLoadingConfig(BaseModel):
+    global_rank: Annotated[int, Field(strict=True, ge=0)] = 0
+
+
+class RawAppStateConfig(BaseModel):
+    model: PydanticModelIFType
+    optimizer: PydanticOptimizerIFType
+    lr_scheduler: Optional[Any] = None
+
+
+class DCPAppStateConfig(BaseModel):
+    raw_app_state: PydanticAppStateType
+    checkpoint_dir_path: Path
+    checkpoint_loading: Optional[PydanticCheckpointLoadingIFType] = None
+
+
+# ----------------------------------------------------------------- grad clipping
+
+
+class GradientClipperConfig(BaseModel):
+    max_norm: float
+    norm_type: str = "p2_norm"
+    error_if_nonfinite: bool = False
+
+
+class LoggingOnlyGradientClipperConfig(BaseModel):
+    norm_type: str = "p2_norm"
+
+
+# ------------------------------------------------------------------- subscribers
+
+
+class RichProgressSubscriberConfig(BaseModel):
+    eval_splits_num_steps: Optional[dict[str, int]] = None
+    train_split_num_steps: Optional[dict[str, tuple[int, int]]] = None
+
+
+class RichResultSubscriberConfig(BaseModel):
+    num_ranks: int = 1
+    global_rank: int = 0
+
+
+class EvaluationResultToDiscSubscriberConfig(BaseModel):
+    output_folder_path: Path
+
+
+class WandBEvaluationResultSubscriberConfig(BaseModel):
+    project: str
+    experiment_id: str
+    mode: str = "OFFLINE"
+    experiment_path: Optional[Path] = None
+    config_file_path: Optional[Path] = None
+
+
+# -------------------------------------------------------------------------- MFU
+
+
+class GPT2MFUCalculatorConfig(BaseModel):
+    n_layer: Annotated[int, Field(strict=True, gt=0)]
+    sequence_length: Annotated[int, Field(strict=True, gt=0)]
+    n_embd: Annotated[int, Field(strict=True, gt=0)]
+    world_size: Annotated[int, Field(strict=True, gt=0)]
+    num_parameters: Optional[int] = None
+    model_parts: Optional[Any] = Field(default=None, validation_alias="wrapped_model")
+    device_mesh: Optional[PydanticDeviceMeshIFType] = None
+
+    model_config = {"populate_by_name": True, "protected_namespaces": ()}
+
+
+# ---------------------------------------------------------------------- profilers
+
+
+class SteppableKernelProfilerConfig(BaseModel):
+    output_folder_path: Path
+    wait_steps: int = 1
+    warmup_steps: int = 1
+    active_steps: int = 3
+    repeat: int = 1
+    with_python_stack: bool = False
+
+
+class SteppableMemoryProfilerConfig(BaseModel):
+    output_folder_path: Path
+    max_steps: int = 0
+
+
+class SteppableCombinedProfilerConfig(BaseModel):
+    profilers: list[Any]

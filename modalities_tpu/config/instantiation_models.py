@@ -1,0 +1,215 @@
+"""Top-level instantiation models (reference: src/modalities/config/instantiation_models.py).
+
+Same settings tree (experiment_id, referencing_keys, env, paths, intervals,
+consistency_enforcement, step_profile, training_target, training_progress,
+warmstart_checkpoint_paths) and the same cross-field validators: tokens-per-step
+consistency (:111-131), last-step logged/evaluated/checkpointed (:133-179), enough
+dataset tokens (:197-207). `cuda_env` is accepted as an alias of `dist_env` so
+reference YAMLs load unchanged.
+"""
+
+from __future__ import annotations
+
+import logging
+from pathlib import Path
+from typing import Annotated, Any, Optional
+
+from pydantic import BaseModel, Field, model_validator
+
+from modalities_tpu.config.pydantic_if_types import (
+    PydanticAppStateType,
+    PydanticCheckpointSavingIFType,
+    PydanticDatasetIFType,
+    PydanticDeviceMeshIFType,
+    PydanticGradientClipperIFType,
+    PydanticLLMDataLoaderIFType,
+    PydanticLossIFType,
+    PydanticMessageSubscriberIFType,
+    PydanticMFUCalculatorIFType,
+    PydanticPipelineIFType,
+    PydanticProfilerIFType,
+    PydanticTokenizerIFType,
+)
+from modalities_tpu.utils.logging import warn_rank_0
+
+logger = logging.getLogger(__name__)
+
+
+class DistEnvSettings(BaseModel):
+    local_rank: Annotated[int, Field(strict=True, ge=0)] = 0
+    world_size: Annotated[int, Field(strict=True, ge=1)] = 1
+    global_rank: Annotated[int, Field(strict=True, ge=0)] = 0
+
+
+class StepProfile(BaseModel):
+    gradient_accumulation_steps: Annotated[int, Field(strict=True, ge=1)]
+    local_train_micro_batch_size: Annotated[int, Field(strict=True, ge=1)]
+    sequence_length: Annotated[int, Field(strict=True, ge=1)]
+    dp_degree: Annotated[int, Field(strict=True, ge=1)]
+
+
+class ConsistencyEnforcement(BaseModel):
+    enforce_tokens_per_step_consistency: bool = True
+    enforce_last_step_logged: bool = True
+    enforce_last_step_evaluated: bool = True
+    enforce_last_step_checkpointed: bool = True
+    enforce_enough_tokens_in_dataset: bool = True
+
+
+class Intervals(BaseModel):
+    training_log_interval_in_steps: Annotated[int, Field(strict=True, ge=1)]
+    checkpointing_interval_in_steps: Annotated[int, Field(strict=True, ge=1)]
+    evaluation_interval_in_steps: Annotated[int, Field(strict=True, ge=1)]
+
+
+class TrainingTarget(BaseModel):
+    num_target_tokens: Annotated[int, Field(strict=True, ge=1)]
+    num_target_steps: Annotated[int, Field(strict=True, ge=1)]
+
+
+class TrainingProgressSettings(BaseModel):
+    global_num_seen_tokens: Annotated[int, Field(strict=True, ge=0)]
+    num_seen_steps: Annotated[int, Field(strict=True, ge=0)]
+    num_seen_samples: Annotated[int, Field(strict=True, ge=0)]
+    last_step: Annotated[int, Field(strict=True, ge=-1)]
+
+
+class Paths(BaseModel):
+    model_config = {"extra": "allow"}
+
+    experiments_root_path: Path
+
+    @model_validator(mode="before")
+    @classmethod
+    def _coerce_paths(cls, values: dict[str, Any]) -> dict[str, Any]:
+        for name, value in values.items():
+            if isinstance(value, str):
+                values[name] = Path(value)
+            elif not isinstance(value, Path):
+                raise TypeError(f"Field '{name}' must be of type Path, but got {type(value)} instead.")
+        return values
+
+
+class WarmstartCheckpointPaths(BaseModel):
+    checkpoint_folder_path: Path
+
+
+class TrainingSettings(BaseModel):
+    experiment_id: str
+    config_file_path: Path
+    referencing_keys: dict[str, str]
+    dist_env: DistEnvSettings = Field(
+        default_factory=DistEnvSettings, validation_alias="cuda_env"
+    )
+    paths: Paths
+    intervals: Intervals
+    consistency_enforcement: ConsistencyEnforcement
+    step_profile: StepProfile
+    training_target: TrainingTarget
+    training_progress: TrainingProgressSettings
+    warmstart_checkpoint_paths: Optional[WarmstartCheckpointPaths] = None
+    debugging: Optional[Any] = None
+
+    model_config = {"populate_by_name": True}
+
+    @model_validator(mode="after")
+    def _check_tokens_per_step_consistency(self) -> "TrainingSettings":
+        remaining_steps = self.training_target.num_target_steps - self.training_progress.num_seen_steps
+        if remaining_steps <= 0:
+            raise ValueError("num_target_steps must exceed num_seen_steps")
+        required = (
+            self.training_target.num_target_tokens - self.training_progress.global_num_seen_tokens
+        ) / remaining_steps
+        actual = (
+            self.step_profile.local_train_micro_batch_size
+            * self.step_profile.sequence_length
+            * self.step_profile.gradient_accumulation_steps
+            * self.step_profile.dp_degree
+        )
+        if required != actual:
+            msg = (
+                f"Required number of tokens per step is ({required}) which does not match "
+                f"the number of tokens per step ({actual}) from the step profile."
+            )
+            if self.consistency_enforcement.enforce_tokens_per_step_consistency:
+                raise ValueError(msg)
+            warn_rank_0(msg)
+        return self
+
+    def _check_interval(self, interval: int, what: str, enforce: bool) -> None:
+        remaining_steps = self.training_target.num_target_steps - self.training_progress.num_seen_steps
+        if remaining_steps % interval != 0:
+            msg = (
+                f"Last step will not be {what}. Since remaining_steps ({remaining_steps}) "
+                f"is not a multiple of the {what} interval ({interval})"
+            )
+            if enforce:
+                raise ValueError(msg)
+            warn_rank_0(msg)
+
+    @model_validator(mode="after")
+    def _check_last_step_intervals(self) -> "TrainingSettings":
+        c = self.consistency_enforcement
+        self._check_interval(self.intervals.training_log_interval_in_steps, "logged", c.enforce_last_step_logged)
+        self._check_interval(self.intervals.evaluation_interval_in_steps, "evaluated", c.enforce_last_step_evaluated)
+        self._check_interval(
+            self.intervals.checkpointing_interval_in_steps, "checkpointed", c.enforce_last_step_checkpointed
+        )
+        return self
+
+
+class TrainingComponentsInstantiationModel(BaseModel):
+    settings: TrainingSettings
+    app_state: PydanticAppStateType
+    loss_fn: PydanticLossIFType
+    train_dataset: PydanticDatasetIFType
+    train_dataloader: PydanticLLMDataLoaderIFType
+    eval_dataloaders: list[PydanticLLMDataLoaderIFType]
+    progress_subscriber: PydanticMessageSubscriberIFType
+    evaluation_subscriber: PydanticMessageSubscriberIFType
+    checkpoint_saving: PydanticCheckpointSavingIFType
+    gradient_clipper: PydanticGradientClipperIFType
+    profiler: Optional[PydanticProfilerIFType] = None
+    mfu_calculator: Optional[PydanticMFUCalculatorIFType] = None
+    scheduled_pipeline: Optional[PydanticPipelineIFType] = None
+    device_mesh: Optional[PydanticDeviceMeshIFType] = None
+    model_raw: Optional[Any] = None
+
+    @model_validator(mode="after")
+    def _check_token_amount_in_dataset(self) -> "TrainingComponentsInstantiationModel":
+        dataset_tokens = len(self.train_dataset) * self.settings.step_profile.sequence_length
+        expected = self.settings.training_target.num_target_tokens
+        if dataset_tokens < expected:
+            msg = f"Not enough tokens in dataset. Actual: {dataset_tokens}, Expected: >={expected}"
+            if self.settings.consistency_enforcement.enforce_enough_tokens_in_dataset:
+                raise ValueError(msg)
+            logger.warning(msg)
+        return self
+
+
+class PackedDatasetComponentsInstantiationModel(BaseModel):
+    class PackedDatasetSettings(BaseModel):
+        src_path: Path
+        dst_path: Optional[Path] = None
+        index_path: Optional[Path] = None
+        jq_pattern: str
+        num_cpus: Annotated[int, Field(strict=True, ge=1)]
+        eod_token: str
+        processing_batch_size: Annotated[int, Field(strict=True, ge=1)]
+        raw_samples_queue_size: Annotated[int, Field(strict=True, ge=1)]
+        processed_samples_queue_size: Annotated[int, Field(strict=True, ge=1)]
+
+    tokenizer: PydanticTokenizerIFType
+    settings: PackedDatasetSettings
+
+
+class TextGenerationSettings(BaseModel):
+    model_path: Path
+    sequence_length: int
+    device: str = "tpu"
+    referencing_keys: dict[str, str] = {}
+
+
+class TextGenerationInstantiationModel(BaseModel):
+    text_inference_component: Any
+    settings: TextGenerationSettings

@@ -65,16 +65,16 @@ PydanticBatchSamplerIFType = _lazy("modalities_tpu.dataloader.samplers", "BatchS
 PydanticCollateFnIFType = _lazy("modalities_tpu.dataloader.collate_fns.collate_if", "CollateFnIF")
 PydanticLLMDataLoaderIFType = _lazy("modalities_tpu.dataloader.dataloader", "LLMDataLoader")
 PydanticTokenizerIFType = _lazy("modalities_tpu.tokenization.tokenizer_wrapper", "TokenizerWrapper")
-PydanticAppStateType = _lazy("modalities_tpu.checkpointing.stateful.app_state", "AppState")
+PydanticAppStateType = _lazy("modalities_tpu.checkpointing.stateful.app_state_factory", "AppStateSpec")
 PydanticCheckpointSavingIFType = _lazy("modalities_tpu.checkpointing.checkpoint_saving", "CheckpointSaving")
 PydanticCheckpointSavingStrategyIFType = _lazy(
     "modalities_tpu.checkpointing.checkpoint_saving_strategies", "CheckpointSavingStrategyIF"
 )
 PydanticCheckpointSavingExecutionIFType = _lazy(
-    "modalities_tpu.checkpointing.checkpoint_saving_execution", "CheckpointSavingExecutionIF"
+    "modalities_tpu.checkpointing.checkpoint_saving_execution", "CheckpointSavingExecutionABC"
 )
 PydanticCheckpointLoadingIFType = _lazy(
-    "modalities_tpu.checkpointing.checkpoint_loading", "CheckpointLoadingIF"
+    "modalities_tpu.checkpointing.orbax.orbax_checkpoint_loading", "CheckpointLoadingIF"
 )
 PydanticMessageSubscriberIFType = _lazy("modalities_tpu.logging_broker.subscriber", "MessageSubscriberIF")
 PydanticGradientClipperIFType = _lazy("modalities_tpu.training.gradient_clipping", "GradientClipperIF")

@@ -17,6 +17,8 @@ class DataloaderFactory:
         batch_sampler: BatchSamplerIF,
         collate_fn: Optional[CollateFnIF] = None,
         num_prefetch_batches: int = 2,
+        num_workers: Optional[int] = None,  # torch DataLoader knobs; host prefetch
+        pin_memory: Optional[bool] = None,  # thread replaces worker processes on TPU
     ) -> LLMDataLoader:
         return LLMDataLoader(
             dataloader_tag=dataloader_tag,

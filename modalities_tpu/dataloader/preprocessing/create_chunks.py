@@ -1,0 +1,37 @@
+"""Split datasets into chunks for distributed shuffling
+(reference: src/modalities/preprocessing/create_chunks.py:9)."""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+import numpy as np
+
+from modalities_tpu.dataloader.large_file_lines_reader import LargeFileLinesReader
+from modalities_tpu.dataloader.packed_data import EmbeddedStreamData
+
+
+class Chunking:
+    @staticmethod
+    def get_chunk_range(num_chunks: int, num_samples: int, chunk_id: int) -> list[int]:
+        samples_per_chunk = num_samples / num_chunks
+        start = int(chunk_id * samples_per_chunk)
+        end = int((chunk_id + 1) * samples_per_chunk) if chunk_id + 1 < num_chunks else num_samples
+        return [start, end]
+
+    @staticmethod
+    def get_tokenized_file_chunk(data: EmbeddedStreamData, num_chunks: int, chunk_id: int) -> list[np.ndarray]:
+        index = data.index_base
+        start, end = Chunking.get_chunk_range(num_chunks, len(index), chunk_id)
+        dtype = {1: "<u1", 2: "<u2", 4: "<u4"}[data.token_size_in_bytes]
+        docs = []
+        for offset, length in index[start:end]:
+            docs.append(
+                np.frombuffer(data.data, dtype=dtype, count=length // data.token_size_in_bytes, offset=offset)
+            )
+        return docs
+
+    @staticmethod
+    def get_jsonl_file_chunk(reader: LargeFileLinesReader, num_chunks: int, chunk_id: int) -> list[str]:
+        start, end = Chunking.get_chunk_range(num_chunks, len(reader), chunk_id)
+        return [reader[i] for i in range(start, end)]

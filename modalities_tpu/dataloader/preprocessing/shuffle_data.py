@@ -1,0 +1,41 @@
+"""Document-level shuffling of tokenized (.pbin) and raw (.jsonl) data
+(reference: src/modalities/preprocessing/shuffle_data.py:9)."""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Optional
+
+import numpy as np
+
+from modalities_tpu.dataloader.packed_data import EmbeddedStreamData, write_pbin_file
+
+
+class DataShuffler:
+    @staticmethod
+    def shuffle_tokenized_data(
+        input_data_path: Path, output_data_path: Path, batch_size: int = 1024, seed: Optional[int] = None
+    ) -> None:
+        """Permute documents of a pbin into a new pbin (streamed in index order)."""
+        esd = EmbeddedStreamData(Path(input_data_path))
+        index = esd.index_base
+        rng = np.random.default_rng(seed)
+        permutation = rng.permutation(len(index))
+        dtype = {1: "<u1", 2: "<u2", 4: "<u4"}[esd.token_size_in_bytes]
+
+        def docs():
+            for doc_id in permutation:
+                offset, length = index[doc_id]
+                yield np.frombuffer(esd.data, dtype=dtype, count=length // esd.token_size_in_bytes,
+                                    offset=offset)
+
+        write_pbin_file(Path(output_data_path), docs(), esd.token_size_in_bytes)
+
+    @staticmethod
+    def shuffle_jsonl_data(
+        input_data_path: Path, output_data_path: Path, seed: Optional[int] = None
+    ) -> None:
+        lines = Path(input_data_path).read_text().splitlines()
+        rng = np.random.default_rng(seed)
+        shuffled = [lines[i] for i in rng.permutation(len(lines))]
+        Path(output_data_path).write_text("\n".join(shuffled) + "\n" if shuffled else "")

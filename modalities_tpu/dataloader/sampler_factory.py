@@ -65,5 +65,17 @@ class SamplerFactory:
 
 class BatchSamplerFactory:
     @staticmethod
-    def create_batch_sampler(sampler, batch_size: int, drop_last: bool = True) -> BatchSampler:
+    def create_batch_sampler(
+        sampler,
+        batch_size: int,
+        drop_last: bool = True,
+        device_mesh: Optional[DeviceMeshHandle] = None,
+    ) -> BatchSampler:
+        """`batch_size` is the per-dp-rank micro batch size (reference semantics: each
+        torch rank loads its own mbs rows). A single-controller process feeds every dp
+        rank its devices own, so the process-level batch is mbs * owned_dp_ranks."""
+        if device_mesh is not None:
+            num_loading_ranks, _ = get_data_loading_info(device_mesh)
+            dp_degree = device_mesh.dp_degree
+            batch_size = batch_size * (dp_degree // num_loading_ranks)
         return BatchSampler(sampler=sampler, batch_size=batch_size, drop_last=drop_last)

@@ -1,0 +1,58 @@
+"""Config-driven text generation entry (reference: src/modalities/inference/inference.py:18)."""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+from modalities_tpu.config.yaml_interp import load_app_config_dict
+
+
+def generate_text(config_file_path: Path) -> None:
+    from modalities_tpu.config.component_factory import ComponentFactory
+    from modalities_tpu.inference.text.inference_component import TextInferenceComponent
+    from modalities_tpu.registry.components import COMPONENTS
+    from modalities_tpu.registry.registry import Registry
+    from pydantic import BaseModel
+
+    from modalities_tpu.config.pydantic_if_types import PydanticModelIFType, PydanticTokenizerIFType
+
+    config_dict = load_app_config_dict(config_file_path)
+
+    class _TextGenModel(BaseModel):
+        model: PydanticModelIFType
+        tokenizer: PydanticTokenizerIFType
+        settings: dict
+
+    components = ComponentFactory(Registry(COMPONENTS)).build_components(config_dict, _TextGenModel)
+    settings = components.settings
+    model = components.model
+
+    import jax
+
+    params = model.init_params(jax.random.PRNGKey(0))
+    checkpoint_path = settings.get("checkpoint_folder_path") or settings.get("model_path")
+    if checkpoint_path:
+        import orbax.checkpoint as ocp
+
+        restored = ocp.StandardCheckpointer().restore(
+            Path(checkpoint_path).absolute(),
+            jax.tree.map(lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), _unboxed(params)),
+        )
+        params = restored
+
+    component = TextInferenceComponent(
+        model=model,
+        params=params,
+        tokenizer=components.tokenizer,
+        prompt_template=settings.get("prompt_template", "{prompt}"),
+        sequence_length=int(settings.get("sequence_length", model.sequence_length)),
+        temperature=float(settings.get("temperature", 1.0)),
+        eod_token=settings.get("eod_token", "<eod>"),
+    )
+    component.run()
+
+
+def _unboxed(tree):
+    from flax.core import meta
+
+    return meta.unbox(tree)

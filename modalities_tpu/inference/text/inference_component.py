@@ -1,0 +1,94 @@
+"""Interactive text generation (reference: src/modalities/inference/text/inference_component.py:11).
+
+The sampling loop jits one next-token step over the growing context (bucketed to
+power-of-two lengths so XLA reuses compilations instead of recompiling per token —
+the reference re-runs the full eager forward per token)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from modalities_tpu.models.model import NNModel
+from modalities_tpu.tokenization.tokenizer_wrapper import TokenizerWrapper
+
+
+class TextInferenceComponent:
+    def __init__(
+        self,
+        model: NNModel,
+        tokenizer: TokenizerWrapper,
+        prompt_template: str,
+        sequence_length: int,
+        temperature: float = 1.0,
+        eod_token: str = "<eod>",
+        device=None,  # accepted for config parity
+        params=None,
+    ):
+        self.model = model
+        self.params = params
+        self.tokenizer = tokenizer
+        self.prompt_template = prompt_template
+        self.sequence_length = sequence_length
+        self.temperature = temperature
+        self.eod_token = eod_token
+        self._jitted_forward = None
+
+    def _forward(self, tokens: np.ndarray):
+        import jax
+
+        if self._jitted_forward is None:
+            model = self.model
+
+            def fwd(params, tokens):
+                return model.apply(params, {model.sample_key: tokens})[model.prediction_key]
+
+            self._jitted_forward = jax.jit(fwd)
+        return self._jitted_forward(self.params, tokens)
+
+    def generate_tokens(self, context: str, max_new_tokens: Optional[int] = None) -> str:
+        import jax
+
+        token_ids = list(self.tokenizer.tokenize(context))
+        try:
+            eod_id = self.tokenizer.get_token_id(self.eod_token)
+        except Exception:
+            eod_id = -1
+        budget = max_new_tokens if max_new_tokens is not None else self.sequence_length - len(token_ids)
+        rng = jax.random.PRNGKey(0)
+        generated = []
+        for step in range(max(0, budget)):
+            window = token_ids[-self.sequence_length :]
+            # bucket the context length so jit caches a few shapes, not one per token
+            bucket = 1 << (len(window) - 1).bit_length()
+            bucket = min(max(bucket, 8), self.sequence_length)
+            padded = np.zeros((1, bucket), dtype=np.int32)
+            padded[0, : len(window)] = window
+            logits = np.asarray(self._forward(padded))[0, len(window) - 1]
+            if self.temperature > 0:
+                probs = np.exp((logits / self.temperature) - np.max(logits / self.temperature))
+                probs = probs / probs.sum()
+                rng, sub = jax.random.split(rng)
+                next_id = int(np.random.default_rng(int(sub[0])).choice(len(probs), p=probs))
+            else:
+                next_id = int(np.argmax(logits))
+            if next_id == eod_id:
+                break
+            token_ids.append(next_id)
+            generated.append(next_id)
+        return self.tokenizer.decode(generated)
+
+    def run(self) -> None:
+        """Interactive prompt loop (reference :32-99)."""
+        while True:
+            try:
+                prompt = input("enter prompt> ").strip()
+            except (EOFError, KeyboardInterrupt):
+                print()
+                break
+            if not prompt:
+                continue
+            text = self.prompt_template.format(prompt=prompt) if self.prompt_template else prompt
+            completion = self.generate_tokens(context=text)
+            print(completion)

@@ -1,0 +1,166 @@
+"""Main: config -> component graph -> jitted step functions -> Gym.run
+(reference: src/modalities/main.py:39-274).
+
+Differences by design: after the factory builds the declarative components
+(AppStateSpec, clipper/profiler descriptors, loaders), `run` assembles ONE
+TrainStepBuilder from them — the point where the reference's in-place wrapper chain
+becomes a composed jit program — and restores the warmstart checkpoint into the
+sharded state if the app_state spec carries a checkpoint path.
+"""
+
+from __future__ import annotations
+
+import shutil
+from pathlib import Path
+from typing import Optional, Type
+
+import yaml
+
+from modalities_tpu.config.component_factory import ComponentFactory
+from modalities_tpu.config.instantiation_models import TrainingComponentsInstantiationModel
+from modalities_tpu.config.yaml_interp import Resolver, load_app_config_dict
+from modalities_tpu.evaluator import Evaluator
+from modalities_tpu.gym import Gym
+from modalities_tpu.logging_broker.message_broker import MessageBroker
+from modalities_tpu.logging_broker.messages import MessageTypes
+from modalities_tpu.logging_broker.publisher import MessagePublisher
+from modalities_tpu.registry.components import COMPONENTS
+from modalities_tpu.registry.registry import ComponentEntity, Registry
+from modalities_tpu.trainer import Trainer
+from modalities_tpu.training.train_step import TrainStepBuilder
+from modalities_tpu.training.training_progress import TrainingProgress
+from modalities_tpu.util import get_synced_experiment_id_of_run, get_total_number_of_trainable_parameters
+from modalities_tpu.utils.logging import get_logger, print_rank_0
+
+logger = get_logger(__name__)
+
+
+class Main:
+    def __init__(
+        self,
+        config_path: Path,
+        experiments_root_path: Optional[Path] = None,
+        additional_resolver_funs: Optional[dict[str, Resolver]] = None,
+        experiment_id: Optional[str] = None,
+    ) -> None:
+        self.config_path = Path(config_path)
+        if experiment_id is None:
+            experiment_id = get_synced_experiment_id_of_run(self.config_path)
+        self.experiment_id = experiment_id
+        self.experiments_root_path = Path(experiments_root_path) if experiments_root_path else None
+        self.config_dict = load_app_config_dict(
+            self.config_path,
+            experiments_root_path=self.experiments_root_path,
+            experiment_id=self.experiment_id,
+            additional_resolver_funs=additional_resolver_funs,
+        )
+        self.registry = Registry(COMPONENTS)
+        self.component_factory = ComponentFactory(self.registry)
+
+    def add_custom_component(self, component_key: str, variant_key: str, custom_component, custom_config) -> None:
+        """Library-extension hook (reference main.py:61)."""
+        self.registry.add_entity(
+            ComponentEntity(component_key, variant_key, custom_component, custom_config)
+        )
+
+    def build_components(self, components_model_type: Type = TrainingComponentsInstantiationModel):
+        return self.component_factory.build_components(self.config_dict, components_model_type)
+
+    def run(self, components: TrainingComponentsInstantiationModel) -> None:
+        settings = components.settings
+
+        # persist resolved config into the experiment folder (reference main.py:134-143)
+        import jax
+
+        if jax.process_index() == 0 and self.experiments_root_path is not None:
+            exp_folder = self.experiments_root_path / self.experiment_id
+            exp_folder.mkdir(parents=True, exist_ok=True)
+            shutil.copy(self.config_path, exp_folder / self.config_path.name)
+            with open(exp_folder / (self.config_path.name + ".resolved"), "w") as f:
+                yaml.safe_dump(_to_plain(self.config_dict), f, sort_keys=False)
+
+        app_state_spec = components.app_state
+        clipper = components.gradient_clipper
+        step_profile = settings.step_profile
+
+        builder = TrainStepBuilder(
+            model=app_state_spec.model,
+            loss_fn=components.loss_fn,
+            optimizer_spec=app_state_spec.optimizer,
+            scheduler_spec=app_state_spec.lr_scheduler,
+            mesh_handle=components.device_mesh,
+            gradient_acc_steps=step_profile.gradient_accumulation_steps,
+            grad_clip_norm=getattr(clipper, "max_norm", None),
+        )
+        step_functions = builder.build()
+
+        if app_state_spec.checkpoint_dir_path is not None:
+            loader = app_state_spec.checkpoint_loading
+            if loader is None:
+                from modalities_tpu.checkpointing.orbax.orbax_checkpoint_loading import OrbaxCheckpointLoading
+
+                loader = OrbaxCheckpointLoading()
+            loader.load_app_state(step_functions.app_state_handle, app_state_spec.checkpoint_dir_path)
+
+        num_params = get_total_number_of_trainable_parameters(step_functions.app_state_handle.state)
+        print_rank_0(f"experiment {self.experiment_id}: {num_params:,} trainable parameters")
+
+        # message broker + publishers (reference main.py:234-274)
+        message_broker = MessageBroker()
+        message_broker.add_subscriber(MessageTypes.BATCH_PROGRESS_UPDATE, components.progress_subscriber)
+        message_broker.add_subscriber(MessageTypes.EVALUATION_RESULT, components.evaluation_subscriber)
+        progress_publisher = MessagePublisher(message_broker)
+        results_publisher = MessagePublisher(message_broker)
+
+        tokens_per_step = (
+            step_profile.local_train_micro_batch_size
+            * step_profile.sequence_length
+            * step_profile.gradient_accumulation_steps
+            * step_profile.dp_degree
+        )
+        progress_settings = settings.training_progress
+        training_progress = TrainingProgress(
+            num_seen_steps_current_run=0,
+            num_seen_tokens_current_run=0,
+            num_target_steps=settings.training_target.num_target_steps,
+            num_target_tokens=settings.training_target.num_target_tokens,
+            num_seen_steps_previous_run=progress_settings.num_seen_steps,
+            num_seen_tokens_previous_run=progress_settings.global_num_seen_tokens,
+        )
+
+        trainer = Trainer(
+            progress_publisher=progress_publisher,
+            evaluation_result_publisher=results_publisher,
+            gradient_acc_steps=step_profile.gradient_accumulation_steps,
+            global_num_tokens_per_train_step=tokens_per_step,
+            num_seen_train_steps=progress_settings.num_seen_steps,
+            global_num_seen_tokens=progress_settings.global_num_seen_tokens,
+            training_log_interval_in_steps=settings.intervals.training_log_interval_in_steps,
+            mfu_calculator=components.mfu_calculator,
+            profiler=components.profiler,
+        )
+        evaluator = Evaluator(
+            progress_publisher=progress_publisher, evaluation_result_publisher=results_publisher
+        )
+        gym = Gym(trainer=trainer, evaluator=evaluator, loss_fun=components.loss_fn)
+        gym.run(
+            step_functions=step_functions,
+            train_data_loader=components.train_dataloader,
+            evaluation_data_loaders=components.eval_dataloaders,
+            checkpoint_saving=components.checkpoint_saving,
+            training_progress=training_progress,
+            evaluation_interval_in_steps=settings.intervals.evaluation_interval_in_steps,
+            checkpointing_interval_in_steps=settings.intervals.checkpointing_interval_in_steps,
+        )
+
+
+def _to_plain(obj):
+    from pathlib import Path as _P
+
+    if isinstance(obj, dict):
+        return {k: _to_plain(v) for k, v in obj.items()}
+    if isinstance(obj, list):
+        return [_to_plain(v) for v in obj]
+    if isinstance(obj, _P):
+        return str(obj)
+    return obj

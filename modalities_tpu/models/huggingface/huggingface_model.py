@@ -1,0 +1,55 @@
+"""HuggingFace passthrough model (reference: src/modalities/models/huggingface/huggingface_model.py:64).
+
+Wraps a Flax-native HF AutoModel so pretrained checkpoints drop into the training
+loop. Requires the requested architecture to have a Flax implementation; torch-only
+models raise a clear error (no torch in the TPU compute path by design).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from modalities_tpu.models.model import NNModel
+
+
+class HuggingFacePretrainedModel(NNModel):
+    def __init__(
+        self,
+        model_type: str,
+        model_name: str,
+        sample_key: str,
+        prediction_key: str,
+        huggingface_prediction_subscription_key: Optional[str] = None,
+        kwargs: Optional[dict] = None,
+    ):
+        super().__init__(sample_key=sample_key, prediction_key=prediction_key)
+        self.model_type = model_type
+        self.model_name = model_name
+        self.huggingface_prediction_subscription_key = (
+            huggingface_prediction_subscription_key or prediction_key
+        )
+        try:
+            from transformers import FlaxAutoModelForCausalLM
+
+            self._hf_model, self._hf_params = FlaxAutoModelForCausalLM.from_pretrained(
+                model_name, **(kwargs or {}), _do_init=True
+            ), None
+        except Exception as e:
+            raise RuntimeError(
+                f"Could not load {model_name!r} as a Flax model. Only architectures with a "
+                f"Flax implementation are supported in the TPU compute path. ({e})"
+            ) from e
+
+    @property
+    def module(self):
+        return self._hf_model.module
+
+    def init_params(self, rng):
+        return {"params": self._hf_model.params}
+
+    def apply(self, params, inputs: dict, train: bool = False, rngs=None) -> dict:
+        outputs = self._hf_model.module.apply(
+            params, inputs[self.sample_key], rngs=rngs
+        )
+        logits = outputs.logits if hasattr(outputs, "logits") else outputs[0]
+        return {self.prediction_key: logits}

@@ -1,0 +1,89 @@
+"""Model transform variants (reference: src/modalities/models/model_factory.py).
+
+The reference mutates torch modules in place (FSDP wrap :168-246, TP plan :657-766,
+compile :353-408, AC wrap, init replay :249-281, debug hooks :410-592). Here each
+variant is a *descriptor update* on the NNModel's TrainSpec — composed functionally
+when the jitted train step is built (training/train_step.py). The YAML surface keeps
+the same variant names, so reference configs translate directly.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from modalities_tpu.models.model import MixedPrecisionSpec, NNModel
+from modalities_tpu.nn.model_initialization.initialization_if import ModelInitializationIF
+from modalities_tpu.running_env.device_mesh import DeviceMeshHandle
+from modalities_tpu.training.activation_checkpointing import ActivationCheckpointing
+from modalities_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+class ModelFactory:
+    @staticmethod
+    def get_fsdp2_wrapped_model(
+        model: NNModel,
+        device_mesh: Optional[DeviceMeshHandle] = None,
+        mixed_precision_settings: Optional[dict] = None,
+        block_names: Optional[list[str]] = None,  # torch-only knobs kept for config parity
+        layers_per_fsdp_unit: Optional[int] = None,
+        reshard_after_forward: bool = True,
+    ) -> NNModel:
+        """FSDP2 'wrap' == enable dp_shard parameter sharding. The actual sharding is the
+        logical-axis rule set (parallel/sharding.py); this variant records the mesh and
+        the mixed-precision policy (param/reduce dtype, reference model_factory.py:201)."""
+        if mixed_precision_settings:
+            mp = MixedPrecisionSpec(
+                param_dtype=str(mixed_precision_settings.get("param_dtype", "float32")).split(".")[-1].lower(),
+                reduce_dtype=str(mixed_precision_settings.get("reduce_dtype", "float32")).split(".")[-1].lower(),
+            )
+            model.update_train_spec(mixed_precision=mp)
+        model.device_mesh = device_mesh
+        return model
+
+    # config-compat alias: FSDP1 path collapses onto the GSPMD sharding too
+    get_fsdp1_wrapped_model = get_fsdp2_wrapped_model
+
+    @staticmethod
+    def get_compiled_model(
+        model: NNModel, block_names: Optional[list[str]] = None, fullgraph: Optional[bool] = None,
+        debug: Optional[bool] = None,
+    ) -> NNModel:
+        """torch.compile equivalent is jax.jit, which the train step always applies —
+        kept as a pass-through so reference configs load unchanged (reference :353-408)."""
+        model.update_train_spec(compiled=True)
+        return model
+
+    @staticmethod
+    def get_activation_checkpointed_model(
+        model: NNModel,
+        activation_checkpointing_variant: str = "full_activation_checkpointing",
+        layers_fqn: Optional[str] = None,
+        ac_freq: int = 1,
+        save_list: Optional[list[str]] = None,
+        device_mesh: Optional[DeviceMeshHandle] = None,
+    ) -> NNModel:
+        return ActivationCheckpointing.apply(
+            model, activation_checkpointing_variant, ac_freq=ac_freq, save_list=tuple(save_list or ())
+        )
+
+    @staticmethod
+    def get_weight_initialized_model(model: NNModel, model_initializer: ModelInitializationIF) -> NNModel:
+        """Record the init routine; applied to the sharded params right after jitted init
+        (the reference's to_empty + reset_parameters replay, :249-281)."""
+        spec = model.train_spec
+        model.update_train_spec(init_routines=spec.init_routines + (model_initializer,))
+        return model
+
+    @staticmethod
+    def get_debugging_enriched_model(model: NNModel, logging_dir_path=None, tracked_ranks=None,
+                                     log_interval_steps: int = 1) -> NNModel:
+        """Per-module tensor-stats debugging (reference :410-592) — on TPU implemented
+        as jitted intermediate captures; records the request on the model."""
+        model.debugging_config = {
+            "logging_dir_path": logging_dir_path,
+            "tracked_ranks": tracked_ranks,
+            "log_interval_steps": log_interval_steps,
+        }
+        return model

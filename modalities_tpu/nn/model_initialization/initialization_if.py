@@ -1,0 +1,11 @@
+"""Model initialization interface (reference: src/modalities/nn/model_initialization/initialization_if.py)."""
+
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+
+
+class ModelInitializationIF(ABC):
+    @abstractmethod
+    def initialize_in_place(self, params, rng):
+        """Return a params tree with the routine applied (pure; name kept for parity)."""

@@ -1,0 +1,303 @@
+"""The built-in component catalog (reference: src/modalities/registry/components.py:187-531).
+
+Same two-level keys (component_key.variant_key) as the reference wherever a component
+exists on TPU; torch-only variants keep their names as aliases onto the TPU-native
+equivalents (fsdp1_wrapped -> GSPMD sharding, dcp -> orbax) so reference YAMLs load.
+"""
+
+from __future__ import annotations
+
+from modalities_tpu.checkpointing.checkpoint_saving import CheckpointSaving
+from modalities_tpu.checkpointing.checkpoint_saving_strategies import (
+    SaveEveryKStepsCheckpointingStrategy,
+    SaveKMostRecentCheckpointsStrategy,
+)
+from modalities_tpu.checkpointing.orbax.orbax_checkpoint_loading import OrbaxCheckpointLoading
+from modalities_tpu.checkpointing.orbax.orbax_checkpoint_saving import OrbaxCheckpointSaving
+from modalities_tpu.checkpointing.stateful.app_state_factory import AppStateFactory
+from modalities_tpu.config import config as cfg
+from modalities_tpu.dataloader.collate_fns.collator_fn_wrapper_for_loss_masking import (
+    LossMaskingCollateFnWrapper,
+)
+from modalities_tpu.dataloader.dataloader_factory import DataloaderFactory
+from modalities_tpu.dataloader.dataset import DummyDataset, DummyDatasetConfig
+from modalities_tpu.dataloader.dataset_factory import DatasetFactory
+from modalities_tpu.dataloader.sampler_factory import BatchSamplerFactory, SamplerFactory
+from modalities_tpu.dataloader.samplers import RandomSampler, SequentialSampler
+from modalities_tpu.loss_functions import CLMCrossEntropyLoss, NCELoss
+from modalities_tpu.logging_broker.subscriber_impl.progress_subscriber import (
+    DummyProgressSubscriber,
+    RichProgressSubscriber,
+)
+from modalities_tpu.logging_broker.subscriber_impl.results_subscriber import (
+    DummyResultSubscriber,
+    EvaluationResultToDiscSubscriber,
+    RichResultSubscriber,
+    WandBEvaluationResultSubscriber,
+)
+from modalities_tpu.models.gpt2.collator import GPT2LLMCollateFn
+from modalities_tpu.models.gpt2.gpt2_model import GPT2LLM, GPT2LLMConfig
+from modalities_tpu.models.huggingface.huggingface_model import HuggingFacePretrainedModel
+from modalities_tpu.models.model_factory import ModelFactory
+from modalities_tpu.nn.model_initialization.composed_initialization import ComposedModelInitialization
+from modalities_tpu.optimizers.optimizer_factory import OptimizerFactory
+from modalities_tpu.optimizers.scheduler_factory import (
+    ConstantLRScheduler,
+    CosineAnnealingLRScheduler,
+    DummyLRScheduler,
+    LinearLRScheduler,
+    LinearWarmupCosineAnnealingLRScheduler,
+    OneCycleLRScheduler,
+    StepLRScheduler,
+)
+from modalities_tpu.registry.registry import ComponentEntity
+from modalities_tpu.running_env.device_mesh import get_device_mesh
+from modalities_tpu.tokenization.tokenizer_wrapper import PreTrainedHFTokenizer, PreTrainedSPTokenizer
+from modalities_tpu.training.gradient_clipping import (
+    DummyGradientClipper,
+    GradientClipper,
+    LoggingOnlyGradientClipper,
+)
+from modalities_tpu.utils.mfu import GPT2MFUCalculator
+from modalities_tpu.utils.number_conversion import (
+    LocalNumBatchesFromNumSamplesConfig,
+    LocalNumBatchesFromNumTokensConfig,
+    NumberConversion,
+    NumberConversionFromCheckpointPathConfig,
+    NumSamplesFromNumTokensConfig,
+    NumStepsFromNumSamplesConfig,
+    NumStepsFromNumTokensConfig,
+    NumStepsFromRawDatasetIndexConfig,
+    NumTokensFromNumStepsConfig,
+    NumTokensFromPackedMemMapDatasetContinuousConfig,
+)
+from modalities_tpu.utils.profilers.profilers import (
+    SteppableCombinedProfiler,
+    SteppableKernelProfiler,
+    SteppableMemoryProfiler,
+    SteppableNoProfiler,
+)
+
+
+def _scheduler_entity(variant: str, scheduler_cls, config_cls) -> ComponentEntity:
+    def build(**kwargs):
+        return scheduler_cls(name=variant, **kwargs)
+
+    return ComponentEntity("scheduler", variant, build, config_cls)
+
+
+COMPONENTS: list[ComponentEntity] = [
+    # models (reference components.py: models section)
+    ComponentEntity("model", "gpt2", GPT2LLM, GPT2LLMConfig),
+    ComponentEntity("model", "gpt2_tp", lambda model, device_mesh: model, cfg.GPT2TPModelConfig),
+    ComponentEntity(
+        "model", "huggingface_pretrained_model", HuggingFacePretrainedModel, cfg.HuggingFacePretrainedModelConfig
+    ),
+    ComponentEntity("model", "fsdp2_wrapped", ModelFactory.get_fsdp2_wrapped_model, cfg.FSDP2WrappedModelConfig),
+    ComponentEntity("model", "fsdp1_wrapped", ModelFactory.get_fsdp2_wrapped_model, cfg.FSDP2WrappedModelConfig),
+    ComponentEntity("model", "model_initialized", ModelFactory.get_weight_initialized_model, cfg.WeightInitializedModelConfig),
+    ComponentEntity(
+        "model", "activation_checkpointed", ModelFactory.get_activation_checkpointed_model, cfg.ActivationCheckpointedModelConfig
+    ),
+    ComponentEntity(
+        "model", "activation_checkpointed_fsdp1", ModelFactory.get_activation_checkpointed_model, cfg.ActivationCheckpointedModelConfig
+    ),
+    ComponentEntity("model", "compiled", ModelFactory.get_compiled_model, cfg.CompiledModelConfig),
+    ComponentEntity(
+        "model", "debugging_enriched", ModelFactory.get_debugging_enriched_model, cfg.DebuggingEnrichedModelConfig
+    ),
+    # device mesh
+    ComponentEntity("device_mesh", "default", get_device_mesh, cfg.DeviceMeshConfig),
+    # model initialization
+    ComponentEntity("model_initialization", "composed", ComposedModelInitialization, cfg.ComposedInitializationConfig),
+    ComponentEntity(
+        "model_initialization", "gpt2_llama3_like", ComposedModelInitialization, cfg.ComposedInitializationConfig
+    ),
+    # losses
+    ComponentEntity("loss", "clm_cross_entropy_loss", CLMCrossEntropyLoss, cfg.CLMCrossEntropyLossConfig),
+    ComponentEntity("loss", "nce_loss", NCELoss, cfg.NCELossConfig),
+    # optimizers
+    ComponentEntity("optimizer", "adam", OptimizerFactory.get_adam, cfg.AdamOptimizerConfig),
+    ComponentEntity("optimizer", "adam_w", OptimizerFactory.get_adam_w, cfg.AdamWOptimizerConfig),
+    # app state
+    ComponentEntity("app_state", "raw", AppStateFactory.get_raw_app_state, cfg.RawAppStateConfig),
+    ComponentEntity("app_state", "dcp", AppStateFactory.get_dcp_checkpointed_app_state_, cfg.DCPAppStateConfig),
+    # schedulers
+    _scheduler_entity("dummy_lr", DummyLRScheduler, cfg.DummyLRSchedulerConfig),
+    _scheduler_entity("step_lr", StepLRScheduler, cfg.StepLRSchedulerConfig),
+    _scheduler_entity("constant_lr", ConstantLRScheduler, cfg.ConstantLRSchedulerConfig),
+    _scheduler_entity("linear_lr", LinearLRScheduler, cfg.LinearLRSchedulerConfig),
+    _scheduler_entity("onecycle_lr", OneCycleLRScheduler, cfg.OneCycleLRSchedulerConfig),
+    _scheduler_entity("cosine_annealing_lr", CosineAnnealingLRScheduler, cfg.CosineAnnealingLRSchedulerConfig),
+    _scheduler_entity(
+        "linear_warmup_cosine_annealing_lr",
+        LinearWarmupCosineAnnealingLRScheduler,
+        cfg.LinearWarmupCosineAnnealingLRSchedulerConfig,
+    ),
+    # tokenizers
+    ComponentEntity("tokenizer", "pretrained_hf_tokenizer", PreTrainedHFTokenizer, cfg.PreTrainedHFTokenizerConfig),
+    ComponentEntity("tokenizer", "pretrained_sp_tokenizer", PreTrainedSPTokenizer, cfg.PreTrainedSPTokenizerConfig),
+    # datasets
+    ComponentEntity("dataset", "dummy_dataset", DatasetFactory.get_dummy_dataset, DummyDatasetConfig),
+    ComponentEntity("dataset", "mem_map_dataset", DatasetFactory.get_mem_map_dataset, cfg.MemMapDatasetConfig),
+    ComponentEntity(
+        "dataset",
+        "packed_mem_map_dataset_continuous",
+        DatasetFactory.get_packed_mem_map_dataset_continuous,
+        cfg.PackedMemMapDatasetContinuousConfig,
+    ),
+    ComponentEntity(
+        "dataset",
+        "packed_mem_map_dataset_megatron",
+        DatasetFactory.get_packed_mem_map_dataset_megatron,
+        cfg.PackedMemMapDatasetMegatronConfig,
+    ),
+    ComponentEntity("dataset", "combined", DatasetFactory.get_combined_dataset, cfg.CombinedDatasetConfig),
+    # samplers
+    ComponentEntity(
+        "sampler", "resumable_distributed_sampler", SamplerFactory.create_resumable_sampler, cfg.ResumableDistributedSamplerConfig
+    ),
+    ComponentEntity(
+        "sampler",
+        "resumable_distributed_multi_dim_sampler",
+        SamplerFactory.create_resumable_distributed_multi_dim_sampler,
+        cfg.ResumableDistributedMultiDimSamplerConfig,
+    ),
+    ComponentEntity("sampler", "sequential_sampler", SequentialSampler, cfg.SequentialSamplerConfig),
+    ComponentEntity("sampler", "random_sampler", RandomSampler, cfg.RandomSamplerConfig),
+    ComponentEntity("batch_sampler", "default", BatchSamplerFactory.create_batch_sampler, cfg.BatchSamplerConfig),
+    # collators
+    ComponentEntity("collate_fn", "gpt_2_llm_collator", GPT2LLMCollateFn, cfg.GPT2LLMCollateFnConfig),
+    ComponentEntity(
+        "collate_fn", "mask_loss_collator_wrapper", LossMaskingCollateFnWrapper, cfg.LossMaskingCollateFnWrapperConfig
+    ),
+    # dataloaders
+    ComponentEntity("data_loader", "default", DataloaderFactory.get_dataloader, cfg.LLMDataLoaderConfig),
+    # checkpointing
+    ComponentEntity(
+        "checkpoint_saving_strategy",
+        "save_every_k_steps_checkpointing_strategy",
+        SaveEveryKStepsCheckpointingStrategy,
+        cfg.SaveEveryKStepsCheckpointingStrategyConfig,
+    ),
+    ComponentEntity(
+        "checkpoint_saving_strategy",
+        "save_k_most_recent_checkpoints_strategy",
+        SaveKMostRecentCheckpointsStrategy,
+        cfg.SaveKMostRecentCheckpointsStrategyConfig,
+    ),
+    ComponentEntity("checkpoint_saving_execution", "dcp", OrbaxCheckpointSaving, cfg.OrbaxCheckpointSavingConfig),
+    ComponentEntity("checkpoint_saving_execution", "orbax", OrbaxCheckpointSaving, cfg.OrbaxCheckpointSavingConfig),
+    ComponentEntity("checkpoint_saving", "default", CheckpointSaving, cfg.CheckpointSavingConfig),
+    ComponentEntity("checkpoint_loading", "dcp", OrbaxCheckpointLoading, cfg.OrbaxCheckpointLoadingConfig),
+    ComponentEntity("checkpoint_loading", "orbax", OrbaxCheckpointLoading, cfg.OrbaxCheckpointLoadingConfig),
+    # gradient clippers (fsdp* names kept as aliases)
+    ComponentEntity("gradient_clipper", "fsdp2", GradientClipper, cfg.GradientClipperConfig),
+    ComponentEntity("gradient_clipper", "fsdp1", GradientClipper, cfg.GradientClipperConfig),
+    ComponentEntity(
+        "gradient_clipper", "fsdp2_logging_only", LoggingOnlyGradientClipper, cfg.LoggingOnlyGradientClipperConfig
+    ),
+    ComponentEntity("gradient_clipper", "dummy", DummyGradientClipper, None),
+    # progress subscribers
+    ComponentEntity("progress_subscriber", "dummy", DummyProgressSubscriber, None),
+    ComponentEntity("progress_subscriber", "rich", RichProgressSubscriber, cfg.RichProgressSubscriberConfig),
+    # results subscribers
+    ComponentEntity("results_subscriber", "dummy", DummyResultSubscriber, None),
+    ComponentEntity("results_subscriber", "rich", RichResultSubscriber, cfg.RichResultSubscriberConfig),
+    ComponentEntity(
+        "results_subscriber",
+        "save_to_disc",
+        EvaluationResultToDiscSubscriber,
+        cfg.EvaluationResultToDiscSubscriberConfig,
+    ),
+    ComponentEntity(
+        "results_subscriber", "wandb", WandBEvaluationResultSubscriber, cfg.WandBEvaluationResultSubscriberConfig
+    ),
+    # layer norms (referenced via norm wrapper configs inside model configs)
+    # mfu
+    ComponentEntity("mfu_calculator", "gpt2", GPT2MFUCalculator, cfg.GPT2MFUCalculatorConfig),
+    # profilers
+    ComponentEntity("profiler", "no_profiler", SteppableNoProfiler, None),
+    ComponentEntity("profiler", "kernel_profiler", SteppableKernelProfiler, cfg.SteppableKernelProfilerConfig),
+    ComponentEntity("profiler", "memory_profiler", SteppableMemoryProfiler, cfg.SteppableMemoryProfilerConfig),
+    ComponentEntity("profiler", "combined_profiler", SteppableCombinedProfiler, cfg.SteppableCombinedProfilerConfig),
+    # number conversion (13 variants, reference components.py number_conversion section)
+    ComponentEntity(
+        "number_conversion",
+        "local_num_batches_from_num_samples",
+        NumberConversion.get_local_num_batches_from_num_samples,
+        LocalNumBatchesFromNumSamplesConfig,
+    ),
+    ComponentEntity(
+        "number_conversion",
+        "local_num_batches_from_num_tokens",
+        NumberConversion.get_local_num_batches_from_num_tokens,
+        LocalNumBatchesFromNumTokensConfig,
+    ),
+    ComponentEntity(
+        "number_conversion",
+        "num_samples_from_num_tokens",
+        NumberConversion.get_num_samples_from_num_tokens,
+        NumSamplesFromNumTokensConfig,
+    ),
+    ComponentEntity(
+        "number_conversion",
+        "num_steps_from_num_samples",
+        NumberConversion.get_num_steps_from_num_samples,
+        NumStepsFromNumSamplesConfig,
+    ),
+    ComponentEntity(
+        "number_conversion",
+        "num_steps_from_num_tokens",
+        NumberConversion.get_num_steps_from_num_tokens,
+        NumStepsFromNumTokensConfig,
+    ),
+    ComponentEntity(
+        "number_conversion",
+        "num_tokens_from_num_steps",
+        NumberConversion.get_num_tokens_from_num_steps,
+        NumTokensFromNumStepsConfig,
+    ),
+    ComponentEntity(
+        "number_conversion",
+        "last_step_from_checkpoint_path",
+        NumberConversion.get_last_step_from_checkpoint_path,
+        NumberConversionFromCheckpointPathConfig,
+    ),
+    ComponentEntity(
+        "number_conversion",
+        "num_seen_steps_from_checkpoint_path",
+        NumberConversion.get_num_seen_steps_from_checkpoint_path,
+        NumberConversionFromCheckpointPathConfig,
+    ),
+    ComponentEntity(
+        "number_conversion",
+        "global_num_seen_tokens_from_checkpoint_path",
+        NumberConversion.get_global_num_seen_tokens_from_checkpoint_path,
+        NumberConversionFromCheckpointPathConfig,
+    ),
+    ComponentEntity(
+        "number_conversion",
+        "global_num_target_tokens_from_checkpoint_path",
+        NumberConversion.get_global_num_target_tokens_from_checkpoint_path,
+        NumberConversionFromCheckpointPathConfig,
+    ),
+    ComponentEntity(
+        "number_conversion",
+        "num_target_steps_from_checkpoint_path",
+        NumberConversion.get_num_target_steps_from_checkpoint_path,
+        NumberConversionFromCheckpointPathConfig,
+    ),
+    ComponentEntity(
+        "number_conversion",
+        "num_tokens_from_packed_mem_map_dataset_continuous",
+        NumberConversion.get_num_tokens_from_packed_mem_map_dataset_continuous,
+        NumTokensFromPackedMemMapDatasetContinuousConfig,
+    ),
+    ComponentEntity(
+        "number_conversion",
+        "num_steps_from_raw_dataset_index",
+        NumberConversion.get_num_steps_from_raw_dataset_index,
+        NumStepsFromRawDatasetIndexConfig,
+    ),
+]

@@ -1,0 +1,66 @@
+"""Process/runtime environment (reference: src/modalities/running_env/cuda_env.py:15-67).
+
+CudaEnv's job (init_process_group("nccl"), set_device, teardown) maps to:
+``jax.distributed.initialize()`` on multi-host TPU pods (single-host needs nothing),
+OOM-aware error logging on exit, and no explicit device selection (the runtime owns
+placement). The context-manager shape is preserved so orchestration code reads the
+same.
+"""
+
+from __future__ import annotations
+
+import os
+import traceback
+from typing import Optional
+
+from modalities_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+class TpuEnv:
+    """Context manager for the distributed runtime (CudaEnv equivalent)."""
+
+    def __init__(self, process_group_backend: Optional[str] = None, timeout_s: int = 600):
+        # backend arg accepted for config parity; collectives are XLA's
+        self.process_group_backend = process_group_backend
+        self.timeout_s = timeout_s
+        self._initialized_distributed = False
+
+    def __enter__(self) -> "TpuEnv":
+        import jax
+
+        coordinator = os.environ.get("JAX_COORDINATOR_ADDRESS") or os.environ.get("COORDINATOR_ADDRESS")
+        num_processes = os.environ.get("JAX_NUM_PROCESSES") or os.environ.get("NNODES")
+        if coordinator and num_processes and int(num_processes) > 1:
+            jax.distributed.initialize(
+                coordinator_address=coordinator,
+                num_processes=int(num_processes),
+                process_id=int(os.environ.get("JAX_PROCESS_ID", os.environ.get("RANK", 0))),
+                initialization_timeout=self.timeout_s,
+            )
+            self._initialized_distributed = True
+        logger.info(
+            "TpuEnv: %d devices over %d processes (platform=%s)",
+            len(jax.devices()),
+            jax.process_count(),
+            jax.devices()[0].platform,
+        )
+        return self
+
+    def __exit__(self, exc_type, exc_val, exc_tb) -> bool:
+        if exc_type is not None:
+            message = "".join(traceback.format_exception(exc_type, exc_val, exc_tb))
+            if "RESOURCE_EXHAUSTED" in message or "Out of memory" in message:
+                logger.error("Device out of memory:\n%s", message)
+            else:
+                logger.error("Error in TpuEnv context:\n%s", message)
+        if self._initialized_distributed:
+            import jax
+
+            jax.distributed.shutdown()
+        return False
+
+
+# alias kept so reference-style code reads unchanged
+CudaEnv = TpuEnv

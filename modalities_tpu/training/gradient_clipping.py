@@ -1,0 +1,59 @@
+"""Gradient clipping (reference: src/modalities/training/gradient_clipping/fsdp_gradient_clipper.py).
+
+The reference computes the global norm across FSDP shards + an extra manual
+all-reduce over the PP mesh (:161-170). Under GSPMD the global norm inside the jitted
+step (optax.global_norm) already spans every mesh axis, so a clipper here is a
+*descriptor* consumed by the train-step builder: max_norm -> optax.clip_by_global_norm
+in the chain; logging-only -> norm reported in metrics without clipping (which the
+builder always does anyway).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from enum import Enum
+from typing import Optional
+
+
+class GradientClippingMode(str, Enum):
+    P2_NORM = "p2_norm"
+    P1_NORM = "p1_norm"
+    MAX_NORM = "max_norm"  # infinity norm
+
+
+class GradientClipperIF:
+    """Descriptor: the builder reads `max_norm`/`norm_type` when assembling the step."""
+
+    max_norm: Optional[float] = None
+    norm_type: GradientClippingMode = GradientClippingMode.P2_NORM
+    error_if_nonfinite: bool = False
+
+
+@dataclass
+class GradientClipper(GradientClipperIF):
+    """Clip to max_norm (reference FSDP2GradientClipper, :161-229)."""
+
+    max_norm: float = 1.0
+    norm_type: GradientClippingMode = GradientClippingMode.P2_NORM
+    error_if_nonfinite: bool = False
+
+    def __post_init__(self):
+        if isinstance(self.norm_type, str):
+            self.norm_type = GradientClippingMode(self.norm_type)
+        if self.norm_type != GradientClippingMode.P2_NORM:
+            raise NotImplementedError(
+                "Only p2_norm clipping is currently supported on TPU (optax.clip_by_global_norm)."
+            )
+
+
+@dataclass
+class LoggingOnlyGradientClipper(GradientClipperIF):
+    """Report the grad norm without clipping (reference FSDP2LoggingOnlyGradientClipper)."""
+
+    max_norm: Optional[float] = None
+    norm_type: GradientClippingMode = GradientClippingMode.P2_NORM
+
+
+@dataclass
+class DummyGradientClipper(GradientClipperIF):
+    max_norm: Optional[float] = None

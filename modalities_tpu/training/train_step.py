@@ -143,8 +143,14 @@ class TrainStepBuilder:
             tx = optax.chain(optax.clip_by_global_norm(self.grad_clip_norm), tx)
         lr_fn = schedule if schedule is not None else (lambda step: self.optimizer_spec.lr)
 
+        init_routines = tuple(getattr(model.train_spec, "init_routines", ()))
+
         def init_state(r) -> AppState:
             params = _unbox(init_fn(r))
+            # registered init routines (model_initialized variant) replace the default
+            # initializers — runs inside the same jitted, sharded init
+            for i, routine in enumerate(init_routines):
+                params = routine.initialize_in_place(params, jax.random.fold_in(r, 1000 + i))
             return AppState(params=params, opt_state=tx.init(params), step=jnp.zeros((), jnp.int32))
 
         if mesh_handle is not None:

@@ -1,0 +1,102 @@
+"""Shared utilities (reference: src/modalities/util.py).
+
+Experiment-id sync uses jax multihost broadcast instead of a torch byte-tensor
+broadcast (reference util.py:70-107); parameter counting works on abstract pytrees
+(no materialization needed).
+"""
+
+from __future__ import annotations
+
+import time
+from datetime import datetime
+from typing import Optional
+
+from modalities_tpu.exceptions import TimeRecorderStateError
+from modalities_tpu.utils.logging import print_rank_0, warn_rank_0  # re-export for parity
+
+__all__ = [
+    "print_rank_0",
+    "warn_rank_0",
+    "get_date_of_run",
+    "get_experiment_id_of_run",
+    "get_synced_experiment_id_of_run",
+    "get_total_number_of_trainable_parameters",
+    "TimeRecorder",
+]
+
+
+def get_date_of_run() -> str:
+    return datetime.now().strftime("%Y-%m-%d__%H-%M-%S")
+
+
+def get_experiment_id_of_run(config_file_path, hash_length: int = 8, date_of_run: Optional[str] = None) -> str:
+    import hashlib
+    from pathlib import Path
+
+    if date_of_run is None:
+        date_of_run = get_date_of_run()
+    hash_str = hashlib.sha256(str(Path(config_file_path)).encode()).hexdigest()[:hash_length]
+    return f"{date_of_run}_{hash_str}"
+
+
+def get_synced_experiment_id_of_run(config_file_path, hash_length: int = 8) -> str:
+    """Process-0 generates the id; all hosts adopt it (reference util.py:107 via
+    byte-tensor broadcast -> here jax.experimental.multihost_utils)."""
+    import jax
+
+    experiment_id = get_experiment_id_of_run(config_file_path, hash_length)
+    if jax.process_count() == 1:
+        return experiment_id
+    from jax.experimental import multihost_utils
+    import numpy as np
+
+    encoded = np.frombuffer(experiment_id.encode().ljust(64), dtype=np.uint8).copy()
+    synced = multihost_utils.broadcast_one_to_all(encoded)
+    return bytes(synced).rstrip().decode()
+
+
+def get_total_number_of_trainable_parameters(model_or_state) -> int:
+    """Global parameter count; accepts an NNModel (abstract count) or a params pytree."""
+    import jax
+    import numpy as np
+
+    if hasattr(model_or_state, "init_params"):
+        tree = jax.eval_shape(model_or_state.init_params, jax.random.PRNGKey(0))
+    elif hasattr(model_or_state, "params"):
+        tree = model_or_state.params
+    else:
+        tree = model_or_state
+    return int(sum(np.prod(x.shape) for x in jax.tree.leaves(tree) if hasattr(x, "shape")))
+
+
+class TimeRecorder:
+    """Start/stop accumulating wall-clock timer (reference util.py:245)."""
+
+    def __init__(self):
+        self.delta_t: float = 0.0
+        self.time_s: float = -1.0
+        self._running = False
+
+    def start(self) -> None:
+        if self._running:
+            raise TimeRecorderStateError("Timer already running")
+        self.time_s = time.perf_counter()
+        self._running = True
+
+    def stop(self) -> None:
+        if not self._running:
+            raise TimeRecorderStateError("Timer not running")
+        self.delta_t += time.perf_counter() - self.time_s
+        self._running = False
+
+    def reset(self) -> None:
+        self.delta_t = 0.0
+        self._running = False
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *args):
+        self.stop()
+        return False

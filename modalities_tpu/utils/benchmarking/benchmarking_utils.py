@@ -1,0 +1,62 @@
+"""Sweep status tracking (reference: src/modalities/utils/benchmarking/benchmarking_utils.py:57-150).
+
+Scans experiment folders for ``evaluation_results.jsonl``, counts logged steps vs the
+config's target, and classifies runs done / failed / remaining; optionally skips
+configs that previously died with an out-of-memory error.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+import yaml
+
+
+def _expected_log_lines(config: dict) -> int:
+    try:
+        settings = config["settings"]
+        target = settings["training_target"]["num_target_steps"]
+        seen = settings["training_progress"]["num_seen_steps"]
+        interval = settings["intervals"]["training_log_interval_in_steps"]
+        return (target - seen) // interval
+    except KeyError:
+        return -1
+
+
+def _died_with_oom(run_dir: Path) -> bool:
+    for error_file in run_dir.glob("error_rank_*.json"):
+        try:
+            record = json.loads(error_file.read_text())
+            if "RESOURCE_EXHAUSTED" in record.get("stacktrace", "") or "Out of memory" in record.get("error", ""):
+                return True
+        except (json.JSONDecodeError, OSError):
+            continue
+    return False
+
+
+def get_updated_sweep_status(sweep_dir: Path, skip_oom_configs: bool = False) -> dict:
+    sweep_dir = Path(sweep_dir)
+    status: dict[str, list[str]] = {"done": [], "failed": [], "remaining": [], "skipped_oom": []}
+    for config_path in sorted(sweep_dir.rglob("config.yaml")):
+        run_dir = config_path.parent
+        with open(config_path) as f:
+            config = yaml.safe_load(f)
+        expected = _expected_log_lines(config)
+        results_files = list(run_dir.rglob("evaluation_results.jsonl"))
+        logged = 0
+        for rf in results_files:
+            logged += sum(
+                1
+                for line in rf.read_text().splitlines()
+                if line.strip() and json.loads(line).get("dataloader_tag") == "train"
+            )
+        if expected > 0 and logged >= expected:
+            status["done"].append(str(run_dir))
+        elif skip_oom_configs and _died_with_oom(run_dir):
+            status["skipped_oom"].append(str(run_dir))
+        elif logged > 0:
+            status["failed"].append(str(run_dir))
+        else:
+            status["remaining"].append(str(run_dir))
+    return status

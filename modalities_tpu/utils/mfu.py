@@ -1,0 +1,85 @@
+"""Model FLOPs utilization (reference: src/modalities/utils/mfu.py:150-197).
+
+Same flops-per-token formula (6N + 12*L*s*h, reference :178-180); the GPU peak-flops
+table (:17) becomes a TPU-generation table keyed off the device kind.
+"""
+
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+from typing import Optional
+
+# bf16 peak FLOP/s per chip by TPU generation
+TPU_PEAK_FLOPS = {
+    "v6e": 918e12,
+    "v6": 918e12,
+    "v5p": 459e12,
+    "v5e": 197e12,
+    "v5 lite": 197e12,
+    "v4": 275e12,
+}
+_DEFAULT_PEAK = 197e12
+
+
+def get_peak_flops(device_kind: Optional[str] = None) -> float:
+    if device_kind is None:
+        try:
+            import jax
+
+            device_kind = jax.devices()[0].device_kind
+        except Exception:
+            return _DEFAULT_PEAK
+    kind = device_kind.lower()
+    if "cpu" in kind:
+        return 1e12  # nominal, CI only
+    for key, val in TPU_PEAK_FLOPS.items():
+        if key in kind:
+            return val
+    return _DEFAULT_PEAK
+
+
+class MFUCalculatorIF(ABC):
+    @abstractmethod
+    def compute(self, tokens_per_second: float) -> float: ...
+
+
+class GPT2MFUCalculator(MFUCalculatorIF):
+    """MFU = tokens/s * (6N + 12*L*s*h) / (world * peak) (reference :150-197)."""
+
+    def __init__(
+        self,
+        n_layer: int,
+        sequence_length: int,
+        n_embd: int,
+        world_size: int,
+        num_parameters: Optional[int] = None,
+        model_parts=None,
+        device_mesh=None,
+        wrapped_model=None,
+    ):
+        self.n_layer = n_layer
+        self.sequence_length = sequence_length
+        self.n_embd = n_embd
+        self.world_size = world_size
+        if num_parameters is None and model_parts is not None:
+            num_parameters = _count_params(model_parts)
+        if num_parameters is None and wrapped_model is not None:
+            num_parameters = _count_params(wrapped_model)
+        self.num_parameters = num_parameters or 0
+        self._peak = get_peak_flops()
+
+    def compute(self, tokens_per_second: float) -> float:
+        flops_per_token = 6 * self.num_parameters + 12 * self.n_layer * self.sequence_length * self.n_embd
+        return tokens_per_second * flops_per_token / (self.world_size * self._peak)
+
+
+def _count_params(model) -> Optional[int]:
+    """Count parameters of an NNModel without materializing them (eval_shape)."""
+    try:
+        import jax
+        import numpy as np
+
+        abstract = jax.eval_shape(model.init_params, jax.random.PRNGKey(0))
+        return int(sum(np.prod(x.shape) for x in jax.tree.leaves(abstract)))
+    except Exception:
+        return None

@@ -1,0 +1,47 @@
+"""Standalone profiling harness (reference: src/modalities/utils/profilers/modalities_profiler.py:36-158).
+
+Builds {steppable_component, profiler} from a config and steps the component
+len(profiler) times inside the profiler context.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Any
+
+from pydantic import BaseModel
+
+from modalities_tpu.config.component_factory import ComponentFactory
+from modalities_tpu.config.pydantic_if_types import PydanticProfilerIFType
+from modalities_tpu.config.yaml_interp import load_app_config_dict
+from modalities_tpu.registry.components import COMPONENTS
+from modalities_tpu.registry.registry import Registry
+from modalities_tpu.utils.profilers.steppable_components import SteppableComponentIF
+
+
+class ProfilerInstantiationModel(BaseModel):
+    steppable_component: Any
+    profiler: PydanticProfilerIFType
+
+
+class ModalitiesProfilerStarter:
+    @staticmethod
+    def run_distributed(config_file_path: Path) -> None:
+        from modalities_tpu.running_env.env import TpuEnv
+
+        with TpuEnv():
+            ModalitiesProfilerStarter.run_single_process(config_file_path)
+
+    @staticmethod
+    def run_single_process(config_file_path: Path) -> None:
+        config_dict = load_app_config_dict(Path(config_file_path))
+        components = ComponentFactory(Registry(COMPONENTS)).build_components(
+            config_dict, ProfilerInstantiationModel
+        )
+        component: SteppableComponentIF = components.steppable_component
+        profiler = components.profiler
+        num_steps = max(len(profiler), 1)
+        with profiler:
+            for _ in range(num_steps):
+                component.step()
+                profiler.step()

@@ -1,0 +1,178 @@
+"""Steppable profilers (reference: src/modalities/utils/profilers/profilers.py:12-220).
+
+Same protocol (enter/exit/step/len) embedded in the Trainer loop (reference
+trainer.py:264,392); the torch.profiler kernel tracer becomes ``jax.profiler`` (XPlane
+trace viewable in TensorBoard/Perfetto), and CUDA memory-history snapshots become
+device memory-stats samples + an optional device memory profile dump.
+"""
+
+from __future__ import annotations
+
+import json
+from abc import ABC, abstractmethod
+from pathlib import Path
+from typing import Optional
+
+from modalities_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+class SteppableProfilerIF(ABC):
+    """Protocol: `with profiler: ... profiler.step()` once per train step."""
+
+    @abstractmethod
+    def __enter__(self): ...
+
+    @abstractmethod
+    def __exit__(self, exc_type, exc_val, exc_tb): ...
+
+    @abstractmethod
+    def step(self) -> None: ...
+
+    def __len__(self) -> int:
+        """Number of steps the profiling schedule spans (0 = unbounded)."""
+        return 0
+
+
+class SteppableNoProfiler(SteppableProfilerIF):
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        return False
+
+    def step(self) -> None:
+        pass
+
+
+class SteppableKernelProfiler(SteppableProfilerIF):
+    """wait/warmup/active schedule -> one jax.profiler trace of the active window
+    (reference SteppableKernelProfiler, :131-220)."""
+
+    def __init__(
+        self,
+        output_folder_path: Path,
+        wait_steps: int = 1,
+        warmup_steps: int = 1,
+        active_steps: int = 3,
+        repeat: int = 1,
+        with_python_stack: bool = False,
+    ):
+        self.output_folder_path = Path(output_folder_path)
+        self.wait_steps = wait_steps
+        self.warmup_steps = warmup_steps
+        self.active_steps = active_steps
+        self.repeat = max(1, repeat)
+        self.with_python_stack = with_python_stack
+        self._step = 0
+        self._tracing = False
+
+    def __len__(self) -> int:
+        return (self.wait_steps + self.warmup_steps + self.active_steps) * self.repeat
+
+    def _cycle_position(self) -> tuple[int, int]:
+        cycle_len = self.wait_steps + self.warmup_steps + self.active_steps
+        return self._step // cycle_len, self._step % cycle_len
+
+    def __enter__(self):
+        self._maybe_toggle()
+        return self
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        if self._tracing:
+            import jax
+
+            jax.profiler.stop_trace()
+            self._tracing = False
+        return False
+
+    def _maybe_toggle(self) -> None:
+        import jax
+
+        cycle, pos = self._cycle_position()
+        if cycle >= self.repeat:
+            if self._tracing:
+                jax.profiler.stop_trace()
+                self._tracing = False
+            return
+        active_start = self.wait_steps + self.warmup_steps
+        if pos == active_start and not self._tracing:
+            self.output_folder_path.mkdir(parents=True, exist_ok=True)
+            jax.profiler.start_trace(
+                str(self.output_folder_path), create_perfetto_trace=True
+            )
+            self._tracing = True
+            logger.info("kernel profiler: trace started at step %d", self._step)
+        elif pos == 0 and self._tracing:
+            jax.profiler.stop_trace()
+            self._tracing = False
+            logger.info("kernel profiler: trace stopped at step %d -> %s", self._step, self.output_folder_path)
+
+    def step(self) -> None:
+        self._step += 1
+        self._maybe_toggle()
+
+
+class SteppableMemoryProfiler(SteppableProfilerIF):
+    """Per-step device memory stats -> jsonl + final memory-profile dump
+    (reference SteppableMemoryProfiler, :86-128)."""
+
+    def __init__(self, output_folder_path: Path, max_steps: int = 0):
+        self.output_folder_path = Path(output_folder_path)
+        self.max_steps = max_steps
+        self._step = 0
+        self._records: list[dict] = []
+
+    def __len__(self) -> int:
+        return self.max_steps
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        self.output_folder_path.mkdir(parents=True, exist_ok=True)
+        with open(self.output_folder_path / "memory_stats.jsonl", "w") as f:
+            for rec in self._records:
+                f.write(json.dumps(rec) + "\n")
+        try:
+            import jax
+
+            jax.profiler.save_device_memory_profile(
+                str(self.output_folder_path / "memory.prof")
+            )
+        except Exception as e:
+            logger.warning("could not save device memory profile: %s", e)
+        return False
+
+    def step(self) -> None:
+        try:
+            import jax
+
+            stats = jax.local_devices()[0].memory_stats() or {}
+        except Exception:
+            stats = {}
+        self._records.append({"step": self._step, **{k: int(v) for k, v in stats.items()}})
+        self._step += 1
+
+
+class SteppableCombinedProfiler(SteppableProfilerIF):
+    def __init__(self, profilers: list[SteppableProfilerIF]):
+        self.profilers = profilers
+
+    def __len__(self) -> int:
+        return max((len(p) for p in self.profilers), default=0)
+
+    def __enter__(self):
+        for p in self.profilers:
+            p.__enter__()
+        return self
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        for p in self.profilers:
+            p.__exit__(exc_type, exc_val, exc_tb)
+        return False
+
+    def step(self) -> None:
+        for p in self.profilers:
+            p.step()

@@ -1,0 +1,57 @@
+"""Steppable components driven by the profiler harness
+(reference: src/modalities/utils/profilers/steppable_components.py:12, batch_generator.py:10)."""
+
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+
+import numpy as np
+
+
+class SteppableComponentIF(ABC):
+    @abstractmethod
+    def step(self) -> None: ...
+
+
+class RandomDatasetBatchGenerator:
+    """Random token batches with fixed shapes (reference batch_generator.py)."""
+
+    def __init__(self, sample_key: str, target_key: str, micro_batch_size: int, sequence_length: int,
+                 vocab_size: int, seed: int = 0):
+        self.sample_key = sample_key
+        self.target_key = target_key
+        self.micro_batch_size = micro_batch_size
+        self.sequence_length = sequence_length
+        self.vocab_size = vocab_size
+        self._rng = np.random.default_rng(seed)
+
+    def get_batch(self) -> dict:
+        tokens = self._rng.integers(
+            0, self.vocab_size, size=(1, self.micro_batch_size, self.sequence_length + 1)
+        )
+        return {
+            "samples": {self.sample_key: tokens[:, :, :-1].astype(np.int32)},
+            "targets": {self.target_key: tokens[:, :, 1:].astype(np.int32)},
+        }
+
+
+class SteppableForwardPass(SteppableComponentIF):
+    """Forward (and optionally backward+update) over random batches — the fwd-only
+    driver for kernel profiling (reference steppable_components.py:12)."""
+
+    def __init__(self, step_functions, batch_generator: RandomDatasetBatchGenerator, include_backward: bool = True):
+        self.step_functions = step_functions
+        self.batch_generator = batch_generator
+        self.include_backward = include_backward
+
+    def step(self) -> None:
+        import jax
+
+        batch = self.step_functions.put_batch(self.batch_generator.get_batch())
+        handle = self.step_functions.app_state_handle
+        if self.include_backward:
+            handle.state, metrics = self.step_functions.train_step(handle.state, batch)
+            jax.block_until_ready(metrics["loss"])
+        else:
+            metrics = self.step_functions.eval_step(handle.state, batch)
+            jax.block_until_ready(metrics["loss"])
