@@ -164,6 +164,9 @@ class GPT2ModelSpec:
     remat_variant: Optional[str] = None
     remat_freq: int = 1
     remat_save_list: tuple[str, ...] = ()
+    context_parallel_axis: Optional[str] = None  # set when the mesh has cp > 1
+    pipeline_axis: Optional[str] = None  # set when the mesh has pp > 1
+    pp_num_microbatches: Optional[int] = None  # GPipe microbatches (default: pp degree)
 
     @property
     def head_dim(self) -> int:
@@ -261,7 +264,14 @@ class CausalSelfAttention(nn.Module):
         k = with_logical_constraint(k, ("batch", "seq", "kv_heads", "head_dim"))
 
         impl = spec.attention_impl
-        if impl == AttentionImplementation.MANUAL.value:
+        if spec.context_parallel_axis is not None:
+            # real context parallelism: ring attention over the cp axis (the slot the
+            # reference leaves unfilled, SURVEY.md §5.7)
+            from modalities_tpu.parallel.ring_attention import ring_attention
+            from modalities_tpu.running_env.device_mesh import current_mesh
+
+            y = ring_attention(q, k, v, current_mesh(), axis_name=spec.context_parallel_axis)
+        elif impl == AttentionImplementation.MANUAL.value:
             y = manual_attention(q, k, v)
         elif impl == AttentionImplementation.DAO_FLASH.value:
             y = flash_attention(q, k, v)
@@ -379,8 +389,38 @@ class GPT2Module(nn.Module):
                 split_rngs={"params": True, "dropout": True},
                 length=spec.n_layer,
                 metadata_params={nn.meta.PARTITION_NAME: "layers"},
-            )
-            x, _ = scanned(spec, self.deterministic, name="blocks")(x, None)
+            )(spec, self.deterministic, name="blocks")
+            if spec.pipeline_axis is not None and not self.is_initializing():
+                if spec.dropout > 0.0 and not self.deterministic:
+                    raise NotImplementedError(
+                        "dropout > 0 with pipeline parallelism is not supported yet "
+                        "(rng threading through the GPipe schedule); set dropout to 0."
+                    )
+                # GPipe over the pp axis: same scan-stacked params (created by the init
+                # path below), applied stage-wise by parallel/pipeline.py
+                from modalities_tpu.parallel.pipeline import pipeline_blocks
+                from modalities_tpu.running_env.device_mesh import current_mesh
+
+                block_params = scanned.variables["params"]
+                deterministic = self.deterministic
+
+                def block_apply(layer_params, xx):
+                    fn = lambda p, a: GPT2Block(spec, deterministic).apply({"params": p["block"]}, a)  # noqa: E731
+                    if spec.remat_variant is not None:
+                        fn = jax.checkpoint(fn, prevent_cse=False)
+                    return fn(layer_params, xx)
+
+                x = pipeline_blocks(
+                    block_params,
+                    x,
+                    current_mesh(),
+                    block_apply,
+                    axis_name=spec.pipeline_axis,
+                    num_microbatches=spec.pp_num_microbatches,
+                    seq_shard_axis=spec.context_parallel_axis,
+                )
+            else:
+                x, _ = scanned(x, None)
         else:
             for i in range(spec.n_layer):
                 x = GPT2Block(spec, self.deterministic, name=f"h_{i}")(x)

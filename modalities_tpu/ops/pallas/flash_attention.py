@@ -1,0 +1,307 @@
+"""Pallas TPU flash attention — the framework's `dao_flash` tier
+(replaces the reference's flash-attn CUDA dependency, pyproject.toml:48,
+gpt2_model.py:643-655).
+
+Design (FlashAttention-2 style, TPU-first):
+- forward: grid (B, Hq, Sq/BQ, Sk/BK) with the kv dimension innermost ("arbitrary"
+  semantics): k/v stream through VMEM one [BK, D] tile per step while fp32
+  accumulators (acc, m, l) persist in VMEM scratch — VMEM stays O(BQ*D + BK*D)
+  regardless of sequence length; logsumexp is saved for the backward.
+- backward: two kernels with the same streaming structure — dq over q blocks
+  (kv innermost) and dk/dv over kv blocks (q innermost) — recomputing probabilities
+  blockwise from the saved logsumexp (no S x S materialization anywhere). GQA folds
+  the q-head group into the kv index map; dk/dv are accumulated per q-head and
+  group-summed outside the kernel.
+- causal blocks above the diagonal are skipped via predicated bodies (@pl.when).
+- block sizes default to 128 (MXU tile) with fallbacks for short sequences;
+  interpret mode keeps CPU tests exact.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+# --------------------------------------------------------------------------- fwd
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
+                *, sm_scale, causal, block_q, block_k):
+    iq = pl.program_id(2)
+    jk = pl.program_id(3)
+    num_kv = pl.num_programs(3)
+
+    @pl.when(jk == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    # causal: blocks entirely above the diagonal contribute nothing
+    needed = jnp.logical_or(not causal, jk * block_k <= iq * block_q + block_q - 1)
+
+    @pl.when(needed)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32) * sm_scale  # [BQ, D]
+        k = k_ref[0, 0].astype(jnp.float32)  # [BK, D]
+        v = v_ref[0, 0].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+        if causal:
+            q_pos = iq * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            k_pos = jk * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        m_prev, l_prev = m_ref[:], l_ref[:]
+        m_new = jnp.maximum(m_prev, s.max(axis=-1))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new[:, None])
+        l_ref[:] = l_prev * alpha + p.sum(axis=-1)
+        acc_ref[:] = acc_ref[:] * alpha[:, None] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        m_ref[:] = m_new
+
+    @pl.when(jk == num_kv - 1)
+    def _finish():
+        l_safe = jnp.maximum(l_ref[:], 1e-30)
+        o_ref[0, 0] = (acc_ref[:] / l_safe[:, None]).astype(o_ref.dtype)
+        lse_ref[0, 0] = m_ref[:] + jnp.log(l_safe)
+
+
+# ---------------------------------------------------------------------- bwd: dq
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc_ref,
+                   *, sm_scale, causal, block_q, block_k):
+    iq = pl.program_id(2)
+    jk = pl.program_id(3)
+    num_kv = pl.num_programs(3)
+
+    @pl.when(jk == 0)
+    def _init():
+        dq_acc_ref[:] = jnp.zeros_like(dq_acc_ref)
+
+    needed = jnp.logical_or(not causal, jk * block_k <= iq * block_q + block_q - 1)
+
+    @pl.when(needed)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)
+        do = do_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0]
+        delta = delta_ref[0, 0]
+        k = k_ref[0, 0].astype(jnp.float32)
+        v = v_ref[0, 0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q * sm_scale, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        if causal:
+            q_pos = iq * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            k_pos = jk * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse[:, None])
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * sm_scale
+        dq_acc_ref[:] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    @pl.when(jk == num_kv - 1)
+    def _finish():
+        dq_ref[0, 0] = dq_acc_ref[:].astype(dq_ref.dtype)
+
+
+# -------------------------------------------------------------------- bwd: dkdv
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+                    dk_acc_ref, dv_acc_ref, *, sm_scale, causal, block_q, block_k):
+    jk = pl.program_id(2)
+    iq = pl.program_id(3)
+    num_q = pl.num_programs(3)
+
+    @pl.when(iq == 0)
+    def _init():
+        dk_acc_ref[:] = jnp.zeros_like(dk_acc_ref)
+        dv_acc_ref[:] = jnp.zeros_like(dv_acc_ref)
+
+    needed = jnp.logical_or(not causal, iq * block_q + block_q - 1 >= jk * block_k)
+
+    @pl.when(needed)
+    def _compute():
+        k = k_ref[0, 0].astype(jnp.float32)
+        v = v_ref[0, 0].astype(jnp.float32)
+        q = q_ref[0, 0].astype(jnp.float32)
+        do = do_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0]
+        delta = delta_ref[0, 0]
+        s = jax.lax.dot_general(
+            q * sm_scale, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        if causal:
+            q_pos = iq * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            k_pos = jk * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse[:, None])
+        dv_acc_ref[:] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * sm_scale
+        dk_acc_ref[:] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    @pl.when(iq == num_q - 1)
+    def _finish():
+        dk_ref[0, 0] = dk_acc_ref[:].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_acc_ref[:].astype(dv_ref.dtype)
+
+
+# ------------------------------------------------------------------- entry point
+
+
+def _pick_block(seq: int, preferred: int) -> int:
+    if seq % preferred == 0:
+        return preferred
+    for cand in (512, 256, 128, 64, 32, 16, 8):
+        if seq % cand == 0 and cand <= seq:
+            return cand
+    return seq
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_attention_bhsd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
+    out, _ = _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret)
+    return out
+
+
+def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
+    """q: [B, Hq, Sq, D]; k/v: [B, Hkv, Sk, D] -> (out, residuals)."""
+    batch, num_heads, seq_q, head_dim = q.shape
+    num_kv_heads, seq_k = k.shape[1], k.shape[2]
+    group = num_heads // num_kv_heads
+
+    kernel = functools.partial(
+        _fwd_kernel, sm_scale=sm_scale, causal=causal, block_q=block_q, block_k=block_k
+    )
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=(batch, num_heads, seq_q // block_q, seq_k // block_k),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, head_dim), lambda b, h, iq, jk: (b, h, iq, 0)),
+            pl.BlockSpec((1, 1, block_k, head_dim), lambda b, h, iq, jk: (b, h // group, jk, 0)),
+            pl.BlockSpec((1, 1, block_k, head_dim), lambda b, h, iq, jk: (b, h // group, jk, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_q, head_dim), lambda b, h, iq, jk: (b, h, iq, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda b, h, iq, jk: (b, h, iq)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(q.shape, q.dtype),
+            jax.ShapeDtypeStruct((batch, num_heads, seq_q), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, head_dim), jnp.float32),
+            pltpu.VMEM((block_q,), jnp.float32),
+            pltpu.VMEM((block_q,), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_fwd_vjp(q, k, v, sm_scale, causal, block_q, block_k, interpret):
+    # custom_vjp fwd receives arguments in the primal order (nondiff included in place)
+    out, res = _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret)
+    return out, res
+
+
+def _flash_bwd_vjp(sm_scale, causal, block_q, block_k, interpret, res, do):
+    q, k, v, out, lse = res
+    batch, num_heads, seq_q, head_dim = q.shape
+    num_kv_heads, seq_k = k.shape[1], k.shape[2]
+    group = num_heads // num_kv_heads
+
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)  # [B, H, Sq]
+
+    dq = pl.pallas_call(
+        functools.partial(
+            _bwd_dq_kernel, sm_scale=sm_scale, causal=causal, block_q=block_q, block_k=block_k
+        ),
+        grid=(batch, num_heads, seq_q // block_q, seq_k // block_k),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, head_dim), lambda b, h, iq, jk: (b, h, iq, 0)),
+            pl.BlockSpec((1, 1, block_k, head_dim), lambda b, h, iq, jk: (b, h // group, jk, 0)),
+            pl.BlockSpec((1, 1, block_k, head_dim), lambda b, h, iq, jk: (b, h // group, jk, 0)),
+            pl.BlockSpec((1, 1, block_q, head_dim), lambda b, h, iq, jk: (b, h, iq, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda b, h, iq, jk: (b, h, iq)),
+            pl.BlockSpec((1, 1, block_q), lambda b, h, iq, jk: (b, h, iq)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, block_q, head_dim), lambda b, h, iq, jk: (b, h, iq, 0)),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, head_dim), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+
+    # dk/dv per q-head (q blocks innermost), then summed over the GQA group
+    dk_h, dv_h = pl.pallas_call(
+        functools.partial(
+            _bwd_dkv_kernel, sm_scale=sm_scale, causal=causal, block_q=block_q, block_k=block_k
+        ),
+        grid=(batch, num_heads, seq_k // block_k, seq_q // block_q),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, head_dim), lambda b, h, jk, iq: (b, h, iq, 0)),
+            pl.BlockSpec((1, 1, block_k, head_dim), lambda b, h, jk, iq: (b, h // group, jk, 0)),
+            pl.BlockSpec((1, 1, block_k, head_dim), lambda b, h, jk, iq: (b, h // group, jk, 0)),
+            pl.BlockSpec((1, 1, block_q, head_dim), lambda b, h, jk, iq: (b, h, iq, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda b, h, jk, iq: (b, h, iq)),
+            pl.BlockSpec((1, 1, block_q), lambda b, h, jk, iq: (b, h, iq)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_k, head_dim), lambda b, h, jk, iq: (b, h, jk, 0)),
+            pl.BlockSpec((1, 1, block_k, head_dim), lambda b, h, jk, iq: (b, h, jk, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((batch, num_heads, seq_k, head_dim), q.dtype),
+            jax.ShapeDtypeStruct((batch, num_heads, seq_k, head_dim), q.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, head_dim), jnp.float32),
+            pltpu.VMEM((block_k, head_dim), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+
+    if group > 1:
+        dk = dk_h.reshape(batch, num_kv_heads, group, seq_k, head_dim).sum(axis=2)
+        dv = dv_h.reshape(batch, num_kv_heads, group, seq_k, head_dim).sum(axis=2)
+    else:
+        dk, dv = dk_h, dv_h
+    return dq, dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_flash_attention_bhsd.defvjp(_flash_fwd_vjp, _flash_bwd_vjp)
+
+
+def pallas_flash_attention(
+    q, k, v, causal: bool = True, sm_scale: float | None = None,
+    block_q: int = 128, block_k: int = 128, interpret: bool = False,
+):
+    """Public entry. q: [B, S, Hq, D], k/v: [B, S, Hkv, D] (model layout) -> [B, S, Hq, D]."""
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    seq_q, seq_k = q.shape[1], k.shape[1]
+    block_q = _pick_block(seq_q, block_q)
+    block_k = _pick_block(seq_k, block_k)
+    qt = q.transpose(0, 2, 1, 3)
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+    out = _flash_attention_bhsd(qt, kt, vt, sm_scale, causal, block_q, block_k, interpret)
+    return out.transpose(0, 2, 1, 3)

@@ -1,0 +1,159 @@
+"""Pipeline parallelism: shard_map GPipe schedule over the `pp` mesh axis
+(reference: src/modalities/models/parallelism/pipeline_parallelism.py — torch
+pipelining's PipelineStage + schedules, re-imagined for SPMD).
+
+Representation: the transformer blocks are scan-stacked (params carry a leading
+"layers" axis, sharded over `pp` by parallel/sharding.py). Each pp group therefore
+already *owns* its stage's contiguous layer slice — stage splitting is a sharding
+fact, not a module-surgery step like the reference's FQN-tree pruning
+(pipeline_parallelism.py:212-277).
+
+Schedule: classic GPipe over M microbatches inside one shard_map region:
+
+    for t in 0 .. M+P-2:                       # P = pp degree
+        x   = (stage 0) ? microbatch[t] : recv
+        y   = stage_blocks(local_params, x)    # lax.scan over local layers
+        recv = ppermute(y, stage s -> s+1)     # ICI neighbor hop
+        (last stage) collects y into outputs
+
+Autodiff of this loop IS the backward schedule: JAX reverses the scan and transposes
+every ppermute, yielding the symmetric reverse-staged backward (1F1B-style overlap is
+a later optimization; DualPipeV/ZBV out of scope this round, as SURVEY.md §7 plans).
+
+The loop runs as `lax.scan` over schedule ticks (static shapes, one compiled body).
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclass
+class Pipeline:
+    """Holder mirroring the reference's Pipeline (stages, schedule) surface."""
+
+    pp_degree: int
+    num_microbatches: int
+    schedule: str = "gpipe"
+
+
+def _gpipe_local(stacked_params, x_microbatches, *, axis_name: str, num_stages: int,
+                 block_apply: Callable, compute_dtype):
+    """Runs on one pp shard. stacked_params: [L/P, ...] pytree; x_microbatches:
+    [M, B, S, E] f32 at the boundary (replicated over pp — its cotangent psum must be
+    f32: bf16 psum in a partial-manual region trips an XLA check). Compute runs in
+    `compute_dtype`. Returns [M, B, S, E] f32, valid on every shard."""
+    x_microbatches = x_microbatches.astype(compute_dtype)
+    stage = jax.lax.axis_index(axis_name)
+    num_micro = x_microbatches.shape[0]
+    perm = [(i, (i + 1) % num_stages) for i in range(num_stages)]
+
+    def stage_fn(x):
+        def body(carry, layer_params):
+            return block_apply(layer_params, carry), None
+
+        out, _ = jax.lax.scan(body, x, stacked_params)
+        return out
+
+    x_shape = x_microbatches.shape[1:]
+
+    def tick(carry, t):
+        recv, outputs = carry
+        mb_index = jnp.clip(t, 0, num_micro - 1)
+        first_stage_input = x_microbatches[mb_index]
+        x = jnp.where(stage == 0, first_stage_input, recv)
+        y = stage_fn(x)
+        out_index = jnp.clip(t - (num_stages - 1), 0, num_micro - 1)
+        is_output_tick = t >= num_stages - 1
+        collected = jnp.where(
+            jnp.logical_and(stage == num_stages - 1, is_output_tick),
+            y,
+            outputs[out_index],
+        )
+        outputs = outputs.at[out_index].set(collected)
+        recv_next = jax.lax.ppermute(y, axis_name, perm)
+        return (recv_next, outputs), None
+
+    init = (
+        jnp.zeros(x_shape, x_microbatches.dtype),
+        jnp.zeros((num_micro,) + x_shape, x_microbatches.dtype),
+    )
+    (recv, outputs), _ = jax.lax.scan(tick, init, jnp.arange(num_micro + num_stages - 1))
+    # broadcast the collected outputs from the last stage to all pp shards so the
+    # (pp-replicated) lm head sees them; backward of psum distributes cotangents back.
+    # psum in f32: bf16 psum inside a partial-manual shard_map region trips an XLA
+    # check ("Invalid binary instruction opcode copy"); f32 is also the safer reduce.
+    masked = jnp.where(stage == num_stages - 1, outputs, jnp.zeros_like(outputs))
+    return jax.lax.psum(masked.astype(jnp.float32), axis_name)
+
+
+def pipeline_blocks(
+    stacked_params,
+    x,
+    mesh,
+    block_apply: Callable,
+    *,
+    axis_name: str = "pp",
+    num_microbatches: Optional[int] = None,
+    seq_shard_axis: Optional[str] = None,
+):
+    """Run scan-stacked transformer blocks as a GPipe pipeline over `axis_name`.
+
+    stacked_params: pytree with leading layers axis L (sharded over pp);
+    x: [B, S, E] activations. Batch is split into `num_microbatches` along B.
+    `seq_shard_axis` (e.g. "cp"): also bind that axis manually with the seq dim
+    sharded over it, so in-block ring attention composes with the pipeline.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    if mesh is None or axis_name not in mesh.axis_names or mesh.shape[axis_name] == 1:
+
+        def body(carry, layer_params):
+            return block_apply(layer_params, carry), None
+
+        out, _ = jax.lax.scan(body, x, stacked_params)
+        return out
+
+    num_stages = mesh.shape[axis_name]
+    batch = x.shape[0]
+    if num_microbatches is None:
+        num_microbatches = num_stages
+    num_microbatches = min(num_microbatches, batch)
+    if batch % num_microbatches != 0:
+        raise ValueError(f"batch ({batch}) must be divisible by num_microbatches ({num_microbatches})")
+
+    total_layers = jax.tree.leaves(stacked_params)[0].shape[0]
+    if total_layers % num_stages != 0:
+        raise ValueError(f"n_layer ({total_layers}) must be divisible by pp degree ({num_stages})")
+
+    compute_dtype = x.dtype
+    x_mb = x.reshape(num_microbatches, batch // num_microbatches, *x.shape[1:]).astype(jnp.float32)
+
+    manual_axes = {axis_name}
+    x_spec = P()
+    if seq_shard_axis is not None and seq_shard_axis in mesh.axis_names and mesh.shape[seq_shard_axis] > 1:
+        manual_axes.add(seq_shard_axis)
+        x_spec = P(None, None, seq_shard_axis)  # [M, B, S, ...]: seq sharded over cp
+
+    param_specs = jax.tree.map(lambda _: P(axis_name), stacked_params)
+    fn = jax.shard_map(
+        functools.partial(
+            _gpipe_local,
+            axis_name=axis_name,
+            num_stages=num_stages,
+            block_apply=block_apply,
+            compute_dtype=compute_dtype,
+        ),
+        mesh=mesh,
+        in_specs=(param_specs, x_spec),
+        out_specs=x_spec,
+        axis_names=frozenset(manual_axes),
+        check_vma=False,
+    )
+    out_mb = fn(stacked_params, x_mb)
+    return out_mb.reshape(batch, *x.shape[1:]).astype(compute_dtype)

@@ -1,0 +1,126 @@
+"""Ring attention over the `cp` mesh axis — real context parallelism.
+
+The reference materializes a `cp` mesh dim but consumes it nowhere (SURVEY.md §5.7:
+no ring attention/Ulysses/blockwise attention exist; trainer.py:165 has only a
+commented-out CP context). This module fills that slot TPU-first:
+
+- sequence dim sharded over `cp`; each device holds local q/k/v chunks
+- k/v chunks rotate around the ring via `lax.ppermute` (ICI neighbor hops) while each
+  device accumulates attention for its q chunk with an online-softmax merge — peak
+  memory O(S_local^2) per device instead of O(S^2), communication fully overlappable
+- causality handled with *global position* masks (device i's chunk j contributes only
+  where q_global >= k_global), so chunks from the "future" merge as exact no-ops
+- differentiable end-to-end: the ring is plain traced JAX (ppermute + einsum), so
+  autodiff produces the reverse ring for dk/dv.
+
+Composable with GQA (kv-head grouping) and remat (the block remat wraps this).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def _chunk_attention_stats(q, k, v, q_offset, k_offset, causal: bool, sm_scale: float):
+    """Blockwise attention with global-position causal mask.
+
+    q: [B, Sq, Hq, D], k/v: [B, Sk, Hkv, D] -> (o_unnorm [B,Sq,Hq,D] f32, m, l [B,Sq,Hq]).
+    """
+    b, sq, hq, d = q.shape
+    hkv = k.shape[2]
+    group = hq // hkv
+    qg = q.reshape(b, sq, hkv, group, d).astype(jnp.float32)
+    s = jnp.einsum("bshgd,bthd->bhgst", qg * sm_scale, k.astype(jnp.float32))  # [B,Hkv,G,Sq,Sk]
+    if causal:
+        q_pos = q_offset + jnp.arange(sq)
+        k_pos = k_offset + jnp.arange(k.shape[1])
+        mask = q_pos[:, None] >= k_pos[None, :]
+        s = jnp.where(mask[None, None, None, :, :], s, NEG_INF)
+    m = s.max(axis=-1)  # [B,Hkv,G,Sq]
+    p = jnp.exp(s - m[..., None])
+    # fully-masked rows: m == NEG_INF -> force p to 0 so l stays 0
+    p = jnp.where((m == NEG_INF)[..., None], 0.0, p)
+    l = p.sum(axis=-1)
+    o = jnp.einsum("bhgst,bthd->bshgd", p, v.astype(jnp.float32))
+    o = o.reshape(b, sq, hq, d)
+    m = m.transpose(0, 3, 1, 2).reshape(b, sq, hq)
+    l = l.transpose(0, 3, 1, 2).reshape(b, sq, hq)
+    return o, m, l
+
+
+def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool, sm_scale: float):
+    """Runs on each cp shard inside shard_map. q/k/v: [B, S_local, H(, kv), D]."""
+    cp = jax.lax.psum(1, axis_name)
+    my_index = jax.lax.axis_index(axis_name)
+    s_local = q.shape[1]
+    b, _, hq, d = q.shape
+
+    acc = jnp.zeros((b, s_local, hq, d), jnp.float32)
+    m_run = jnp.full((b, s_local, hq), NEG_INF, jnp.float32)
+    l_run = jnp.zeros((b, s_local, hq), jnp.float32)
+
+    k_cur, v_cur = k, v
+    perm = [(i, (i + 1) % cp) for i in range(cp)]
+
+    for r in range(cp):
+        j_index = (my_index - r) % cp  # which chunk we currently hold
+        o_r, m_r, l_r = _chunk_attention_stats(
+            q, k_cur, v_cur,
+            q_offset=my_index * s_local,
+            k_offset=j_index * s_local,
+            causal=causal,
+            sm_scale=sm_scale,
+        )
+        m_new = jnp.maximum(m_run, m_r)
+        # guard: if both are NEG_INF the row has no keys yet; keep weights at 0
+        alpha = jnp.where(m_run == NEG_INF, 0.0, jnp.exp(m_run - m_new))
+        beta = jnp.where(m_r == NEG_INF, 0.0, jnp.exp(m_r - m_new))
+        acc = acc * alpha[..., None] + o_r * beta[..., None]
+        l_run = l_run * alpha + l_r * beta
+        m_run = m_new
+        if r != cp - 1:
+            k_cur = jax.lax.ppermute(k_cur, axis_name, perm)
+            v_cur = jax.lax.ppermute(v_cur, axis_name, perm)
+
+    l_safe = jnp.maximum(l_run, 1e-30)
+    return (acc / l_safe[..., None]).astype(q.dtype)
+
+
+def ring_attention(
+    q, k, v, mesh, *, axis_name: str = "cp", causal: bool = True, sm_scale: float | None = None
+):
+    """Context-parallel attention. q: [B, S, Hq, D], k/v: [B, S, Hkv, D], with S
+    sharded over `axis_name`; all other axes left to GSPMD (shard_map auto mode)."""
+    from jax.sharding import PartitionSpec as P
+
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    # mesh is None during mesh-context-free traces (eval_shape); shapes are identical
+    # on the fallback path, so abstract evaluation stays faithful
+    if mesh is None or axis_name not in mesh.axis_names or mesh.shape[axis_name] == 1:
+        return jax.nn.dot_product_attention(q, k, v, is_causal=causal, scale=sm_scale)
+
+    # Already inside a manual region over cp (e.g. the pp pipeline's shard_map binds
+    # {pp, cp})? Then q/k/v are per-shard local and collectives over cp are legal
+    # directly — run the ring body without nesting another shard_map.
+    ambient = jax.sharding.get_abstract_mesh()
+    if ambient is not None and axis_name in getattr(ambient, "manual_axes", ()):
+        return _ring_attention_local(q, k, v, axis_name=axis_name, causal=causal, sm_scale=sm_scale)
+
+    spec = P(None, axis_name, None, None)
+    # only `cp` is manual; dp/tp stay auto so GSPMD keeps partitioning batch/heads
+    fn = jax.shard_map(
+        functools.partial(_ring_attention_local, axis_name=axis_name, causal=causal, sm_scale=sm_scale),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        axis_names=frozenset({axis_name}),
+        check_vma=False,
+    )
+    return fn(q, k, v)

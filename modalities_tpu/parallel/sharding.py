@@ -38,6 +38,7 @@ def default_logical_axis_rules(mesh_handle: DeviceMeshHandle, sequence_parallel:
     tp = "tp" if has("tp") else None
     dp_shard = "dp_shard" if "dp_shard" in axis_names else None
     cp = "cp" if has("cp") else None
+    pp = "pp" if has("pp") else None
 
     batch_axes = tuple(n for n in ("dp_replicate", "dp_shard") if n in axis_names)
 
@@ -55,7 +56,10 @@ def default_logical_axis_rules(mesh_handle: DeviceMeshHandle, sequence_parallel:
         ("mlp", tp),
         ("vocab", tp),
         ("seq_param", None),
-        ("layers", None),  # scan axis; pp splits it at stage boundaries, not via sharding
+        # stacked-block scan axis: sharded over pp so each stage group owns its layers'
+        # params (the GSPMD expression of stage-wise parameter placement; the shard_map
+        # GPipe schedule in parallel/pipeline.py consumes the same layout)
+        ("layers", pp),
     ]
     return tuple(rules)
 

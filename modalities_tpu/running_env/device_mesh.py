@@ -174,6 +174,17 @@ def get_device_mesh(
     return DeviceMeshHandle(mesh, degrees, enable_loss_parallel=cfg.enable_loss_parallel)
 
 
+def current_mesh():
+    """The ambient physical mesh (entered via `with mesh:`); None outside a context.
+    Used by model code that needs explicit collectives (ring attention) without
+    threading the mesh object through module attributes."""
+    from jax._src import mesh as mesh_lib
+
+    m = mesh_lib.thread_resources.env.physical_mesh
+    # outside a context the physical mesh is a 0-d placeholder with no axis names
+    return m if m.axis_names else None
+
+
 def get_parallel_degree(mesh_handle: DeviceMeshHandle, method: ParallelismDegrees | str) -> int:
     return mesh_handle.get_parallel_degree(method)
 

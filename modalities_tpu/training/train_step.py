@@ -112,6 +112,13 @@ class TrainStepBuilder:
         seed = seed if seed is not None else model.seed
         rng = jax.random.PRNGKey(seed)
 
+        # enable ring-attention CP / GPipe PP when the mesh has those axes
+        if mesh_handle is not None and hasattr(model, "with_spec_updates"):
+            if mesh_handle.degrees.get("cp", 1) > 1:
+                model.with_spec_updates(context_parallel_axis="cp")
+            if mesh_handle.degrees.get("pp", 1) > 1:
+                model.with_spec_updates(pipeline_axis="pp")
+
         init_fn = lambda r: model.init_params(r)  # noqa: E731
 
         # --- shardings from flax logical-axis metadata
@@ -216,14 +223,25 @@ class TrainStepBuilder:
             return {"loss": loss_fn(predictions, batch["targets"])}
 
         if mesh_handle is not None:
-            with mesh_handle.mesh:
-                train_step_c = jax.jit(
-                    train_step,
-                    donate_argnums=(0,),
-                    in_shardings=(state_shardings, None),
-                    out_shardings=(state_shardings, replicated_sharding),
-                )
-                eval_step_c = jax.jit(eval_step, in_shardings=(state_shardings, None))
+            mesh = mesh_handle.mesh
+            train_step_j = jax.jit(
+                train_step,
+                donate_argnums=(0,),
+                in_shardings=(state_shardings, None),
+                out_shardings=(state_shardings, replicated_sharding),
+            )
+            eval_step_j = jax.jit(eval_step, in_shardings=(state_shardings, None))
+
+            # execute (and trace) under the mesh context so in-model collectives
+            # (ring attention shard_map) can resolve the ambient mesh
+            def train_step_c(state, batch):
+                with mesh:
+                    return train_step_j(state, batch)
+
+            def eval_step_c(state, batch):
+                with mesh:
+                    return eval_step_j(state, batch)
+
         else:
             train_step_c = jax.jit(train_step, donate_argnums=(0,))
             eval_step_c = jax.jit(eval_step)

@@ -145,3 +145,75 @@ def test_unknown_weight_decay_group_raises():
     params = meta.unbox(model.init_params(jax.random.PRNGKey(0)))
     with pytest.raises(ValueError, match="not in model's weight_decay_groups"):
         build_weight_decay_mask(params, model, ["bogus"])
+
+
+def test_dp_cp_equivalence():
+    """dp8 vs dp2 x cp4 (ring attention) must produce identical losses — the
+    CP-vs-single-device oracle for the cp mesh dim."""
+    model = tiny_gpt2("pytorch_flash")
+    mesh_dp = get_device_mesh(device_type="cpu", data_parallel_shard_degree=8, world_size=8)
+    mesh_cp = get_device_mesh(
+        device_type="cpu", data_parallel_shard_degree=2, context_parallel_degree=4, world_size=8
+    )
+    rng = np.random.default_rng(5)
+    raw = _batch(rng, 1, 8, 32)
+
+    losses = {}
+    for name, mesh in [("dp", mesh_dp), ("dp_cp", mesh_cp)]:
+        model_run = tiny_gpt2("pytorch_flash")
+        fns = _builder(model_run, mesh, clip=1.0).build(seed=0)
+        state = fns.app_state_handle.state
+        ls = []
+        for _ in range(3):
+            state, metrics = fns.train_step(state, fns.put_batch(raw))
+            ls.append(float(metrics["loss"]))
+        losses[name] = ls
+    np.testing.assert_allclose(losses["dp"], losses["dp_cp"], rtol=3e-4, atol=3e-4)
+
+
+def test_dp_pp_equivalence():
+    """dp8 vs pp2 x dp4 (GPipe schedule) must produce identical losses — the PP
+    fwd/bwd-vs-FSDP oracle (reference test_pp_fwd_bwd_pass.py)."""
+    mesh_dp = get_device_mesh(device_type="cpu", data_parallel_shard_degree=8, world_size=8)
+    mesh_pp = get_device_mesh(
+        device_type="cpu", data_parallel_shard_degree=4, pipeline_parallel_degree=2, world_size=8
+    )
+    rng = np.random.default_rng(6)
+    raw = _batch(rng, 1, 8, 16)
+
+    losses = {}
+    for name, mesh in [("dp", mesh_dp), ("pp_dp", mesh_pp)]:
+        model_run = tiny_gpt2("pytorch_flash")
+        fns = _builder(model_run, mesh, clip=1.0).build(seed=0)
+        state = fns.app_state_handle.state
+        ls = []
+        for _ in range(3):
+            state, metrics = fns.train_step(state, fns.put_batch(raw))
+            ls.append(float(metrics["loss"]))
+        losses[name] = ls
+    np.testing.assert_allclose(losses["dp"], losses["pp_dp"], rtol=3e-4, atol=3e-4)
+
+
+def test_dp_vs_pp_cp_combined_equivalence():
+    """dp8 vs pp2 x dp2 x cp2 — all schedule-bearing parallelism forms composed."""
+    mesh_dp = get_device_mesh(device_type="cpu", data_parallel_shard_degree=8, world_size=8)
+    mesh_mix = get_device_mesh(
+        device_type="cpu",
+        data_parallel_shard_degree=2,
+        context_parallel_degree=2,
+        pipeline_parallel_degree=2,
+        world_size=8,
+    )
+    rng = np.random.default_rng(8)
+    raw = _batch(rng, 1, 8, 32)
+
+    losses = {}
+    for name, mesh in [("dp", mesh_dp), ("mix", mesh_mix)]:
+        fns = _builder(tiny_gpt2("pytorch_flash"), mesh, clip=1.0).build(seed=0)
+        state = fns.app_state_handle.state
+        ls = []
+        for _ in range(2):
+            state, metrics = fns.train_step(state, fns.put_batch(raw))
+            ls.append(float(metrics["loss"]))
+        losses[name] = ls
+    np.testing.assert_allclose(losses["dp"], losses["mix"], rtol=5e-4, atol=5e-4)
