@@ -318,6 +318,13 @@ class GPT2LLMCollateFnConfig(BaseModel):
     target_key: str
 
 
+class CoCaCollatorConfig(BaseModel):
+    sample_keys: list[str]
+    target_keys: list[str]
+    text_sample_key: str
+    text_target_key: str
+
+
 class LossMaskingCollateFnWrapperConfig(BaseModel):
     wrapped_collate_fn: PydanticCollateFnIFType
     target_keys_to_mask: list[str]

@@ -1,0 +1,140 @@
+"""Instruction-tuning data preparation: jinja2 chat templating + train/val/test split +
+index/pbin creation (reference: src/modalities/dataloader/apply_chat_template.py:15,
+create_instruction_tuning_data.py:12).
+
+Host-side tooling, fully TPU-agnostic: streams a conversations JSONL, renders each
+conversation through a sandboxed jinja2 chat template (with role remapping), splits
+into partitions by weighted random draw, then runs the index + pack pipeline per
+partition. Output filenames carry a config-hash suffix so regenerated datasets never
+silently alias old ones.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import shutil
+from pathlib import Path
+from typing import Optional
+
+import numpy as np
+import yaml
+from pydantic import BaseModel, Field
+
+from modalities_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+class Splitting(BaseModel):
+    train: int = Field(ge=0, le=100)
+    val: int = Field(ge=0, le=100)
+    test: int = Field(ge=0, le=100)
+
+
+class SplitConfig(BaseModel):
+    splitting: Splitting
+    seed: int = 0
+
+
+class InstructionDataTransformation(BaseModel):
+    role_mapping: dict[str, str]
+
+
+class InstructionTuningSettings(BaseModel):
+    src_path: Path
+    dst_path: Path
+    messages_key: str = "messages"
+    pbin_creation_config_file_path: Optional[Path] = None
+    split_config: SplitConfig
+
+
+class InstructionTuningDataInstantiationModel(BaseModel):
+    settings: InstructionTuningSettings
+    instruction_data_transformation: InstructionDataTransformation
+    jinja2_chat_template: str
+    chat_template_data: dict = {}
+
+
+def _compile_chat_template(template_str: str):
+    from jinja2.sandbox import ImmutableSandboxedEnvironment
+
+    env = ImmutableSandboxedEnvironment(trim_blocks=True, lstrip_blocks=True)
+
+    def raise_exception(message):
+        raise ValueError(message)
+
+    env.globals["raise_exception"] = raise_exception
+    env.filters["tojson"] = lambda value, **kw: json.dumps(value, **kw)
+    return env.from_string(template_str)
+
+
+def _file_hash(path: Path, length: int = 7) -> str:
+    digest = hashlib.sha256(Path(path).read_bytes()).hexdigest()
+    return digest[:length]
+
+
+def split_and_apply_chat_template(config_file_path: Path, config_dict: dict) -> dict[str, Path]:
+    config = InstructionTuningDataInstantiationModel(**config_dict)
+    settings = config.settings
+    template = _compile_chat_template(config.jinja2_chat_template)
+    role_mapping = config.instruction_data_transformation.role_mapping
+
+    hash_str = _file_hash(config_file_path)
+    dst_path = Path(settings.dst_path)
+    dst_path = dst_path.parent / f"{Path(settings.src_path).stem}_{hash_str}" / dst_path.name
+    dst_path.parent.mkdir(parents=True, exist_ok=True)
+    shutil.copyfile(config_file_path, dst_path.parent / f"{Path(config_file_path).stem}_{hash_str}.yaml")
+    default_suffix = f".{hash_str}" + "".join(dst_path.suffixes)
+
+    splits = {k: v for k, v in settings.split_config.splitting.model_dump().items() if v > 0}
+    total = sum(splits.values())
+    names = list(splits)
+    probabilities = np.asarray([splits[n] / total for n in names])
+    rng = np.random.default_rng(settings.split_config.seed)
+
+    out_paths = {
+        name: dst_path.with_name(f"{dst_path.stem}_{name}").with_suffix(default_suffix) for name in names
+    }
+    out_files = {name: path.open("w") for name, path in out_paths.items()}
+    counts = {name: 0 for name in names}
+    try:
+        with open(settings.src_path) as src:
+            for line in src:
+                if not line.strip():
+                    continue
+                entry = json.loads(line)
+                messages = [
+                    {**m, "role": role_mapping.get(m.get("role"), m.get("role"))}
+                    for m in entry[settings.messages_key]
+                ]
+                entry["chat"] = template.render(messages=messages, chat_template_data=config.chat_template_data)
+                partition = names[int(rng.choice(len(names), p=probabilities))]
+                json.dump(entry, out_files[partition], ensure_ascii=False)
+                out_files[partition].write("\n")
+                counts[partition] += 1
+    finally:
+        for f in out_files.values():
+            f.close()
+    logger.info("Chat template applied: %s", {n: counts[n] for n in names})
+    return {name: path for name, path in out_paths.items() if counts[name] > 0}
+
+
+def create_instruction_tuning_data(config_file_path: Path) -> None:
+    from modalities_tpu.api import FileExistencePolicy, create_raw_data_index, pack_encoded_data
+    from modalities_tpu.config.yaml_interp import load_app_config_dict
+
+    config_dict = load_app_config_dict(config_file_path)
+    partition_paths = split_and_apply_chat_template(Path(config_file_path), config_dict)
+    config = InstructionTuningDataInstantiationModel(**config_dict)
+
+    for partition, jsonl_path in partition_paths.items():
+        idx_path = jsonl_path.with_suffix(".idx")
+        create_raw_data_index(jsonl_path, idx_path, file_existence_policy=FileExistencePolicy.OVERRIDE)
+        if config.settings.pbin_creation_config_file_path is None:
+            continue
+        pbin_config = load_app_config_dict(config.settings.pbin_creation_config_file_path)
+        pbin_config["settings"]["src_path"] = str(jsonl_path)
+        pbin_config["settings"]["index_path"] = str(idx_path)
+        pbin_config["settings"]["dst_path"] = str(jsonl_path.with_suffix(".pbin"))
+        pack_encoded_data(pbin_config, file_existence_policy=FileExistencePolicy.OVERRIDE)

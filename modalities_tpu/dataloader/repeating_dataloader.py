@@ -1,0 +1,35 @@
+"""Epoch-repeating dataloader wrapper (reference: RepeatingDataLoader in
+src/modalities/dataloader/dataloader.py). Restarts the wrapped loader each epoch,
+optionally reshuffling (sampler epoch bump) between epochs."""
+
+from __future__ import annotations
+
+from modalities_tpu.dataloader.dataloader import LLMDataLoader
+
+
+class RepeatingDataLoader:
+    def __init__(self, dataloader: LLMDataLoader, reshuffle_after_epoch: bool = False):
+        self.dataloader = dataloader
+        self.reshuffle_after_epoch = reshuffle_after_epoch
+        self.current_epoch = 0
+
+    @property
+    def dataloader_tag(self) -> str:
+        return self.dataloader.dataloader_tag
+
+    @property
+    def batch_size(self) -> int:
+        return self.dataloader.batch_size
+
+    def __len__(self) -> int:
+        return len(self.dataloader)
+
+    def __iter__(self):
+        while True:
+            for batch in self.dataloader:
+                yield batch
+            self.current_epoch += 1
+            if self.reshuffle_after_epoch:
+                sampler = getattr(self.dataloader.batch_sampler, "sampler", None)
+                if sampler is not None and hasattr(sampler, "epoch"):
+                    sampler.epoch = self.current_epoch

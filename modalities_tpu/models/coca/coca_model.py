@@ -1,0 +1,286 @@
+"""CoCa — Contrastive Captioner multimodal model
+(reference: src/modalities/models/coca/coca_model.py:86, multi_modal_decoder.py:98,
+text_decoder.py:10, attention_pooling.py:7; paper arXiv:2205.01917).
+
+Architecture (parity): ViT image encoder -> attention pooling with learned queries
+(n_vision_queries for cross-attention + 1 as the contrastive vision cls token);
+unimodal text decoder (causal, cls token appended) producing the text cls embedding;
+multimodal decoder with cross-attention over pooled vision tokens producing caption
+logits. wte of the text decoder is tied to the multimodal decoder's lm head.
+
+TPU-first: single linen module tree, fused SDPA everywhere, fp32 contrastive head.
+"""
+
+from __future__ import annotations
+
+from typing import Annotated, Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+from pydantic import BaseModel, Field
+
+from modalities_tpu.models.model import NNModel
+from modalities_tpu.models.vision_transformer.vision_transformer_model import (
+    VisionTransformerConfig,
+    _VisionTransformerModule,
+)
+from modalities_tpu.nn.attention import AttentionType, MultiHeadAttention
+from modalities_tpu.nn.mlp import MLP
+
+
+class TextDecoderConfig(BaseModel):
+    sample_key: str
+    prediction_key: str
+    block_size: Annotated[int, Field(ge=1)]
+    vocab_size: Annotated[int, Field(ge=1)]
+    n_layer_text: Annotated[int, Field(ge=1)]
+    n_layer_multimodal_text: Annotated[int, Field(ge=1)]
+    n_head: Annotated[int, Field(ge=1)]
+    n_embd: Annotated[int, Field(ge=1)]
+    ffn_hidden: Annotated[int, Field(ge=1)]
+    dropout: Annotated[float, Field(ge=0.0)]
+    bias: bool
+    attention_config: Optional[dict] = None
+    activation: str = "gelu"
+    epsilon: Annotated[float, Field(ge=0.0)] = 1e-5
+
+
+class CoCaConfig(BaseModel):
+    prediction_key: str = "logits"
+    vision_embd_prediction_key: str
+    text_embd_prediction_key: str
+    vision_cls_prediction_key: str
+    text_cls_prediction_key: str
+    vision_encoder_config: VisionTransformerConfig
+    text_decoder_config: TextDecoderConfig
+    n_pool_head: Annotated[int, Field(ge=1)]
+    n_vision_queries: Annotated[int, Field(ge=1)]
+    bias_attn_pool: bool
+    epsilon_attn_pool: Annotated[float, Field(ge=0.0)]
+
+
+class AttentionPooling(nn.Module):
+    """Learned-query cross-attention pooling (reference attention_pooling.py:7)."""
+
+    n_embd: int
+    n_head: int
+    bias: bool
+    epsilon: float
+
+    @nn.compact
+    def __call__(self, queries, context):
+        x = nn.LayerNorm(epsilon=self.epsilon, name="ln_1", dtype=queries.dtype)(queries)
+        context = nn.LayerNorm(epsilon=self.epsilon, name="ln_context", dtype=context.dtype)(context)
+        x = MultiHeadAttention(
+            n_embd=self.n_embd,
+            n_head=self.n_head,
+            bias=self.bias,
+            attention_type=AttentionType.CROSS_ATTENTION,
+            name="attn",
+        )(x, context=context)
+        return nn.LayerNorm(epsilon=self.epsilon, name="ln_2", dtype=x.dtype)(x)
+
+
+class _DecoderBlock(nn.Module):
+    """Causal text block, optionally with cross-attention (multimodal)."""
+
+    n_embd: int
+    n_head: int
+    ffn_hidden: int
+    bias: bool
+    dropout: float
+    epsilon: float
+    with_cross_attention: bool = False
+    deterministic: bool = True
+
+    @nn.compact
+    def __call__(self, x, context=None):
+        h = nn.LayerNorm(epsilon=self.epsilon, name="ln_1", dtype=x.dtype)(x)
+        x = x + MultiHeadAttention(
+            n_embd=self.n_embd, n_head=self.n_head, bias=self.bias, dropout=self.dropout,
+            attention_type=AttentionType.CAUSAL_SELF_ATTENTION,
+            deterministic=self.deterministic, name="attn",
+        )(h)
+        if self.with_cross_attention:
+            hc = nn.LayerNorm(epsilon=self.epsilon, name="ln_cross", dtype=x.dtype)(x)
+            x = x + MultiHeadAttention(
+                n_embd=self.n_embd, n_head=self.n_head, bias=self.bias, dropout=self.dropout,
+                attention_type=AttentionType.CROSS_ATTENTION,
+                deterministic=self.deterministic, name="cross_attn",
+            )(hc, context=context)
+        h2 = nn.LayerNorm(epsilon=self.epsilon, name="ln_2", dtype=x.dtype)(x)
+        x = x + MLP(
+            in_features=self.n_embd, hidden_features=self.ffn_hidden, bias=self.bias,
+            dropout=self.dropout, deterministic=self.deterministic, name="mlp",
+        )(h2)
+        return x
+
+
+class _CoCaModule(nn.Module):
+    cfg: dict
+    deterministic: bool = True
+
+    @nn.compact
+    def __call__(self, images, text_ids):
+        cfg = self.cfg
+        td = cfg["text_decoder"]
+        b = text_ids.shape[0]
+
+        # ---- vision encoder + attention pooling
+        vision_embd = _VisionTransformerModule(cfg["vision_spec"], self.deterministic, name="vision_encoder")(images)
+        queries = self.param(
+            "vision_queries", nn.initializers.normal(1.0), (cfg["n_vision_queries"] + 1, cfg["vision_n_embd"])
+        )
+        queries = jnp.broadcast_to(queries[None], (b, *queries.shape)).astype(vision_embd.dtype)
+        pooled = AttentionPooling(
+            n_embd=cfg["vision_n_embd"], n_head=cfg["n_pool_head"], bias=cfg["bias_attn_pool"],
+            epsilon=cfg["epsilon_attn_pool"], name="attn_pool",
+        )(queries, context=vision_embd)
+        vision_context, vision_cls = pooled[:, :-1, :], pooled[:, -1:, :]
+
+        # ---- unimodal text decoder (cls token appended; block_size + 1 positions)
+        wte = self.param("wte", nn.initializers.normal(0.02), (td["vocab_size"], td["n_embd"]))
+        wpe = self.param("wpe", nn.initializers.normal(0.02), (td["block_size"] + 1, td["n_embd"]))
+        text_cls_token = self.param("text_cls_token", nn.initializers.normal(0.02), (1, 1, td["n_embd"]))
+        x = jnp.take(wte, text_ids, axis=0)
+        x = jnp.concatenate([x, jnp.broadcast_to(text_cls_token, (b, 1, td["n_embd"]))], axis=1)
+        x = x + wpe[None, : x.shape[1], :]
+        for i in range(td["n_layer_text"]):
+            x = _DecoderBlock(
+                n_embd=td["n_embd"], n_head=td["n_head"], ffn_hidden=td["ffn_hidden"],
+                bias=td["bias"], dropout=td["dropout"], epsilon=td["epsilon"],
+                deterministic=self.deterministic, name=f"text_block_{i}",
+            )(x)
+        x = nn.LayerNorm(epsilon=td["epsilon"], name="text_ln_f", dtype=x.dtype)(x)
+        text_embd, text_cls = x[:, :-1, :], x[:, -1:, :]
+
+        # ---- multimodal decoder with cross-attention over pooled vision tokens
+        y = text_embd
+        for i in range(td["n_layer_multimodal_text"]):
+            y = _DecoderBlock(
+                n_embd=td["n_embd"], n_head=td["n_head"], ffn_hidden=td["ffn_hidden"],
+                bias=td["bias"], dropout=td["dropout"], epsilon=td["epsilon"],
+                with_cross_attention=True, deterministic=self.deterministic,
+                name=f"multimodal_block_{i}",
+            )(y, context=vision_context)
+        y = nn.LayerNorm(epsilon=td["epsilon"], name="mm_ln_f", dtype=y.dtype)(y)
+        # weight tying: lm head shares wte (reference coca_model.py:171-173)
+        logits = jnp.einsum("bse,ve->bsv", y.astype(jnp.float32), wte.astype(jnp.float32))
+        return logits, vision_cls.squeeze(1), text_cls.squeeze(1)
+
+
+class CoCa(NNModel):
+    def __init__(
+        self,
+        prediction_key: str,
+        vision_cls_prediction_key: str,
+        text_cls_prediction_key: str,
+        vision_embd_prediction_key: str,
+        text_embd_prediction_key: str,
+        n_vision_queries: int,
+        n_pool_head: int,
+        bias_attn_pool: bool,
+        epsilon_attn_pool: float,
+        vision_encoder_config: VisionTransformerConfig,
+        text_decoder_config: TextDecoderConfig,
+        seed: Optional[int] = None,
+    ):
+        if isinstance(vision_encoder_config, dict):
+            vision_encoder_config = VisionTransformerConfig(**vision_encoder_config)
+        if isinstance(text_decoder_config, dict):
+            text_decoder_config = TextDecoderConfig(**text_decoder_config)
+        super().__init__(
+            sample_key=text_decoder_config.sample_key,
+            prediction_key=prediction_key,
+            seed=seed,
+            weight_decay_groups={
+                "linear": [r".*(attn|mlp)/.*kernel.*"],
+                "embedding": [r".*(wte|wpe|vision_queries|cls_token|embedding_fn).*"],
+                "norm": [r".*(ln_|norm).*"],
+            },
+        )
+        self.vision_cls_prediction_key = vision_cls_prediction_key
+        self.text_cls_prediction_key = text_cls_prediction_key
+        self.vision_embd_prediction_key = vision_embd_prediction_key
+        self.text_embd_prediction_key = text_embd_prediction_key
+        self.vision_sample_key = vision_encoder_config.sample_key
+        img_size = vision_encoder_config.img_size
+        self.img_size = (img_size, img_size) if isinstance(img_size, int) else tuple(img_size)
+        self.n_img_channels = vision_encoder_config.n_img_channels
+        self.block_size = text_decoder_config.block_size
+
+        from modalities_tpu.models.vision_transformer.vision_transformer_model import VisionTransformer as _VT
+
+        vision_spec = {
+            "ffn_hidden": vision_encoder_config.ffn_hidden or 4 * vision_encoder_config.n_embd,
+            "block_size": _VT.get_block_size(
+                self.img_size, vision_encoder_config.patch_size, vision_encoder_config.patch_stride,
+                vision_encoder_config.add_cls_token,
+            ),
+            "n_embd": vision_encoder_config.n_embd,
+            "n_head": vision_encoder_config.n_head,
+            "n_layer": vision_encoder_config.n_layer,
+            "n_classes": None,  # encoder mode: emit patch embeddings
+            "dropout": vision_encoder_config.dropout,
+            "patch_size": vision_encoder_config.patch_size,
+            "patch_stride": vision_encoder_config.patch_stride,
+            "add_cls_token": vision_encoder_config.add_cls_token,
+            "bias": vision_encoder_config.bias,
+        }
+        self._cfg = {
+            "vision_spec": vision_spec,
+            "vision_n_embd": vision_encoder_config.n_embd,
+            "n_vision_queries": n_vision_queries,
+            "n_pool_head": n_pool_head,
+            "bias_attn_pool": bias_attn_pool,
+            "epsilon_attn_pool": epsilon_attn_pool,
+            "text_decoder": dict(text_decoder_config),
+        }
+
+    @property
+    def module(self):
+        return _CoCaModule(self._cfg, deterministic=True)
+
+    def train_module(self):
+        return _CoCaModule(self._cfg, deterministic=False)
+
+    def init_params(self, rng):
+        images = jnp.zeros((1, *self.img_size, self.n_img_channels), jnp.float32)
+        text = jnp.zeros((1, self.block_size), jnp.int32)
+        return self.module.init(rng, images, text)
+
+    def apply(self, params, inputs: dict, train: bool = False, rngs=None) -> dict:
+        module = self.train_module() if train else self.module
+        logits, vision_cls, text_cls = module.apply(
+            params, inputs[self.vision_sample_key], inputs[self.sample_key], rngs=rngs
+        )
+        return {
+            self.prediction_key: logits,
+            self.vision_cls_prediction_key: vision_cls,
+            self.text_cls_prediction_key: text_cls,
+        }
+
+
+class CoCaCollateFn:
+    """Collator for (image, text) pairs (reference: models/coca/collator.py)."""
+
+    def __init__(self, sample_keys: list[str], target_keys: list[str], text_sample_key: str, text_target_key: str):
+        self.sample_keys = sample_keys
+        self.target_keys = target_keys
+        self.text_sample_key = text_sample_key
+        self.text_target_key = text_target_key
+
+    def __call__(self, batch: list[dict]):
+        import numpy as np
+
+        from modalities_tpu.batch import DatasetBatch
+
+        samples = {
+            key: np.stack([np.asarray(d[key]) for d in batch]) for key in self.sample_keys
+        }
+        targets = {key: np.stack([np.asarray(d[key]) for d in batch]) for key in self.target_keys}
+        # CLM shift on the text modality (reference collator semantics)
+        text = samples[self.text_sample_key]
+        samples[self.text_sample_key] = text[:, :-1]
+        targets[self.text_target_key] = text[:, 1:]
+        return DatasetBatch(targets=targets, samples=samples)

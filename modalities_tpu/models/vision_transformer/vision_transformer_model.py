@@ -1,0 +1,212 @@
+"""Vision Transformer (reference: src/modalities/models/vision_transformer/vision_transformer_model.py).
+
+TPU-first: patch embedding as a strided conv (linen Conv, NHWC — the TPU-native image
+layout, vs the reference's NCHW), pre-norm blocks with fused SDPA, optional cls token,
+classification head or embedding output. Dict-in/dict-out like every framework model.
+"""
+
+from __future__ import annotations
+
+from typing import Annotated, Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+from pydantic import BaseModel, Field
+
+from modalities_tpu.models.model import NNModel
+from modalities_tpu.nn.attention import AttentionType, MultiHeadAttention
+from modalities_tpu.nn.mlp import MLP
+
+
+class VisionTransformerConfig(BaseModel):
+    sample_key: str
+    prediction_key: str
+    img_size: Annotated[int, Field(ge=1)] | tuple[int, int] = 224
+    n_classes: Optional[Annotated[int, Field(ge=1)]] = 1000
+    n_layer: Annotated[int, Field(ge=1)] = 12
+    attention_config: Optional[dict] = None
+    n_head: Annotated[int, Field(ge=1)] = 8
+    n_embd: Annotated[int, Field(ge=1)] = 768
+    dropout: Annotated[float, Field(ge=0.0)] = 0.0
+    patch_size: Annotated[int, Field(ge=1)] = 16
+    patch_stride: Annotated[int, Field(ge=1)] = 16
+    n_img_channels: Annotated[int, Field(ge=1)] = 3
+    add_cls_token: bool = True
+    bias: bool = True
+    ffn_hidden: Optional[Annotated[int, Field(ge=1)]] = None  # default 4*n_embd
+
+
+class ImagePatchEmbedding(nn.Module):
+    """Conv patchifier + optional cls token (reference :51-110). Input NHWC."""
+
+    n_embd: int = 768
+    patch_size: int = 16
+    patch_stride: int = 16
+    add_cls_token: bool = True
+
+    @nn.compact
+    def __call__(self, x):
+        b = x.shape[0]
+        x = nn.Conv(
+            features=self.n_embd,
+            kernel_size=(self.patch_size, self.patch_size),
+            strides=(self.patch_stride, self.patch_stride),
+            padding="VALID",
+            name="conv",
+            dtype=x.dtype,
+        )(x)
+        x = x.reshape(b, -1, self.n_embd)  # b (h w) c
+        if self.add_cls_token:
+            cls_token = self.param("cls_token", nn.initializers.zeros, (1, 1, self.n_embd))
+            x = jnp.concatenate([jnp.broadcast_to(cls_token, (b, 1, self.n_embd)).astype(x.dtype), x], axis=1)
+        return x
+
+
+class VisionTransformerBlock(nn.Module):
+    """Pre-norm MHA + MLP block (reference :111-162)."""
+
+    n_embd: int = 768
+    n_head: int = 8
+    ffn_hidden: int = 3072
+    bias: bool = True
+    dropout: float = 0.0
+    deterministic: bool = True
+
+    @nn.compact
+    def __call__(self, x):
+        h = nn.LayerNorm(name="norm1", dtype=x.dtype)(x)
+        x = x + MultiHeadAttention(
+            n_embd=self.n_embd,
+            n_head=self.n_head,
+            bias=self.bias,
+            dropout=self.dropout,
+            attention_type=AttentionType.NON_CAUSAL_SELF_ATTENTION,
+            deterministic=self.deterministic,
+            name="attention",
+        )(h)
+        h2 = nn.LayerNorm(name="norm2", dtype=x.dtype)(x)
+        x = x + MLP(
+            in_features=self.n_embd,
+            hidden_features=self.ffn_hidden,
+            bias=self.bias,
+            dropout=self.dropout,
+            deterministic=self.deterministic,
+            name="mlp",
+        )(h2)
+        return x
+
+
+class _VisionTransformerModule(nn.Module):
+    spec: dict
+    deterministic: bool = True
+
+    @nn.compact
+    def __call__(self, x):
+        s = self.spec
+        x = ImagePatchEmbedding(
+            n_embd=s["n_embd"],
+            patch_size=s["patch_size"],
+            patch_stride=s["patch_stride"],
+            add_cls_token=s["add_cls_token"],
+            name="embedding_fn",
+        )(x)
+        # learned positional embedding over patch (+cls) positions
+        # (reference vision_transformer_model.py:223,255)
+        pos = self.param(
+            "positional_embedding", nn.initializers.normal(0.02), (1, s["block_size"], s["n_embd"])
+        )
+        x = x + pos.astype(x.dtype)
+        x = nn.Dropout(s["dropout"])(x, deterministic=self.deterministic or s["dropout"] == 0.0)
+        for i in range(s["n_layer"]):
+            x = VisionTransformerBlock(
+                n_embd=s["n_embd"],
+                n_head=s["n_head"],
+                ffn_hidden=s["ffn_hidden"],
+                bias=s["bias"],
+                dropout=s["dropout"],
+                deterministic=self.deterministic,
+                name=f"blocks_{i}",
+            )(x)
+        x = nn.LayerNorm(name="norm", dtype=x.dtype)(x)
+        if s["n_classes"] is not None:
+            if s["add_cls_token"]:
+                pooled = x[:, 0]
+            else:
+                pooled = x.mean(axis=1)
+            return nn.Dense(s["n_classes"], use_bias=s["bias"], name="head")(pooled)
+        return x
+
+
+class VisionTransformer(NNModel):
+    """Framework-level ViT (reference :164-280)."""
+
+    def __init__(
+        self,
+        sample_key: str,
+        prediction_key: str,
+        img_size=224,
+        n_classes: Optional[int] = 1000,
+        n_layer: int = 12,
+        attention_config=None,
+        n_head: int = 8,
+        n_embd: int = 768,
+        dropout: float = 0.0,
+        patch_size: int = 16,
+        patch_stride: int = 16,
+        n_img_channels: int = 3,
+        add_cls_token: bool = True,
+        bias: bool = True,
+        ffn_hidden: Optional[int] = None,
+        seed: Optional[int] = None,
+    ):
+        super().__init__(sample_key=sample_key, prediction_key=prediction_key, seed=seed,
+                         weight_decay_groups={
+                             "linear": [r".*(attention|mlp|head)/.*kernel.*"],
+                             "embedding": [r".*(embedding_fn|cls_token).*"],
+                             "norm": [r".*(norm).*"],
+                         })
+        img_size = (img_size, img_size) if isinstance(img_size, int) else tuple(img_size)
+        self.img_size = img_size
+        self.n_img_channels = n_img_channels
+        self._spec = {
+            "ffn_hidden": ffn_hidden or 4 * n_embd,
+            "block_size": self.get_block_size(img_size, patch_size, patch_stride, add_cls_token),
+            "n_embd": n_embd,
+            "n_head": n_head,
+            "n_layer": n_layer,
+            "n_classes": n_classes,
+            "dropout": dropout,
+            "patch_size": patch_size,
+            "patch_stride": patch_stride,
+            "add_cls_token": add_cls_token,
+            "bias": bias,
+        }
+        self._block_size = self.get_block_size(img_size, patch_size, patch_stride, add_cls_token)
+
+    @staticmethod
+    def get_block_size(img_size, patch_size, patch_stride, add_cls_token) -> int:
+        h = (img_size[0] - patch_size) // patch_stride + 1
+        w = (img_size[1] - patch_size) // patch_stride + 1
+        return h * w + int(add_cls_token)
+
+    @property
+    def block_size(self) -> int:
+        return self._block_size
+
+    @property
+    def module(self):
+        return _VisionTransformerModule(self._spec, deterministic=True)
+
+    def train_module(self):
+        return _VisionTransformerModule(self._spec, deterministic=False)
+
+    def init_params(self, rng):
+        import jax
+
+        dummy = jnp.zeros((1, *self.img_size, self.n_img_channels), jnp.float32)
+        return self.module.init(rng, dummy)
+
+    def apply(self, params, inputs: dict, train: bool = False, rngs=None) -> dict:
+        module = self.train_module() if train else self.module
+        out = module.apply(params, inputs[self.sample_key], rngs=rngs)
+        return {self.prediction_key: out}

@@ -1,0 +1,54 @@
+"""Generic multi-head attention for non-GPT2 models (reference: src/modalities/nn/attention.py:26).
+
+Supports causal self-attention and cross-attention (context != None), always through
+the fused SDPA path.
+"""
+
+from __future__ import annotations
+
+from enum import Enum
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+class AttentionType(str, Enum):
+    CAUSAL_SELF_ATTENTION = "causal_self_attention"
+    NON_CAUSAL_SELF_ATTENTION = "non_causal_self_attention"
+    CROSS_ATTENTION = "cross_attention"
+
+
+class AttentionConfig:
+    """Placeholder for reference-parity (qkv transforms live in the GPT2 model)."""
+
+    def __init__(self, attention_engine_type: Optional[str] = None):
+        self.attention_engine_type = attention_engine_type
+
+
+class MultiHeadAttention(nn.Module):
+    n_embd: int
+    n_head: int
+    bias: bool = True
+    dropout: float = 0.0
+    attention_type: AttentionType = AttentionType.CAUSAL_SELF_ATTENTION
+    deterministic: bool = True
+
+    @nn.compact
+    def __call__(self, x, context=None):
+        head_dim = self.n_embd // self.n_head
+        is_cross = self.attention_type == AttentionType.CROSS_ATTENTION
+        if is_cross and context is None:
+            raise ValueError("cross_attention requires a context tensor")
+        kv_source = context if is_cross else x
+        q = nn.DenseGeneral((self.n_head, head_dim), use_bias=self.bias, name="q_attn", dtype=x.dtype)(x)
+        k = nn.DenseGeneral((self.n_head, head_dim), use_bias=self.bias, name="k_attn", dtype=x.dtype)(kv_source)
+        v = nn.DenseGeneral((self.n_head, head_dim), use_bias=self.bias, name="v_attn", dtype=x.dtype)(kv_source)
+        causal = self.attention_type == AttentionType.CAUSAL_SELF_ATTENTION
+        y = jax.nn.dot_product_attention(q, k, v, is_causal=causal)
+        y = nn.Dropout(self.dropout)(y, deterministic=self.deterministic or self.dropout == 0.0)
+        out = nn.DenseGeneral(
+            self.n_embd, axis=(-2, -1), use_bias=self.bias, name="c_proj", dtype=x.dtype
+        )(y)
+        return nn.Dropout(self.dropout)(out, deterministic=self.deterministic or self.dropout == 0.0)

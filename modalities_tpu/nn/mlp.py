@@ -1,0 +1,25 @@
+"""Generic GELU MLP (reference: src/modalities/nn/mlp.py:6)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+
+
+class MLP(nn.Module):
+    in_features: int
+    hidden_features: Optional[int] = None
+    out_features: Optional[int] = None
+    bias: bool = True
+    dropout: float = 0.0
+    deterministic: bool = True
+
+    @nn.compact
+    def __call__(self, x):
+        hidden = self.hidden_features or 4 * self.in_features
+        out = self.out_features or self.in_features
+        x = nn.Dense(hidden, use_bias=self.bias, name="fc1", dtype=x.dtype)(x)
+        x = nn.gelu(x)
+        x = nn.Dense(out, use_bias=self.bias, name="fc2", dtype=x.dtype)(x)
+        return nn.Dropout(self.dropout)(x, deterministic=self.deterministic or self.dropout == 0.0)

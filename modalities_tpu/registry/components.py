@@ -79,6 +79,42 @@ from modalities_tpu.utils.profilers.profilers import (
 )
 
 
+def _repeating_dataloader(**kwargs):
+    from modalities_tpu.dataloader.repeating_dataloader import RepeatingDataLoader
+
+    return RepeatingDataLoader(**kwargs)
+
+
+def _coca_config():
+    from modalities_tpu.models.coca.coca_model import CoCaConfig
+
+    return CoCaConfig
+
+
+def _vit_config():
+    from modalities_tpu.models.vision_transformer.vision_transformer_model import VisionTransformerConfig
+
+    return VisionTransformerConfig
+
+
+def _coca(**kwargs):
+    from modalities_tpu.models.coca.coca_model import CoCa
+
+    return CoCa(**kwargs)
+
+
+def _vision_transformer(**kwargs):
+    from modalities_tpu.models.vision_transformer.vision_transformer_model import VisionTransformer
+
+    return VisionTransformer(**kwargs)
+
+
+def _coca_collator(**kwargs):
+    from modalities_tpu.models.coca.coca_model import CoCaCollateFn
+
+    return CoCaCollateFn(**kwargs)
+
+
 def _scheduler_entity(variant: str, scheduler_cls, config_cls) -> ComponentEntity:
     def build(**kwargs):
         return scheduler_cls(name=variant, **kwargs)
@@ -93,6 +129,8 @@ COMPONENTS: list[ComponentEntity] = [
     ComponentEntity(
         "model", "huggingface_pretrained_model", HuggingFacePretrainedModel, cfg.HuggingFacePretrainedModelConfig
     ),
+    ComponentEntity("model", "coca", _coca, _coca_config()),
+    ComponentEntity("model", "vision_transformer", _vision_transformer, _vit_config()),
     ComponentEntity("model", "fsdp2_wrapped", ModelFactory.get_fsdp2_wrapped_model, cfg.FSDP2WrappedModelConfig),
     ComponentEntity("model", "fsdp1_wrapped", ModelFactory.get_fsdp2_wrapped_model, cfg.FSDP2WrappedModelConfig),
     ComponentEntity("model", "model_initialized", ModelFactory.get_weight_initialized_model, cfg.WeightInitializedModelConfig),
@@ -171,8 +209,10 @@ COMPONENTS: list[ComponentEntity] = [
     ComponentEntity(
         "collate_fn", "mask_loss_collator_wrapper", LossMaskingCollateFnWrapper, cfg.LossMaskingCollateFnWrapperConfig
     ),
+    ComponentEntity("collate_fn", "coca_collator", _coca_collator, cfg.CoCaCollatorConfig),
     # dataloaders
     ComponentEntity("data_loader", "default", DataloaderFactory.get_dataloader, cfg.LLMDataLoaderConfig),
+    ComponentEntity("data_loader", "repeating_data_loader", _repeating_dataloader, cfg.RepeatingDataLoaderConfig),
     # checkpointing
     ComponentEntity(
         "checkpoint_saving_strategy",

@@ -1,0 +1,143 @@
+"""Numerical debugging — the reference's NaN hooks + per-module tensor stats
+(reference: src/modalities/utils/debug_components.py:25, model_factory.py:410-592
+get_debugging_enriched_model).
+
+Torch registers eager forward/backward hooks; under jit the equivalents are:
+- ``enable_nan_checks()``: jax_debug_nans — XLA re-runs the failing op un-jitted and
+  raises at the first NaN-producing primitive (the fail-fast tier).
+- ``collect_tree_stats``: jitted per-leaf stats (nan/inf counts, mean/std/min/max,
+  global shape + sharding) over params/grads/activations.
+- ``DebugStatsLogger``: accumulates those stats per step and writes the per-rank
+  jsonl stream the reference's analysis notebooks consume.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Optional
+
+import numpy as np
+
+from modalities_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+def enable_nan_checks(enable: bool = True) -> None:
+    import jax
+
+    jax.config.update("jax_debug_nans", enable)
+
+
+import functools as _functools
+
+
+@_functools.cache
+def _tree_stats_fn():
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def stats(tree):
+        def leaf_stats(x):
+            x32 = x.astype(jnp.float32)
+            return {
+                "nan_count": jnp.isnan(x32).sum(),
+                "inf_count": jnp.isinf(x32).sum(),
+                "mean": jnp.nanmean(x32),
+                "std": jnp.nanstd(x32),
+                "min": jnp.nanmin(x32),
+                "max": jnp.nanmax(x32),
+            }
+
+        return jax.tree.map(leaf_stats, tree)
+
+    return stats
+
+
+def collect_tree_stats(tree, prefix: str = "") -> dict[str, dict]:
+    """Per-leaf numerical stats. One jitted program over the whole tree + ONE blocking
+    device_get for all leaves (not per-leaf syncs)."""
+    import jax
+
+    arrays = {}
+    flat = jax.tree_util.tree_flatten_with_path(tree)[0]
+    meta = {}
+    for path, leaf in flat:
+        if not hasattr(leaf, "shape") or leaf.size == 0:
+            continue
+        name = prefix + "/".join(str(getattr(p, "key", getattr(p, "idx", p))) for p in path)
+        arrays[name] = leaf
+        try:
+            sharded = not leaf.sharding.is_fully_replicated
+        except Exception:
+            sharded = False
+        meta[name] = {"global_shape": list(leaf.shape), "sharded": sharded}
+
+    device_stats = jax.device_get(_tree_stats_fn()(arrays))
+    out = {}
+    for name, stats in device_stats.items():
+        record = {k: float(v) for k, v in stats.items()}
+        record["nan_count"] = int(record["nan_count"])
+        record["inf_count"] = int(record["inf_count"])
+        record.update(meta[name])
+        out[name] = record
+    return out
+
+
+class DebugStatsLogger:
+    """Per-rank jsonl stream of param/grad stats (reference per-rank debug jsonl)."""
+
+    def __init__(self, logging_dir_path: Path, tracked_ranks: Optional[list[int]] = None,
+                 log_interval_steps: int = 1):
+        import jax
+
+        self.logging_dir_path = Path(logging_dir_path)
+        self.rank = jax.process_index()
+        self.enabled = tracked_ranks is None or self.rank in tracked_ranks
+        self.log_interval_steps = log_interval_steps
+        if self.enabled:
+            self.logging_dir_path.mkdir(parents=True, exist_ok=True)
+            self._file = (self.logging_dir_path / f"debug_stats_rank_{self.rank}.jsonl").open("a")
+        else:
+            self._file = None
+
+    def log(self, step: int, **trees) -> None:
+        """log(step, params=..., grads=..., activations=...)"""
+        if not self.enabled or step % self.log_interval_steps != 0:
+            return
+        record: dict = {"step": step}
+        for name, tree in trees.items():
+            stats = collect_tree_stats(tree, prefix=f"{name}/")
+            record[name] = stats
+            bad = {k: v for k, v in stats.items() if v["nan_count"] or v["inf_count"]}
+            if bad:
+                logger.warning("step %d: non-finite values in %s: %s", step, name, sorted(bad))
+        self._file.write(json.dumps(record) + "\n")
+        self._file.flush()
+
+    def close(self) -> None:
+        if self._file is not None:
+            self._file.close()
+
+
+@_functools.cache
+def _nonfinite_check_fn():
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def check(t):
+        leaves = jax.tree.leaves(t)
+        return jnp.logical_not(
+            jnp.all(jnp.asarray([jnp.all(jnp.isfinite(x.astype(jnp.float32))) for x in leaves]))
+        )
+
+    return check
+
+
+def has_nonfinite(tree) -> bool:
+    """Cheap device-side check used by gradient_clipper.error_if_nonfinite
+    (reference fsdp_gradient_clipper.py:118)."""
+    return bool(_nonfinite_check_fn()(tree))

@@ -1,0 +1,67 @@
+"""End-to-end tokenization consistency check: jsonl <-> idx <-> pbin <-> re-tokenization
+(reference: src/modalities/utils/verify_tokenization_consistency.py:159)."""
+
+from __future__ import annotations
+
+import tempfile
+from pathlib import Path
+from typing import Callable
+
+import numpy as np
+
+from modalities_tpu.dataloader.create_index import IndexGenerator
+from modalities_tpu.dataloader.dataset import PackedMemMapDatasetBase
+from modalities_tpu.dataloader.large_file_lines_reader import LargeFileLinesReader
+from modalities_tpu.dataloader.packed_data import PackedDataGenerator
+from modalities_tpu.utils.jsonpath import compile_pattern
+from modalities_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+def verify_tokenization_consistency(
+    src_path: Path,
+    eod_token: str,
+    tokenizer,
+    jq_pattern: str = ".text",
+    sample_key: str = "input_ids",
+) -> None:
+    """Pack src_path into a temp pbin and verify every document round-trips:
+    pbin tokens == tokenize(jq(line)) + EOD. Raises on any mismatch."""
+    src_path = Path(src_path)
+    with tempfile.TemporaryDirectory() as tmp:
+        tmp = Path(tmp)
+        index_path = tmp / "data.idx"
+        IndexGenerator(src_path).create_index(index_path)
+        pbin_path = PackedDataGenerator(
+            src_path=src_path,
+            tokenizer=tokenizer,
+            eod_token=eod_token,
+            number_of_processes=1,
+            jq_pattern=jq_pattern,
+            processing_batch_size=64,
+            raw_samples_queue_size=8,
+            processed_samples_queue_size=8,
+            index_path=index_path,
+        ).run(tmp / "data.pbin")
+
+        reader = LargeFileLinesReader(src_path, index_path)
+        dataset = PackedMemMapDatasetBase(pbin_path, sample_key=sample_key)
+        extract = compile_pattern(jq_pattern)
+        eod_id = tokenizer.get_token_id(eod_token)
+
+        if len(reader) != len(dataset):
+            raise ValueError(
+                f"Document count mismatch: jsonl has {len(reader)} lines, pbin has {len(dataset)}"
+            )
+        for i in range(len(reader)):
+            expected = list(tokenizer.tokenize(extract(reader[i])))
+            if not expected or expected[-1] != eod_id:
+                expected = expected + [eod_id]
+            actual = dataset[i][sample_key].tolist()
+            if actual != expected:
+                raise ValueError(
+                    f"Tokenization mismatch at document {i}: "
+                    f"pbin has {actual[:16]}..., re-tokenization gives {expected[:16]}..."
+                )
+    logger.info("Tokenization consistency verified for %d documents.", len(reader))

@@ -1,0 +1,42 @@
+"""HF export: weight mapping + logit equivalence vs stock LlamaForCausalLM
+(reference: conversion/gpt2 check_converted_model logit-diff test, :70)."""
+
+import jax
+import numpy as np
+import pytest
+
+from modalities_tpu.conversion.gpt2.convert_gpt2 import check_converted_model, convert_model_checkpoint
+from tests.models.test_gpt2_model import tiny_gpt2
+
+
+@pytest.mark.parametrize("tying,kv", [(True, 2), (False, 4)])
+def test_export_logit_equivalence(tying, kv):
+    from flax.core import meta
+
+    model = tiny_gpt2("pytorch_flash", use_weight_tying=tying, n_head_kv=kv)
+    params = meta.unbox(model.init_params(jax.random.PRNGKey(0)))
+    hf_model, config = convert_model_checkpoint(model, params)
+    assert config.num_key_value_heads == kv
+    assert config.tie_word_embeddings == tying
+    check_converted_model(hf_model, model, params, num_testruns=2)
+
+
+def test_export_rejects_gelu_config():
+    from flax.core import meta
+
+    model = tiny_gpt2("pytorch_flash", activation_type="gelu")
+    params = meta.unbox(model.init_params(jax.random.PRNGKey(0)))
+    with pytest.raises(NotImplementedError, match="SwiGLU"):
+        convert_model_checkpoint(model, params)
+
+
+def test_roundtrip_save_load(tmp_path):
+    from flax.core import meta
+    from transformers import AutoModelForCausalLM
+
+    model = tiny_gpt2("pytorch_flash")
+    params = meta.unbox(model.init_params(jax.random.PRNGKey(1)))
+    hf_model, _ = convert_model_checkpoint(model, params)
+    hf_model.save_pretrained(tmp_path / "export")
+    reloaded = AutoModelForCausalLM.from_pretrained(tmp_path / "export")
+    check_converted_model(reloaded, model, params, num_testruns=1)

@@ -1,0 +1,68 @@
+"""Instruction-tuning data prep (reference tests/instruction_tuning suite)."""
+
+import json
+from pathlib import Path
+
+import pytest
+import yaml
+
+from modalities_tpu.dataloader.instruction_tuning.create_instruction_tuning_data import (
+    create_instruction_tuning_data,
+    split_and_apply_chat_template,
+)
+
+CHAT_TEMPLATE = (
+    "{% for m in messages %}"
+    "{{ m.role }}: {{ m.content }}{{ chat_template_data.special_tokens.eod }}\n"
+    "{% endfor %}"
+)
+
+
+@pytest.fixture
+def it_config(tmp_path):
+    src = tmp_path / "conversations.jsonl"
+    rows = [
+        {"messages": [{"role": "user", "content": f"hi {i}"}, {"role": "bot", "content": f"hello {i}"}]}
+        for i in range(50)
+    ]
+    src.write_text("\n".join(json.dumps(r) for r in rows) + "\n")
+    config = {
+        "settings": {
+            "src_path": str(src),
+            "dst_path": str(tmp_path / "out" / "data.jsonl"),
+            "messages_key": "messages",
+            "split_config": {"splitting": {"train": 80, "val": 10, "test": 10}, "seed": 1},
+        },
+        "instruction_data_transformation": {"role_mapping": {"user": "User", "bot": "Assistant"}},
+        "jinja2_chat_template": CHAT_TEMPLATE,
+        "chat_template_data": {"special_tokens": {"eod": "<eod>"}},
+    }
+    config_path = tmp_path / "it_config.yaml"
+    config_path.write_text(yaml.safe_dump(config))
+    return config_path, config, tmp_path
+
+
+def test_split_and_apply_chat_template(it_config):
+    config_path, config, tmp_path = it_config
+    mapping = split_and_apply_chat_template(config_path, config)
+    assert set(mapping) <= {"train", "val", "test"}
+    total = 0
+    for partition, path in mapping.items():
+        lines = [json.loads(line) for line in Path(path).read_text().splitlines()]
+        total += len(lines)
+        assert all("chat" in rec for rec in lines)
+        assert "User: hi" in lines[0]["chat"]
+        assert "Assistant: hello" in lines[0]["chat"]
+        assert "<eod>" in lines[0]["chat"]
+    assert total == 50
+    # train should dominate with 80% weight
+    train_lines = len(Path(mapping["train"]).read_text().splitlines())
+    assert train_lines > 25
+
+
+def test_create_instruction_tuning_data_builds_indexes(it_config):
+    config_path, config, tmp_path = it_config
+    create_instruction_tuning_data(config_path)
+    out_dir = next((tmp_path / "out").glob("conversations_*"))
+    idx_files = list(out_dir.glob("*.idx"))
+    assert idx_files, "no index files created"

@@ -1,0 +1,89 @@
+"""Debug stats, nan detection, repeating loader, tokenization verification."""
+
+import json
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+
+def test_collect_tree_stats_flags_nonfinite(tmp_path):
+    from modalities_tpu.utils.debug_components import DebugStatsLogger, collect_tree_stats, has_nonfinite
+
+    tree = {"good": jnp.ones((4, 4)), "bad": jnp.asarray([1.0, jnp.nan, jnp.inf])}
+    stats = collect_tree_stats(tree)
+    assert stats["good"]["nan_count"] == 0
+    assert stats["bad"]["nan_count"] == 1
+    assert stats["bad"]["inf_count"] == 1
+    assert has_nonfinite(tree)
+    assert not has_nonfinite({"x": jnp.ones(3)})
+
+    dbg_logger = DebugStatsLogger(tmp_path, log_interval_steps=1)
+    dbg_logger.log(0, params=tree)
+    dbg_logger.close()
+    rec = json.loads((tmp_path / "debug_stats_rank_0.jsonl").read_text().splitlines()[0])
+    assert rec["params"]["params/bad"]["nan_count"] == 1
+
+
+def test_repeating_dataloader_bumps_epoch(tmp_path):
+    from modalities_tpu.dataloader.dataloader import LLMDataLoader
+    from modalities_tpu.dataloader.repeating_dataloader import RepeatingDataLoader
+    from modalities_tpu.dataloader.samplers import BatchSampler, ResumableDistributedSampler
+
+    dataset = [{"x": np.asarray([i])} for i in range(8)]
+    sampler = ResumableDistributedSampler(dataset, rank=0, num_replicas=1, shuffle=True, seed=1)
+    loader = LLMDataLoader("train", dataset, BatchSampler(sampler, 2, True), collate_fn=None,
+                           num_prefetch_batches=0)
+    repeating = RepeatingDataLoader(loader, reshuffle_after_epoch=True)
+    it = iter(repeating)
+    first_epoch = [next(it) for _ in range(4)]
+    second_epoch = [next(it) for _ in range(4)]
+    assert repeating.current_epoch == 1
+    assert sampler.epoch == 1
+    flat1 = [int(d["x"][0]) for b in first_epoch for d in b]
+    flat2 = [int(d["x"][0]) for b in second_epoch for d in b]
+    assert sorted(flat1) == sorted(flat2) == list(range(8))
+    assert flat1 != flat2  # reshuffled
+
+
+def test_verify_tokenization_consistency(tmp_path):
+    from modalities_tpu.utils.verify_tokenization_consistency import verify_tokenization_consistency
+
+    src = tmp_path / "d.jsonl"
+    src.write_text('\n'.join('{"text": "doc %d words"}' % i for i in range(5)) + "\n")
+
+    class Tok:
+        vocab_size = 300
+
+        def tokenize(self, text):
+            return [ord(c) % 250 for c in text]
+
+        def get_token_id(self, t):
+            return 255
+
+    verify_tokenization_consistency(src, eod_token="<eod>", tokenizer=Tok())
+
+
+def test_verify_tokenization_detects_mismatch(tmp_path):
+    from modalities_tpu.utils.verify_tokenization_consistency import verify_tokenization_consistency
+
+    src = tmp_path / "d.jsonl"
+    src.write_text('{"text": "abc"}\n')
+
+    marker = tmp_path / "first_call_done"
+
+    class FlakyTok:
+        # nondeterministic across calls; file-based state survives the pack worker fork
+        vocab_size = 300
+
+        def tokenize(self, text):
+            if marker.exists():
+                return [9, 9, 9]
+            marker.touch()
+            return [1, 2, 3]
+
+        def get_token_id(self, t):
+            return 255
+
+    with pytest.raises(ValueError, match="mismatch"):
+        verify_tokenization_consistency(src, eod_token="<eod>", tokenizer=FlakyTok())
