@@ -12,14 +12,28 @@ from pathlib import Path
 
 
 class IndexGenerator:
-    def __init__(self, src_file: Path, drop_faulty_entries: bool = False):
+    def __init__(self, src_file: Path, drop_faulty_entries: bool = False, use_native: bool = True):
         self.src_file = Path(src_file)
         self.drop_faulty_entries = drop_faulty_entries
+        self.use_native = use_native
 
     def create_index(self, target_path_for_index_file: Path) -> None:
         target = Path(target_path_for_index_file)
         if target.exists():
             raise FileExistsError(f"Index file already exists at {target}")
+        index = self._native_index() if self.use_native else None
+        if index is None:
+            index = self._python_index()
+        with target.open("wb") as f:
+            pickle.dump(index, f)
+
+    def _native_index(self):
+        """memchr-driven C scan (modalities_tpu/native); None if unavailable."""
+        from modalities_tpu.native import build_jsonl_index_native
+
+        return build_jsonl_index_native(self.src_file)
+
+    def _python_index(self) -> list[tuple[int, int]]:
         index: list[tuple[int, int]] = []
         with self.src_file.open("rb") as f:
             offset = 0
@@ -29,5 +43,4 @@ class IndexGenerator:
                 if content:  # skip empty lines but keep offsets correct
                     index.append((offset, len(content)))
                 offset += length
-        with target.open("wb") as f:
-            pickle.dump(index, f)
+        return index

@@ -17,6 +17,8 @@ class DataShuffler:
         input_data_path: Path, output_data_path: Path, batch_size: int = 1024, seed: Optional[int] = None
     ) -> None:
         """Permute documents of a pbin into a new pbin (streamed in index order)."""
+        from modalities_tpu.native import gather_token_docs_native
+
         esd = EmbeddedStreamData(Path(input_data_path))
         index = esd.index_base
         rng = np.random.default_rng(seed)
@@ -24,10 +26,20 @@ class DataShuffler:
         dtype = {1: "<u1", 2: "<u2", 4: "<u4"}[esd.token_size_in_bytes]
 
         def docs():
-            for doc_id in permutation:
-                offset, length = index[doc_id]
-                yield np.frombuffer(esd.data, dtype=dtype, count=length // esd.token_size_in_bytes,
-                                    offset=offset)
+            # batched native byte-span gather (modalities_tpu/native); numpy fallback
+            for start in range(0, len(permutation), batch_size):
+                chunk = [index[doc_id] for doc_id in permutation[start : start + batch_size]]
+                gathered = gather_token_docs_native(esd.data, chunk)
+                if gathered is not None:
+                    pos = 0
+                    for _, length in chunk:
+                        yield np.frombuffer(gathered, dtype=dtype, count=length // esd.token_size_in_bytes,
+                                            offset=pos)
+                        pos += length
+                else:
+                    for offset, length in chunk:
+                        yield np.frombuffer(esd.data, dtype=dtype,
+                                            count=length // esd.token_size_in_bytes, offset=offset)
 
         write_pbin_file(Path(output_data_path), docs(), esd.token_size_in_bytes)
 

@@ -39,11 +39,16 @@ from modalities_tpu.models.components.layer_norms import (
 from modalities_tpu.models.model import NNModel
 
 
-def with_logical_constraint(x, axes):
-    """Sharding hint over logical axis names; resolved by parallel/sharding.py rules."""
-    from flax.linen import partitioning as nn_partitioning
+def with_logical_constraint(x, axes, spec=None):
+    """Sharding hint over logical axis names; resolved by parallel/sharding.py rules
+    (active only when the train step installs an axis_rules context). Skipped for
+    blocks running under the pp pipeline (spec.pipeline_axis set): inside that manual
+    shard_map region values are per-shard and mesh-axis constraints are invalid."""
+    if spec is not None and spec.pipeline_axis is not None:
+        return x
+    from modalities_tpu.parallel.sharding import constrain_activation
 
-    return nn_partitioning.with_sharding_constraint(x, axes)
+    return constrain_activation(x, axes)
 
 
 class PositionTypes(str, Enum):
@@ -260,8 +265,8 @@ class CausalSelfAttention(nn.Module):
             q = apply_rope(q, cos, sin)
             k = apply_rope(k, cos, sin)
 
-        q = with_logical_constraint(q, ("batch", "seq", "heads", "head_dim"))
-        k = with_logical_constraint(k, ("batch", "seq", "kv_heads", "head_dim"))
+        q = with_logical_constraint(q, ("batch", "seq", "heads", "head_dim"), spec)
+        k = with_logical_constraint(k, ("batch", "seq", "kv_heads", "head_dim"), spec)
 
         impl = spec.attention_impl
         if spec.context_parallel_axis is not None:
@@ -304,14 +309,14 @@ class MLP(nn.Module):
         spec = self.spec
         if spec.activation == ActivationType.GELU.value:
             h = _dense_general(spec, spec.ffn_hidden, "c_fc", ("embed", "mlp"), x.dtype)(x)
-            h = with_logical_constraint(h, ("batch", "seq", "mlp"))
+            h = with_logical_constraint(h, ("batch", "seq", "mlp"), spec)
             out = _dense_general(spec, spec.n_embd, "c_proj", ("mlp", "embed"), x.dtype)(nn.gelu(h))
         else:  # swiglu / fused_swiglu
             hidden = spec.swiglu_hidden
             w = _dense_general(spec, hidden, "W", ("embed", "mlp"), x.dtype)(x)
             v = _dense_general(spec, hidden, "V", ("embed", "mlp"), x.dtype)(x)
             h = nn.silu(w) * v
-            h = with_logical_constraint(h, ("batch", "seq", "mlp"))
+            h = with_logical_constraint(h, ("batch", "seq", "mlp"), spec)
             out = _dense_general(spec, spec.n_embd, "W_2", ("mlp", "embed"), x.dtype)(h)
         return nn.Dropout(rate=spec.dropout)(out, deterministic=self.deterministic or spec.dropout == 0.0)
 
@@ -325,7 +330,7 @@ class GPT2Block(nn.Module):
     @nn.compact
     def __call__(self, x):
         spec = self.spec
-        x = with_logical_constraint(x, ("batch", "seq", "embed"))
+        x = with_logical_constraint(x, ("batch", "seq", "embed"), spec)
         h = build_norm(spec.attn_norm, "attention_norm", dtype=x.dtype)(x)
         x = x + CausalSelfAttention(spec, self.deterministic, name="attn")(h)
         h2 = build_norm(spec.ffn_norm, "ffn_norm", dtype=x.dtype)(x)

@@ -1,0 +1,108 @@
+"""ctypes loader for the native data-plane library (src/data_ops.cpp).
+
+Compiles on first use with g++ (cached next to the sources); every consumer has a
+pure-Python fallback, so a missing toolchain degrades gracefully.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import subprocess
+from pathlib import Path
+from typing import Optional
+
+import numpy as np
+
+from modalities_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+_NATIVE_DIR = Path(__file__).parent
+_SRC = _NATIVE_DIR / "src" / "data_ops.cpp"
+_SO = _NATIVE_DIR / "libmodalities_data.so"
+
+_lib = None
+_load_failed = False
+
+
+def _build() -> bool:
+    try:
+        subprocess.run(
+            ["g++", "-O3", "-shared", "-fPIC", str(_SRC), "-o", str(_SO)],
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+        return True
+    except Exception as e:
+        logger.warning("native data_ops build failed (%s); using Python fallbacks", e)
+        return False
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    global _lib, _load_failed
+    if _lib is not None or _load_failed:
+        return _lib
+    if not _SO.exists() or _SO.stat().st_mtime < _SRC.stat().st_mtime:
+        if not _build():
+            _load_failed = True
+            return None
+    try:
+        lib = ctypes.CDLL(str(_SO))
+        lib.count_jsonl_lines.argtypes = [ctypes.c_char_p]
+        lib.count_jsonl_lines.restype = ctypes.c_int64
+        lib.build_jsonl_index.argtypes = [
+            ctypes.c_char_p,
+            np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+            ctypes.c_int64,
+        ]
+        lib.build_jsonl_index.restype = ctypes.c_int64
+        lib.gather_token_docs.argtypes = [
+            np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS"),
+            ctypes.c_int64,
+            np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+            ctypes.c_int64,
+            np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS"),
+            ctypes.c_int64,
+        ]
+        lib.gather_token_docs.restype = ctypes.c_int64
+        _lib = lib
+    except OSError as e:
+        logger.warning("could not load native data_ops (%s); using Python fallbacks", e)
+        _load_failed = True
+    return _lib
+
+
+def build_jsonl_index_native(path: Path) -> Optional[list[tuple[int, int]]]:
+    """(offset, length) per non-empty line, or None if the native lib is unavailable."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    path_bytes = str(path).encode()
+    n = lib.count_jsonl_lines(path_bytes)
+    if n < 0:
+        return None
+    offsets = np.empty(max(n, 1), dtype=np.int64)
+    lengths = np.empty(max(n, 1), dtype=np.int64)
+    written = lib.build_jsonl_index(path_bytes, offsets, lengths, max(n, 1))
+    if written < 0:
+        return None
+    return list(zip(offsets[:written].tolist(), lengths[:written].tolist()))
+
+
+def gather_token_docs_native(data: np.ndarray, spans: list[tuple[int, int]]) -> Optional[np.ndarray]:
+    """Concatenate byte spans of a pbin data section into one contiguous buffer."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    offsets = np.asarray([s[0] for s in spans], dtype=np.int64)
+    lengths = np.asarray([s[1] for s in spans], dtype=np.int64)
+    total = int(lengths.sum())
+    out = np.empty(total, dtype=np.uint8)
+    data_arr = np.ascontiguousarray(np.asarray(data, dtype=np.uint8))
+    written = lib.gather_token_docs(data_arr, len(data_arr), offsets, lengths, len(spans), out, total)
+    if written != total:
+        return None
+    return out

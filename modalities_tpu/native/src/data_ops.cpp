@@ -1,0 +1,123 @@
+// Native data-plane kernels for the host-side pipeline.
+//
+// The reference framework leans on external native code for its data path (Rust HF
+// tokenizers, C sentencepiece, C jq — SURVEY.md §2.9); its own jsonl indexing and
+// token gathering are pure Python. Here the framework ships its own native layer for
+// the two host-side hot loops that feed TPUs:
+//
+//   * build_jsonl_index: one memchr-driven pass over a (typically multi-GB) jsonl
+//     file producing (offset, length) per line — the .idx sidecar contents.
+//   * gather_token_docs: batched (offset, length) byte-span gather from the pbin
+//     memmap into one contiguous output buffer — the collator/dataset hot loop.
+//
+// Exposed with plain C linkage and driven from Python via ctypes (no pybind11 in the
+// image). Built on first use by modalities_tpu/native/__init__.py (_build: g++ -O3
+// -shared -fPIC).
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+
+extern "C" {
+
+// Counts lines (newline-terminated records, plus a trailing unterminated one).
+// Returns -1 on IO error.
+int64_t count_jsonl_lines(const char* path) {
+    FILE* f = std::fopen(path, "rb");
+    if (!f) return -1;
+    constexpr size_t BUF = 1 << 20;
+    char* buf = new char[BUF];
+    int64_t lines = 0;
+    size_t got;
+    bool last_was_newline = true;
+    while ((got = std::fread(buf, 1, BUF, f)) > 0) {
+        const char* p = buf;
+        const char* end = buf + got;
+        while ((p = static_cast<const char*>(memchr(p, '\n', end - p))) != nullptr) {
+            ++lines;
+            ++p;
+        }
+        last_was_newline = (buf[got - 1] == '\n');
+    }
+    if (std::ferror(f)) { delete[] buf; std::fclose(f); return -1; }
+    if (!last_was_newline) ++lines;
+    delete[] buf;
+    std::fclose(f);
+    return lines;
+}
+
+// Fills offsets/lengths (caller-allocated, max_entries each) with the byte span of
+// every non-empty line. Lengths exclude the trailing newline. Returns the number of
+// entries written, or -1 on IO error, or -2 if max_entries was too small.
+int64_t build_jsonl_index(const char* path, int64_t* offsets, int64_t* lengths,
+                          int64_t max_entries) {
+    FILE* f = std::fopen(path, "rb");
+    if (!f) return -1;
+    constexpr size_t BUF = 1 << 20;
+    char* buf = new char[BUF];
+    int64_t n = 0;
+    int64_t file_pos = 0;
+    int64_t line_start = 0;
+    int64_t line_len = 0;       // bytes in the current line so far (no newline)
+    bool line_has_content = false;
+    size_t got;
+    auto emit = [&](void) -> bool {
+        if (line_has_content) {
+            if (n >= max_entries) return false;
+            offsets[n] = line_start;
+            lengths[n] = line_len;
+            ++n;
+        }
+        return true;
+    };
+    while ((got = std::fread(buf, 1, BUF, f)) > 0) {
+        size_t chunk_off = 0;
+        while (chunk_off < got) {
+            const char* nl = static_cast<const char*>(
+                memchr(buf + chunk_off, '\n', got - chunk_off));
+            if (nl == nullptr) {
+                size_t rest = got - chunk_off;
+                if (!line_has_content && rest > 0) {
+                    // line starts inside this chunk if it had no bytes yet
+                    if (line_len == 0) line_start = file_pos + chunk_off;
+                    line_has_content = true;
+                }
+                line_len += rest;
+                break;
+            }
+            size_t upto = nl - (buf + chunk_off);
+            if (upto > 0 && line_len == 0) line_start = file_pos + chunk_off;
+            if (upto > 0) line_has_content = true;
+            line_len += upto;
+            if (!emit()) { delete[] buf; std::fclose(f); return -2; }
+            line_len = 0;
+            line_has_content = false;
+            chunk_off += upto + 1;
+            line_start = file_pos + chunk_off;
+        }
+        file_pos += got;
+    }
+    if (std::ferror(f)) { delete[] buf; std::fclose(f); return -1; }
+    if (!emit()) { delete[] buf; std::fclose(f); return -2; }
+    delete[] buf;
+    std::fclose(f);
+    return n;
+}
+
+// Gathers n byte spans (offsets/lengths into `data`, which is data_len bytes long)
+// into `out` back to back. Returns total bytes written, or -1 on a span that is
+// negative or out of bounds (corrupt index) or if the spans exceed out_capacity.
+int64_t gather_token_docs(const uint8_t* data, int64_t data_len,
+                          const int64_t* offsets, const int64_t* lengths, int64_t n,
+                          uint8_t* out, int64_t out_capacity) {
+    int64_t written = 0;
+    for (int64_t i = 0; i < n; ++i) {
+        if (offsets[i] < 0 || lengths[i] < 0 || offsets[i] + lengths[i] > data_len) return -1;
+        if (written + lengths[i] > out_capacity) return -1;
+        std::memcpy(out + written, data + offsets[i], lengths[i]);
+        written += lengths[i];
+    }
+    return written;
+}
+
+}  // extern "C"

@@ -102,6 +102,56 @@ def params_shardings(abstract_params, rules: LogicalRules, mesh: Mesh):
     return jax.tree.map(to_named, logical_specs, is_leaf=lambda x: isinstance(x, P))
 
 
+# ------------------------------------------------------------------ activations
+# Thread-local activation-constraint rules. flax's global `axis_rules` context also
+# affects param machinery (its apply-time shape validation re-runs boxed initializers
+# and crashes on DenseGeneral's flat-kernel init under active rules), so activation
+# hints use this independent channel: the train step installs the rules, and
+# `constrain_activation` lowers logical axes to lax.with_sharding_constraint.
+
+import threading
+
+_ACTIVATION_RULES = threading.local()
+
+
+class activation_rules:
+    """Context manager installing (rules, mesh) for activation constraints. The
+    concrete mesh must be carried here: the legacy `with mesh:` context does NOT
+    populate jax.sharding.get_abstract_mesh() under jax.jit tracing."""
+
+    def __init__(self, rules: LogicalRules, mesh: Mesh):
+        self.rules = rules
+        self.mesh = mesh
+
+    def __enter__(self):
+        self._prev = getattr(_ACTIVATION_RULES, "state", None)
+        _ACTIVATION_RULES.state = (self.rules, self.mesh)
+        return self
+
+    def __exit__(self, *exc):
+        _ACTIVATION_RULES.state = self._prev
+        return False
+
+
+def constrain_activation(x, logical_axes):
+    """Apply a sharding constraint for logical axis names, if rules are installed;
+    no-op inside manual shard_map regions (pp/cp) and outside any rules context."""
+    state = getattr(_ACTIVATION_RULES, "state", None)
+    if not state:
+        return x
+    rules, mesh = state
+    ambient = jax.sharding.get_abstract_mesh()
+    if ambient is not None and getattr(ambient, "manual_axes", ()):
+        return x
+    spec = logical_to_mesh_spec(tuple(logical_axes), rules)
+    if all(s is None for s in spec):
+        return x
+    try:
+        return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+    except ValueError:
+        return x
+
+
 def batch_sharding(mesh_handle: DeviceMeshHandle) -> NamedSharding:
     """Global batch: batch dim over (dp_replicate, dp_shard), seq dim over cp."""
     axis_names = mesh_handle.axis_names

@@ -224,6 +224,9 @@ class TrainStepBuilder:
 
         if mesh_handle is not None:
             mesh = mesh_handle.mesh
+            from modalities_tpu.parallel.sharding import activation_rules
+
+            rules = self.rules
             train_step_j = jax.jit(
                 train_step,
                 donate_argnums=(0,),
@@ -233,13 +236,15 @@ class TrainStepBuilder:
             eval_step_j = jax.jit(eval_step, in_shardings=(state_shardings, None))
 
             # execute (and trace) under the mesh context so in-model collectives
-            # (ring attention shard_map) can resolve the ambient mesh
+            # (ring attention shard_map) resolve the ambient mesh, and under the
+            # flax logical-axis rules so in-model with_sharding_constraint hints
+            # (activation/SP shardings) lower to real mesh constraints
             def train_step_c(state, batch):
-                with mesh:
+                with mesh, activation_rules(rules, mesh):
                     return train_step_j(state, batch)
 
             def eval_step_c(state, batch):
-                with mesh:
+                with mesh, activation_rules(rules, mesh):
                     return eval_step_j(state, batch)
 
         else:

@@ -1,0 +1,44 @@
+"""Native C++ data-plane kernels vs pure-Python oracles."""
+
+import pickle
+
+import numpy as np
+import pytest
+
+from modalities_tpu.dataloader.create_index import IndexGenerator
+from modalities_tpu.native import build_jsonl_index_native, gather_token_docs_native, get_lib
+
+pytestmark = pytest.mark.skipif(get_lib() is None, reason="native toolchain unavailable")
+
+
+def test_native_index_matches_python(tmp_path):
+    src = tmp_path / "d.jsonl"
+    # include empty lines, unicode, and a missing trailing newline
+    src.write_bytes(b'{"a": 1}\n\n{"b": "unicode \xc3\xa4"}\n{"tail": true}')
+    native = build_jsonl_index_native(src)
+    gen = IndexGenerator(src, use_native=False)
+    python = gen._python_index()
+    assert native == python
+    assert len(native) == 3  # empty line skipped
+
+
+def test_index_generator_uses_native_and_matches(tmp_path):
+    src = tmp_path / "big.jsonl"
+    lines = [('{"text": "line %d %s"}' % (i, "x" * (i % 37))) for i in range(5000)]
+    src.write_text("\n".join(lines) + "\n")
+    IndexGenerator(src, use_native=True).create_index(tmp_path / "native.idx")
+    IndexGenerator(src, use_native=False).create_index(tmp_path / "python.idx")
+    a = pickle.loads((tmp_path / "native.idx").read_bytes())
+    b = pickle.loads((tmp_path / "python.idx").read_bytes())
+    assert a == b
+    # spot-check a span decodes to its line
+    off, length = a[1234]
+    assert src.read_bytes()[off : off + length].decode() == lines[1234]
+
+
+def test_gather_token_docs(tmp_path):
+    data = np.arange(1000, dtype=np.uint8)
+    spans = [(0, 10), (500, 20), (990, 10)]
+    out = gather_token_docs_native(data, spans)
+    expected = np.concatenate([data[o : o + l] for o, l in spans])
+    np.testing.assert_array_equal(out, expected)
