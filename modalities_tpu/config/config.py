@@ -16,6 +16,7 @@ from pydantic import BaseModel, Field
 
 from modalities_tpu.config.pydantic_if_types import (
     PydanticAppStateType,
+    PydanticLossIFType,
     PydanticBatchSamplerIFType,
     PydanticCheckpointLoadingIFType,
     PydanticCheckpointSavingExecutionIFType,
@@ -478,3 +479,28 @@ class SteppableMemoryProfilerConfig(BaseModel):
 
 class SteppableCombinedProfilerConfig(BaseModel):
     profilers: list[Any]
+
+
+# ---------------------------------------------------------------- profiler harness
+
+
+class RandomDatasetBatchGeneratorConfig(BaseModel):
+    sample_key: str
+    target_key: str
+    micro_batch_size: Annotated[int, Field(strict=True, gt=0)]
+    sequence_length: Annotated[int, Field(strict=True, gt=0)]
+    vocab_size: Annotated[int, Field(strict=True, gt=0)]
+    seed: int = 0
+
+
+class SteppableForwardPassConfig(BaseModel):
+    """Builds a jitted train/eval step over random batches for the profiler harness
+    (reference steppable_components.py:12)."""
+
+    model: PydanticModelIFType
+    loss_fn: PydanticLossIFType
+    optimizer: PydanticOptimizerIFType
+    batch_generator: Any
+    device_mesh: Optional[PydanticDeviceMeshIFType] = None
+    include_backward: bool = True
+    gradient_accumulation_steps: Annotated[int, Field(strict=True, ge=1)] = 1

@@ -1,0 +1,63 @@
+"""HF adapter: expose a framework checkpoint through the HuggingFace interface
+(reference: src/modalities/models/huggingface_adapters/hf_adapter.py:67).
+
+The reference subclasses PreTrainedModel around its torch modules. Here the adapter
+rides the conversion path instead: `save_pretrained` maps the params onto the stock
+Llama layout (conversion/gpt2), so `from_pretrained` on the exported directory needs
+no custom classes or trust_remote_code at all.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Optional
+
+from modalities_tpu.models.gpt2.gpt2_model import GPT2LLM
+from modalities_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+class HFModelAdapter:
+    """Binds (model, params) and offers the HF save/load surface."""
+
+    def __init__(self, model: GPT2LLM, params):
+        self.model = model
+        self.params = params
+
+    def save_pretrained(self, save_directory: Path, verify: bool = True) -> None:
+        from modalities_tpu.conversion.gpt2.convert_gpt2 import (
+            check_converted_model,
+            convert_model_checkpoint,
+        )
+
+        hf_model, _ = convert_model_checkpoint(self.model, self.params)
+        if verify:
+            check_converted_model(hf_model, self.model, self.params, num_testruns=1)
+        save_directory = Path(save_directory)
+        save_directory.mkdir(parents=True, exist_ok=True)
+        hf_model.save_pretrained(save_directory)
+        logger.info("HF adapter export written to %s", save_directory)
+
+    @staticmethod
+    def from_pretrained(directory: Path):
+        """Load an exported directory back as a stock HF model (torch)."""
+        from transformers import AutoModelForCausalLM
+
+        return AutoModelForCausalLM.from_pretrained(str(Path(directory).absolute()))
+
+    def forward(self, input_ids):
+        """HF-style forward on the JAX side: returns an object with .logits."""
+        import numpy as np
+
+        logits = self.model.apply(self.params, {self.model.sample_key: np.asarray(input_ids)})[
+            self.model.prediction_key
+        ]
+
+        class _Output:
+            def __init__(self, logits):
+                self.logits = logits
+
+        return _Output(logits)
+
+    __call__ = forward

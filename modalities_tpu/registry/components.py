@@ -79,6 +79,32 @@ from modalities_tpu.utils.profilers.profilers import (
 )
 
 
+def _random_batch_generator(**kwargs):
+    from modalities_tpu.utils.profilers.steppable_components import RandomDatasetBatchGenerator
+
+    return RandomDatasetBatchGenerator(**kwargs)
+
+
+def _steppable_forward_pass(model, loss_fn, optimizer, batch_generator, device_mesh=None,
+                            include_backward=True, gradient_accumulation_steps=1):
+    from modalities_tpu.training.train_step import TrainStepBuilder
+    from modalities_tpu.utils.profilers.steppable_components import SteppableForwardPass
+
+    step_functions = TrainStepBuilder(
+        model=model,
+        loss_fn=loss_fn,
+        optimizer_spec=optimizer,
+        mesh_handle=device_mesh,
+        gradient_acc_steps=gradient_accumulation_steps,
+    ).build()
+    return SteppableForwardPass(
+        step_functions,
+        batch_generator,
+        include_backward=include_backward,
+        gradient_accumulation_steps=gradient_accumulation_steps,
+    )
+
+
 def _repeating_dataloader(**kwargs):
     from modalities_tpu.dataloader.repeating_dataloader import RepeatingDataLoader
 
@@ -256,6 +282,11 @@ COMPONENTS: list[ComponentEntity] = [
     # layer norms (referenced via norm wrapper configs inside model configs)
     # mfu
     ComponentEntity("mfu_calculator", "gpt2", GPT2MFUCalculator, cfg.GPT2MFUCalculatorConfig),
+    # profiler harness steppables
+    ComponentEntity("batch_generator", "random_dataset_batch_generator", _random_batch_generator,
+                    cfg.RandomDatasetBatchGeneratorConfig),
+    ComponentEntity("steppable_component", "forward_pass", _steppable_forward_pass,
+                    cfg.SteppableForwardPassConfig),
     # profilers
     ComponentEntity("profiler", "no_profiler", SteppableNoProfiler, None),
     ComponentEntity("profiler", "kernel_profiler", SteppableKernelProfiler, cfg.SteppableKernelProfilerConfig),

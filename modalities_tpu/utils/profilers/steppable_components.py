@@ -25,9 +25,9 @@ class RandomDatasetBatchGenerator:
         self.vocab_size = vocab_size
         self._rng = np.random.default_rng(seed)
 
-    def get_batch(self) -> dict:
+    def get_batch(self, num_microbatches: int = 1) -> dict:
         tokens = self._rng.integers(
-            0, self.vocab_size, size=(1, self.micro_batch_size, self.sequence_length + 1)
+            0, self.vocab_size, size=(num_microbatches, self.micro_batch_size, self.sequence_length + 1)
         )
         return {
             "samples": {self.sample_key: tokens[:, :, :-1].astype(np.int32)},
@@ -39,19 +39,30 @@ class SteppableForwardPass(SteppableComponentIF):
     """Forward (and optionally backward+update) over random batches — the fwd-only
     driver for kernel profiling (reference steppable_components.py:12)."""
 
-    def __init__(self, step_functions, batch_generator: RandomDatasetBatchGenerator, include_backward: bool = True):
+    def __init__(self, step_functions, batch_generator: RandomDatasetBatchGenerator,
+                 include_backward: bool = True, gradient_accumulation_steps: int = 1):
         self.step_functions = step_functions
         self.batch_generator = batch_generator
         self.include_backward = include_backward
+        self.gradient_accumulation_steps = gradient_accumulation_steps
 
     def step(self) -> None:
         import jax
 
-        batch = self.step_functions.put_batch(self.batch_generator.get_batch())
         handle = self.step_functions.app_state_handle
         if self.include_backward:
+            # train_step scans over the leading accumulation dim
+            raw = self.batch_generator.get_batch(self.gradient_accumulation_steps)
+            batch = self.step_functions.put_batch(raw)
             handle.state, metrics = self.step_functions.train_step(handle.state, batch)
             jax.block_until_ready(metrics["loss"])
         else:
+            # eval_step takes a flat (batch, seq) micro-batch
+            raw = self.batch_generator.get_batch(1)
+            flat = {
+                "samples": {k: v[0] for k, v in raw["samples"].items()},
+                "targets": {k: v[0] for k, v in raw["targets"].items()},
+            }
+            batch = self.step_functions.put_batch(flat)
             metrics = self.step_functions.eval_step(handle.state, batch)
             jax.block_until_ready(metrics["loss"])

@@ -43,6 +43,9 @@ def test_main_end_to_end(workdir):
     lines = [json.loads(line) for line in results_file.read_text().splitlines()]
     train_lines = [rec for rec in lines if rec["dataloader_tag"] == "train"]
     assert len(train_lines) == 4  # 8 steps / log interval 2
+    val_lines = [rec for rec in lines if rec["dataloader_tag"] == "val"]
+    assert len(val_lines) >= 2  # eval at steps 0, 4, 8 (interval 4)
+    assert all(np.isfinite(rec["losses"]["loss avg"]) for rec in val_lines)
     losses = [rec["losses"]["train loss avg"] for rec in train_lines]
     assert losses[-1] < losses[0]  # learning
     assert train_lines[-1]["num_train_steps_done"] == 8

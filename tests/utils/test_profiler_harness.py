@@ -1,0 +1,81 @@
+"""Profiler harness: config -> steppable component + profiler -> stepped run
+(reference: modalities_profiler.py:36-158)."""
+
+import yaml
+
+from modalities_tpu.utils.profilers.modalities_profiler import ModalitiesProfilerStarter
+
+
+def test_profiler_harness_end_to_end(tmp_path):
+    config = {
+        "model": {
+            "component_key": "model",
+            "variant_key": "gpt2",
+            "config": {
+                "sample_key": "input_ids",
+                "prediction_key": "logits",
+                "poe_type": "NOPE",
+                "sequence_length": 32,
+                "vocab_size": 128,
+                "n_layer": 1,
+                "n_head_q": 2,
+                "n_head_kv": 2,
+                "n_embd": 128,
+                "ffn_hidden": 128,
+                "dropout": 0.0,
+                "bias": False,
+                "attention_config": {"qkv_transforms": []},
+                "attention_implementation": "pytorch_flash",
+                "activation_type": "swiglu",
+                "attention_norm_config": {"norm_type": "rms_norm", "config": {"ndim": 128, "bias": False}},
+                "ffn_norm_config": {"norm_type": "rms_norm", "config": {"ndim": 128, "bias": False}},
+                "lm_head_norm_config": {"norm_type": "rms_norm", "config": {"ndim": 128, "bias": False}},
+                "use_weight_tying": True,
+            },
+        },
+        "steppable_component": {
+            "component_key": "steppable_component",
+            "variant_key": "forward_pass",
+            "config": {
+                "model": {"instance_key": "model", "pass_type": "BY_REFERENCE"},
+                "loss_fn": {
+                    "component_key": "loss",
+                    "variant_key": "clm_cross_entropy_loss",
+                    "config": {"target_key": "target_ids", "prediction_key": "logits"},
+                },
+                "optimizer": {
+                    "component_key": "optimizer",
+                    "variant_key": "adam_w",
+                    "config": {
+                        "lr": 1e-3,
+                        "betas": [0.9, 0.95],
+                        "eps": 1e-8,
+                        "weight_decay": 0.0,
+                        "weight_decay_groups_excluded": [],
+                        "wrapped_model": {"instance_key": "model", "pass_type": "BY_REFERENCE"},
+                    },
+                },
+                "batch_generator": {
+                    "component_key": "batch_generator",
+                    "variant_key": "random_dataset_batch_generator",
+                    "config": {
+                        "sample_key": "input_ids",
+                        "target_key": "target_ids",
+                        "micro_batch_size": 2,
+                        "sequence_length": 32,
+                        "vocab_size": 128,
+                    },
+                },
+                "include_backward": True,
+            },
+        },
+        "profiler": {
+            "component_key": "profiler",
+            "variant_key": "memory_profiler",
+            "config": {"output_folder_path": str(tmp_path / "prof"), "max_steps": 2},
+        },
+    }
+    cfg_path = tmp_path / "profiler_config.yaml"
+    cfg_path.write_text(yaml.safe_dump(config))
+    ModalitiesProfilerStarter.run_single_process(cfg_path)
+    assert (tmp_path / "prof" / "memory_stats.jsonl").exists()
