@@ -1,0 +1,66 @@
+"""Byte-format compatibility: read .pbin files produced by the REFERENCE framework's
+own pack pipeline (mounted read-only test data) with this framework's loaders —
+the compatibility surface SURVEY.md §7 step 2 mandates."""
+
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+REFERENCE_PBIN = Path("/root/reference/tutorials/scaling_up/data/lorem_ipsum_long.pbin")
+
+pytestmark = pytest.mark.skipif(
+    not REFERENCE_PBIN.exists(), reason="reference test data not mounted"
+)
+
+
+def test_reads_reference_packed_file():
+    from modalities_tpu.dataloader.dataset import PackedMemMapDatasetBase
+    from modalities_tpu.dataloader.packed_data import EmbeddedStreamData
+
+    esd = EmbeddedStreamData(REFERENCE_PBIN)
+    assert esd.token_size_in_bytes in (1, 2, 4)
+    assert esd.data_len > 0
+    assert len(esd.index_base) > 0
+    # spans tile the data section contiguously
+    offset = 0
+    for off, length in esd.index_base:
+        assert off == offset
+        offset += length
+    assert offset == esd.data_len
+
+    ds = PackedMemMapDatasetBase(REFERENCE_PBIN, sample_key="input_ids")
+    first = ds[0]["input_ids"]
+    last = ds[len(ds) - 1]["input_ids"]
+    assert first.ndim == 1 and first.size > 0
+    assert last.ndim == 1 and last.size > 0
+    assert int(first.max()) < 2 ** (8 * esd.token_size_in_bytes)
+
+
+def test_continuous_windows_over_reference_file():
+    from modalities_tpu.dataloader.dataset import PackedMemMapDatasetContinuous
+
+    ds = PackedMemMapDatasetContinuous(
+        REFERENCE_PBIN, sample_key="input_ids", block_size=129, reuse_last_target=True
+    )
+    assert len(ds) > 0
+    sample = ds[0]["input_ids"]
+    assert sample.shape == (129,)
+    # overlap-by-one invariant between consecutive windows
+    nxt = ds[1]["input_ids"]
+    assert sample[-1] == nxt[0]
+
+
+def test_reference_idx_sidecar_reads():
+    from modalities_tpu.dataloader.large_file_lines_reader import LargeFileLinesReader
+
+    jsonl = Path("/root/reference/tests/data/datasets/lorem_ipsum_long.jsonl")
+    idx = jsonl.with_suffix(".idx")
+    if not (jsonl.exists() and idx.exists()):
+        pytest.skip("reference jsonl/idx pair not present")
+    reader = LargeFileLinesReader(jsonl, idx)
+    assert len(reader) > 0
+    import json
+
+    rec = json.loads(reader[0])
+    assert isinstance(rec, dict)

@@ -1,0 +1,79 @@
+"""LR schedule math vs torch semantics (reference scheduler variants)."""
+
+import numpy as np
+import pytest
+
+from modalities_tpu.optimizers.optimizer_factory import OptimizerSpec
+from modalities_tpu.optimizers.scheduler_factory import (
+    ConstantLRScheduler,
+    CosineAnnealingLRScheduler,
+    DummyLRScheduler,
+    LinearLRScheduler,
+    LinearWarmupCosineAnnealingLRScheduler,
+    OneCycleLRScheduler,
+    StepLRScheduler,
+)
+
+
+def _opt(lr=0.1):
+    return OptimizerSpec(kind="adam_w", lr=lr)
+
+
+def test_dummy_constant():
+    fn = DummyLRScheduler(name="d", optimizer=_opt()).absolute_lr_schedule()
+    assert float(fn(0)) == pytest.approx(0.1)
+    assert float(fn(1000)) == pytest.approx(0.1)
+
+
+def test_step_lr():
+    fn = StepLRScheduler(name="s", optimizer=_opt(), step_size=10, gamma=0.5).absolute_lr_schedule()
+    assert float(fn(0)) == pytest.approx(0.1)
+    assert float(fn(10)) == pytest.approx(0.05)
+    assert float(fn(25)) == pytest.approx(0.025)
+
+
+def test_constant_lr_factor_window():
+    fn = ConstantLRScheduler(name="c", optimizer=_opt(), factor=0.5, total_iters=4).absolute_lr_schedule()
+    assert float(fn(0)) == pytest.approx(0.05)
+    assert float(fn(3)) == pytest.approx(0.05)
+    assert float(fn(4)) == pytest.approx(0.1)
+
+
+def test_linear_lr_ramp():
+    fn = LinearLRScheduler(
+        name="l", optimizer=_opt(), start_factor=0.5, end_factor=1.0, total_iters=10
+    ).absolute_lr_schedule()
+    assert float(fn(0)) == pytest.approx(0.05)
+    assert float(fn(5)) == pytest.approx(0.075)
+    assert float(fn(10)) == pytest.approx(0.1)
+    assert float(fn(20)) == pytest.approx(0.1)
+
+
+def test_cosine_annealing():
+    fn = CosineAnnealingLRScheduler(name="ca", optimizer=_opt(), t_max=100, eta_min=0.01).absolute_lr_schedule()
+    assert float(fn(0)) == pytest.approx(0.1)
+    assert float(fn(100)) == pytest.approx(0.01)
+    assert 0.01 < float(fn(50)) < 0.1
+
+
+def test_onecycle():
+    fn = OneCycleLRScheduler(
+        name="oc", optimizer=_opt(), max_lr=0.1, total_steps=100, pct_start=0.3, div_factor=25.0,
+        final_div_factor=1e4,
+    ).absolute_lr_schedule()
+    assert float(fn(0)) == pytest.approx(0.1 / 25.0, rel=1e-3)
+    assert float(fn(30)) == pytest.approx(0.1, rel=1e-3)  # peak at pct_start
+    assert float(fn(100)) == pytest.approx(0.1 / 25.0 / 1e4, abs=1e-5)
+
+
+def test_warmup_cosine():
+    fn = LinearWarmupCosineAnnealingLRScheduler(
+        name="wc", optimizer=_opt(), warmup_steps=10, total_steps=100, initial_lr=0.0,
+        final_lr=0.001, max_lr=0.1,
+    ).absolute_lr_schedule()
+    assert float(fn(0)) == pytest.approx(0.0)
+    assert float(fn(5)) == pytest.approx(0.05)
+    assert float(fn(10)) == pytest.approx(0.1)
+    assert float(fn(100)) == pytest.approx(0.001, rel=1e-2)
+    values = [float(fn(t)) for t in range(10, 101, 10)]
+    assert all(a >= b for a, b in zip(values, values[1:]))  # monotone decay after warmup

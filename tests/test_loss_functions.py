@@ -1,0 +1,17 @@
+"""Loss function semantics."""
+
+import numpy as np
+import pytest
+
+
+def test_loss_ignore_index():
+    import jax.numpy as jnp
+
+    from modalities_tpu.loss_functions import CLMCrossEntropyLoss
+
+    loss_fn = CLMCrossEntropyLoss(target_key="y", prediction_key="p")
+    logits = jnp.zeros((1, 4, 8))
+    targets = jnp.asarray([[1, 2, -100, -100]])
+    # uniform logits -> loss = log(8) over the 2 unmasked positions
+    loss = loss_fn({"p": logits}, {"y": targets})
+    assert float(loss) == pytest.approx(np.log(8), rel=1e-5)

@@ -1,0 +1,17 @@
+"""MFU calculator math (reference utils/mfu.py formula)."""
+
+import pytest
+
+
+def test_mfu_calculator():
+    from modalities_tpu.utils.mfu import GPT2MFUCalculator, get_peak_flops
+
+    calc = GPT2MFUCalculator(
+        n_layer=12, sequence_length=2048, n_embd=768, world_size=1, num_parameters=124_000_000
+    )
+    flops_per_token = 6 * 124_000_000 + 12 * 12 * 2048 * 768
+    tokens_per_sec = 10_000
+    expected = tokens_per_sec * flops_per_token / get_peak_flops()
+    assert calc.compute(tokens_per_sec) == pytest.approx(expected)
+
+
