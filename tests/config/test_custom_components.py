@@ -1,0 +1,63 @@
+"""Library-extension hook: register custom components on Main's registry
+(reference tutorials/library_usage + Main.add_custom_component, main.py:61)."""
+
+import numpy as np
+import yaml
+from pydantic import BaseModel
+
+from modalities_tpu.config.component_factory import ComponentFactory
+from modalities_tpu.registry.components import COMPONENTS
+from modalities_tpu.registry.registry import ComponentEntity, Registry
+
+
+class _CustomCollate:
+    def __init__(self, sample_key: str, pad_to: int):
+        self.sample_key = sample_key
+        self.pad_to = pad_to
+
+    def __call__(self, batch):
+        return batch
+
+
+class _CustomCollateConfig(BaseModel):
+    sample_key: str
+    pad_to: int
+
+
+def test_custom_component_registration_and_build():
+    registry = Registry(COMPONENTS)
+    registry.add_entity(
+        ComponentEntity("collate_fn", "my_custom_collator", _CustomCollate, _CustomCollateConfig)
+    )
+    config = {
+        "collate_fn": {
+            "component_key": "collate_fn",
+            "variant_key": "my_custom_collator",
+            "config": {"sample_key": "input_ids", "pad_to": 128},
+        }
+    }
+
+    class _Model(BaseModel):
+        collate_fn: object
+
+    built = ComponentFactory(registry).build_components(config, _Model)
+    assert isinstance(built.collate_fn, _CustomCollate)
+    assert built.collate_fn.pad_to == 128
+
+
+def test_main_add_custom_component(tmp_path):
+    from modalities_tpu.main import Main
+
+    cfg = tmp_path / "c.yaml"
+    cfg.write_text(yaml.safe_dump({
+        "thing": {"component_key": "collate_fn", "variant_key": "my_custom_collator",
+                   "config": {"sample_key": "x", "pad_to": 7}}
+    }))
+    main = Main(cfg, experiment_id="custom_test")
+    main.add_custom_component("collate_fn", "my_custom_collator", _CustomCollate, _CustomCollateConfig)
+
+    class _Model(BaseModel):
+        thing: object
+
+    built = main.build_components(_Model)
+    assert isinstance(built.thing, _CustomCollate)

@@ -34,7 +34,8 @@ def test_reads_reference_packed_file():
     last = ds[len(ds) - 1]["input_ids"]
     assert first.ndim == 1 and first.size > 0
     assert last.ndim == 1 and last.size > 0
-    assert int(first.max()) < 2 ** (8 * esd.token_size_in_bytes)
+    # the reference packed this file with a GPT2-family tokenizer (vocab ~50k)
+    assert int(first.max()) < 60_000
 
 
 def test_continuous_windows_over_reference_file():
