@@ -1,7 +1,6 @@
 """Library-extension hook: register custom components on Main's registry
 (reference tutorials/library_usage + Main.add_custom_component, main.py:61)."""
 
-import numpy as np
 import yaml
 from pydantic import BaseModel
 
