@@ -37,11 +37,13 @@ def _probe_tpu(timeout_s: int = 180) -> bool:
         start_new_session=True,
     )
     deadline = time.monotonic() + timeout_s
-    while time.monotonic() < deadline:
+    while True:
         if proc.poll() is not None:
             out = proc.stdout.read() if proc.stdout else ""
             return proc.returncode == 0 and "tpu" in out
-        time.sleep(1.0)
+        if time.monotonic() >= deadline:
+            break
+        time.sleep(min(1.0, max(0.0, deadline - time.monotonic())))
     proc.kill()
     for _ in range(10):  # bounded reap; abandon a D-state child rather than block
         if proc.poll() is not None:
