@@ -6,6 +6,14 @@ Metric: model FLOPs utilization (MFU) of a GPT2 train step (fwd+bwd+optimizer, b
 compute) at the largest model that fits the chip. vs_baseline compares against the
 reference's strongest published MFU, 0.6867 (6.7B on 8xA100, reference README.md:339;
 see BASELINE.md) — the number to beat on TPU.
+
+Robustness: the TPU claim on this host can be wedged (hangs or raises UNAVAILABLE on
+init). A watchdog child process probes reachability first; if the parent's own init
+still fails, the script re-execs itself with the CPU backend so the JSON line always
+emits. Model candidates are tried largest-first with OOM step-down.
+
+Env knobs: BENCH_CONFIG=<idx> pin a candidate, BENCH_ITERS=<n> timing iterations,
+BENCH_TPU_PROBE=0 skip the watchdog probe, JAX_PLATFORMS=cpu force CPU.
 """
 
 import json
@@ -52,6 +60,19 @@ def _probe_tpu(timeout_s: int = 180) -> bool:
     return False
 
 
+def _reexec_on_cpu() -> None:
+    """Replace this process with a CPU-backend copy of itself (clean interpreter, no
+    half-initialized TPU runtime). Guarded: never loops because the child sees
+    JAX_PLATFORMS=cpu and takes the CPU path unconditionally."""
+    os.environ["PALLAS_AXON_POOL_IPS"] = ""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["BENCH_TPU_PROBE"] = "0"
+    os.environ.pop("BENCH_CONFIG", None)  # pins index the TPU list; meaningless on CPU
+    sys.stdout.flush()
+    sys.stderr.flush()
+    os.execv(sys.executable, [sys.executable, os.path.abspath(__file__)])
+
+
 def peak_flops_per_chip() -> float:
     """bf16 peak FLOP/s by TPU generation (BASELINE.md: v5p 459e12)."""
     import jax
@@ -72,30 +93,34 @@ def peak_flops_per_chip() -> float:
     return 197e12
 
 
-def main() -> None:
-    tpu_reachable = _probe_tpu()
-    if not tpu_reachable:
-        # fall back to CPU so the bench always emits its JSON line
-        os.environ["PALLAS_AXON_POOL_IPS"] = ""
-        os.environ["JAX_PLATFORMS"] = "cpu"
+# Candidate configs, largest first. A ~1.3B model in bf16 params + bf16 adam state
+# fits a 16 GB v5e with full remat; f32 everything would need ~21 GB (VERDICT.md
+# round-1 note: bench >=1B, not 160M). Each entry: model dims + microbatch + dtypes.
+_TPU_CANDIDATES = [
+    # (name, n_layer, n_embd, n_head, ffn, seq, mb, attn_impl, param_dtype, remat)
+    ("1.3b_flash_mb8", 24, 2048, 16, 8192, 2048, 8, "dao_flash", "bfloat16", "full"),
+    ("1.3b_sdpa_mb8", 24, 2048, 16, 8192, 2048, 8, "pytorch_flash", "bfloat16", "full"),
+    ("1.3b_flash_mb4", 24, 2048, 16, 8192, 2048, 4, "dao_flash", "bfloat16", "full"),
+    ("760m_sdpa_mb8", 24, 1536, 12, 6144, 2048, 8, "pytorch_flash", "bfloat16", "full"),
+    ("410m_sdpa_mb8", 24, 1024, 16, 4096, 2048, 8, "pytorch_flash", "float32", None),
+]
+_CPU_CANDIDATE = ("cpu_tiny", 2, 256, 4, 1024, 256, 4, "pytorch_flash", "float32", None)
 
+
+def _run_candidate(cand, iters: int):
+    """Build the train step for one candidate and time it. Returns the result dict."""
     import jax
-
-    dev = jax.devices()[0]
-    on_tpu = dev.platform == "tpu"
 
     from modalities_tpu.loss_functions import CLMCrossEntropyLoss
     from modalities_tpu.models.gpt2.gpt2_model import AttentionConfig, GPT2LLM
+    from modalities_tpu.models.model import MixedPrecisionSpec
     from modalities_tpu.optimizers.optimizer_factory import OptimizerFactory
     from modalities_tpu.running_env.device_mesh import get_device_mesh
     from modalities_tpu.training.train_step import TrainStepBuilder
 
-    # single-chip benchmark config (160M-class GPT so it fits v5e comfortably)
-    if on_tpu:
-        n_layer, n_embd, n_head, seq, mb = 12, 768, 12, 2048, 8
-    else:
-        n_layer, n_embd, n_head, seq, mb = 2, 256, 4, 256, 4
+    name, n_layer, n_embd, n_head, ffn, seq, mb, attn_impl, param_dtype, remat = cand
     vocab = 50304
+    dev = jax.devices()[0]
 
     model = GPT2LLM(
         sample_key="input_ids",
@@ -107,7 +132,7 @@ def main() -> None:
         n_head_q=n_head,
         n_head_kv=n_head,
         n_embd=n_embd,
-        ffn_hidden=4 * n_embd,
+        ffn_hidden=ffn,
         dropout=0.0,
         bias=False,
         attention_config=AttentionConfig(
@@ -118,7 +143,7 @@ def main() -> None:
                 }
             ]
         ),
-        attention_implementation="dao_flash" if on_tpu else "pytorch_flash",
+        attention_implementation=attn_impl,
         activation_type="swiglu",
         attention_norm_config={"norm_type": "rms_norm", "config": {"ndim": n_embd, "bias": False}},
         ffn_norm_config={"norm_type": "rms_norm", "config": {"ndim": n_embd, "bias": False}},
@@ -126,6 +151,16 @@ def main() -> None:
         use_weight_tying=True,
         seed=0,
     )
+    # bf16 params + bf16 grads: pure-throughput bench profile; reduce==param dtype
+    # because acc_steps=1 (no accumulation happens)
+    model.update_train_spec(
+        mixed_precision=MixedPrecisionSpec(
+            param_dtype=param_dtype, compute_dtype="bfloat16", reduce_dtype=param_dtype
+        )
+    )
+    if remat is not None:
+        model.with_spec_updates(remat_variant=remat)
+
     mesh = get_device_mesh(
         device_type=dev.platform, data_parallel_shard_degree=1, world_size=1, devices=jax.devices()[:1]
     )
@@ -160,7 +195,6 @@ def main() -> None:
     state, metrics = fns.train_step(state, batch)
     jax.block_until_ready(metrics["loss"])
 
-    iters = 20 if on_tpu else 3
     start = time.perf_counter()
     for _ in range(iters):
         state, metrics = fns.train_step(state, batch)
@@ -176,23 +210,84 @@ def main() -> None:
     mfu = tokens_per_sec * flops_per_token / peak_flops_per_chip()
 
     baseline_mfu = 0.6867  # reference best (6.7B, 8xA100, README.md:339)
-    print(
-        json.dumps(
-            {
-                "metric": "gpt_train_mfu_single_chip",
-                "value": round(mfu, 4),
-                "unit": "MFU (fraction of bf16 peak)",
-                "vs_baseline": round(mfu / baseline_mfu, 4),
-                "detail": {
-                    "tokens_per_sec": round(tokens_per_sec, 1),
-                    "params": n_params,
-                    "device": dev.device_kind,
-                    "seq": seq,
-                    "micro_batch": mb,
-                },
-            }
-        )
-    )
+    return {
+        "metric": "gpt_train_mfu_single_chip",
+        "value": round(mfu, 4),
+        "unit": "MFU (fraction of bf16 peak)",
+        "vs_baseline": round(mfu / baseline_mfu, 4),
+        "detail": {
+            "config": name,
+            "tokens_per_sec": round(tokens_per_sec, 1),
+            "step_time_s": round(elapsed / iters, 4),
+            "params": n_params,
+            "device": dev.device_kind,
+            "seq": seq,
+            "micro_batch": mb,
+        },
+    }
+
+
+def _is_oom(exc: BaseException) -> bool:
+    msg = str(exc)
+    return "RESOURCE_EXHAUSTED" in msg or "Out of memory" in msg or "out of memory" in msg
+
+
+def main() -> None:
+    forced_cpu = os.environ.get("JAX_PLATFORMS", "").lower() == "cpu"
+    tpu_reachable = _probe_tpu() if not forced_cpu else False
+    if not tpu_reachable and not forced_cpu:
+        # fall back to CPU so the bench always emits its JSON line
+        os.environ["PALLAS_AXON_POOL_IPS"] = ""
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        forced_cpu = True
+
+    import jax
+
+    if forced_cpu:
+        # the axon sitecustomize registers the TPU plugin and locks jax_platforms at
+        # interpreter startup, so the env var alone is not enough — override the live
+        # config too (otherwise jax.devices() below still touches the wedged claim)
+        jax.config.update("jax_platforms", "cpu")
+
+    try:
+        dev = jax.devices()[0]
+    except Exception as exc:  # probe passed but the parent's own claim failed
+        print(f"bench: device init failed ({exc}); re-exec on CPU", file=sys.stderr)
+        if forced_cpu:
+            raise  # CPU init failing is unrecoverable; surface it
+        _reexec_on_cpu()
+        return
+
+    on_tpu = dev.platform == "tpu"
+    candidates = list(_TPU_CANDIDATES) if on_tpu else [_CPU_CANDIDATE]
+    pin = os.environ.get("BENCH_CONFIG")
+    if pin is not None and int(pin) < len(candidates):
+        candidates = [candidates[int(pin)]]
+    elif pin is not None:
+        print(f"bench: ignoring BENCH_CONFIG={pin} (only {len(candidates)} candidates)", file=sys.stderr)
+    iters = int(os.environ.get("BENCH_ITERS", "20" if on_tpu else "3"))
+
+    result, errors = None, []
+    for cand in candidates:
+        try:
+            result = _run_candidate(cand, iters)
+            break
+        except Exception as exc:  # noqa: BLE001 — OOM/step-down ladder
+            errors.append(f"{cand[0]}: {type(exc).__name__}: {str(exc)[:200]}")
+            if not _is_oom(exc):
+                # non-OOM failure: keep stepping down (a kernel-tier bug must not
+                # leave the bench silent), but record it loudly
+                print(f"bench: candidate {cand[0]} failed (non-OOM): {exc}", file=sys.stderr)
+            continue
+    if result is None:
+        if on_tpu:
+            print("bench: all TPU candidates failed; re-exec on CPU", file=sys.stderr)
+            print("\n".join(errors), file=sys.stderr)
+            _reexec_on_cpu()
+            return
+        raise RuntimeError("all bench candidates failed:\n" + "\n".join(errors))
+
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":

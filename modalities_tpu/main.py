@@ -83,6 +83,27 @@ class Main:
         clipper = components.gradient_clipper
         step_profile = settings.step_profile
 
+        # debugging_enriched model variant -> per-rank stats logger + grads exposure
+        debug_cfg = getattr(app_state_spec.model, "debugging_config", None)
+        debug_stats_logger = None
+        if debug_cfg is not None:
+            from modalities_tpu.utils.debug_components import DebugStatsLogger
+
+            debug_dir = debug_cfg.get("logging_dir_path")
+            if debug_dir is None and self.experiments_root_path is not None:
+                debug_dir = self.experiments_root_path / self.experiment_id / "debug"
+            if debug_dir is not None:
+                debug_stats_logger = DebugStatsLogger(
+                    logging_dir_path=debug_dir,
+                    tracked_ranks=debug_cfg.get("tracked_ranks"),
+                    log_interval_steps=debug_cfg.get("log_interval_steps", 1),
+                )
+            else:
+                logger.warning(
+                    "debugging_enriched model requested but no logging_dir_path configured "
+                    "and no experiments_root_path to derive one — debug stats are DISABLED"
+                )
+
         builder = TrainStepBuilder(
             model=app_state_spec.model,
             loss_fn=components.loss_fn,
@@ -91,6 +112,8 @@ class Main:
             mesh_handle=components.device_mesh,
             gradient_acc_steps=step_profile.gradient_accumulation_steps,
             grad_clip_norm=getattr(clipper, "max_norm", None),
+            grad_clipper=clipper if hasattr(clipper, "build_transform") else None,
+            expose_grads=debug_stats_logger is not None,
         )
         step_functions = builder.build()
 
@@ -138,6 +161,7 @@ class Main:
             training_log_interval_in_steps=settings.intervals.training_log_interval_in_steps,
             mfu_calculator=components.mfu_calculator,
             profiler=components.profiler,
+            debug_stats_logger=debug_stats_logger,
         )
         evaluator = Evaluator(
             progress_publisher=progress_publisher, evaluation_result_publisher=results_publisher

@@ -172,13 +172,46 @@ class GPT2ModelSpec:
     context_parallel_axis: Optional[str] = None  # set when the mesh has cp > 1
     pipeline_axis: Optional[str] = None  # set when the mesh has pp > 1
     pp_num_microbatches: Optional[int] = None  # GPipe microbatches (default: pp degree)
+    param_dtype: str = "float32"  # storage dtype (MixedPrecisionSpec.param_dtype)
+    compute_dtype: str = "bfloat16"  # block compute dtype (MXU-native)
 
     @property
     def head_dim(self) -> int:
         return self.n_embd // self.n_head_q
 
     def __hash__(self):
-        return hash((self.vocab_size, self.n_layer, self.n_embd, self.n_head_q, self.n_head_kv, id(self)))
+        # hash a subset of the fields __eq__ compares (never id()): value-equal specs
+        # must hash equal so jit/linen caches keyed on static module fields hit
+        return hash(
+            (
+                self.vocab_size,
+                self.sequence_length,
+                self.n_layer,
+                self.n_head_q,
+                self.n_head_kv,
+                self.n_embd,
+                self.ffn_hidden,
+                self.dropout,
+                self.bias,
+                self.poe_type,
+                self.activation,
+                self.attention_impl,
+                self.use_rope,
+                self.rope_base_freq,
+                self.use_qk_norm,
+                self.use_weight_tying,
+                self.swiglu_hidden,
+                self.scan_layers,
+                self.remat_variant,
+                self.remat_freq,
+                self.remat_save_list,
+                self.context_parallel_axis,
+                self.pipeline_axis,
+                self.pp_num_microbatches,
+                self.param_dtype,
+                self.compute_dtype,
+            )
+        )
 
 
 def _rope_tables(head_dim: int, seq_len: int, base_freq: int, dtype=jnp.float32):
@@ -239,6 +272,7 @@ def _dense_general(spec, features, name, kernel_axes, dtype):
         kernel_init=nn.with_logical_partitioning(nn.initializers.normal(0.02), kernel_axes),
         bias_init=nn.with_logical_partitioning(nn.initializers.zeros, bias_axes),
         dtype=dtype,
+        param_dtype=jnp.dtype(spec.param_dtype),
     )
 
 
@@ -294,6 +328,7 @@ class CausalSelfAttention(nn.Module):
             ),
             bias_init=nn.with_logical_partitioning(nn.initializers.zeros, ("embed",)),
             dtype=x.dtype,
+            param_dtype=jnp.dtype(spec.param_dtype),
         )(y)
         return nn.Dropout(rate=spec.dropout)(out, deterministic=self.deterministic or spec.dropout == 0.0)
 
@@ -368,12 +403,13 @@ class GPT2Module(nn.Module):
     @nn.compact
     def __call__(self, input_ids):
         spec = self.spec
-        compute_dtype = jnp.bfloat16
+        compute_dtype = jnp.dtype(spec.compute_dtype)
+        param_dtype = jnp.dtype(spec.param_dtype)
         wte = self.param(
             "wte",
             nn.with_logical_partitioning(nn.initializers.normal(0.02), ("vocab", "embed")),
             (spec.vocab_size, spec.n_embd),
-            jnp.float32,
+            param_dtype,
         )
         x = jnp.take(wte, input_ids, axis=0).astype(compute_dtype)
         if spec.poe_type == PositionTypes.ABSOLUTE.value:
@@ -381,7 +417,7 @@ class GPT2Module(nn.Module):
                 "wpe",
                 nn.with_logical_partitioning(nn.initializers.normal(0.02), ("seq_param", "embed")),
                 (spec.sequence_length, spec.n_embd),
-                jnp.float32,
+                param_dtype,
             )
             x = x + wpe[None, : input_ids.shape[1], :].astype(compute_dtype)
         x = nn.Dropout(rate=spec.dropout)(x, deterministic=self.deterministic or spec.dropout == 0.0)
@@ -440,8 +476,8 @@ class GPT2Module(nn.Module):
                 use_bias=False,
                 name="lm_head",
                 kernel_init=nn.with_logical_partitioning(nn.initializers.normal(0.02), ("embed", "vocab")),
-                dtype=jnp.float32,
-                param_dtype=jnp.float32,
+                dtype=jnp.float32,  # logits compute stays fp32 for a stable softmax
+                param_dtype=param_dtype,
             )(x.astype(jnp.float32))
         return with_logical_constraint(logits, ("batch", "seq", "vocab"))
 

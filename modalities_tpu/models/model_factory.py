@@ -79,8 +79,10 @@ class ModelFactory:
     @staticmethod
     def get_debugging_enriched_model(model: NNModel, logging_dir_path=None, tracked_ranks=None,
                                      log_interval_steps: int = 1) -> NNModel:
-        """Per-module tensor-stats debugging (reference :410-592) — on TPU implemented
-        as jitted intermediate captures; records the request on the model."""
+        """Per-module tensor-stats debugging (reference :410-592). Main reads this
+        config to (a) build a DebugStatsLogger writing per-rank jsonl stats and
+        (b) have the train step expose grads in its metrics; the Trainer then logs
+        param/grad stats every log_interval_steps (trainer.py)."""
         model.debugging_config = {
             "logging_dir_path": logging_dir_path,
             "tracked_ranks": tracked_ranks,

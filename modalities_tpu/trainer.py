@@ -43,6 +43,7 @@ class Trainer:
         mfu_calculator=None,
         profiler=None,
         gc_frequency: int = 10,
+        debug_stats_logger=None,
     ) -> None:
         self.progress_publisher = progress_publisher
         self.evaluation_result_publisher = evaluation_result_publisher
@@ -54,6 +55,8 @@ class Trainer:
         self.mfu_calculator = mfu_calculator
         self.profiler = profiler
         self.gc_frequency = gc_frequency
+        # debugging_enriched model variant: per-rank jsonl stats on params/grads
+        self.debug_stats_logger = debug_stats_logger
 
     def train(
         self,
@@ -103,6 +106,7 @@ class Trainer:
 
                 device_batch = put_batch(stacked)
                 state, metrics = train_step(state, device_batch)
+                debug_grads = metrics.pop("grads", None)  # exposed only when debugging
                 pending_metrics.append(metrics)
                 step_id += 1
                 training_progress.num_seen_steps_current_run += 1
@@ -119,6 +123,12 @@ class Trainer:
                     )
                     pending_metrics = []
                     interval_start = time.perf_counter()
+
+                if self.debug_stats_logger is not None:
+                    trees = {"params": state.params}
+                    if debug_grads is not None:
+                        trees["grads"] = debug_grads
+                    self.debug_stats_logger.log(step_id, **trees)
 
                 if self.gc_frequency > 0 and step_id % self.gc_frequency == 0:
                     gc.collect(1)
@@ -138,6 +148,20 @@ class Trainer:
             if self.gc_frequency > 0:
                 gc.enable()
 
+        # flush tail metrics when the loop exits mid-interval (target steps reached or
+        # loader exhausted) so token/loss accounting stays honest
+        if pending_metrics:
+            self._publish_interval(
+                pending_metrics, step_id, train_loader.dataloader_tag, interval_start, training_progress
+            )
+        if micro_stack_samples:
+            logger.warning(
+                "dropping %d trailing microbatches at end of dataloader (< gradient_acc_steps=%d); "
+                "their tokens are not counted",
+                len(micro_stack_samples),
+                self.gradient_acc_steps,
+            )
+
         step_functions.app_state_handle.state = state
 
     def _publish_interval(
@@ -149,6 +173,14 @@ class Trainer:
         training_progress: TrainingProgress,
     ) -> None:
         # single host sync point per interval: fetch the accumulated device metrics
+        if "nonfinite_grads" in pending_metrics[0]:
+            flags = np.asarray([int(m["nonfinite_grads"]) for m in pending_metrics])
+            if flags.any():
+                first_bad = step_id - len(pending_metrics) + 1 + int(flags.argmax())
+                raise RuntimeError(
+                    f"non-finite gradient norm at train step {first_bad} "
+                    "(gradient_clipper.error_if_nonfinite=True)"
+                )
         losses = np.asarray([m["loss"] for m in pending_metrics], dtype=np.float64)
         grad_norms = np.asarray([m["grad_norm"] for m in pending_metrics], dtype=np.float64)
         lrs = np.asarray([m["lr"] for m in pending_metrics], dtype=np.float64)

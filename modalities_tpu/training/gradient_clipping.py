@@ -2,10 +2,12 @@
 
 The reference computes the global norm across FSDP shards + an extra manual
 all-reduce over the PP mesh (:161-170). Under GSPMD the global norm inside the jitted
-step (optax.global_norm) already spans every mesh axis, so a clipper here is a
-*descriptor* consumed by the train-step builder: max_norm -> optax.clip_by_global_norm
-in the chain; logging-only -> norm reported in metrics without clipping (which the
-builder always does anyway).
+step already spans every mesh axis, so a clipper here is a *descriptor* consumed by
+the train-step builder: it contributes an optax transformation implementing the
+requested norm (p2/p1/inf, reference :161-170), and `error_if_nonfinite`
+(reference :118) makes the step report a non-finite-grad flag that the Trainer
+raises on at the next host sync (the async-dispatch equivalent of torch's
+clip_grad_norm_ raising inline).
 """
 
 from __future__ import annotations
@@ -21,12 +23,57 @@ class GradientClippingMode(str, Enum):
     MAX_NORM = "max_norm"  # infinity norm
 
 
+def global_norm_by_mode(tree, mode: GradientClippingMode):
+    """Global gradient norm across the whole (sharded) tree for the given mode."""
+    import jax
+    import jax.numpy as jnp
+
+    leaves = [jnp.asarray(x, jnp.float32) for x in jax.tree.leaves(tree)]
+    if mode == GradientClippingMode.P2_NORM:
+        return jnp.sqrt(sum(jnp.sum(x * x) for x in leaves))
+    if mode == GradientClippingMode.P1_NORM:
+        return sum(jnp.sum(jnp.abs(x)) for x in leaves)
+    return jnp.max(jnp.stack([jnp.max(jnp.abs(x)) for x in leaves]))
+
+
+def clip_by_norm_mode(max_norm: float, mode: GradientClippingMode):
+    """optax transformation clipping the global p2/p1/inf norm to max_norm
+    (torch.nn.utils.clip_grad_norm_ semantics: scale = max_norm / max(norm, max_norm))."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    def init_fn(params):
+        del params
+        return optax.EmptyState()
+
+    def update_fn(updates, state, params=None):
+        del params
+        norm = global_norm_by_mode(updates, mode)
+        scale = jnp.minimum(1.0, max_norm / jnp.maximum(norm, 1e-16))
+        updates = jax.tree.map(lambda g: (g * scale).astype(g.dtype), updates)
+        return updates, state
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
 class GradientClipperIF:
-    """Descriptor: the builder reads `max_norm`/`norm_type` when assembling the step."""
+    """Descriptor: the builder reads `max_norm`/`norm_type`/`error_if_nonfinite`
+    when assembling the step."""
 
     max_norm: Optional[float] = None
     norm_type: GradientClippingMode = GradientClippingMode.P2_NORM
     error_if_nonfinite: bool = False
+
+    def build_transform(self):
+        """optax transformation for this clipper, or None for logging-only/dummy."""
+        if self.max_norm is None:
+            return None
+        import optax
+
+        if self.norm_type == GradientClippingMode.P2_NORM:
+            return optax.clip_by_global_norm(self.max_norm)
+        return clip_by_norm_mode(self.max_norm, self.norm_type)
 
 
 @dataclass
@@ -40,10 +87,6 @@ class GradientClipper(GradientClipperIF):
     def __post_init__(self):
         if isinstance(self.norm_type, str):
             self.norm_type = GradientClippingMode(self.norm_type)
-        if self.norm_type != GradientClippingMode.P2_NORM:
-            raise NotImplementedError(
-                "Only p2_norm clipping is currently supported on TPU (optax.clip_by_global_norm)."
-            )
 
 
 @dataclass

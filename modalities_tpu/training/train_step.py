@@ -92,7 +92,9 @@ class TrainStepBuilder:
         mesh_handle: Optional[DeviceMeshHandle] = None,
         gradient_acc_steps: int = 1,
         grad_clip_norm: Optional[float] = None,
+        grad_clipper=None,
         sequence_parallel: bool = True,
+        expose_grads: bool = False,
     ):
         self.model = model
         self.loss_fn = loss_fn
@@ -101,6 +103,8 @@ class TrainStepBuilder:
         self.mesh_handle = mesh_handle
         self.gradient_acc_steps = gradient_acc_steps
         self.grad_clip_norm = grad_clip_norm
+        self.grad_clipper = grad_clipper  # full descriptor (norm_type, error_if_nonfinite)
+        self.expose_grads = expose_grads  # debugging_enriched: return grads in metrics
         self.rules = (
             default_logical_axis_rules(mesh_handle, sequence_parallel) if mesh_handle is not None else ()
         )
@@ -118,6 +122,23 @@ class TrainStepBuilder:
                 model.with_spec_updates(context_parallel_axis="cp")
             if mesh_handle.degrees.get("pp", 1) > 1:
                 model.with_spec_updates(pipeline_axis="pp")
+
+        # honor the mixed-precision policy (reference model_factory.py:201): the
+        # param/compute dtypes recorded by the fsdp2_wrapped variant flow into the
+        # module's static spec, reduce_dtype governs grad accumulation below
+        mixed_precision = getattr(model.train_spec, "mixed_precision", None)
+        if (
+            mixed_precision is not None
+            and hasattr(model, "with_spec_updates")
+            and hasattr(getattr(model, "config_spec", None), "param_dtype")
+        ):
+            model.with_spec_updates(
+                param_dtype=mixed_precision.param_dtype,
+                compute_dtype=mixed_precision.compute_dtype,
+            )
+        reduce_dtype = (
+            jnp.dtype(mixed_precision.reduce_dtype) if mixed_precision is not None else jnp.float32
+        )
 
         init_fn = lambda r: model.init_params(r)  # noqa: E731
 
@@ -146,7 +167,20 @@ class TrainStepBuilder:
         abstract_params = _unbox(boxed_abstract)
         schedule = self.scheduler_spec.absolute_lr_schedule() if self.scheduler_spec is not None else None
         tx = self.optimizer_spec.build(abstract_params, schedule)
-        if self.grad_clip_norm is not None:
+        from modalities_tpu.training.gradient_clipping import (
+            GradientClippingMode,
+            global_norm_by_mode,
+        )
+
+        norm_mode = GradientClippingMode.P2_NORM
+        error_if_nonfinite = False
+        if self.grad_clipper is not None:
+            norm_mode = self.grad_clipper.norm_type
+            error_if_nonfinite = bool(getattr(self.grad_clipper, "error_if_nonfinite", False))
+            clip_tx = self.grad_clipper.build_transform()
+            if clip_tx is not None:
+                tx = optax.chain(clip_tx, tx)
+        elif self.grad_clip_norm is not None:
             tx = optax.chain(optax.clip_by_global_norm(self.grad_clip_norm), tx)
         lr_fn = schedule if schedule is not None else (lambda step: self.optimizer_spec.lr)
 
@@ -184,6 +218,7 @@ class TrainStepBuilder:
         loss_fn = self.loss_fn
         sample_key = model.sample_key
         acc_steps = self.gradient_acc_steps
+        expose_grads = self.expose_grads
 
         def compute_loss(params, samples, targets, dropout_rng):
             predictions = model.apply(
@@ -194,20 +229,26 @@ class TrainStepBuilder:
         def train_step(state: AppState, batch: dict) -> tuple[AppState, dict]:
             """batch: {"samples": {k: [acc, mb, ...]}, "targets": {k: [acc, mb, ...]}}"""
             samples, targets = batch["samples"], batch["targets"]
-            dropout_rng = jax.random.fold_in(jax.random.PRNGKey(0), state.step)
+            # fresh dropout mask per step AND per microbatch, rooted at the build seed
+            step_rng = jax.random.fold_in(jax.random.PRNGKey(seed), state.step)
 
             def micro(acc, xs):
-                s, t = xs
+                mb_index, s, t = xs
+                dropout_rng = jax.random.fold_in(step_rng, mb_index)
                 loss, grads = jax.value_and_grad(compute_loss)(state.params, s, t, dropout_rng)
                 g_acc, l_acc = acc
-                return (jax.tree.map(jnp.add, g_acc, grads), l_acc + loss), None
+                # accumulate in reduce_dtype (fp32 by default) even when grads are bf16
+                g_acc = jax.tree.map(lambda a, g: a + g.astype(reduce_dtype), g_acc, grads)
+                return (g_acc, l_acc + loss), None
 
-            zero_grads = jax.tree.map(jnp.zeros_like, state.params)
-            (grads, loss_sum), _ = jax.lax.scan(micro, (zero_grads, 0.0), (samples, targets))
-            grads = jax.tree.map(lambda g: g / acc_steps, grads)
+            zero_grads = jax.tree.map(lambda p: jnp.zeros(p.shape, reduce_dtype), state.params)
+            (grads, loss_sum), _ = jax.lax.scan(
+                micro, (zero_grads, 0.0), (jnp.arange(acc_steps), samples, targets)
+            )
+            grads = jax.tree.map(lambda g, p: (g / acc_steps).astype(p.dtype), grads, state.params)
             loss = loss_sum / acc_steps
 
-            grad_norm = optax.global_norm(grads)
+            grad_norm = global_norm_by_mode(grads, norm_mode)
             updates, new_opt_state = tx.update(grads, state.opt_state, state.params)
             new_params = optax.apply_updates(state.params, updates)
             new_state = AppState(params=new_params, opt_state=new_opt_state, step=state.step + 1)
@@ -216,6 +257,13 @@ class TrainStepBuilder:
                 "grad_norm": grad_norm,
                 "lr": jnp.asarray(lr_fn(state.step), jnp.float32),
             }
+            if error_if_nonfinite:
+                # consumed by Trainer at the next host sync (async equivalent of
+                # torch clip_grad_norm_(error_if_nonfinite=True) raising inline)
+                metrics["nonfinite_grads"] = (~jnp.isfinite(grad_norm)).astype(jnp.int32)
+            if expose_grads:
+                # debugging_enriched path: Trainer feeds these to DebugStatsLogger
+                metrics["grads"] = grads
             return new_state, metrics
 
         def eval_step(state: AppState, batch: dict) -> dict:
@@ -227,11 +275,20 @@ class TrainStepBuilder:
             from modalities_tpu.parallel.sharding import activation_rules
 
             rules = self.rules
+            metrics_shardings: dict = {
+                "loss": replicated_sharding,
+                "grad_norm": replicated_sharding,
+                "lr": replicated_sharding,
+            }
+            if error_if_nonfinite:
+                metrics_shardings["nonfinite_grads"] = replicated_sharding
+            if expose_grads:
+                metrics_shardings["grads"] = param_shardings  # keep grads sharded
             train_step_j = jax.jit(
                 train_step,
                 donate_argnums=(0,),
                 in_shardings=(state_shardings, None),
-                out_shardings=(state_shardings, replicated_sharding),
+                out_shardings=(state_shardings, metrics_shardings),
             )
             eval_step_j = jax.jit(eval_step, in_shardings=(state_shardings, None))
 
