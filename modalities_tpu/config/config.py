@@ -123,6 +123,16 @@ class DebuggingEnrichedModelConfig(BaseModel):
     log_interval_steps: Annotated[int, Field(strict=True, ge=1)] = 1
 
 
+class PipelinedModelConfig(BaseModel):
+    """Pipeline schedule selection (reference ScheduledPipelineConfig)."""
+
+    model: PydanticModelIFType
+    pp_schedule_name: str = "1f1b"
+    num_microbatches: Optional[Annotated[int, Field(strict=True, ge=1)]] = None
+    batch_size: Optional[Annotated[int, Field(strict=True, ge=1)]] = None
+    microbatch_size: Optional[Annotated[int, Field(strict=True, ge=1)]] = None
+
+
 class HuggingFacePretrainedModelConfig(BaseModel):
     model_type: str
     model_name: str

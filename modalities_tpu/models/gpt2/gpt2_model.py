@@ -172,6 +172,7 @@ class GPT2ModelSpec:
     context_parallel_axis: Optional[str] = None  # set when the mesh has cp > 1
     pipeline_axis: Optional[str] = None  # set when the mesh has pp > 1
     pp_num_microbatches: Optional[int] = None  # GPipe microbatches (default: pp degree)
+    pp_schedule: str = "gpipe"  # "gpipe" = in-module autodiff GPipe; "1f1b" = scheduled executor
     param_dtype: str = "float32"  # storage dtype (MixedPrecisionSpec.param_dtype)
     compute_dtype: str = "bfloat16"  # block compute dtype (MXU-native)
 
@@ -208,6 +209,7 @@ class GPT2ModelSpec:
                 self.context_parallel_axis,
                 self.pipeline_axis,
                 self.pp_num_microbatches,
+                self.pp_schedule,
                 self.param_dtype,
                 self.compute_dtype,
             )
@@ -236,19 +238,24 @@ def apply_rope(x, cos, sin):
     return x * cos + _rotate_half(x) * sin
 
 
-def manual_attention(q, k, v):
-    """Oracle attention: einsum + fp32 softmax with causal mask.
-    q: [B,S,Hq,D], k/v: [B,S,Hkv,D]; GQA convention: q head h uses kv head h // group."""
-    b, s, hq, d = q.shape
+def masked_attention(q, k, v, mask):
+    """einsum + fp32 softmax attention with an explicit [Sq, Sk] boolean mask.
+    q: [B,Sq,Hq,D], k/v: [B,Sk,Hkv,D]; GQA convention: q head h uses kv head h // group."""
+    b, sq, hq, d = q.shape
     hkv = k.shape[2]
     group = hq // hkv
-    qg = q.reshape(b, s, hkv, group, d)
+    qg = q.reshape(b, sq, hkv, group, d)
     logits = jnp.einsum("bshgd,bthd->bhgst", qg, k).astype(jnp.float32) / math.sqrt(d)
-    causal = jnp.tril(jnp.ones((s, s), dtype=bool))
-    logits = jnp.where(causal[None, None, None, :, :], logits, jnp.finfo(jnp.float32).min)
+    logits = jnp.where(mask[None, None, None, :, :], logits, jnp.finfo(jnp.float32).min)
     probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
     out = jnp.einsum("bhgst,bthd->bshgd", probs, v)
-    return out.reshape(b, s, hq, d)
+    return out.reshape(b, sq, hq, d)
+
+
+def manual_attention(q, k, v):
+    """Oracle attention: causal mask over a square sequence (reference :595-658)."""
+    s = q.shape[1]
+    return masked_attention(q, k, v, jnp.tril(jnp.ones((s, s), dtype=bool)))
 
 
 def sdpa_attention(q, k, v):
@@ -432,11 +439,6 @@ class GPT2Module(nn.Module):
                 metadata_params={nn.meta.PARTITION_NAME: "layers"},
             )(spec, self.deterministic, name="blocks")
             if spec.pipeline_axis is not None and not self.is_initializing():
-                if spec.dropout > 0.0 and not self.deterministic:
-                    raise NotImplementedError(
-                        "dropout > 0 with pipeline parallelism is not supported yet "
-                        "(rng threading through the GPipe schedule); set dropout to 0."
-                    )
                 # GPipe over the pp axis: same scan-stacked params (created by the init
                 # path below), applied stage-wise by parallel/pipeline.py
                 from modalities_tpu.parallel.pipeline import pipeline_blocks
@@ -444,12 +446,23 @@ class GPT2Module(nn.Module):
 
                 block_params = scanned.variables["params"]
                 deterministic = self.deterministic
+                pp_dropout_rng = (
+                    self.make_rng("dropout")
+                    if spec.dropout > 0.0 and not self.deterministic
+                    else None
+                )
 
-                def block_apply(layer_params, xx):
-                    fn = lambda p, a: GPT2Block(spec, deterministic).apply({"params": p["block"]}, a)  # noqa: E731
+                def block_apply(layer_params, xx, rng=None):
+                    def fn(p, a, r):
+                        return GPT2Block(spec, deterministic).apply(
+                            {"params": p["block"]},
+                            a,
+                            rngs={"dropout": r} if r is not None else None,
+                        )
+
                     if spec.remat_variant is not None:
                         fn = jax.checkpoint(fn, prevent_cse=False)
-                    return fn(layer_params, xx)
+                    return fn(layer_params, xx, rng)
 
                 x = pipeline_blocks(
                     block_params,
@@ -459,6 +472,7 @@ class GPT2Module(nn.Module):
                     axis_name=spec.pipeline_axis,
                     num_microbatches=spec.pp_num_microbatches,
                     seq_shard_axis=spec.context_parallel_axis,
+                    dropout_rng=pp_dropout_rng,
                 )
             else:
                 x, _ = scanned(x, None)
@@ -593,3 +607,71 @@ class GPT2LLM(NNModel):
         module = self.train_module() if train else self.module
         logits = module.apply(params, inputs[self.sample_key], rngs=rngs)
         return {self.prediction_key: logits}
+
+    # ------------------------------------------------------- scheduled pipelining
+    def split_pp_params(self, params):
+        """(stacked_block_params, shared_params) for the scheduled pipeline executor
+        (parallel/pipeline_scheduled.py). Stacked = the scan-over-layers subtree
+        (pp-sharded on its leading axis); shared = embeddings + head norm (+ head)."""
+        inner = dict(params["params"])
+        stacked = inner.pop("blocks")
+        return stacked, {"params": inner}
+
+    def merge_pp_grads(self, stacked_grads, shared_grads):
+        inner = dict(shared_grads["params"])
+        inner["blocks"] = stacked_grads
+        return {"params": inner}
+
+    def pp_stage_fns(self, loss_fn):
+        """Stage functions for the scheduled 1F1B pipeline: embed / block / head+loss.
+        Mirrors GPT2Module.__call__ exactly (same submodule names so param subtrees
+        line up); the head computes fp32 logits like the module path."""
+        from modalities_tpu.parallel.pipeline_scheduled import PipelineStageFns
+
+        spec = self.config_spec
+        compute_dtype = jnp.dtype(spec.compute_dtype)
+        prediction_key = self.prediction_key
+        target_key = loss_fn.target_key
+
+        def embed(shared, tokens, rng):
+            p = shared["params"]
+            x = jnp.take(p["wte"], tokens, axis=0).astype(compute_dtype)
+            if spec.poe_type == PositionTypes.ABSOLUTE.value:
+                x = x + p["wpe"][None, : tokens.shape[1], :].astype(compute_dtype)
+            if spec.dropout > 0.0 and rng is not None:
+                keep = jax.random.bernoulli(rng, 1.0 - spec.dropout, x.shape)
+                x = jnp.where(keep, x / (1.0 - spec.dropout), jnp.zeros_like(x))
+            return x
+
+        def block(layer_params, x, rng):
+            deterministic = rng is None
+            return GPT2Block(spec, deterministic).apply(
+                {"params": layer_params["block"]},
+                x,
+                rngs={"dropout": rng} if rng is not None else None,
+            )
+
+        ignore_index = getattr(loss_fn, "ignore_index", None)
+
+        def head_loss(shared, x, targets):
+            """Returns (mean loss over this microbatch, valid-token weight). The weight
+            lets the executor reproduce the GLOBAL token mean exactly even when
+            ignore_index masking makes microbatch token counts unequal."""
+            p = shared["params"]
+            h = build_norm(spec.lm_head_norm, "lm_head_norm").apply(
+                {"params": p.get("lm_head_norm", {})}, x
+            )
+            if spec.use_weight_tying:
+                logits = jnp.einsum(
+                    "bse,ve->bsv", h.astype(jnp.float32), p["wte"].astype(jnp.float32)
+                )
+            else:
+                logits = h.astype(jnp.float32) @ p["lm_head"]["kernel"].astype(jnp.float32)
+            loss = loss_fn({prediction_key: logits}, {target_key: targets})
+            if ignore_index is None:
+                weight = jnp.asarray(targets.size, jnp.float32)
+            else:
+                weight = jnp.maximum((targets != ignore_index).sum().astype(jnp.float32), 1.0)
+            return loss, weight
+
+        return PipelineStageFns(embed=embed, block=block, head_loss=head_loss)

@@ -69,6 +69,41 @@ class ModelFactory:
         )
 
     @staticmethod
+    def get_pipelined_model(
+        model: NNModel,
+        pp_schedule_name: str = "1f1b",
+        num_microbatches: Optional[int] = None,
+        batch_size: Optional[int] = None,
+        microbatch_size: Optional[int] = None,
+    ) -> NNModel:
+        """Select the pipeline schedule (reference: PipelineFactory.get_scheduled_pipeline,
+        pipeline_parallelism.py:294-337). "gpipe" = in-module autodiff GPipe;
+        "1f1b" = scheduled executor with in-region loss and O(pp) residual memory
+        (parallel/pipeline_scheduled.py). num_microbatches may be given directly or
+        derived from batch_size // microbatch_size like the reference."""
+        name = pp_schedule_name.strip().lower()
+        if name not in ("gpipe", "1f1b"):
+            raise NotImplementedError(
+                f"pipeline schedule {pp_schedule_name!r} not supported yet "
+                "(have: gpipe, 1f1b; reference also ships Interleaved1F1B/ZBVZeroBubble/DualPipeV)"
+            )
+        if num_microbatches is None and (batch_size is not None) != (microbatch_size is not None):
+            raise ValueError(
+                "pipelined model: batch_size and microbatch_size must be given together"
+            )
+        if num_microbatches is None and batch_size is not None and microbatch_size is not None:
+            if batch_size % microbatch_size != 0:
+                raise ValueError(
+                    f"batch_size ({batch_size}) must be divisible by microbatch_size ({microbatch_size})"
+                )
+            num_microbatches = batch_size // microbatch_size
+        if hasattr(model, "with_spec_updates"):
+            model.with_spec_updates(pp_schedule=name, pp_num_microbatches=num_microbatches)
+        else:
+            raise NotImplementedError("pipelined model variant requires a scan-stacked model (gpt2)")
+        return model
+
+    @staticmethod
     def get_weight_initialized_model(model: NNModel, model_initializer: ModelInitializationIF) -> NNModel:
         """Record the init routine; applied to the sharded params right after jitted init
         (the reference's to_empty + reset_parameters replay, :249-281)."""

@@ -43,21 +43,31 @@ class Pipeline:
 
 
 def _gpipe_local(stacked_params, x_microbatches, *, axis_name: str, num_stages: int,
-                 block_apply: Callable, compute_dtype):
+                 block_apply: Callable, compute_dtype, dropout_rng=None):
     """Runs on one pp shard. stacked_params: [L/P, ...] pytree; x_microbatches:
     [M, B, S, E] f32 at the boundary (replicated over pp — its cotangent psum must be
     f32: bf16 psum in a partial-manual region trips an XLA check). Compute runs in
-    `compute_dtype`. Returns [M, B, S, E] f32, valid on every shard."""
+    `compute_dtype`. Returns [M, B, S, E] f32, valid on every shard.
+
+    `dropout_rng`: folded per (microbatch, stage, layer) so every block draws an
+    independent mask (reference schedules draw fresh masks per microbatch)."""
     x_microbatches = x_microbatches.astype(compute_dtype)
     stage = jax.lax.axis_index(axis_name)
     num_micro = x_microbatches.shape[0]
+    num_local_layers = jax.tree.leaves(stacked_params)[0].shape[0]
     perm = [(i, (i + 1) % num_stages) for i in range(num_stages)]
 
-    def stage_fn(x):
-        def body(carry, layer_params):
-            return block_apply(layer_params, carry), None
+    def stage_fn(x, mb_rng):
+        def body(carry, xs):
+            layer_params, local_idx = xs
+            layer_rng = (
+                None
+                if mb_rng is None
+                else jax.random.fold_in(mb_rng, stage * num_local_layers + local_idx)
+            )
+            return block_apply(layer_params, carry, layer_rng), None
 
-        out, _ = jax.lax.scan(body, x, stacked_params)
+        out, _ = jax.lax.scan(body, x, (stacked_params, jnp.arange(num_local_layers)))
         return out
 
     x_shape = x_microbatches.shape[1:]
@@ -67,7 +77,11 @@ def _gpipe_local(stacked_params, x_microbatches, *, axis_name: str, num_stages: 
         mb_index = jnp.clip(t, 0, num_micro - 1)
         first_stage_input = x_microbatches[mb_index]
         x = jnp.where(stage == 0, first_stage_input, recv)
-        y = stage_fn(x)
+        # this stage processes microbatch t - stage at tick t (stage 0 feeds mb t);
+        # folding the stage's OWN microbatch keeps masks distinct across microbatches
+        own_mb = jnp.clip(t - stage, 0, num_micro - 1)
+        mb_rng = None if dropout_rng is None else jax.random.fold_in(dropout_rng, own_mb)
+        y = stage_fn(x, mb_rng)
         out_index = jnp.clip(t - (num_stages - 1), 0, num_micro - 1)
         is_output_tick = t >= num_stages - 1
         collected = jnp.where(
@@ -101,6 +115,7 @@ def pipeline_blocks(
     axis_name: str = "pp",
     num_microbatches: Optional[int] = None,
     seq_shard_axis: Optional[str] = None,
+    dropout_rng=None,
 ):
     """Run scan-stacked transformer blocks as a GPipe pipeline over `axis_name`.
 
@@ -108,15 +123,20 @@ def pipeline_blocks(
     x: [B, S, E] activations. Batch is split into `num_microbatches` along B.
     `seq_shard_axis` (e.g. "cp"): also bind that axis manually with the seq dim
     sharded over it, so in-block ring attention composes with the pipeline.
+    `block_apply(layer_params, x, rng)` receives a per-(microbatch, layer) dropout
+    key derived from `dropout_rng` (None = deterministic).
     """
     from jax.sharding import PartitionSpec as P
 
     if mesh is None or axis_name not in mesh.axis_names or mesh.shape[axis_name] == 1:
+        num_layers = jax.tree.leaves(stacked_params)[0].shape[0]
 
-        def body(carry, layer_params):
-            return block_apply(layer_params, carry), None
+        def body(carry, xs):
+            layer_params, idx = xs
+            layer_rng = None if dropout_rng is None else jax.random.fold_in(dropout_rng, idx)
+            return block_apply(layer_params, carry, layer_rng), None
 
-        out, _ = jax.lax.scan(body, x, stacked_params)
+        out, _ = jax.lax.scan(body, x, (stacked_params, jnp.arange(num_layers)))
         return out
 
     num_stages = mesh.shape[axis_name]
@@ -148,6 +168,7 @@ def pipeline_blocks(
             num_stages=num_stages,
             block_apply=block_apply,
             compute_dtype=compute_dtype,
+            dropout_rng=dropout_rng,
         ),
         mesh=mesh,
         in_specs=(param_specs, x_spec),

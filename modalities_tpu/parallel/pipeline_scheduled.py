@@ -1,0 +1,256 @@
+"""Scheduled pipeline executor: hand-rolled fwd/bwd over static schedule tables
+(reference: torch pipelining's _PipelineScheduleRuntime executing GPipe/1F1B action
+lists, src/modalities/models/parallelism/pipeline_parallelism.py:294-337 — re-built
+for SPMD).
+
+Unlike the autodiff GPipe in parallel/pipeline.py (which differentiates through the
+tick scan and therefore (a) computes the loss OUTSIDE the pipeline on the gathered
+[M, ...] output and (b) lets scan-autodiff store per-tick residuals), this executor:
+
+- computes the lm-head + loss INSIDE the pipelined region, per microbatch, the tick
+  after the last stage finishes it (the torch schedule's `loss_fn` slot). The head is
+  computed redundantly by every stage after a psum-broadcast — uniform SPMD compute
+  that costs no wall-clock vs. leaving stages idle in the bubble;
+- stores only a ring buffer of stage INPUTS (`max_inflight + 1` slots) and recomputes
+  each stage forward under ``jax.vjp`` at its backward tick (full remat — the
+  standard PP memory/compute trade). 1F1B's `max_inflight <= P` bound therefore
+  directly caps residual memory, where GPipe holds all M;
+- accumulates param grads explicitly: stacked (pp-sharded) block grads locally,
+  shared (pp-replicated: embedding/head) grads stage-masked then psum'd.
+
+Collectives per tick: one fwd ppermute (activations), one bwd ppermute (cotangents),
+one psum-broadcast (last-stage output for the head slot) — all riding ICI neighbors.
+psums/cotangent buffers are fp32 (bf16 psum inside a partial-manual region trips an
+XLA CPU check; fp32 is also the safer reduce).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from modalities_tpu.parallel.pipeline_schedules import build_schedule_tables
+
+
+class PipelineStageFns(NamedTuple):
+    """Model-provided stage functions (see GPT2LLM.pp_stage_fns).
+
+    embed(shared_params, tokens[B,S], rng|None) -> x[B,S,E] (compute dtype)
+    block(layer_params, x, rng|None) -> x       (one transformer block)
+    head_loss(shared_params, x, targets[B,S]) -> (scalar mean loss, valid-token
+        weight) — the weight reproduces the global token mean under ignore_index
+        masking (per-microbatch contributions are weighted, not averaged equally)
+    """
+
+    embed: Callable
+    block: Callable
+    head_loss: Callable
+
+
+def _masked_add(acc, update, mask):
+    return jax.tree.map(lambda a, u: a + jnp.where(mask, u, jnp.zeros_like(u)), acc, update)
+
+
+def _buf_set(buf, index, value, mask):
+    """buf.at[index].set(value) where mask else buf (applied leaf-wise)."""
+    new = buf.at[index].set(value)
+    return jnp.where(mask, new, buf)
+
+
+def scheduled_pipeline_loss_and_grads(
+    stage_fns: PipelineStageFns,
+    stacked_params,
+    shared_params,
+    tokens,
+    targets,
+    mesh,
+    *,
+    axis_name: str = "pp",
+    schedule: str = "1f1b",
+    num_microbatches: Optional[int] = None,
+    rng=None,
+):
+    """Run one pipelined fwd+bwd over the global batch; returns
+    (mean_loss, stacked_grads, shared_grads).
+
+    tokens/targets: [B, S] (batch split into microbatches along B).
+    stacked_params: leading layers axis, sharded over `axis_name`.
+    Differentiation is hand-rolled (schedule tables + jax.vjp per slot); do not wrap
+    this in jax.grad.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    num_stages = mesh.shape[axis_name]
+    batch = tokens.shape[0]
+    M = num_microbatches or num_stages
+    M = min(M, batch)
+    if batch % M != 0:
+        raise ValueError(f"batch ({batch}) must be divisible by num_microbatches ({M})")
+    tables = build_schedule_tables(schedule, num_stages, M)
+    ring = tables.max_inflight + 1  # +1: recv/broadcast lands one tick before use
+
+    tokens_mb = tokens.reshape(M, batch // M, *tokens.shape[1:])
+    targets_mb = targets.reshape(M, batch // M, *targets.shape[1:])
+
+    param_specs = jax.tree.map(lambda _: P(axis_name), stacked_params)
+    shared_specs = jax.tree.map(lambda _: P(), shared_params)
+
+    local = functools.partial(
+        _scheduled_local,
+        stage_fns=stage_fns,
+        tables=tables,
+        ring=ring,
+        axis_name=axis_name,
+        rng=rng,
+    )
+    fn = jax.shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(param_specs, shared_specs, P(), P()),
+        out_specs=(P(), param_specs, shared_specs),
+        axis_names=frozenset({axis_name}),
+        check_vma=False,
+    )
+    return fn(stacked_params, shared_params, tokens_mb, targets_mb)
+
+
+def _scheduled_local(stacked_local, shared, tokens_mb, targets_mb, *, stage_fns, tables,
+                     ring, axis_name, rng):
+    """Per-pp-shard tick loop. All buffers have static shapes; the schedule tables are
+    baked in as constants and indexed by (tick, stage)."""
+    embed, block, head_loss = stage_fns
+    P_ = tables.num_stages
+    M = tables.num_microbatches
+    stage = jax.lax.axis_index(axis_name)
+    num_local_layers = jax.tree.leaves(stacked_local)[0].shape[0]
+
+    f_tab = jnp.asarray(tables.f)  # [T, P]
+    b_tab = jnp.asarray(tables.b)
+    h_tab = jnp.asarray(tables.h)  # [T]
+
+    fwd_perm = [(i, (i + 1) % P_) for i in range(P_)]
+    bwd_perm = [(i, (i - 1) % P_) for i in range(P_)]
+
+    def block_rng(mb_index):
+        """Per-microbatch per-layer dropout keys, disjoint from the embed key."""
+        if rng is None:
+            return None
+        return jax.random.fold_in(jax.random.fold_in(rng, 1), mb_index)
+
+    def embed_rng(mb_index):
+        if rng is None:
+            return None
+        return jax.random.fold_in(jax.random.fold_in(rng, 2), mb_index)
+
+    def blocks_fwd(params_loc, x, mb_index):
+        mb_key = block_rng(mb_index)
+
+        def body(carry, xs):
+            layer_params, local_idx = xs
+            layer_rng = (
+                None
+                if mb_key is None
+                else jax.random.fold_in(mb_key, stage * num_local_layers + local_idx)
+            )
+            return block(layer_params, carry, layer_rng), None
+
+        out, _ = jax.lax.scan(body, x, (params_loc, jnp.arange(num_local_layers)))
+        return out
+
+    # probe shapes/dtypes with an abstract forward so buffers can be allocated
+    x_shape = jax.eval_shape(embed, shared, tokens_mb[0], embed_rng(0))
+    compute_dtype = x_shape.dtype
+
+    def tick(carry, t):
+        abuf, xbuf, ybuf, gbuf, g_stacked, g_shared, losses, weights = carry
+        fm = f_tab[t, stage]
+        bm = b_tab[t, stage]
+        hm = h_tab[t]
+
+        # ---- F slot (uniform compute; masked writes) --------------------------
+        fm_c = jnp.clip(fm, 0, M - 1)
+        x0 = embed(shared, tokens_mb[fm_c], embed_rng(fm_c))
+        x_in = jnp.where(stage == 0, x0, abuf[fm_c % ring])
+        y = blocks_fwd(stacked_local, x_in, fm_c)
+        xbuf = _buf_set(xbuf, fm_c % ring, x_in, fm >= 0)
+
+        # broadcast the last stage's fresh output for the (uniform) head slot
+        last_fm = f_tab[t, P_ - 1]
+        last_fm_c = jnp.clip(last_fm, 0, M - 1)
+        y_bc = jax.lax.psum(
+            jnp.where(stage == P_ - 1, y, jnp.zeros_like(y)).astype(jnp.float32), axis_name
+        )
+        ybuf = _buf_set(ybuf, last_fm_c % ring, y_bc.astype(compute_dtype), last_fm >= 0)
+
+        # ---- H slot: head + loss fwd/bwd, redundantly on every stage ----------
+        hm_c = jnp.clip(hm, 0, M - 1)
+        loss_h, head_pull, w_h = jax.vjp(
+            lambda sh, xx: head_loss(sh, xx, targets_mb[hm_c]),
+            shared,
+            ybuf[hm_c % ring],
+            has_aux=True,
+        )
+        # seed with the microbatch's token weight: grads accumulate d(sum of token
+        # losses); dividing by the total weight at the end gives the global mean
+        g_shared_h, g_y_head = head_pull(w_h.astype(loss_h.dtype))
+        losses = _buf_set(losses, hm_c, loss_h, hm >= 0)
+        weights = _buf_set(weights, hm_c, w_h, hm >= 0)
+        # identical on all stages: keep one stage's copy, psum at the end
+        g_shared = _masked_add(g_shared, g_shared_h, (stage == P_ - 1) & (hm >= 0))
+        gbuf = _buf_set(gbuf, hm_c % ring, g_y_head.astype(jnp.float32), hm >= 0)
+
+        # ---- B slot: recompute stage forward under vjp (remat), pull cotangent
+        bm_c = jnp.clip(bm, 0, M - 1)
+        x_saved = xbuf[bm_c % ring]
+        _, pull = jax.vjp(lambda p, xx: blocks_fwd(p, xx, bm_c), stacked_local, x_saved)
+        g_p, g_x = pull(gbuf[bm_c % ring].astype(compute_dtype))
+        g_stacked = _masked_add(g_stacked, g_p, bm >= 0)
+
+        # embedding backward: only stage 0's input is the embedding output
+        _, pull_e = jax.vjp(lambda sh: embed(sh, tokens_mb[bm_c], embed_rng(bm_c)), shared)
+        (g_shared_e,) = pull_e(g_x)
+        g_shared = _masked_add(g_shared, g_shared_e, (stage == 0) & (bm >= 0))
+
+        # ---- tick-end hops ----------------------------------------------------
+        act = jax.lax.ppermute(y, axis_name, fwd_perm)
+        recv_fm = jnp.where(stage > 0, f_tab[t, jnp.clip(stage - 1, 0, P_ - 1)], -1)
+        recv_fm_c = jnp.clip(recv_fm, 0, M - 1)
+        abuf = _buf_set(abuf, recv_fm_c % ring, act, recv_fm >= 0)
+
+        cot = jax.lax.ppermute(g_x.astype(jnp.float32), axis_name, bwd_perm)
+        recv_bm = jnp.where(stage < P_ - 1, b_tab[t, jnp.clip(stage + 1, 0, P_ - 1)], -1)
+        recv_bm_c = jnp.clip(recv_bm, 0, M - 1)
+        gbuf = _buf_set(gbuf, recv_bm_c % ring, cot, recv_bm >= 0)
+
+        return (abuf, xbuf, ybuf, gbuf, g_stacked, g_shared, losses, weights), None
+
+    buf = lambda: jnp.zeros((ring,) + x_shape.shape, compute_dtype)  # noqa: E731
+    init = (
+        buf(),  # abuf: activations received from the previous stage
+        buf(),  # xbuf: my stage inputs, kept for the remat backward
+        buf(),  # ybuf: broadcast last-stage outputs awaiting their head slot
+        jnp.zeros((ring,) + x_shape.shape, jnp.float32),  # gbuf: cotangents
+        jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), stacked_local),
+        jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), shared),
+        jnp.zeros((M,), jnp.float32),
+        jnp.zeros((M,), jnp.float32),  # per-microbatch valid-token weights
+    )
+    final_carry, _ = jax.lax.scan(tick, init, jnp.arange(tables.num_ticks))
+    _, _, _, _, g_stacked, g_shared, losses, weights = final_carry
+
+    # token-weighted mean == the unpipelined global mean, also under ignore_index
+    # masking with unequal per-microbatch token counts (cotangents were seeded with
+    # each microbatch's weight, so grads currently hold d(sum of token losses))
+    total_weight = jnp.maximum(weights.sum(), 1.0)
+    loss = (losses * weights).sum() / total_weight
+    g_stacked = jax.tree.map(
+        lambda g, p: (g / total_weight).astype(p.dtype), g_stacked, stacked_local
+    )
+    g_shared = jax.tree.map(lambda g: g / total_weight, g_shared)
+    # shared params are pp-replicated: stage-masked contributions sum across stages
+    g_shared = jax.tree.map(lambda g: jax.lax.psum(g, axis_name), g_shared)
+    g_shared = jax.tree.map(lambda g, p: g.astype(p.dtype), g_shared, shared)
+    return loss, g_stacked, g_shared

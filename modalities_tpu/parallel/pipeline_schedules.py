@@ -1,0 +1,200 @@
+"""Pipeline schedules as static tick tables (reference:
+src/modalities/models/parallelism/pipeline_parallelism.py:13-20 — torch pipelining's
+GPipe/1F1B schedule classes, re-imagined for SPMD).
+
+A schedule here is three integer tables indexed [tick, stage] (microbatch id or -1):
+
+- ``f``: which microbatch this stage runs a block-FORWARD for at this tick
+- ``b``: which microbatch this stage runs a block-BACKWARD for at this tick
+- ``h``: which microbatch the (redundantly computed, pp-uniform) head+loss fwd/bwd
+  runs for at this tick — the same value for every stage, because the last stage's
+  output is psum-broadcast and every stage computes the head identically (uniform
+  SPMD compute costs no extra wall-clock: the alternative is an idle bubble).
+
+Because every TPU executes the same program each tick (SPMD), a schedule's quality
+shows up as (a) total tick count (bubble) and (b) the maximum number of in-flight
+microbatches per stage (residual ring-buffer size — the 1F1B memory advantage).
+
+Tables are built by a tiny dependency-respecting simulator, so any schedule is just
+a different op-picking policy; correctness (dependencies, buffer bounds) is asserted
+structurally and unit-tested rather than trusted.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+
+@dataclass(frozen=True)
+class ScheduleTables:
+    """Static schedule: arrays [T, P] (f/b) and [T] (h); -1 = no-op."""
+
+    f: np.ndarray
+    b: np.ndarray
+    h: np.ndarray
+    num_stages: int
+    num_microbatches: int
+
+    @property
+    def num_ticks(self) -> int:
+        return self.f.shape[0]
+
+    @property
+    def max_inflight(self) -> int:
+        """Max microbatches any stage holds between its F and its B (ring size)."""
+        worst = 0
+        for s in range(self.num_stages):
+            inflight = 0
+            best = 0
+            for t in range(self.num_ticks):
+                if self.f[t, s] >= 0:
+                    inflight += 1
+                best = max(best, inflight)
+                if self.b[t, s] >= 0:
+                    inflight -= 1
+            worst = max(worst, best)
+        return worst
+
+    @property
+    def bubble_fraction(self) -> float:
+        """Fraction of stage-tick compute slots that are idle (garbage compute in
+        SPMD): one F-or-B slot per stage per tick; H slots are uniform useful work."""
+        total_slots = self.num_ticks * self.num_stages
+        useful = int((self.f >= 0).sum() + (self.b >= 0).sum())
+        return 1.0 - useful / total_slots
+
+
+SUPPORTED_SCHEDULES = ("gpipe", "1f1b")
+
+
+def build_schedule_tables(schedule: str, num_stages: int, num_microbatches: int) -> ScheduleTables:
+    """Simulate the schedule tick by tick, honoring the SPMD dependency rules:
+
+    - F(s, m) needs F(s-1, m) at a strictly earlier tick (activation hop at tick end)
+    - H(m) needs F(P-1, m) at the SAME tick or earlier (the executor runs the F
+      slots, then the output broadcast, then the H slot within one tick body)
+    - B(P-1, m) needs H(m) at a strictly earlier tick (loss cotangent)
+    - B(s, m) needs B(s+1, m) at a strictly earlier tick (cotangent hop) and F(s, m)
+    - ONE compute slot per stage per tick: F or B, never both (they are sequential on
+      hardware — allowing both would model a 2x-throughput tick and break bubble and
+      in-flight accounting); one H per tick, uniform across stages (piggybacked)
+
+    Policy per stage: "gpipe" = all forwards first (classic fill/drain);
+    "1f1b" = prefer backward whenever one is ready (PipeDream-flush pattern, bounds
+    in-flight microbatches at ~P instead of M).
+    """
+    if schedule not in SUPPORTED_SCHEDULES:
+        raise NotImplementedError(
+            f"pipeline schedule {schedule!r} not supported (have {SUPPORTED_SCHEDULES})"
+        )
+    P, M = num_stages, num_microbatches
+    f_done = -np.ones((P, M), dtype=np.int64)  # tick when F(s, m) ran
+    b_done = -np.ones((P, M), dtype=np.int64)
+    h_done = -np.ones((M,), dtype=np.int64)
+
+    f_rows, b_rows, h_rows = [], [], []
+    t = 0
+    max_ticks = 8 * (M + P) + 16  # safety valve: any sane schedule fits
+    while (b_done < 0).any() or (h_done < 0).any():
+        if t >= max_ticks:
+            raise RuntimeError(f"schedule {schedule} did not converge (P={P}, M={M})")
+        f_row = -np.ones(P, dtype=np.int64)
+        b_row = -np.ones(P, dtype=np.int64)
+
+        for s in range(P):
+            # candidate ops for this stage at this tick
+            fm = next(
+                (
+                    m
+                    for m in range(M)
+                    if f_done[s, m] < 0 and (s == 0 or (0 <= f_done[s - 1, m] < t))
+                ),
+                -1,
+            )
+            if schedule == "1f1b" and fm >= 0:
+                # 1F1B warmup cap: a stage never holds more than P - s microbatches
+                # in flight (the PipeDream-flush memory bound)
+                inflight = int((f_done[s] >= 0).sum() - (b_done[s] >= 0).sum())
+                if inflight >= max(1, P - s):
+                    fm = -1
+            bm = next(
+                (
+                    m
+                    for m in range(M)
+                    if b_done[s, m] < 0
+                    and 0 <= f_done[s, m] < t
+                    and (
+                        (s == P - 1 and 0 <= h_done[m] < t)
+                        or (s < P - 1 and 0 <= b_done[s + 1, m] < t)
+                    )
+                ),
+                -1,
+            )
+            if schedule == "gpipe":
+                # forwards strictly first; backwards once no forward remains
+                if fm >= 0:
+                    f_row[s] = fm
+                elif bm >= 0:
+                    b_row[s] = bm
+            else:  # 1f1b: drain a backward whenever one is ready, else forward
+                if bm >= 0:
+                    b_row[s] = bm
+                elif fm >= 0:
+                    f_row[s] = fm
+
+        for s in range(P):
+            if f_row[s] >= 0:
+                f_done[s, f_row[s]] = t
+            if b_row[s] >= 0:
+                b_done[s, b_row[s]] = t
+        # head slot: earliest microbatch whose last-stage forward is done, including
+        # one that completed in THIS tick (executor order: F slots, broadcast, H slot)
+        hm = next(
+            (m for m in range(M) if h_done[m] < 0 and 0 <= f_done[P - 1, m] <= t), -1
+        )
+        if hm >= 0:
+            h_done[hm] = t
+        f_rows.append(f_row)
+        b_rows.append(b_row)
+        h_rows.append(hm)
+        t += 1
+
+    tables = ScheduleTables(
+        f=np.stack(f_rows),
+        b=np.stack(b_rows),
+        h=np.asarray(h_rows, dtype=np.int64),
+        num_stages=P,
+        num_microbatches=M,
+    )
+    _validate(tables)
+    return tables
+
+
+def _validate(tb: ScheduleTables) -> None:
+    """Structural correctness: every op exactly once, dependencies strictly ordered."""
+    P, M = tb.num_stages, tb.num_microbatches
+    f_at = -np.ones((P, M), dtype=np.int64)
+    b_at = -np.ones((P, M), dtype=np.int64)
+    h_at = -np.ones((M,), dtype=np.int64)
+    for t in range(tb.num_ticks):
+        for s in range(P):
+            if tb.f[t, s] >= 0:
+                assert f_at[s, tb.f[t, s]] < 0, "duplicate forward"
+                f_at[s, tb.f[t, s]] = t
+            if tb.b[t, s] >= 0:
+                assert b_at[s, tb.b[t, s]] < 0, "duplicate backward"
+                b_at[s, tb.b[t, s]] = t
+        if tb.h[t] >= 0:
+            assert h_at[tb.h[t]] < 0, "duplicate head op"
+            h_at[tb.h[t]] = t
+    assert (f_at >= 0).all() and (b_at >= 0).all() and (h_at >= 0).all(), "missing ops"
+    for m in range(M):
+        for s in range(1, P):
+            assert f_at[s - 1, m] < f_at[s, m], "forward dependency violated"
+        assert f_at[P - 1, m] <= h_at[m], "head before last forward"
+        assert h_at[m] < b_at[P - 1, m], "last-stage backward before head"
+        for s in range(P - 1):
+            assert b_at[s + 1, m] < b_at[s, m], "backward dependency violated"
+            assert f_at[s, m] < b_at[s, m], "backward before forward"

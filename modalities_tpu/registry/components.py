@@ -170,6 +170,7 @@ COMPONENTS: list[ComponentEntity] = [
     ComponentEntity(
         "model", "debugging_enriched", ModelFactory.get_debugging_enriched_model, cfg.DebuggingEnrichedModelConfig
     ),
+    ComponentEntity("model", "pipelined", ModelFactory.get_pipelined_model, cfg.PipelinedModelConfig),
     # device mesh
     ComponentEntity("device_mesh", "default", get_device_mesh, cfg.DeviceMeshConfig),
     # model initialization

@@ -226,6 +226,51 @@ class TrainStepBuilder:
             )
             return loss_fn(predictions, targets)
 
+        # scheduled pipelining (1F1B): hand-rolled fwd/bwd with in-region loss replaces
+        # value_and_grad through the in-module autodiff GPipe (the "gpipe" default)
+        model_spec = getattr(model, "config_spec", None)
+        pp_scheduled = (
+            mesh_handle is not None
+            and mesh_handle.degrees.get("pp", 1) > 1
+            and model_spec is not None
+            and getattr(model_spec, "pp_schedule", "gpipe") != "gpipe"
+            and hasattr(model, "pp_stage_fns")
+        )
+        if pp_scheduled:
+            if mesh_handle.degrees.get("cp", 1) > 1:
+                raise NotImplementedError(
+                    "scheduled pipeline (pp_schedule != 'gpipe') does not compose with "
+                    "context parallelism yet; use the default gpipe schedule with cp"
+                )
+            from modalities_tpu.parallel.pipeline_scheduled import (
+                scheduled_pipeline_loss_and_grads,
+            )
+
+            pp_stage_fns = model.pp_stage_fns(loss_fn)
+            target_key = loss_fn.target_key
+            pp_mesh = mesh_handle.mesh
+            model_dropout = getattr(model_spec, "dropout", 0.0)
+
+            def loss_and_grads(params, samples, targets, dropout_rng):
+                stacked, shared = model.split_pp_params(params)
+                loss, g_stacked, g_shared = scheduled_pipeline_loss_and_grads(
+                    pp_stage_fns,
+                    stacked,
+                    shared,
+                    samples[sample_key],
+                    targets[target_key],
+                    pp_mesh,
+                    schedule=model_spec.pp_schedule,
+                    num_microbatches=model_spec.pp_num_microbatches,
+                    rng=dropout_rng if model_dropout > 0.0 else None,
+                )
+                return loss, model.merge_pp_grads(g_stacked, g_shared)
+
+        else:
+
+            def loss_and_grads(params, samples, targets, dropout_rng):
+                return jax.value_and_grad(compute_loss)(params, samples, targets, dropout_rng)
+
         def train_step(state: AppState, batch: dict) -> tuple[AppState, dict]:
             """batch: {"samples": {k: [acc, mb, ...]}, "targets": {k: [acc, mb, ...]}}"""
             samples, targets = batch["samples"], batch["targets"]
@@ -235,7 +280,7 @@ class TrainStepBuilder:
             def micro(acc, xs):
                 mb_index, s, t = xs
                 dropout_rng = jax.random.fold_in(step_rng, mb_index)
-                loss, grads = jax.value_and_grad(compute_loss)(state.params, s, t, dropout_rng)
+                loss, grads = loss_and_grads(state.params, s, t, dropout_rng)
                 g_acc, l_acc = acc
                 # accumulate in reduce_dtype (fp32 by default) even when grads are bf16
                 g_acc = jax.tree.map(lambda a, g: a + g.astype(reduce_dtype), g_acc, grads)

@@ -10,7 +10,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from modalities_tpu.parallel.pipeline import pipeline_blocks
 
 
-def _block_apply(layer_params, x):
+def _block_apply(layer_params, x, rng=None):
     """Simple nonlinear 'transformer block' stand-in: x + tanh(x @ W + b)."""
     w, b = layer_params["w"], layer_params["b"]
     return x + jnp.tanh(x @ w + b)

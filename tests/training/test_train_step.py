@@ -217,3 +217,120 @@ def test_dp_vs_pp_cp_combined_equivalence():
             ls.append(float(metrics["loss"]))
         losses[name] = ls
     np.testing.assert_allclose(losses["dp"], losses["mix"], rtol=5e-4, atol=5e-4)
+
+
+def test_dp_pp_1f1b_equivalence():
+    """dp8 vs pp2 x dp4 under the scheduled 1F1B executor: identical losses to pure
+    DP — the oracle for the hand-rolled fwd/bwd (reference 1F1B schedule,
+    pipeline_parallelism.py:294-337)."""
+    mesh_dp = get_device_mesh(device_type="cpu", data_parallel_shard_degree=8, world_size=8)
+    mesh_pp = get_device_mesh(
+        device_type="cpu", data_parallel_shard_degree=4, pipeline_parallel_degree=2, world_size=8
+    )
+    rng = np.random.default_rng(6)
+    raw = _batch(rng, 1, 8, 16)
+
+    losses = {}
+    for name, mesh in [("dp", mesh_dp), ("pp_1f1b", mesh_pp)]:
+        model_run = tiny_gpt2("pytorch_flash")
+        if name == "pp_1f1b":
+            model_run.with_spec_updates(pp_schedule="1f1b", pp_num_microbatches=4)
+        fns = _builder(model_run, mesh, clip=1.0).build(seed=0)
+        state = fns.app_state_handle.state
+        ls = []
+        for _ in range(3):
+            state, metrics = fns.train_step(state, fns.put_batch(raw))
+            ls.append(float(metrics["loss"]))
+        losses[name] = ls
+    np.testing.assert_allclose(losses["dp"], losses["pp_1f1b"], rtol=3e-4, atol=3e-4)
+
+
+def test_pp_1f1b_dropout_deterministic():
+    """dropout > 0 under scheduled PP: same seed reproduces identical losses,
+    different seed diverges, and the model trains (VERDICT r1 #5)."""
+    mesh_pp = get_device_mesh(
+        device_type="cpu", data_parallel_shard_degree=4, pipeline_parallel_degree=2, world_size=8
+    )
+    rng = np.random.default_rng(9)
+    raw = _batch(rng, 1, 8, 16)
+
+    def run(seed):
+        model_run = tiny_gpt2("pytorch_flash", dropout=0.3)
+        model_run.with_spec_updates(pp_schedule="1f1b", pp_num_microbatches=4)
+        fns = _builder(model_run, mesh_pp, clip=1.0).build(seed=seed)
+        state = fns.app_state_handle.state
+        ls = []
+        for _ in range(5):
+            state, metrics = fns.train_step(state, fns.put_batch(raw))
+            ls.append(float(metrics["loss"]))
+        return ls
+
+    a, b, c = run(0), run(0), run(1)
+    assert a == b, "same seed must be bit-deterministic under scheduled PP"
+    assert a != c, "dropout must depend on the seed under scheduled PP"
+    assert a[-1] < a[0], f"did not train with dropout under 1F1B: {a}"
+
+
+def test_pp_gpipe_dropout_deterministic():
+    """dropout > 0 under the default (autodiff GPipe) PP path: same-seed determinism
+    and training progress — reference default GPT2 configs run unmodified."""
+    mesh_pp = get_device_mesh(
+        device_type="cpu", data_parallel_shard_degree=4, pipeline_parallel_degree=2, world_size=8
+    )
+    rng = np.random.default_rng(11)
+    raw = _batch(rng, 1, 8, 16)
+
+    def run(seed):
+        model_run = tiny_gpt2("pytorch_flash", dropout=0.3)
+        fns = _builder(model_run, mesh_pp, clip=1.0).build(seed=seed)
+        state = fns.app_state_handle.state
+        ls = []
+        for _ in range(5):
+            state, metrics = fns.train_step(state, fns.put_batch(raw))
+            ls.append(float(metrics["loss"]))
+        return ls
+
+    a, b, c = run(0), run(0), run(1)
+    assert a == b
+    assert a != c
+    assert a[-1] < a[0], f"did not train with dropout under GPipe PP: {a}"
+
+
+def test_pipelined_model_variant_selects_schedule():
+    from modalities_tpu.models.model_factory import ModelFactory
+
+    m = tiny_gpt2("pytorch_flash")
+    ModelFactory.get_pipelined_model(m, "1F1B", batch_size=8, microbatch_size=2)
+    assert m.config_spec.pp_schedule == "1f1b"
+    assert m.config_spec.pp_num_microbatches == 4
+    with pytest.raises(NotImplementedError, match="dualpipe_v"):
+        ModelFactory.get_pipelined_model(m, "dualpipe_v")
+
+
+def test_dp_pp_1f1b_equivalence_with_ignore_index():
+    """Unequal valid-token counts across pp microbatches (ignore_index=-100) must not
+    skew the 1F1B loss: contributions are token-weighted, matching the global mean."""
+    mesh_dp = get_device_mesh(device_type="cpu", data_parallel_shard_degree=8, world_size=8)
+    mesh_pp = get_device_mesh(
+        device_type="cpu", data_parallel_shard_degree=4, pipeline_parallel_degree=2, world_size=8
+    )
+    rng = np.random.default_rng(13)
+    raw = _batch(rng, 1, 8, 16)
+    # heavily mask the first half of the batch -> pp microbatches see very different counts
+    t = raw["targets"]["target_ids"]
+    t[:, :4, 2:] = -100
+    raw["targets"]["target_ids"] = t
+
+    losses = {}
+    for name, mesh in [("dp", mesh_dp), ("pp_1f1b", mesh_pp)]:
+        model_run = tiny_gpt2("pytorch_flash")
+        if name == "pp_1f1b":
+            model_run.with_spec_updates(pp_schedule="1f1b", pp_num_microbatches=4)
+        fns = _builder(model_run, mesh, clip=1.0).build(seed=0)
+        state = fns.app_state_handle.state
+        ls = []
+        for _ in range(2):
+            state, metrics = fns.train_step(state, fns.put_batch(raw))
+            ls.append(float(metrics["loss"]))
+        losses[name] = ls
+    np.testing.assert_allclose(losses["dp"], losses["pp_1f1b"], rtol=3e-4, atol=3e-4)
