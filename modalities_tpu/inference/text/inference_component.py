@@ -1,8 +1,9 @@
 """Interactive text generation (reference: src/modalities/inference/text/inference_component.py:11).
 
-The sampling loop jits one next-token step over the growing context (bucketed to
-power-of-two lengths so XLA reuses compilations instead of recompiling per token —
-the reference re-runs the full eager forward per token)."""
+Models exposing `decode_step` (GPT2) generate via a jitted KV-cache loop: the prompt
+prefills the cache token-group-wise, then each new token is one O(1) cached step with
+a single compiled shape — where the reference re-runs the full eager forward per
+token (:60-72). Models without a cache fall back to the bucketed full re-forward."""
 
 from __future__ import annotations
 
@@ -47,6 +48,29 @@ class TextInferenceComponent:
             self._jitted_forward = jax.jit(fwd)
         return self._jitted_forward(self.params, tokens)
 
+    _PREFILL_CHUNKS = (64, 16, 4, 1)  # power-of-two groups: bounded compile count
+
+    def _decode_step(self):
+        import jax
+
+        if getattr(self, "_jitted_decode", None) is None:
+            model = self.model
+            self._jitted_decode = jax.jit(
+                lambda params, cache, toks: model.decode_step(params, cache, toks),
+                donate_argnums=(1,),
+            )
+        return self._jitted_decode
+
+    def _sample(self, logits: np.ndarray, rng):
+        import jax
+
+        if self.temperature > 0:
+            probs = np.exp((logits / self.temperature) - np.max(logits / self.temperature))
+            probs = probs / probs.sum()
+            rng, sub = jax.random.split(rng)
+            return int(np.random.default_rng(int(sub[0])).choice(len(probs), p=probs)), rng
+        return int(np.argmax(logits)), rng
+
     def generate_tokens(self, context: str, max_new_tokens: Optional[int] = None) -> str:
         import jax
 
@@ -57,8 +81,50 @@ class TextInferenceComponent:
             eod_id = -1
         budget = max_new_tokens if max_new_tokens is not None else self.sequence_length - len(token_ids)
         rng = jax.random.PRNGKey(0)
-        generated = []
-        for step in range(max(0, budget)):
+        if hasattr(self.model, "decode_step") and hasattr(self.model, "init_decode_cache"):
+            generated = self._generate_cached(token_ids, eod_id, max(0, budget), rng)
+        else:
+            generated = self._generate_reforward(token_ids, eod_id, max(0, budget), rng)
+        return self.tokenizer.decode(generated)
+
+    def _generate_cached(self, token_ids: list[int], eod_id: int, budget: int, rng) -> list[int]:
+        """KV-cache path: chunked group prefill (a few compiled shapes), then O(1) per
+        generated token. When the cache fills mid-generation, the remainder continues
+        on the sliding-window re-forward path so both paths emit identical outputs."""
+        window = token_ids[-self.sequence_length :]
+        if budget <= 0 or not window:
+            return []
+        step = self._decode_step()
+        cache = self.model.init_decode_cache(self.params, batch_size=1)
+        pos = 0
+        while pos < len(window):
+            chunk = next(c for c in self._PREFILL_CHUNKS if c <= len(window) - pos)
+            toks = np.asarray([window[pos : pos + chunk]], dtype=np.int32)
+            logits, cache = step(self.params, cache, toks)
+            pos += chunk
+        generated: list[int] = []
+        consumed = len(window)
+        while len(generated) < budget:
+            next_id, rng = self._sample(np.asarray(logits)[0, -1], rng)
+            if next_id == eod_id:
+                return generated
+            generated.append(next_id)
+            consumed += 1
+            if consumed >= self.sequence_length:
+                # cache full: continue with the sliding-window fallback for parity
+                generated += self._generate_reforward(
+                    window + generated, eod_id, budget - len(generated), rng
+                )
+                return generated
+            logits, cache = step(self.params, cache, np.asarray([[next_id]], dtype=np.int32))
+        return generated
+
+    def _generate_reforward(self, token_ids: list[int], eod_id: int, budget: int, rng) -> list[int]:
+        """Fallback for models without a KV cache: bucketed full re-forward per token,
+        sliding the context window once it exceeds sequence_length."""
+        token_ids = list(token_ids)
+        generated: list[int] = []
+        for _ in range(budget):
             window = token_ids[-self.sequence_length :]
             # bucket the context length so jit caches a few shapes, not one per token
             bucket = 1 << (len(window) - 1).bit_length()
@@ -66,18 +132,12 @@ class TextInferenceComponent:
             padded = np.zeros((1, bucket), dtype=np.int32)
             padded[0, : len(window)] = window
             logits = np.asarray(self._forward(padded))[0, len(window) - 1]
-            if self.temperature > 0:
-                probs = np.exp((logits / self.temperature) - np.max(logits / self.temperature))
-                probs = probs / probs.sum()
-                rng, sub = jax.random.split(rng)
-                next_id = int(np.random.default_rng(int(sub[0])).choice(len(probs), p=probs))
-            else:
-                next_id = int(np.argmax(logits))
+            next_id, rng = self._sample(logits, rng)
             if next_id == eod_id:
                 break
             token_ids.append(next_id)
             generated.append(next_id)
-        return self.tokenizer.decode(generated)
+        return generated
 
     def run(self) -> None:
         """Interactive prompt loop (reference :32-99)."""

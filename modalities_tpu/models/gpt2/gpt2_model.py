@@ -284,10 +284,17 @@ def _dense_general(spec, features, name, kernel_axes, dtype):
 
 
 class CausalSelfAttention(nn.Module):
-    """GQA causal attention with separate q/k/v projections (reference :447-502)."""
+    """GQA causal attention with separate q/k/v projections (reference :447-502).
+
+    `decode=True` enables the autoregressive KV cache: k/v for incoming positions are
+    written into a ``cache`` variable collection at the running index and attention
+    runs the new queries against the full cached prefix (O(1) work per new token
+    instead of re-forwarding the whole context). Prefill works by calling with the
+    whole prompt at once (index advances by its length)."""
 
     spec: GPT2ModelSpec
     deterministic: bool = True
+    decode: bool = False
 
     @nn.compact
     def __call__(self, x):
@@ -300,6 +307,9 @@ class CausalSelfAttention(nn.Module):
         if spec.use_qk_norm and spec.qk_norm is not None:
             q = build_norm(spec.qk_norm, "q_norm", dtype=x.dtype)(q)
             k = build_norm(spec.qk_norm, "k_norm", dtype=x.dtype)(k)
+
+        if self.decode:
+            return self._decode_attention(x, q, k, v)
 
         if spec.use_rope:
             cos, sin = _rope_tables(head_dim, x.shape[1], spec.rope_base_freq, dtype=x.dtype)
@@ -324,6 +334,46 @@ class CausalSelfAttention(nn.Module):
         else:
             y = sdpa_attention(q, k, v)
 
+        return self._project_out(x, y)
+
+    def _decode_attention(self, x, q, k, v):
+        """KV-cached attention step: new positions [B, S_in] appended at the running
+        cache index; S_in > 1 = prefill, S_in == 1 = one decode step."""
+        spec = self.spec
+        head_dim = spec.head_dim
+        b, s_in = x.shape[0], x.shape[1]
+        max_len = spec.sequence_length
+
+        cached_k = self.variable(
+            "cache", "cached_key", jnp.zeros, (b, max_len, spec.n_head_kv, head_dim), k.dtype
+        )
+        cached_v = self.variable(
+            "cache", "cached_value", jnp.zeros, (b, max_len, spec.n_head_kv, head_dim), v.dtype
+        )
+        cache_index = self.variable("cache", "cache_index", lambda: jnp.zeros((), jnp.int32))
+        i = cache_index.value
+
+        if spec.use_rope:
+            cos, sin = _rope_tables(head_dim, max_len, spec.rope_base_freq, dtype=x.dtype)
+            cos_i = jax.lax.dynamic_slice_in_dim(cos, i, s_in)
+            sin_i = jax.lax.dynamic_slice_in_dim(sin, i, s_in)
+            q = apply_rope(q, cos_i, sin_i)
+            k = apply_rope(k, cos_i, sin_i)
+
+        k_all = jax.lax.dynamic_update_slice(cached_k.value, k, (0, i, 0, 0))
+        v_all = jax.lax.dynamic_update_slice(cached_v.value, v, (0, i, 0, 0))
+        if not self.is_initializing():
+            cached_k.value = k_all
+            cached_v.value = v_all
+            cache_index.value = i + s_in
+
+        # position t of this call attends to cache positions <= i + t
+        mask = jnp.arange(max_len)[None, :] <= (i + jnp.arange(s_in))[:, None]
+        y = masked_attention(q, k_all, v_all, mask)
+        return self._project_out(x, y)
+
+    def _project_out(self, x, y):
+        spec = self.spec
         y = nn.Dropout(rate=spec.dropout)(y, deterministic=self.deterministic or spec.dropout == 0.0)
         out = nn.DenseGeneral(
             features=spec.n_embd,
@@ -368,13 +418,14 @@ class GPT2Block(nn.Module):
 
     spec: GPT2ModelSpec
     deterministic: bool = True
+    decode: bool = False
 
     @nn.compact
     def __call__(self, x):
         spec = self.spec
         x = with_logical_constraint(x, ("batch", "seq", "embed"), spec)
         h = build_norm(spec.attn_norm, "attention_norm", dtype=x.dtype)(x)
-        x = x + CausalSelfAttention(spec, self.deterministic, name="attn")(h)
+        x = x + CausalSelfAttention(spec, self.deterministic, self.decode, name="attn")(h)
         h2 = build_norm(spec.ffn_norm, "ffn_norm", dtype=x.dtype)(x)
         x = x + MLP(spec, self.deterministic, name="mlp")(h2)
         return x
@@ -385,27 +436,32 @@ class _BlockScanBody(nn.Module):
 
     spec: GPT2ModelSpec
     deterministic: bool = True
+    decode: bool = False
 
     @nn.compact
     def __call__(self, carry, _):
         spec = self.spec
         block_cls = GPT2Block
-        if spec.remat_variant in ("full", "selective_layer", "selective_op"):
+        if spec.remat_variant in ("full", "selective_layer", "selective_op") and not self.decode:
             policy = None
             if spec.remat_variant == "selective_op":
                 from modalities_tpu.training.activation_checkpointing import save_list_policy
 
                 policy = save_list_policy(spec.remat_save_list)
             block_cls = nn.remat(GPT2Block, prevent_cse=False, policy=policy)
-        x = block_cls(spec, self.deterministic, name="block")(carry)
+        x = block_cls(spec, self.deterministic, self.decode, name="block")(carry)
         return x, None
 
 
 class GPT2Module(nn.Module):
-    """The linen module behind GPT2LLM: wte/wpe -> blocks -> lm_head_norm -> lm_head."""
+    """The linen module behind GPT2LLM: wte/wpe -> blocks -> lm_head_norm -> lm_head.
+
+    `decode=True`: autoregressive KV-cache mode — pass tokens for NEW positions only;
+    per-layer k/v caches and the running position live in the ``cache`` collection."""
 
     spec: GPT2ModelSpec
     deterministic: bool = True
+    decode: bool = False
 
     @nn.compact
     def __call__(self, input_ids):
@@ -426,18 +482,25 @@ class GPT2Module(nn.Module):
                 (spec.sequence_length, spec.n_embd),
                 param_dtype,
             )
-            x = x + wpe[None, : input_ids.shape[1], :].astype(compute_dtype)
+            if self.decode:
+                pos_var = self.variable("cache", "wpe_index", lambda: jnp.zeros((), jnp.int32))
+                pos = pos_var.value + jnp.arange(input_ids.shape[1])
+                if not self.is_initializing():
+                    pos_var.value = pos_var.value + input_ids.shape[1]
+                x = x + jnp.take(wpe, pos, axis=0)[None].astype(compute_dtype)
+            else:
+                x = x + wpe[None, : input_ids.shape[1], :].astype(compute_dtype)
         x = nn.Dropout(rate=spec.dropout)(x, deterministic=self.deterministic or spec.dropout == 0.0)
         x = with_logical_constraint(x, ("batch", "seq", "embed"))
 
         if spec.scan_layers:
             scanned = nn.scan(
                 _BlockScanBody,
-                variable_axes={"params": 0},
+                variable_axes={"params": 0, "cache": 0},
                 split_rngs={"params": True, "dropout": True},
                 length=spec.n_layer,
                 metadata_params={nn.meta.PARTITION_NAME: "layers"},
-            )(spec, self.deterministic, name="blocks")
+            )(spec, self.deterministic, self.decode, name="blocks")
             if spec.pipeline_axis is not None and not self.is_initializing():
                 # GPipe over the pp axis: same scan-stacked params (created by the init
                 # path below), applied stage-wise by parallel/pipeline.py
@@ -478,7 +541,7 @@ class GPT2Module(nn.Module):
                 x, _ = scanned(x, None)
         else:
             for i in range(spec.n_layer):
-                x = GPT2Block(spec, self.deterministic, name=f"h_{i}")(x)
+                x = GPT2Block(spec, self.deterministic, self.decode, name=f"h_{i}")(x)
 
         x = build_norm(spec.lm_head_norm, "lm_head_norm")(x)
         x = with_logical_constraint(x, ("batch", "seq", "embed"))
@@ -607,6 +670,26 @@ class GPT2LLM(NNModel):
         module = self.train_module() if train else self.module
         logits = module.apply(params, inputs[self.sample_key], rngs=rngs)
         return {self.prediction_key: logits}
+
+    # ----------------------------------------------------------- KV-cache decoding
+    def init_decode_cache(self, params, batch_size: int):
+        """Zeroed per-layer KV caches + position counters for `decode_step`. Shapes
+        come from an abstract init (eval_shape) — no parameter materialization."""
+        module = GPT2Module(self.config_spec, deterministic=True, decode=True)
+        dummy = jnp.zeros((batch_size, 1), dtype=jnp.int32)
+        abstract = jax.eval_shape(lambda: module.init(jax.random.PRNGKey(0), dummy))
+        return jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), abstract["cache"])
+
+    def decode_step(self, params, cache, tokens):
+        """One cached autoregressive step (tokens = NEW positions only, [B, S_in];
+        S_in > 1 prefills the prompt). Returns (logits [B, S_in, V], updated cache).
+        O(1) work per generated token vs. the reference's full re-forward
+        (inference/text/inference_component.py:60-72)."""
+        module = GPT2Module(self.config_spec, deterministic=True, decode=True)
+        logits, mutated = module.apply(
+            {**params, "cache": cache}, tokens, mutable=["cache"]
+        )
+        return logits, mutated["cache"]
 
     # ------------------------------------------------------- scheduled pipelining
     def split_pp_params(self, params):

@@ -105,7 +105,15 @@ class Trainer:
                 micro_stack_samples, micro_stack_targets = [], []
 
                 device_batch = put_batch(stacked)
-                state, metrics = train_step(state, device_batch)
+                # the debug step variant (grads in metrics) runs ONLY on logging ticks
+                # so the extra grad tree isn't materialized on every step
+                debug_tick = (
+                    self.debug_stats_logger is not None
+                    and step_functions.train_step_debug is not None
+                    and (step_id + 1) % self.debug_stats_logger.log_interval_steps == 0
+                )
+                step_fn = step_functions.train_step_debug if debug_tick else train_step
+                state, metrics = step_fn(state, device_batch)
                 debug_grads = metrics.pop("grads", None)  # exposed only when debugging
                 pending_metrics.append(metrics)
                 step_id += 1

@@ -73,6 +73,9 @@ class StepFunctions:
     put_batch: Callable[[dict], dict]
     app_state_handle: AppStateHandle
     mesh_handle: DeviceMeshHandle
+    # debugging_enriched: same step but with grads in metrics — used by the Trainer
+    # ONLY on logging ticks so the grad tree isn't materialized on every step
+    train_step_debug: Optional[Callable[[AppState, Any], tuple[AppState, dict]]] = None
 
 
 class TrainStepBuilder:
@@ -271,45 +274,50 @@ class TrainStepBuilder:
             def loss_and_grads(params, samples, targets, dropout_rng):
                 return jax.value_and_grad(compute_loss)(params, samples, targets, dropout_rng)
 
-        def train_step(state: AppState, batch: dict) -> tuple[AppState, dict]:
-            """batch: {"samples": {k: [acc, mb, ...]}, "targets": {k: [acc, mb, ...]}}"""
-            samples, targets = batch["samples"], batch["targets"]
-            # fresh dropout mask per step AND per microbatch, rooted at the build seed
-            step_rng = jax.random.fold_in(jax.random.PRNGKey(seed), state.step)
+        def make_train_step(with_grads: bool):
+            def train_step(state: AppState, batch: dict) -> tuple[AppState, dict]:
+                """batch: {"samples": {k: [acc, mb, ...]}, "targets": {k: [acc, mb, ...]}}"""
+                samples, targets = batch["samples"], batch["targets"]
+                # fresh dropout mask per step AND per microbatch, rooted at the build seed
+                step_rng = jax.random.fold_in(jax.random.PRNGKey(seed), state.step)
 
-            def micro(acc, xs):
-                mb_index, s, t = xs
-                dropout_rng = jax.random.fold_in(step_rng, mb_index)
-                loss, grads = loss_and_grads(state.params, s, t, dropout_rng)
-                g_acc, l_acc = acc
-                # accumulate in reduce_dtype (fp32 by default) even when grads are bf16
-                g_acc = jax.tree.map(lambda a, g: a + g.astype(reduce_dtype), g_acc, grads)
-                return (g_acc, l_acc + loss), None
+                def micro(acc, xs):
+                    mb_index, s, t = xs
+                    dropout_rng = jax.random.fold_in(step_rng, mb_index)
+                    loss, grads = loss_and_grads(state.params, s, t, dropout_rng)
+                    g_acc, l_acc = acc
+                    # accumulate in reduce_dtype (fp32 by default) even when grads are bf16
+                    g_acc = jax.tree.map(lambda a, g: a + g.astype(reduce_dtype), g_acc, grads)
+                    return (g_acc, l_acc + loss), None
 
-            zero_grads = jax.tree.map(lambda p: jnp.zeros(p.shape, reduce_dtype), state.params)
-            (grads, loss_sum), _ = jax.lax.scan(
-                micro, (zero_grads, 0.0), (jnp.arange(acc_steps), samples, targets)
-            )
-            grads = jax.tree.map(lambda g, p: (g / acc_steps).astype(p.dtype), grads, state.params)
-            loss = loss_sum / acc_steps
+                zero_grads = jax.tree.map(lambda p: jnp.zeros(p.shape, reduce_dtype), state.params)
+                (grads, loss_sum), _ = jax.lax.scan(
+                    micro, (zero_grads, 0.0), (jnp.arange(acc_steps), samples, targets)
+                )
+                grads = jax.tree.map(lambda g, p: (g / acc_steps).astype(p.dtype), grads, state.params)
+                loss = loss_sum / acc_steps
 
-            grad_norm = global_norm_by_mode(grads, norm_mode)
-            updates, new_opt_state = tx.update(grads, state.opt_state, state.params)
-            new_params = optax.apply_updates(state.params, updates)
-            new_state = AppState(params=new_params, opt_state=new_opt_state, step=state.step + 1)
-            metrics = {
-                "loss": loss,
-                "grad_norm": grad_norm,
-                "lr": jnp.asarray(lr_fn(state.step), jnp.float32),
-            }
-            if error_if_nonfinite:
-                # consumed by Trainer at the next host sync (async equivalent of
-                # torch clip_grad_norm_(error_if_nonfinite=True) raising inline)
-                metrics["nonfinite_grads"] = (~jnp.isfinite(grad_norm)).astype(jnp.int32)
-            if expose_grads:
-                # debugging_enriched path: Trainer feeds these to DebugStatsLogger
-                metrics["grads"] = grads
-            return new_state, metrics
+                grad_norm = global_norm_by_mode(grads, norm_mode)
+                updates, new_opt_state = tx.update(grads, state.opt_state, state.params)
+                new_params = optax.apply_updates(state.params, updates)
+                new_state = AppState(params=new_params, opt_state=new_opt_state, step=state.step + 1)
+                metrics = {
+                    "loss": loss,
+                    "grad_norm": grad_norm,
+                    "lr": jnp.asarray(lr_fn(state.step), jnp.float32),
+                }
+                if error_if_nonfinite:
+                    # consumed by Trainer at the next host sync (async equivalent of
+                    # torch clip_grad_norm_(error_if_nonfinite=True) raising inline)
+                    metrics["nonfinite_grads"] = (~jnp.isfinite(grad_norm)).astype(jnp.int32)
+                if with_grads:
+                    # debugging_enriched path: Trainer feeds these to DebugStatsLogger
+                    metrics["grads"] = grads
+                return new_state, metrics
+
+            return train_step
+
+        train_step = make_train_step(False)
 
         def eval_step(state: AppState, batch: dict) -> dict:
             predictions = model.apply(state.params, batch["samples"], train=False)
@@ -327,8 +335,6 @@ class TrainStepBuilder:
             }
             if error_if_nonfinite:
                 metrics_shardings["nonfinite_grads"] = replicated_sharding
-            if expose_grads:
-                metrics_shardings["grads"] = param_shardings  # keep grads sharded
             train_step_j = jax.jit(
                 train_step,
                 donate_argnums=(0,),
@@ -349,9 +355,26 @@ class TrainStepBuilder:
                 with mesh, activation_rules(rules, mesh):
                     return eval_step_j(state, batch)
 
+            train_step_debug_c = None
+            if expose_grads:
+                debug_metrics_shardings = dict(metrics_shardings, grads=param_shardings)
+                train_step_debug_j = jax.jit(
+                    make_train_step(True),
+                    donate_argnums=(0,),
+                    in_shardings=(state_shardings, None),
+                    out_shardings=(state_shardings, debug_metrics_shardings),
+                )
+
+                def train_step_debug_c(state, batch):
+                    with mesh, activation_rules(rules, mesh):
+                        return train_step_debug_j(state, batch)
+
         else:
             train_step_c = jax.jit(train_step, donate_argnums=(0,))
             eval_step_c = jax.jit(eval_step)
+            train_step_debug_c = (
+                jax.jit(make_train_step(True), donate_argnums=(0,)) if expose_grads else None
+            )
 
         put_batch = self._make_put_batch(data_sharding)
 
@@ -362,6 +385,7 @@ class TrainStepBuilder:
             put_batch=put_batch,
             app_state_handle=handle,
             mesh_handle=mesh_handle,
+            train_step_debug=train_step_debug_c,
         )
 
     # ------------------------------------------------------------------ data

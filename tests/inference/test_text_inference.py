@@ -54,3 +54,48 @@ def test_generation_respects_sequence_budget():
     long_prompt = "x" * 15
     out = component.generate_tokens(long_prompt)  # only 1 token of budget
     assert len(out) <= 1
+
+
+def test_kv_cache_decode_matches_full_forward():
+    """decode_step (prefill + one-token steps) must reproduce the full forward's
+    logits — the KV-cache correctness oracle."""
+    import numpy as np
+
+    model = tiny_gpt2("manual")
+    params = model.init_params(jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+    toks = rng.integers(0, 128, size=(2, 12)).astype(np.int32)
+    full = np.asarray(model.apply(params, {"input_ids": toks})["logits"])
+
+    cache = model.init_decode_cache(params, batch_size=2)
+    logits, cache = model.decode_step(params, cache, toks[:, :8])  # prompt prefill
+    outs = [np.asarray(logits)]
+    for t in range(8, 12):
+        logits, cache = model.decode_step(params, cache, toks[:, t : t + 1])
+        outs.append(np.asarray(logits))
+    incremental = np.concatenate(outs, axis=1)
+    np.testing.assert_allclose(incremental, full, rtol=1e-5, atol=1e-5)
+
+
+def test_kv_cache_greedy_matches_reforward_path():
+    """The cached generation loop must emit the same greedy tokens as the full
+    re-forward fallback (VERDICT r1 #8 acceptance: identical output, O(1) steps)."""
+    from flax.core import meta
+
+    model = tiny_gpt2("manual")
+    params = meta.unbox(model.init_params(jax.random.PRNGKey(0)))
+    kwargs = dict(
+        params=params, tokenizer=_Tok(), prompt_template="{prompt}",
+        sequence_length=32, temperature=0, eod_token="<eod>",
+    )
+    cached = TextInferenceComponent(model=model, **kwargs)
+    assert hasattr(model, "decode_step")
+    out_cached = cached.generate_tokens("hello world", max_new_tokens=10)
+
+    reforward = TextInferenceComponent(model=model, **kwargs)
+    ids = reforward._generate_reforward(
+        [ord(c) % 120 for c in "hello world"], 127, 10, jax.random.PRNGKey(0)
+    )
+    out_reforward = reforward.tokenizer.decode(ids)
+    assert out_cached == out_reforward
+    assert len(out_cached) > 0
