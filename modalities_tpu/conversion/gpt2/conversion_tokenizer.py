@@ -1,0 +1,81 @@
+"""Tokenizer conversion for HF export
+(reference: src/modalities/conversion/gpt2/conversion_tokenizer.py).
+
+Two source kinds:
+- a SentencePiece ``.model`` file -> wrapped as a HF ``LlamaTokenizer`` with special
+  -token handling delegated to the inner SP model (the reference's approach: legacy
+  mode, no auto bos/eos). Requires the optional ``sentencepiece`` package.
+- any HF tokenizer directory / hub name -> loaded with AutoTokenizer and re-saved
+  alongside the exported model (the common case for models trained with the HF
+  tokenizer wrapper).
+
+Returns the (bos, eos, pad, unk) ids so the caller can stamp them into the exported
+model/generation configs.
+"""
+
+from __future__ import annotations
+
+import shutil
+import tempfile
+from pathlib import Path
+from typing import Optional
+
+from modalities_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+TokenIds = tuple[Optional[int], Optional[int], Optional[int], Optional[int]]
+
+
+def convert_tokenizer(tokenizer_path: str | Path, output_dir: str | Path) -> TokenIds:
+    """Convert/copy the training tokenizer into `output_dir`; returns (bos, eos, pad, unk)."""
+    path = Path(tokenizer_path)
+    if path.suffix == ".model":
+        return _convert_sentencepiece(path, Path(output_dir))
+    return _convert_hf(path, Path(output_dir))
+
+
+def _convert_hf(path: Path, output_dir: Path) -> TokenIds:
+    from transformers import AutoTokenizer
+
+    tokenizer = AutoTokenizer.from_pretrained(str(path))
+    tokenizer.save_pretrained(str(output_dir))
+    return (
+        tokenizer.bos_token_id,
+        tokenizer.eos_token_id,
+        tokenizer.pad_token_id,
+        getattr(tokenizer, "unk_token_id", None),
+    )
+
+
+def _convert_sentencepiece(model_file: Path, output_dir: Path) -> TokenIds:
+    """SP model -> LlamaTokenizer in legacy mode (reference conversion_tokenizer.py:11-44):
+    special-token logic stays inside the SP model; the HF wrapper adds nothing."""
+    try:
+        import sentencepiece as spm
+        from transformers import LlamaTokenizer
+    except ImportError as exc:  # pragma: no cover - environment-dependent
+        raise ImportError(
+            "SentencePiece tokenizer conversion requires the 'sentencepiece' package "
+            "(not installed in this environment). Install it or export the tokenizer "
+            "from its HF directory instead."
+        ) from exc
+
+    sp = spm.SentencePieceProcessor()
+    sp.Load(str(model_file))
+    with tempfile.TemporaryDirectory() as tmp:
+        shutil.copy(model_file, Path(tmp) / "tokenizer.model")
+        hf_tokenizer = LlamaTokenizer.from_pretrained(
+            tmp, bos_token=None, eos_token=None, pad_token=None, unk_token=None
+        )
+    hf_tokenizer.add_bos_token = False
+    hf_tokenizer.add_eos_token = False
+    # legacy=True: tokenization goes straight through SentencePiece, no extra
+    # special-token splitting on top (reference :35-37)
+    hf_tokenizer.legacy = True
+    hf_tokenizer.save_pretrained(str(output_dir))
+
+    def _maybe(i: int) -> Optional[int]:
+        return i if i >= 0 else None
+
+    return (_maybe(sp.bos_id()), _maybe(sp.eos_id()), _maybe(sp.pad_id()), _maybe(sp.unk_id()))

@@ -152,11 +152,19 @@ def convert_gpt2(config_file_path: Path, output_hf_checkpoint_dir: Path, num_tes
     if checkpoint_path:
         import orbax.checkpoint as ocp
 
-        # training checkpoints hold the full AppState (params/opt_state/step);
-        # restore just the params subtree
-        # restore without a target: the full AppState (params/opt_state/step) loads as
-        # plain arrays; the conversion only needs the params subtree
-        restored = ocp.StandardCheckpointer().restore(Path(checkpoint_path).absolute())
+        # training checkpoints hold the full AppState (params/opt_state/step). A
+        # targetless restore would pin the SAVING topology (fails when converting on
+        # fewer devices than trained on), so build the target from the checkpoint's
+        # own metadata with every leaf placed on this host's first device.
+        checkpointer = ocp.StandardCheckpointer()
+        path = Path(checkpoint_path).absolute()
+        meta = checkpointer.metadata(path)
+        tree_meta = getattr(meta, "item_metadata", meta)
+        sharding = jax.sharding.SingleDeviceSharding(jax.devices()[0])
+        abstract = jax.tree.map(
+            lambda m: jax.ShapeDtypeStruct(m.shape, m.dtype, sharding=sharding), tree_meta
+        )
+        restored = checkpointer.restore(path, abstract)
         params = restored["params"]
 
     hf_model, _ = convert_model_checkpoint(model, params)
@@ -164,5 +172,25 @@ def convert_gpt2(config_file_path: Path, output_hf_checkpoint_dir: Path, num_tes
         check_converted_model(hf_model, model, params, num_testruns)
     output_hf_checkpoint_dir = Path(output_hf_checkpoint_dir)
     output_hf_checkpoint_dir.mkdir(parents=True, exist_ok=True)
+
+    # tokenizer rides along when the config names one (reference convert_gpt2.py:
+    # "If a tokenizer is specified in the config, it will be converted as well")
+    tokenizer_path = components.settings.get("tokenizer_model_path") or components.settings.get(
+        "tokenizer_path"
+    )
+    if tokenizer_path:
+        from modalities_tpu.conversion.gpt2.conversion_tokenizer import convert_tokenizer
+
+        bos, eos, pad, _unk = convert_tokenizer(tokenizer_path, output_hf_checkpoint_dir)
+        # generation_config was snapshotted from the LlamaConfig defaults at model
+        # construction; stamp BOTH configs or generation_config.json keeps bos=1/eos=2
+        for target in (hf_model.config, hf_model.generation_config):
+            if bos is not None:
+                target.bos_token_id = bos
+            if eos is not None:
+                target.eos_token_id = eos
+            if pad is not None:
+                target.pad_token_id = pad
+
     hf_model.save_pretrained(output_hf_checkpoint_dir)
     logger.info("HF checkpoint written to %s", output_hf_checkpoint_dir)

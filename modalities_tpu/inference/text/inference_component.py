@@ -91,7 +91,12 @@ class TextInferenceComponent:
         """KV-cache path: chunked group prefill (a few compiled shapes), then O(1) per
         generated token. When the cache fills mid-generation, the remainder continues
         on the sliding-window re-forward path so both paths emit identical outputs."""
-        window = token_ids[-self.sequence_length :]
+        # cache capacity is the MODEL's sequence length; a larger configured
+        # sequence_length must not let prefill write past the cache end (the index
+        # clamp in dynamic_update_slice would silently corrupt the context)
+        spec_len = getattr(getattr(self.model, "config_spec", None), "sequence_length", None)
+        capacity = min(self.sequence_length, spec_len) if spec_len else self.sequence_length
+        window = token_ids[-capacity:]
         if budget <= 0 or not window:
             return []
         step = self._decode_step()
@@ -110,7 +115,7 @@ class TextInferenceComponent:
                 return generated
             generated.append(next_id)
             consumed += 1
-            if consumed >= self.sequence_length:
+            if consumed >= capacity:
                 # cache full: continue with the sliding-window fallback for parity
                 generated += self._generate_reforward(
                     window + generated, eod_id, budget - len(generated), rng

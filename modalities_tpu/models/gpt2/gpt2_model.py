@@ -501,7 +501,9 @@ class GPT2Module(nn.Module):
                 length=spec.n_layer,
                 metadata_params={nn.meta.PARTITION_NAME: "layers"},
             )(spec, self.deterministic, self.decode, name="blocks")
-            if spec.pipeline_axis is not None and not self.is_initializing():
+            # decode never pipelines: generation is single-host and must go through
+            # the scanned path so the per-layer KV caches are read/written
+            if spec.pipeline_axis is not None and not self.is_initializing() and not self.decode:
                 # GPipe over the pp axis: same scan-stacked params (created by the init
                 # path below), applied stage-wise by parallel/pipeline.py
                 from modalities_tpu.parallel.pipeline import pipeline_blocks

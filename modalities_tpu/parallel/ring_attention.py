@@ -27,11 +27,17 @@ import jax.numpy as jnp
 NEG_INF = -1e30
 
 
-def _chunk_attention_stats(q, k, v, q_offset, k_offset, causal: bool, sm_scale: float):
-    """Blockwise attention with global-position causal mask.
+# k-block size for the fused (flash-style) local attention: above this key length
+# the per-hop logits are computed block-by-block under lax.scan with an online-softmax
+# merge, so per-device peak memory is O(S_local * BLOCK_K) instead of O(S_local^2).
+# (The Pallas flash kernel can't serve the ring hop directly: the merge needs the
+# UNNORMALIZED (o, m, l) stats, which the kernel does not expose.)
+BLOCK_K = 1024
 
-    q: [B, Sq, Hq, D], k/v: [B, Sk, Hkv, D] -> (o_unnorm [B,Sq,Hq,D] f32, m, l [B,Sq,Hq]).
-    """
+
+def _dense_chunk_stats(q, k, v, q_offset, k_offset, causal: bool, sm_scale: float):
+    """One dense logits block. q: [B,Sq,Hq,D], k/v: [B,Sk,Hkv,D]
+    -> (o_unnorm [B,Sq,Hq,D] f32, m, l [B,Sq,Hq] f32)."""
     b, sq, hq, d = q.shape
     hkv = k.shape[2]
     group = hq // hkv
@@ -52,6 +58,53 @@ def _chunk_attention_stats(q, k, v, q_offset, k_offset, causal: bool, sm_scale: 
     m = m.transpose(0, 3, 1, 2).reshape(b, sq, hq)
     l = l.transpose(0, 3, 1, 2).reshape(b, sq, hq)
     return o, m, l
+
+
+def _merge_stats(acc, m_run, l_run, o_r, m_r, l_r):
+    """Online-softmax merge of one partial block into the running (acc, m, l)."""
+    m_new = jnp.maximum(m_run, m_r)
+    alpha = jnp.where(m_run == NEG_INF, 0.0, jnp.exp(m_run - m_new))
+    beta = jnp.where(m_r == NEG_INF, 0.0, jnp.exp(m_r - m_new))
+    acc = acc * alpha[..., None] + o_r * beta[..., None]
+    l_run = l_run * alpha + l_r * beta
+    return acc, m_new, l_run
+
+
+def _chunk_attention_stats(
+    q, k, v, q_offset, k_offset, causal: bool, sm_scale: float, block_k: int = BLOCK_K
+):
+    """Local attention with global-position causal mask, fused over k blocks when the
+    key chunk is long (the memory profile CP exists for at 32k+ contexts)."""
+    sk = k.shape[1]
+    if sk <= 2 * block_k or sk % block_k != 0:
+        return _dense_chunk_stats(q, k, v, q_offset, k_offset, causal, sm_scale)
+
+    b, sq, hq, d = q.shape
+    num_blocks = sk // block_k
+    k_blocks = k.reshape(b, num_blocks, block_k, *k.shape[2:]).transpose(1, 0, 2, 3, 4)
+    v_blocks = v.reshape(b, num_blocks, block_k, *v.shape[2:]).transpose(1, 0, 2, 3, 4)
+
+    # remat the block body: without it, scan-autodiff saves every block's softmax
+    # residuals and backward peak memory is O(Sq*Sk) again (flash-attention practice:
+    # recompute per-block stats in the backward pass)
+    @jax.checkpoint
+    def body(carry, xs):
+        acc, m_run, l_run = carry
+        blk_index, k_b, v_b = xs
+        o_r, m_r, l_r = _dense_chunk_stats(
+            q, k_b, v_b, q_offset, k_offset + blk_index * block_k, causal, sm_scale
+        )
+        return _merge_stats(acc, m_run, l_run, o_r, m_r, l_r), None
+
+    init = (
+        jnp.zeros((b, sq, hq, d), jnp.float32),
+        jnp.full((b, sq, hq), NEG_INF, jnp.float32),
+        jnp.zeros((b, sq, hq), jnp.float32),
+    )
+    (acc, m_run, l_run), _ = jax.lax.scan(
+        body, init, (jnp.arange(num_blocks), k_blocks, v_blocks)
+    )
+    return acc, m_run, l_run
 
 
 def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool, sm_scale: float):
@@ -77,13 +130,7 @@ def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool, sm_scale: fl
             causal=causal,
             sm_scale=sm_scale,
         )
-        m_new = jnp.maximum(m_run, m_r)
-        # guard: if both are NEG_INF the row has no keys yet; keep weights at 0
-        alpha = jnp.where(m_run == NEG_INF, 0.0, jnp.exp(m_run - m_new))
-        beta = jnp.where(m_r == NEG_INF, 0.0, jnp.exp(m_r - m_new))
-        acc = acc * alpha[..., None] + o_r * beta[..., None]
-        l_run = l_run * alpha + l_r * beta
-        m_run = m_new
+        acc, m_run, l_run = _merge_stats(acc, m_run, l_run, o_r, m_r, l_r)
         if r != cp - 1:
             k_cur = jax.lax.ppermute(k_cur, axis_name, perm)
             v_cur = jax.lax.ppermute(v_cur, axis_name, perm)

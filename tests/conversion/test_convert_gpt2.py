@@ -40,3 +40,69 @@ def test_roundtrip_save_load(tmp_path):
     hf_model.save_pretrained(tmp_path / "export")
     reloaded = AutoModelForCausalLM.from_pretrained(tmp_path / "export")
     check_converted_model(reloaded, model, params, num_testruns=1)
+
+
+def _tiny_hf_tokenizer_dir(tmp_path):
+    """Build a tiny WordLevel HF tokenizer fully offline (no hub access)."""
+    from tokenizers import Tokenizer
+    from tokenizers.models import WordLevel
+    from tokenizers.pre_tokenizers import Whitespace
+    from transformers import PreTrainedTokenizerFast
+
+    vocab = {"<pad>": 0, "<bos>": 1, "<eos>": 2, "hello": 3, "world": 4, "the": 5}
+    tok = Tokenizer(WordLevel(vocab, unk_token="<pad>"))
+    tok.pre_tokenizer = Whitespace()
+    fast = PreTrainedTokenizerFast(
+        tokenizer_object=tok, bos_token="<bos>", eos_token="<eos>", pad_token="<pad>"
+    )
+    src = tmp_path / "src_tok"
+    fast.save_pretrained(src)
+    return src
+
+
+def test_tokenizer_conversion_roundtrip(tmp_path):
+    from transformers import AutoTokenizer
+
+    from modalities_tpu.conversion.gpt2.conversion_tokenizer import convert_tokenizer
+
+    src = _tiny_hf_tokenizer_dir(tmp_path)
+    out = tmp_path / "export"
+    bos, eos, pad, _ = convert_tokenizer(src, out)
+    assert (bos, eos, pad) == (1, 2, 0)
+    reloaded = AutoTokenizer.from_pretrained(out)
+    assert reloaded.encode("hello world the", add_special_tokens=False) == [3, 4, 5]
+
+
+def test_full_export_loads_in_vanilla_transformers_with_tokenizer(tmp_path):
+    """VERDICT r1 #6 acceptance: exported checkpoint + tokenizer load with vanilla
+    transformers; fp32-compute logit diff < 1e-4."""
+    from flax.core import meta
+    from transformers import AutoModelForCausalLM, AutoTokenizer
+
+    from modalities_tpu.conversion.gpt2.conversion_tokenizer import convert_tokenizer
+    from modalities_tpu.models.model import MixedPrecisionSpec
+
+    model = tiny_gpt2("manual")
+    # fp32 compute for a tight numerical bar (training default is bf16 blocks)
+    model.with_spec_updates(compute_dtype="float32")
+    params = meta.unbox(model.init_params(jax.random.PRNGKey(2)))
+    hf_model, _ = convert_model_checkpoint(model, params)
+    out = tmp_path / "export"
+    hf_model.save_pretrained(out)
+    convert_tokenizer(_tiny_hf_tokenizer_dir(tmp_path), out)
+
+    reloaded = AutoModelForCausalLM.from_pretrained(out)
+    tok = AutoTokenizer.from_pretrained(out)
+    assert tok.encode("hello world", add_special_tokens=False) == [3, 4]
+
+    import numpy as np
+    import torch
+
+    rng = np.random.default_rng(3)
+    tokens = rng.integers(0, 128, size=(2, 16))
+    jax_logits = np.asarray(
+        model.apply(params, {model.sample_key: tokens.astype(np.int32)})[model.prediction_key]
+    )
+    with torch.no_grad():
+        torch_logits = reloaded(torch.from_numpy(tokens)).logits.float().numpy()
+    assert np.abs(jax_logits - torch_logits).max() < 1e-4

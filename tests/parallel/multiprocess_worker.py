@@ -1,0 +1,85 @@
+"""Worker for the 2-process distributed CPU test (run via subprocess, not pytest).
+
+Each process owns 4 virtual CPU devices of a global 8-device dp mesh, feeds ONLY its
+own rows of the global batch through put_batch's make_array_from_process_local_data
+branch, and runs one real train step. Prints `LOSS <value>` — the parent asserts both
+processes agree with the single-process oracle. (Reference: multi-rank test tier,
+tests/run_distributed_tests.sh:36-50.)
+
+Usage: multiprocess_worker.py <coordinator_port> <process_id> <num_processes>
+       multiprocess_worker.py single            # single-process oracle
+"""
+
+import os
+import sys
+
+os.environ["PALLAS_AXON_POOL_IPS"] = ""
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=4"
+).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+
+def build_and_step(local_rows_slice):
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+    from modalities_tpu.loss_functions import CLMCrossEntropyLoss
+    from modalities_tpu.optimizers.optimizer_factory import OptimizerFactory
+    from modalities_tpu.running_env.device_mesh import get_data_loading_info, get_device_mesh
+    from modalities_tpu.training.train_step import TrainStepBuilder
+    from tests.models.test_gpt2_model import tiny_gpt2
+
+    world = len(jax.devices())
+    mesh = get_device_mesh(device_type="cpu", data_parallel_shard_degree=world, world_size=world)
+    num_ranks, rank = get_data_loading_info(mesh)
+
+    model = tiny_gpt2("pytorch_flash")
+    opt = OptimizerFactory.get_adam_w(
+        lr=1e-3, betas=(0.9, 0.95), eps=1e-8, weight_decay=0.1,
+        weight_decay_groups_excluded=["norm", "embedding"], wrapped_model=model,
+    )
+    fns = TrainStepBuilder(
+        model=model,
+        loss_fn=CLMCrossEntropyLoss(target_key="target_ids", prediction_key="logits"),
+        optimizer_spec=opt,
+        mesh_handle=mesh,
+        gradient_acc_steps=1,
+        grad_clip_norm=1.0,
+    ).build(seed=0)
+
+    # the GLOBAL batch is the same on every process; each feeds only its rows
+    rng = np.random.default_rng(0)
+    tokens = rng.integers(0, 128, size=(1, 8, 17))
+    rows_per_rank = 8 // num_ranks
+    lo = rank * rows_per_rank
+    local = tokens[:, lo : lo + rows_per_rank] if local_rows_slice else tokens
+    batch = fns.put_batch(
+        {
+            "samples": {"input_ids": local[:, :, :-1].astype(np.int32)},
+            "targets": {"target_ids": local[:, :, 1:].astype(np.int32)},
+        }
+    )
+    state, metrics = fns.train_step(fns.app_state_handle.state, batch)
+    return float(metrics["loss"])
+
+
+def main() -> None:
+    if sys.argv[1] == "single":
+        print(f"LOSS {build_and_step(local_rows_slice=False):.6f}", flush=True)
+        return
+    port, pid, nprocs = int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3])
+    jax.distributed.initialize(
+        coordinator_address=f"127.0.0.1:{port}", num_processes=nprocs, process_id=pid
+    )
+    assert jax.process_count() == nprocs, jax.process_count()
+    loss = build_and_step(local_rows_slice=True)
+    print(f"LOSS {loss:.6f}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,63 @@
+"""Multi-process (multi-host-shaped) validation: two jax.distributed CPU processes,
+4 virtual devices each, drive put_batch's `make_array_from_process_local_data` branch
+and the per-host data split; the global result must match single-process exactly.
+(Reference: the multi-rank tiers of tests/run_distributed_tests.sh:36-50.)"""
+
+import os
+import socket
+import subprocess
+import sys
+from pathlib import Path
+
+WORKER = Path(__file__).parent / "multiprocess_worker.py"
+
+
+def _clean_env():
+    env = dict(os.environ)
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)  # the worker sets its own device count (4 per process)
+    env["PYTHONPATH"] = str(WORKER.parent.parent.parent)
+    return env
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _parse_loss(out: str) -> float:
+    for line in out.splitlines():
+        if line.startswith("LOSS "):
+            return float(line.split()[1])
+    raise AssertionError(f"no LOSS line in output:\n{out}")
+
+
+def test_two_process_put_batch_matches_single_process():
+    env = _clean_env()
+    single = subprocess.run(
+        [sys.executable, str(WORKER), "single"],
+        capture_output=True, text=True, timeout=600, env=env,
+    )
+    assert single.returncode == 0, single.stderr[-3000:]
+    oracle = _parse_loss(single.stdout)
+
+    port = _free_port()
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(WORKER), str(port), str(pid), "2"],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env,
+        )
+        for pid in range(2)
+    ]
+    outs = []
+    for p in procs:
+        out, err = p.communicate(timeout=600)
+        assert p.returncode == 0, err[-3000:]
+        outs.append(_parse_loss(out))
+
+    # every process reports the same global loss, equal to the single-process oracle:
+    # each fed only its own rows, so agreement proves the local-shard assembly is right
+    assert outs[0] == outs[1]
+    assert abs(outs[0] - oracle) < 1e-5, (outs, oracle)
