@@ -27,3 +27,8 @@ class CheckpointSaving:
             training_progress=training_progress,
             app_state_handle=app_state_handle,
         )
+
+    def wait_until_finished(self) -> None:
+        """Drain pending (async) saves; flushes the deferred resume pointer."""
+        if hasattr(self.checkpoint_saving_execution, "wait_until_finished"):
+            self.checkpoint_saving_execution.wait_until_finished()

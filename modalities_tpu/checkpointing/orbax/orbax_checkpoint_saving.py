@@ -60,6 +60,11 @@ class OrbaxCheckpointSaving(CheckpointSavingExecutionABC):
         self.global_rank = global_rank
         self.use_async = use_async
         self._checkpointer = None
+        # async saves: the resume pointer for a folder is written only once its
+        # background commit is confirmed (at the next save or wait_until_finished) —
+        # otherwise a crash mid-commit leaves the pointer referencing a folder that
+        # does not exist yet and warmstart fails
+        self._pending_info_folder: Path | None = None
 
     def _get_checkpointer(self):
         # StandardCheckpointer is async under the hood (background commit thread);
@@ -75,24 +80,50 @@ class OrbaxCheckpointSaving(CheckpointSavingExecutionABC):
         folder.parent.mkdir(parents=True, exist_ok=True)
         logger.info("Saving sharded checkpoint to %s ...", folder)
         checkpointer = self._get_checkpointer()
+        # (an async checkpointer waits for the PREVIOUS save's commit here before
+        # starting the new one, so the pending pointer below is safe to flush)
         checkpointer.save(folder.absolute(), app_state_handle.state)
-        if not self.use_async:
+        self._flush_pending_info()
+        if self.use_async:
+            self._pending_info_folder = folder
+        else:
             # block until the atomic commit (tmp-dir rename) completes — the fence the
             # reference implements with dist.barrier() (fsdp_checkpoint_saving.py:259-263)
             checkpointer.wait_until_finished()
+            self._write_info(folder)
         logger.info("Checkpoint saved.")
 
-        if _process_index() == 0:
-            info = {"checkpoint_folder_path": str(folder.absolute())}
-            info_path = folder.parent / LAST_CHECKPOINT_INFO_FILE_NAME
-            with open(info_path, "w", encoding="utf-8") as f:
-                json.dump(info, f)
-            logger.info("Checkpoint info saved to %s.", info_path)
-
-    def _delete_checkpoint(self, training_progress: TrainingProgress) -> None:
+    def _write_info(self, folder: Path) -> None:
         if _process_index() != 0:
             return
+        info = {"checkpoint_folder_path": str(folder.absolute())}
+        info_path = folder.parent / LAST_CHECKPOINT_INFO_FILE_NAME
+        with open(info_path, "w", encoding="utf-8") as f:
+            json.dump(info, f)
+        logger.info("Checkpoint info saved to %s.", info_path)
+
+    def _flush_pending_info(self) -> None:
+        if self._pending_info_folder is not None:
+            self._write_info(self._pending_info_folder)
+            self._pending_info_folder = None
+
+    def _delete_checkpoint(self, training_progress: TrainingProgress) -> None:
         folder = checkpoint_folder_path(self.checkpoint_path, self.experiment_id, training_progress)
+        # deleting the folder the on-disk resume pointer still references (k=1 ring
+        # with use_async: the deferred pointer was just flushed to folder N-1 and the
+        # ring now deletes N-1) would leave a dangling pointer for a whole interval:
+        # drain the in-flight commit so the pointer advances to the newest folder
+        # first. The drain runs on EVERY process (Orbax commits are collective).
+        if self.use_async:
+            info_path = self.checkpoint_path / LAST_CHECKPOINT_INFO_FILE_NAME
+            try:
+                current = json.loads(info_path.read_text())["checkpoint_folder_path"]
+            except (OSError, ValueError, KeyError):
+                current = None
+            if current == str(folder.absolute()):
+                self.wait_until_finished()
+        if _process_index() != 0:
+            return
         if not folder.exists():
             raise CheckpointingError(
                 f"Checkpoint folder {folder} could not be removed. It does not exist!"
@@ -102,6 +133,7 @@ class OrbaxCheckpointSaving(CheckpointSavingExecutionABC):
     def wait_until_finished(self) -> None:
         if self._checkpointer is not None:
             self._checkpointer.wait_until_finished()
+        self._flush_pending_info()
 
 
 def _process_index() -> int:

@@ -131,6 +131,7 @@ class PipelinedModelConfig(BaseModel):
     num_microbatches: Optional[Annotated[int, Field(strict=True, ge=1)]] = None
     batch_size: Optional[Annotated[int, Field(strict=True, ge=1)]] = None
     microbatch_size: Optional[Annotated[int, Field(strict=True, ge=1)]] = None
+    num_virtual_stages: Optional[Annotated[int, Field(strict=True, ge=1)]] = None
 
 
 class HuggingFacePretrainedModelConfig(BaseModel):

@@ -61,6 +61,11 @@ class ResumableDistributedSampler(SamplerIF):
 
     def __iter__(self) -> Iterator[int]:
         if self.shuffle:
+            # NOTE divergence from the reference: torch.randperm(seed) and numpy
+            # PCG64(seed) produce DIFFERENT permutations for the same seed. Resuming
+            # from a reference-produced checkpoint via skip_num_global_samples restores
+            # compatibly but does NOT reproduce the reference's data ORDER. Internal
+            # determinism (same seed+epoch => same stream) is guaranteed.
             rng = np.random.Generator(np.random.PCG64(self.seed + self.epoch))
             indices_full = rng.permutation(len(self.dataset)).tolist()
         else:

@@ -52,10 +52,16 @@ class Gym:
                     app_state_handle=step_functions.app_state_handle,
                 )
 
-        self.trainer.train(
-            step_functions=step_functions,
-            train_loader=train_data_loader,
-            training_progress=training_progress,
-            evaluation_callback=evaluation_callback,
-            checkpointing_callback=checkpointing_callback,
-        )
+        try:
+            self.trainer.train(
+                step_functions=step_functions,
+                train_loader=train_data_loader,
+                training_progress=training_progress,
+                evaluation_callback=evaluation_callback,
+                checkpointing_callback=checkpointing_callback,
+            )
+        finally:
+            # drain async checkpoint commits (and flush the deferred resume pointer)
+            # before the process can exit
+            if checkpoint_saving is not None and hasattr(checkpoint_saving, "wait_until_finished"):
+                checkpoint_saving.wait_until_finished()

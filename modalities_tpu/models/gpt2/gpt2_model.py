@@ -172,7 +172,8 @@ class GPT2ModelSpec:
     context_parallel_axis: Optional[str] = None  # set when the mesh has cp > 1
     pipeline_axis: Optional[str] = None  # set when the mesh has pp > 1
     pp_num_microbatches: Optional[int] = None  # GPipe microbatches (default: pp degree)
-    pp_schedule: str = "gpipe"  # "gpipe" = in-module autodiff GPipe; "1f1b" = scheduled executor
+    pp_schedule: str = "gpipe"  # "gpipe" = in-module autodiff GPipe; "1f1b"/"interleaved_1f1b" = scheduled executor
+    pp_num_virtual: int = 1  # virtual chunks per device (interleaved_1f1b)
     param_dtype: str = "float32"  # storage dtype (MixedPrecisionSpec.param_dtype)
     compute_dtype: str = "bfloat16"  # block compute dtype (MXU-native)
 
@@ -210,6 +211,7 @@ class GPT2ModelSpec:
                 self.pipeline_axis,
                 self.pp_num_microbatches,
                 self.pp_schedule,
+                self.pp_num_virtual,
                 self.param_dtype,
                 self.compute_dtype,
             )
@@ -558,7 +560,7 @@ class GPT2Module(nn.Module):
                 dtype=jnp.float32,  # logits compute stays fp32 for a stable softmax
                 param_dtype=param_dtype,
             )(x.astype(jnp.float32))
-        return with_logical_constraint(logits, ("batch", "seq", "vocab"))
+        return with_logical_constraint(logits, ("batch", "seq", "vocab_logits"))
 
 
 class GPT2LLM(NNModel):

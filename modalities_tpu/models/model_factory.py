@@ -75,18 +75,34 @@ class ModelFactory:
         num_microbatches: Optional[int] = None,
         batch_size: Optional[int] = None,
         microbatch_size: Optional[int] = None,
+        num_virtual_stages: Optional[int] = None,
     ) -> NNModel:
         """Select the pipeline schedule (reference: PipelineFactory.get_scheduled_pipeline,
         pipeline_parallelism.py:294-337). "gpipe" = in-module autodiff GPipe;
-        "1f1b" = scheduled executor with in-region loss and O(pp) residual memory
-        (parallel/pipeline_scheduled.py). num_microbatches may be given directly or
-        derived from batch_size // microbatch_size like the reference."""
+        "1f1b"/"interleaved_1f1b" = scheduled executor with in-region loss and bounded
+        residual memory (parallel/pipeline_scheduled.py). num_microbatches may be
+        given directly or derived from batch_size // microbatch_size like the
+        reference; interleaved_1f1b additionally takes num_virtual_stages chunks per
+        device."""
         name = pp_schedule_name.strip().lower()
-        if name not in ("gpipe", "1f1b"):
+        if name not in ("gpipe", "1f1b", "interleaved_1f1b"):
             raise NotImplementedError(
                 f"pipeline schedule {pp_schedule_name!r} not supported yet "
-                "(have: gpipe, 1f1b; reference also ships Interleaved1F1B/ZBVZeroBubble/DualPipeV)"
+                "(have: gpipe, 1f1b, interleaved_1f1b; reference also ships "
+                "ZBVZeroBubble/DualPipeV)"
             )
+        if name == "interleaved_1f1b":
+            if num_virtual_stages is None:
+                num_virtual_stages = 2  # the schedule's minimum (and common) setting
+            elif num_virtual_stages < 2:
+                raise ValueError("interleaved_1f1b requires num_virtual_stages >= 2")
+        elif num_virtual_stages is not None and num_virtual_stages != 1:
+            raise ValueError(
+                f"num_virtual_stages={num_virtual_stages} requires pp_schedule_name="
+                f"'interleaved_1f1b' (got {pp_schedule_name!r})"
+            )
+        else:
+            num_virtual_stages = 1
         if num_microbatches is None and (batch_size is not None) != (microbatch_size is not None):
             raise ValueError(
                 "pipelined model: batch_size and microbatch_size must be given together"
@@ -98,7 +114,11 @@ class ModelFactory:
                 )
             num_microbatches = batch_size // microbatch_size
         if hasattr(model, "with_spec_updates"):
-            model.with_spec_updates(pp_schedule=name, pp_num_microbatches=num_microbatches)
+            model.with_spec_updates(
+                pp_schedule=name,
+                pp_num_microbatches=num_microbatches,
+                pp_num_virtual=num_virtual_stages,
+            )
         else:
             raise NotImplementedError("pipelined model variant requires a scan-stacked model (gpt2)")
         return model

@@ -1,22 +1,34 @@
 """Scheduled pipeline executor: hand-rolled fwd/bwd over static schedule tables
-(reference: torch pipelining's _PipelineScheduleRuntime executing GPipe/1F1B action
-lists, src/modalities/models/parallelism/pipeline_parallelism.py:294-337 — re-built
-for SPMD).
+(reference: torch pipelining's _PipelineScheduleRuntime executing GPipe/1F1B/
+Interleaved1F1B action lists, src/modalities/models/parallelism/
+pipeline_parallelism.py:294-337 — re-built for SPMD).
 
 Unlike the autodiff GPipe in parallel/pipeline.py (which differentiates through the
 tick scan and therefore (a) computes the loss OUTSIDE the pipeline on the gathered
 [M, ...] output and (b) lets scan-autodiff store per-tick residuals), this executor:
 
-- computes the lm-head + loss INSIDE the pipelined region, per microbatch, the tick
-  after the last stage finishes it (the torch schedule's `loss_fn` slot). The head is
+- computes the lm-head + loss INSIDE the pipelined region, per microbatch, in the
+  tick the last stage finishes it (the torch schedule's `loss_fn` slot). The head is
   computed redundantly by every stage after a psum-broadcast — uniform SPMD compute
   that costs no wall-clock vs. leaving stages idle in the bubble;
-- stores only a ring buffer of stage INPUTS (`max_inflight + 1` slots) and recomputes
-  each stage forward under ``jax.vjp`` at its backward tick (full remat — the
-  standard PP memory/compute trade). 1F1B's `max_inflight <= P` bound therefore
-  directly caps residual memory, where GPipe holds all M;
+- stores stage INPUTS in a small slot-planned buffer (static interval coloring of
+  every (chunk, microbatch) lifetime — collision-free by construction, sized at the
+  schedule's true in-flight bound) and recomputes each stage forward under
+  ``jax.vjp`` at its backward tick (full remat — the standard PP memory/compute
+  trade). 1F1B's bounded in-flight count therefore directly caps residual memory,
+  where GPipe holds all M microbatches;
 - accumulates param grads explicitly: stacked (pp-sharded) block grads locally,
-  shared (pp-replicated: embedding/head) grads stage-masked then psum'd.
+  shared (pp-replicated: embedding/head) grads stage-masked then psum'd;
+- per-microbatch loss contributions are token-weighted so `ignore_index` masking
+  reproduces the unpipelined global mean exactly.
+
+Interleaved 1F1B (`num_virtual` > 1): each device owns V layer chunks; global stage
+``g = chunk*P + device``. The stacked [L, ...] params are viewed as
+[V, P, L/(V*P), ...] with axis 1 sharded over pp, so device s holds chunks
+{c*P + s}. Activations still hop device -> device+1; the wrap from device P-1 to 0
+advances the chunk. Note: at high pp degrees the greedy interleaved tables are
+correct but not tight — prefer "1f1b" with more microbatches there
+(parallel/pipeline_schedules.py).
 
 Collectives per tick: one fwd ppermute (activations), one bwd ppermute (cotangents),
 one psum-broadcast (last-stage output for the head slot) — all riding ICI neighbors.
@@ -50,12 +62,67 @@ class PipelineStageFns(NamedTuple):
     head_loss: Callable
 
 
+def _slot_assignment(tables):
+    """Static buffer-slot plan: greedy interval coloring of each (chunk, microbatch)
+    key's lifetime across ALL devices (write of the earliest hop/F -> last backward).
+    Guarantees two live keys never share a slot (modulo-ring indexing aliases for
+    interleaved schedules) while keeping the slot count at the true in-flight bound
+    instead of the full V*M keyspace. Returns (slot_of [V*M], num_slots,
+    y_slot_of [M], num_y_slots) — y covers the head buffer, keyed by microbatch."""
+    import numpy as np
+
+    V, P, M = tables.num_virtual, tables.num_stages, tables.num_microbatches
+    G = V * P
+    f_at = -np.ones((G, M), dtype=np.int64)
+    b_at = -np.ones((G, M), dtype=np.int64)
+    h_at = -np.ones((M,), dtype=np.int64)
+    for t in range(tables.num_ticks):
+        for s in range(P):
+            if tables.f[t, s] >= 0:
+                c, m = divmod(int(tables.f[t, s]), M)
+                f_at[c * P + s, m] = t
+            if tables.b[t, s] >= 0:
+                c, m = divmod(int(tables.b[t, s]), M)
+                b_at[c * P + s, m] = t
+        if tables.h[t] >= 0:
+            h_at[tables.h[t]] = t
+
+    def color(intervals):
+        """intervals: list of (start, end, key); returns ({key: slot}, num_slots)."""
+        slots_end: list[int] = []  # last occupied tick per slot
+        assign = {}
+        for start, end, key in sorted(intervals):
+            for i, busy_until in enumerate(slots_end):
+                if busy_until < start:
+                    slots_end[i] = end
+                    assign[key] = i
+                    break
+            else:
+                assign[key] = len(slots_end)
+                slots_end.append(end)
+        return assign, max(1, len(slots_end))
+
+    main_intervals = []
+    for c in range(V):
+        for m in range(M):
+            start = min(int(f_at[max(c * P + s - 1, 0), m]) for s in range(P))
+            end = max(int(b_at[c * P + s, m]) for s in range(P))
+            main_intervals.append((start, end, c * M + m))
+    main_assign, num_slots = color(main_intervals)
+    slot_of = np.asarray([main_assign[k] for k in range(V * M)], dtype=np.int64)
+
+    y_intervals = [(int(f_at[G - 1, m]), int(h_at[m]), m) for m in range(M)]
+    y_assign, num_y_slots = color(y_intervals)
+    y_slot_of = np.asarray([y_assign[m] for m in range(M)], dtype=np.int64)
+    return slot_of, num_slots, y_slot_of, num_y_slots
+
+
 def _masked_add(acc, update, mask):
     return jax.tree.map(lambda a, u: a + jnp.where(mask, u, jnp.zeros_like(u)), acc, update)
 
 
 def _buf_set(buf, index, value, mask):
-    """buf.at[index].set(value) where mask else buf (applied leaf-wise)."""
+    """buf.at[index].set(value) where mask else buf."""
     new = buf.at[index].set(value)
     return jnp.where(mask, new, buf)
 
@@ -71,6 +138,7 @@ def scheduled_pipeline_loss_and_grads(
     axis_name: str = "pp",
     schedule: str = "1f1b",
     num_microbatches: Optional[int] = None,
+    num_virtual: int = 1,
     rng=None,
 ):
     """Run one pipelined fwd+bwd over the global batch; returns
@@ -89,20 +157,38 @@ def scheduled_pipeline_loss_and_grads(
     M = min(M, batch)
     if batch % M != 0:
         raise ValueError(f"batch ({batch}) must be divisible by num_microbatches ({M})")
-    tables = build_schedule_tables(schedule, num_stages, M)
-    ring = tables.max_inflight + 1  # +1: recv/broadcast lands one tick before use
+    V = num_virtual
+    tables = build_schedule_tables(schedule, num_stages, M, num_virtual=V)
+    # collision-free static slot plan sized at the true in-flight bound
+    slot_plan = _slot_assignment(tables)
+
+    total_layers = jax.tree.leaves(stacked_params)[0].shape[0]
+    if total_layers % (V * num_stages) != 0:
+        raise ValueError(
+            f"n_layer ({total_layers}) must be divisible by num_virtual*pp ({V}*{num_stages})"
+        )
+    layers_per_chunk = total_layers // (V * num_stages)
 
     tokens_mb = tokens.reshape(M, batch // M, *tokens.shape[1:])
     targets_mb = targets.reshape(M, batch // M, *targets.shape[1:])
 
-    param_specs = jax.tree.map(lambda _: P(axis_name), stacked_params)
+    # view [L, ...] as [V, P, L_vc, ...]: global stage g = c*P + s owns a contiguous
+    # layer block, device s holds chunks {c*P + s}; sharding axis 1 over pp
+    def to_chunks(p):
+        return p.reshape(V, num_stages, layers_per_chunk, *p.shape[1:])
+
+    def from_chunks(g):
+        return g.reshape(total_layers, *g.shape[3:])
+
+    stacked_chunked = jax.tree.map(to_chunks, stacked_params)
+    param_specs = jax.tree.map(lambda _: P(None, axis_name), stacked_chunked)
     shared_specs = jax.tree.map(lambda _: P(), shared_params)
 
     local = functools.partial(
         _scheduled_local,
         stage_fns=stage_fns,
         tables=tables,
-        ring=ring,
+        slot_plan=slot_plan,
         axis_name=axis_name,
         rng=rng,
     )
@@ -114,22 +200,29 @@ def scheduled_pipeline_loss_and_grads(
         axis_names=frozenset({axis_name}),
         check_vma=False,
     )
-    return fn(stacked_params, shared_params, tokens_mb, targets_mb)
+    loss, g_stacked, g_shared = fn(stacked_chunked, shared_params, tokens_mb, targets_mb)
+    return loss, jax.tree.map(from_chunks, g_stacked), g_shared
 
 
-def _scheduled_local(stacked_local, shared, tokens_mb, targets_mb, *, stage_fns, tables,
-                     ring, axis_name, rng):
-    """Per-pp-shard tick loop. All buffers have static shapes; the schedule tables are
-    baked in as constants and indexed by (tick, stage)."""
+def _scheduled_local(stacked_chunked, shared, tokens_mb, targets_mb, *, stage_fns, tables,
+                     slot_plan, axis_name, rng):
+    """Per-pp-shard tick loop. stacked_chunked local shape: [V, 1, L_vc, ...] (axis 1
+    was the pp shard). All buffers are static-shape; schedule tables are baked-in
+    constants indexed by (tick, device); table values encode chunk*M + microbatch."""
     embed, block, head_loss = stage_fns
     P_ = tables.num_stages
     M = tables.num_microbatches
+    V = tables.num_virtual
+    slot_of_np, num_slots, y_slot_of_np, num_y_slots = slot_plan
+    slot_of = jnp.asarray(slot_of_np)  # [V*M] -> buffer slot
+    y_slot_of = jnp.asarray(y_slot_of_np)  # [M] -> head-buffer slot
     stage = jax.lax.axis_index(axis_name)
-    num_local_layers = jax.tree.leaves(stacked_local)[0].shape[0]
+    stacked_local = jax.tree.map(lambda p: p.squeeze(1), stacked_chunked)  # [V, L_vc, ...]
+    layers_per_chunk = jax.tree.leaves(stacked_local)[0].shape[1]
 
-    f_tab = jnp.asarray(tables.f)  # [T, P]
+    f_tab = jnp.asarray(tables.f)  # [T, P], values c*M + m or -1
     b_tab = jnp.asarray(tables.b)
-    h_tab = jnp.asarray(tables.h)  # [T]
+    h_tab = jnp.asarray(tables.h)  # [T], microbatch ids
 
     fwd_perm = [(i, (i + 1) % P_) for i in range(P_)]
     bwd_perm = [(i, (i - 1) % P_) for i in range(P_)]
@@ -145,52 +238,65 @@ def _scheduled_local(stacked_local, shared, tokens_mb, targets_mb, *, stage_fns,
             return None
         return jax.random.fold_in(jax.random.fold_in(rng, 2), mb_index)
 
-    def blocks_fwd(params_loc, x, mb_index):
+    def blocks_fwd(params_v, chunk, x, mb_index):
+        """Apply this device's chunk `chunk` (global stage chunk*P + stage)."""
+        params_c = jax.tree.map(
+            lambda p: jax.lax.dynamic_index_in_dim(p, chunk, axis=0, keepdims=False), params_v
+        )
         mb_key = block_rng(mb_index)
+        global_stage = chunk * P_ + stage
 
         def body(carry, xs):
             layer_params, local_idx = xs
             layer_rng = (
                 None
                 if mb_key is None
-                else jax.random.fold_in(mb_key, stage * num_local_layers + local_idx)
+                else jax.random.fold_in(mb_key, global_stage * layers_per_chunk + local_idx)
             )
             return block(layer_params, carry, layer_rng), None
 
-        out, _ = jax.lax.scan(body, x, (params_loc, jnp.arange(num_local_layers)))
+        out, _ = jax.lax.scan(body, x, (params_c, jnp.arange(layers_per_chunk)))
         return out
 
     # probe shapes/dtypes with an abstract forward so buffers can be allocated
     x_shape = jax.eval_shape(embed, shared, tokens_mb[0], embed_rng(0))
     compute_dtype = x_shape.dtype
 
+    def decode(op):
+        """table value -> (chunk, microbatch, valid); clipped for safe indexing."""
+        c = jnp.clip(op // M, 0, V - 1)
+        m = jnp.clip(op % M, 0, M - 1)
+        return c, m, op >= 0
+
     def tick(carry, t):
         abuf, xbuf, ybuf, gbuf, g_stacked, g_shared, losses, weights = carry
-        fm = f_tab[t, stage]
-        bm = b_tab[t, stage]
+        c_f, m_f, f_valid = decode(f_tab[t, stage])
+        c_b, m_b, b_valid = decode(b_tab[t, stage])
         hm = h_tab[t]
+        hm_c = jnp.clip(hm, 0, M - 1)
 
         # ---- F slot (uniform compute; masked writes) --------------------------
-        fm_c = jnp.clip(fm, 0, M - 1)
-        x0 = embed(shared, tokens_mb[fm_c], embed_rng(fm_c))
-        x_in = jnp.where(stage == 0, x0, abuf[fm_c % ring])
-        y = blocks_fwd(stacked_local, x_in, fm_c)
-        xbuf = _buf_set(xbuf, fm_c % ring, x_in, fm >= 0)
+        x0 = embed(shared, tokens_mb[m_f], embed_rng(m_f))
+        is_first_stage = (stage == 0) & (c_f == 0)
+        f_slot = slot_of[c_f * M + m_f]
+        x_in = jnp.where(is_first_stage, x0, abuf[f_slot])
+        y = blocks_fwd(stacked_local, c_f, x_in, m_f)
+        xbuf = _buf_set(xbuf, f_slot, x_in, f_valid)
 
-        # broadcast the last stage's fresh output for the (uniform) head slot
-        last_fm = f_tab[t, P_ - 1]
-        last_fm_c = jnp.clip(last_fm, 0, M - 1)
+        # broadcast the last GLOBAL stage's fresh output for the (uniform) head slot
+        last_op = f_tab[t, P_ - 1]
+        c_last, m_last, last_valid = decode(last_op)
+        is_final_output = last_valid & (c_last == V - 1)
         y_bc = jax.lax.psum(
             jnp.where(stage == P_ - 1, y, jnp.zeros_like(y)).astype(jnp.float32), axis_name
         )
-        ybuf = _buf_set(ybuf, last_fm_c % ring, y_bc.astype(compute_dtype), last_fm >= 0)
+        ybuf = _buf_set(ybuf, y_slot_of[m_last], y_bc.astype(compute_dtype), is_final_output)
 
         # ---- H slot: head + loss fwd/bwd, redundantly on every stage ----------
-        hm_c = jnp.clip(hm, 0, M - 1)
         loss_h, head_pull, w_h = jax.vjp(
             lambda sh, xx: head_loss(sh, xx, targets_mb[hm_c]),
             shared,
-            ybuf[hm_c % ring],
+            ybuf[y_slot_of[hm_c]],
             has_aux=True,
         )
         # seed with the microbatch's token weight: grads accumulate d(sum of token
@@ -200,39 +306,52 @@ def _scheduled_local(stacked_local, shared, tokens_mb, targets_mb, *, stage_fns,
         weights = _buf_set(weights, hm_c, w_h, hm >= 0)
         # identical on all stages: keep one stage's copy, psum at the end
         g_shared = _masked_add(g_shared, g_shared_h, (stage == P_ - 1) & (hm >= 0))
-        gbuf = _buf_set(gbuf, hm_c % ring, g_y_head.astype(jnp.float32), hm >= 0)
+        # the last GLOBAL stage's backward consumes this as its incoming cotangent
+        gbuf = _buf_set(
+            gbuf, slot_of[(V - 1) * M + hm_c], g_y_head.astype(jnp.float32), hm >= 0
+        )
 
-        # ---- B slot: recompute stage forward under vjp (remat), pull cotangent
-        bm_c = jnp.clip(bm, 0, M - 1)
-        x_saved = xbuf[bm_c % ring]
-        _, pull = jax.vjp(lambda p, xx: blocks_fwd(p, xx, bm_c), stacked_local, x_saved)
-        g_p, g_x = pull(gbuf[bm_c % ring].astype(compute_dtype))
-        g_stacked = _masked_add(g_stacked, g_p, bm >= 0)
+        # ---- B slot: recompute chunk forward under vjp (remat), pull cotangent
+        b_slot = slot_of[c_b * M + m_b]
+        x_saved = xbuf[b_slot]
+        _, pull = jax.vjp(
+            lambda pv, xx: blocks_fwd(pv, c_b, xx, m_b), stacked_local, x_saved
+        )
+        g_p, g_x = pull(gbuf[b_slot].astype(compute_dtype))
+        g_stacked = _masked_add(g_stacked, g_p, b_valid)
 
-        # embedding backward: only stage 0's input is the embedding output
-        _, pull_e = jax.vjp(lambda sh: embed(sh, tokens_mb[bm_c], embed_rng(bm_c)), shared)
+        # embedding backward: only global stage 0's input is the embedding output
+        _, pull_e = jax.vjp(lambda sh: embed(sh, tokens_mb[m_b], embed_rng(m_b)), shared)
         (g_shared_e,) = pull_e(g_x)
-        g_shared = _masked_add(g_shared, g_shared_e, (stage == 0) & (bm >= 0))
+        g_shared = _masked_add(g_shared, g_shared_e, (stage == 0) & (c_b == 0) & b_valid)
 
         # ---- tick-end hops ----------------------------------------------------
+        # activation: device s -> s+1 (same chunk); wrap P-1 -> 0 advances the chunk
         act = jax.lax.ppermute(y, axis_name, fwd_perm)
-        recv_fm = jnp.where(stage > 0, f_tab[t, jnp.clip(stage - 1, 0, P_ - 1)], -1)
-        recv_fm_c = jnp.clip(recv_fm, 0, M - 1)
-        abuf = _buf_set(abuf, recv_fm_c % ring, act, recv_fm >= 0)
+        prev_op = f_tab[t, jnp.where(stage > 0, stage - 1, P_ - 1)]
+        c_p, m_p, p_valid = decode(prev_op)
+        c_recv = jnp.where(stage > 0, c_p, c_p + 1)
+        recv_ok = p_valid & (c_recv < V) & ~((stage == 0) & (c_p == V - 1))
+        c_recv = jnp.clip(c_recv, 0, V - 1)
+        abuf = _buf_set(abuf, slot_of[c_recv * M + m_p], act, recv_ok)
 
+        # cotangent: device s -> s-1 (same chunk); wrap 0 -> P-1 retreats the chunk
         cot = jax.lax.ppermute(g_x.astype(jnp.float32), axis_name, bwd_perm)
-        recv_bm = jnp.where(stage < P_ - 1, b_tab[t, jnp.clip(stage + 1, 0, P_ - 1)], -1)
-        recv_bm_c = jnp.clip(recv_bm, 0, M - 1)
-        gbuf = _buf_set(gbuf, recv_bm_c % ring, cot, recv_bm >= 0)
+        next_op = b_tab[t, jnp.where(stage < P_ - 1, stage + 1, 0)]
+        c_n, m_n, n_valid = decode(next_op)
+        c_recv_b = jnp.where(stage < P_ - 1, c_n, c_n - 1)
+        recv_b_ok = n_valid & (c_recv_b >= 0) & ~((stage == P_ - 1) & (c_n == 0))
+        c_recv_b = jnp.clip(c_recv_b, 0, V - 1)
+        gbuf = _buf_set(gbuf, slot_of[c_recv_b * M + m_n], cot, recv_b_ok)
 
         return (abuf, xbuf, ybuf, gbuf, g_stacked, g_shared, losses, weights), None
 
-    buf = lambda: jnp.zeros((ring,) + x_shape.shape, compute_dtype)  # noqa: E731
+    buf = lambda n, dtype=compute_dtype: jnp.zeros((n,) + x_shape.shape, dtype)  # noqa: E731
     init = (
-        buf(),  # abuf: activations received from the previous stage
-        buf(),  # xbuf: my stage inputs, kept for the remat backward
-        buf(),  # ybuf: broadcast last-stage outputs awaiting their head slot
-        jnp.zeros((ring,) + x_shape.shape, jnp.float32),  # gbuf: cotangents
+        buf(num_slots),  # abuf: activations received from the previous device
+        buf(num_slots),  # xbuf: my stage inputs, kept for the remat backward
+        buf(num_y_slots),  # ybuf: broadcast last-stage outputs awaiting their head slot
+        buf(num_slots, jnp.float32),  # gbuf: cotangents
         jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), stacked_local),
         jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), shared),
         jnp.zeros((M,), jnp.float32),
@@ -247,7 +366,7 @@ def _scheduled_local(stacked_local, shared, tokens_mb, targets_mb, *, stage_fns,
     total_weight = jnp.maximum(weights.sum(), 1.0)
     loss = (losses * weights).sum() / total_weight
     g_stacked = jax.tree.map(
-        lambda g, p: (g / total_weight).astype(p.dtype), g_stacked, stacked_local
+        lambda g, p: (g / total_weight).astype(p.dtype)[:, None], g_stacked, stacked_local
     )
     g_shared = jax.tree.map(lambda g: g / total_weight, g_shared)
     # shared params are pp-replicated: stage-masked contributions sum across stages

@@ -1,23 +1,36 @@
 """Pipeline schedules as static tick tables (reference:
 src/modalities/models/parallelism/pipeline_parallelism.py:13-20 — torch pipelining's
-GPipe/1F1B schedule classes, re-imagined for SPMD).
+GPipe/1F1B/Interleaved1F1B schedule classes, re-imagined for SPMD).
 
-A schedule here is three integer tables indexed [tick, stage] (microbatch id or -1):
+A schedule here is three integer tables indexed [tick, device] (f/b) and [tick] (h):
 
-- ``f``: which microbatch this stage runs a block-FORWARD for at this tick
-- ``b``: which microbatch this stage runs a block-BACKWARD for at this tick
+- ``f``: which (virtual_chunk, microbatch) this device runs a block-FORWARD for,
+  encoded as ``chunk * M + microbatch`` (-1 = none)
+- ``b``: same encoding for the block-BACKWARD slot
 - ``h``: which microbatch the (redundantly computed, pp-uniform) head+loss fwd/bwd
-  runs for at this tick — the same value for every stage, because the last stage's
-  output is psum-broadcast and every stage computes the head identically (uniform
-  SPMD compute costs no extra wall-clock: the alternative is an idle bubble).
+  runs for — identical on every device (the last stage's output is psum-broadcast)
 
-Because every TPU executes the same program each tick (SPMD), a schedule's quality
-shows up as (a) total tick count (bubble) and (b) the maximum number of in-flight
-microbatches per stage (residual ring-buffer size — the 1F1B memory advantage).
+THE TICK MODEL MATCHES THE EXECUTOR: every tick the SPMD program executes one
+F-unit, one B-unit, and one head-unit on EVERY device (masked no-ops still burn the
+compute — that is the nature of single-program pipelining). A good schedule therefore
+fills BOTH the F and B slot of as many ticks as possible; `bubble_fraction` counts
+unfilled F/B slots. GPipe (all forwards, then all backwards) can at best fill half
+the slots — 1F1B fills both in steady state, which is why it is ~2x faster here, on
+top of its O(P) in-flight memory bound (`max_inflight`).
 
-Tables are built by a tiny dependency-respecting simulator, so any schedule is just
-a different op-picking policy; correctness (dependencies, buffer bounds) is asserted
-structurally and unit-tested rather than trusted.
+Interleaved 1F1B: `num_virtual` > 1 virtual chunks per device. Global stage
+``g = chunk * P + device`` owns the layer block ``[g*L/(V*P), (g+1)*L/(V*P))``;
+activations still hop device -> device+1 each tick (wrapping device P-1 -> 0 advances
+the chunk), so the per-microbatch fill latency stays P hops per chunk but each hop
+carries 1/V of the layers — the bubble shrinks by ~V.
+
+Executor slot order within a tick: F slots -> last-stage broadcast -> H slot -> B
+slots -> hops. Hence F(g,m), H(m), and B on the SAME device may share a tick, while
+anything crossing devices needs a strictly earlier tick.
+
+Tables come from a dependency-checking simulator; `_validate` re-checks every
+ordering constraint structurally, so a policy bug cannot emit a silently-wrong
+schedule.
 """
 
 from __future__ import annotations
@@ -29,13 +42,14 @@ import numpy as np
 
 @dataclass(frozen=True)
 class ScheduleTables:
-    """Static schedule: arrays [T, P] (f/b) and [T] (h); -1 = no-op."""
+    """Static schedule: arrays [T, P] (f/b; values chunk*M+mb or -1) and [T] (h)."""
 
     f: np.ndarray
     b: np.ndarray
     h: np.ndarray
     num_stages: int
     num_microbatches: int
+    num_virtual: int = 1
 
     @property
     def num_ticks(self) -> int:
@@ -43,11 +57,10 @@ class ScheduleTables:
 
     @property
     def max_inflight(self) -> int:
-        """Max microbatches any stage holds between its F and its B (ring size)."""
+        """Max (chunk, microbatch) residuals any device holds between F and B."""
         worst = 0
         for s in range(self.num_stages):
-            inflight = 0
-            best = 0
+            inflight = best = 0
             for t in range(self.num_ticks):
                 if self.f[t, s] >= 0:
                     inflight += 1
@@ -59,103 +72,132 @@ class ScheduleTables:
 
     @property
     def bubble_fraction(self) -> float:
-        """Fraction of stage-tick compute slots that are idle (garbage compute in
-        SPMD): one F-or-B slot per stage per tick; H slots are uniform useful work."""
-        total_slots = self.num_ticks * self.num_stages
+        """Unfilled F/B slots (each tick has BOTH slots on every device)."""
+        total_slots = 2 * self.num_ticks * self.num_stages
         useful = int((self.f >= 0).sum() + (self.b >= 0).sum())
         return 1.0 - useful / total_slots
 
 
-SUPPORTED_SCHEDULES = ("gpipe", "1f1b")
+SUPPORTED_SCHEDULES = ("gpipe", "1f1b", "interleaved_1f1b")
 
 
-def build_schedule_tables(schedule: str, num_stages: int, num_microbatches: int) -> ScheduleTables:
-    """Simulate the schedule tick by tick, honoring the SPMD dependency rules:
+def build_schedule_tables(
+    schedule: str, num_stages: int, num_microbatches: int, num_virtual: int = 1
+) -> ScheduleTables:
+    """Simulate the schedule tick by tick. Dependency rules (g = chunk*P + device):
 
-    - F(s, m) needs F(s-1, m) at a strictly earlier tick (activation hop at tick end)
-    - H(m) needs F(P-1, m) at the SAME tick or earlier (the executor runs the F
-      slots, then the output broadcast, then the H slot within one tick body)
-    - B(P-1, m) needs H(m) at a strictly earlier tick (loss cotangent)
-    - B(s, m) needs B(s+1, m) at a strictly earlier tick (cotangent hop) and F(s, m)
-    - ONE compute slot per stage per tick: F or B, never both (they are sequential on
-      hardware — allowing both would model a 2x-throughput tick and break bubble and
-      in-flight accounting); one H per tick, uniform across stages (piggybacked)
+    - F(g, m) needs F(g-1, m) at a strictly earlier tick (activation hop at tick end)
+    - H(m) needs F(last_g, m) at the same tick or earlier (broadcast precedes H slot)
+    - B(last_g, m) needs H(m) at the same tick or earlier (H slot precedes B slot)
+    - B(g, m) needs B(g+1, m) strictly earlier (cotangent hop) and F(g, m) same tick
+      or earlier (the F slot runs first and saves the residual)
+    - one F slot and one B slot per device per tick; one H per tick
 
-    Policy per stage: "gpipe" = all forwards first (classic fill/drain);
-    "1f1b" = prefer backward whenever one is ready (PipeDream-flush pattern, bounds
-    in-flight microbatches at ~P instead of M).
+    Policies: "gpipe" = all forwards first (B slots idle during fill — the classic
+    memory-hungry baseline); "1f1b" = backward-eager with a per-device in-flight cap
+    (PipeDream-flush); "interleaved_1f1b" = 1f1b over num_virtual chunks per device.
     """
     if schedule not in SUPPORTED_SCHEDULES:
         raise NotImplementedError(
-            f"pipeline schedule {schedule!r} not supported (have {SUPPORTED_SCHEDULES})"
+            f"pipeline schedule {schedule!r} not supported (have {SUPPORTED_SCHEDULES}; "
+            "reference also ships ZBVZeroBubble/DualPipeV)"
         )
-    P, M = num_stages, num_microbatches
-    f_done = -np.ones((P, M), dtype=np.int64)  # tick when F(s, m) ran
-    b_done = -np.ones((P, M), dtype=np.int64)
+    if schedule != "interleaved_1f1b" and num_virtual != 1:
+        raise ValueError(f"{schedule} requires num_virtual=1 (got {num_virtual})")
+    if schedule == "interleaved_1f1b" and num_virtual < 2:
+        raise ValueError("interleaved_1f1b requires num_virtual >= 2")
+
+    P, M, V = num_stages, num_microbatches, num_virtual
+    G = V * P  # global stages; g's device is g % P, chunk is g // P
+    f_done = -np.ones((G, M), dtype=np.int64)
+    b_done = -np.ones((G, M), dtype=np.int64)
     h_done = -np.ones((M,), dtype=np.int64)
+    last_g = G - 1
+
+    def f_candidate(s: int, t: int):
+        """Ready forward for device s, DEEPEST chunk first (advancing a microbatch
+        toward the last global stage beats starting fresh early-chunk work — the
+        m-major order deadlocks interleaved schedules: every device fills its
+        in-flight cap with chunk-0 microbatches before anything reaches the last
+        stage, so no backward can ever start). Within a chunk, microbatches in order."""
+        for c in range(V - 1, -1, -1):
+            g = c * P + s
+            for m in range(M):
+                if f_done[g, m] >= 0:
+                    continue
+                if g > 0 and not (0 <= f_done[g - 1, m] < t):
+                    continue
+                return g, m
+        return None
+
+    def b_candidate(s: int, t: int):
+        """Lowest-(m, later-chunk-first) ready backward, using only previous-tick
+        state (the simulator picks B slots first so freed residual slots are visible
+        to this tick's F cap; the executor still runs F before B within the tick —
+        all B dependencies here are strictly earlier, so that order is consistent)."""
+        for m in range(M):
+            for c in range(V - 1, -1, -1):  # drain later chunks first (deps point up)
+                g = c * P + s
+                if b_done[g, m] >= 0:
+                    continue
+                if not (0 <= f_done[g, m] < t):
+                    continue
+                if g == last_g:
+                    if not (0 <= h_done[m] < t):
+                        continue
+                elif not (0 <= b_done[g + 1, m] < t):
+                    continue
+                return g, m
+        return None
 
     f_rows, b_rows, h_rows = [], [], []
     t = 0
-    max_ticks = 8 * (M + P) + 16  # safety valve: any sane schedule fits
+    max_ticks = 16 * (V * M + P) + 32
     while (b_done < 0).any() or (h_done < 0).any():
         if t >= max_ticks:
-            raise RuntimeError(f"schedule {schedule} did not converge (P={P}, M={M})")
+            raise RuntimeError(f"schedule {schedule} did not converge (P={P}, M={M}, V={V})")
         f_row = -np.ones(P, dtype=np.int64)
         b_row = -np.ones(P, dtype=np.int64)
 
+        # B slots first in the SIMULATION (their deps are all strictly-earlier), so
+        # the freed residual slots are visible to this tick's F in-flight cap
         for s in range(P):
-            # candidate ops for this stage at this tick
-            fm = next(
-                (
-                    m
-                    for m in range(M)
-                    if f_done[s, m] < 0 and (s == 0 or (0 <= f_done[s - 1, m] < t))
-                ),
-                -1,
-            )
-            if schedule == "1f1b" and fm >= 0:
-                # 1F1B warmup cap: a stage never holds more than P - s microbatches
-                # in flight (the PipeDream-flush memory bound)
-                inflight = int((f_done[s] >= 0).sum() - (b_done[s] >= 0).sum())
-                if inflight >= max(1, P - s):
-                    fm = -1
-            bm = next(
-                (
-                    m
-                    for m in range(M)
-                    if b_done[s, m] < 0
-                    and 0 <= f_done[s, m] < t
-                    and (
-                        (s == P - 1 and 0 <= h_done[m] < t)
-                        or (s < P - 1 and 0 <= b_done[s + 1, m] < t)
-                    )
-                ),
-                -1,
-            )
-            if schedule == "gpipe":
-                # forwards strictly first; backwards once no forward remains
-                if fm >= 0:
-                    f_row[s] = fm
-                elif bm >= 0:
-                    b_row[s] = bm
-            else:  # 1f1b: drain a backward whenever one is ready, else forward
-                if bm >= 0:
-                    b_row[s] = bm
-                elif fm >= 0:
-                    f_row[s] = fm
+            if schedule == "gpipe" and (f_done < 0).any():
+                break
+            cand = b_candidate(s, t)
+            if cand is None:
+                continue
+            g, m = cand
+            b_row[s] = g // P * M + m
+            b_done[g, m] = t
 
+        # F slots
         for s in range(P):
-            if f_row[s] >= 0:
-                f_done[s, f_row[s]] = t
-            if b_row[s] >= 0:
-                b_done[s, b_row[s]] = t
-        # head slot: earliest microbatch whose last-stage forward is done, including
-        # one that completed in THIS tick (executor order: F slots, broadcast, H slot)
-        hm = next(
-            (m for m in range(M) if h_done[m] < 0 and 0 <= f_done[P - 1, m] <= t), -1
-        )
+            cand = f_candidate(s, t)
+            if cand is None:
+                continue
+            g, m = cand
+            if schedule in ("1f1b", "interleaved_1f1b") and g < P:
+                # Warmup cap on STARTING new microbatches (chunk-0 forwards only):
+                # throttling deeper-chunk forwards deadlocks interleaving — every
+                # device fills up before any microbatch reaches the last stage and no
+                # backward can ever run. Advancing started work is always allowed, so
+                # residuals are bounded at ~V * cap per device. The +1 headroom covers
+                # the cotangent hop landing a tick after the upstream backward.
+                # steady state needs ~V*P microbatches in flight to keep all V*P
+                # global stages busy (interleaving trades memory for bubble)
+                started = int((f_done[s] >= 0).sum())
+                drained = int((b_done[s] >= 0).sum())
+                if started - drained >= max(1, V * (P - s)) + 1:
+                    continue
+            f_row[s] = g // P * M + m
+            f_done[g, m] = t
+
+        # H slot: sees this tick's last-stage forward (broadcast precedes it)
+        hm = next((m for m in range(M) if h_done[m] < 0 and 0 <= f_done[last_g, m] <= t), -1)
         if hm >= 0:
             h_done[hm] = t
+
         f_rows.append(f_row)
         b_rows.append(b_row)
         h_rows.append(hm)
@@ -167,34 +209,42 @@ def build_schedule_tables(schedule: str, num_stages: int, num_microbatches: int)
         h=np.asarray(h_rows, dtype=np.int64),
         num_stages=P,
         num_microbatches=M,
+        num_virtual=V,
     )
     _validate(tables)
     return tables
 
 
 def _validate(tb: ScheduleTables) -> None:
-    """Structural correctness: every op exactly once, dependencies strictly ordered."""
-    P, M = tb.num_stages, tb.num_microbatches
-    f_at = -np.ones((P, M), dtype=np.int64)
-    b_at = -np.ones((P, M), dtype=np.int64)
+    """Structural correctness: every op exactly once, dependencies ordered per the
+    executor's in-tick slot order (F -> broadcast -> H -> B -> hops)."""
+    P, M, V = tb.num_stages, tb.num_microbatches, tb.num_virtual
+    G = V * P
+    f_at = -np.ones((G, M), dtype=np.int64)
+    b_at = -np.ones((G, M), dtype=np.int64)
     h_at = -np.ones((M,), dtype=np.int64)
     for t in range(tb.num_ticks):
         for s in range(P):
             if tb.f[t, s] >= 0:
-                assert f_at[s, tb.f[t, s]] < 0, "duplicate forward"
-                f_at[s, tb.f[t, s]] = t
+                c, m = divmod(int(tb.f[t, s]), M)
+                g = c * P + s
+                assert f_at[g, m] < 0, "duplicate forward"
+                f_at[g, m] = t
             if tb.b[t, s] >= 0:
-                assert b_at[s, tb.b[t, s]] < 0, "duplicate backward"
-                b_at[s, tb.b[t, s]] = t
+                c, m = divmod(int(tb.b[t, s]), M)
+                g = c * P + s
+                assert b_at[g, m] < 0, "duplicate backward"
+                b_at[g, m] = t
         if tb.h[t] >= 0:
             assert h_at[tb.h[t]] < 0, "duplicate head op"
             h_at[tb.h[t]] = t
     assert (f_at >= 0).all() and (b_at >= 0).all() and (h_at >= 0).all(), "missing ops"
     for m in range(M):
-        for s in range(1, P):
-            assert f_at[s - 1, m] < f_at[s, m], "forward dependency violated"
-        assert f_at[P - 1, m] <= h_at[m], "head before last forward"
-        assert h_at[m] < b_at[P - 1, m], "last-stage backward before head"
-        for s in range(P - 1):
-            assert b_at[s + 1, m] < b_at[s, m], "backward dependency violated"
-            assert f_at[s, m] < b_at[s, m], "backward before forward"
+        for g in range(1, G):
+            assert f_at[g - 1, m] < f_at[g, m], "forward dependency violated"
+        assert f_at[G - 1, m] <= h_at[m], "head before last forward"
+        assert h_at[m] <= b_at[G - 1, m], "last-stage backward before head"
+        for g in range(G - 1):
+            assert b_at[g + 1, m] < b_at[g, m], "backward dependency violated"
+        for g in range(G):
+            assert f_at[g, m] <= b_at[g, m], "backward before forward"

@@ -55,6 +55,12 @@ def default_logical_axis_rules(mesh_handle: DeviceMeshHandle, sequence_parallel:
         ("head_dim", None),
         ("mlp", tp),
         ("vocab", tp),
+        # LOGITS vocab dim: sharded over tp only when loss parallelism is enabled —
+        # the CE logsumexp/gather then runs on vocab shards with XLA-inserted psums
+        # (the reference lists loss parallel as "planned"; here it is one rule).
+        # Disabled: logits replicate over tp before the loss (DTensor-redistribute
+        # equivalent).
+        ("vocab_logits", tp if getattr(mesh_handle, "enable_loss_parallel", False) else None),
         ("seq_param", None),
         # stacked-block scan axis: sharded over pp so each stage group owns its layers'
         # params (the GSPMD expression of stage-wise parameter placement; the shard_map

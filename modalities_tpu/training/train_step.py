@@ -265,6 +265,7 @@ class TrainStepBuilder:
                     pp_mesh,
                     schedule=model_spec.pp_schedule,
                     num_microbatches=model_spec.pp_num_microbatches,
+                    num_virtual=getattr(model_spec, "pp_num_virtual", 1),
                     rng=dropout_rng if model_dropout > 0.0 else None,
                 )
                 return loss, model.merge_pp_grads(g_stacked, g_shared)

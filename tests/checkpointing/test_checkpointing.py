@@ -165,3 +165,30 @@ def test_warmstart_topology_change_equivalence(tmp_path):
         losses_b.append(float(metrics["loss"]))
 
     np.testing.assert_allclose(losses_a[3:], losses_b, rtol=2e-4, atol=2e-4)
+
+
+def test_async_save_defers_resume_pointer_until_commit(tmp_path):
+    """ADVICE r1: with use_async=True the resume pointer must only ever reference a
+    COMMITTED checkpoint — it is written at the next save (which waits for the
+    previous commit) or at wait_until_finished, never right after save() returns."""
+    mesh = get_device_mesh(device_type="cpu", data_parallel_shard_degree=8, world_size=8)
+    model = tiny_gpt2("pytorch_flash")
+    fns = _builder(model, mesh).build(seed=0)
+    execution = OrbaxCheckpointSaving(tmp_path, experiment_id="async", use_async=True)
+    saving = CheckpointSaving(SaveKMostRecentCheckpointsStrategy(k=2), execution)
+
+    saving.save_checkpoint(_progress(1), fns.app_state_handle)
+    # pointer for save 1 is pending, not yet on disk
+    assert not (tmp_path / "last_checkpoint_info.json").exists()
+    assert execution._pending_info_folder is not None
+
+    saving.save_checkpoint(_progress(2), fns.app_state_handle)
+    # save 2 waited for save 1's commit -> save 1's pointer flushed
+    info = json.loads((tmp_path / "last_checkpoint_info.json").read_text())
+    assert "seen_steps_1-" in info["checkpoint_folder_path"]
+    assert Path(info["checkpoint_folder_path"]).exists()
+
+    saving.wait_until_finished()
+    info = json.loads((tmp_path / "last_checkpoint_info.json").read_text())
+    assert "seen_steps_2-" in info["checkpoint_folder_path"]
+    assert Path(info["checkpoint_folder_path"]).exists()
