@@ -203,6 +203,7 @@ def _run_candidate(cand, iters: int):
 
     tokens_per_step = mb * seq
     tokens_per_sec = tokens_per_step * iters / elapsed
+    on_tpu = dev.platform == "tpu"
 
     n_params = sum(int(np.prod(x.shape)) for x in jax.tree.leaves(state.params))
     # train FLOPs/token ~ 6N + 12*L*s*h (reference mfu.py:178-180 formula)
@@ -223,6 +224,9 @@ def _run_candidate(cand, iters: int):
             "device": dev.device_kind,
             "seq": seq,
             "micro_batch": mb,
+            # CPU fallback line => the TPU claim was unreachable (wedged relay);
+            # the MFU value is a CI placeholder, not a hardware result
+            "tpu_unreachable": not on_tpu,
         },
     }
 

@@ -65,6 +65,11 @@ class OrbaxCheckpointSaving(CheckpointSavingExecutionABC):
         # otherwise a crash mid-commit leaves the pointer referencing a folder that
         # does not exist yet and warmstart fails
         self._pending_info_folder: Path | None = None
+        # last folder the resume pointer was flushed for — tracked on EVERY process
+        # (deterministic in-memory state) so collective-drain decisions never depend
+        # on reading the rank-0-written pointer file (stale shared-fs reads would let
+        # ranks diverge and deadlock in the Orbax commit barrier)
+        self._last_info_folder: Path | None = None
 
     def _get_checkpointer(self):
         # StandardCheckpointer is async under the hood (background commit thread);
@@ -94,6 +99,7 @@ class OrbaxCheckpointSaving(CheckpointSavingExecutionABC):
         logger.info("Checkpoint saved.")
 
     def _write_info(self, folder: Path) -> None:
+        self._last_info_folder = folder  # every process tracks this (see __init__)
         if _process_index() != 0:
             return
         info = {"checkpoint_folder_path": str(folder.absolute())}
@@ -109,19 +115,14 @@ class OrbaxCheckpointSaving(CheckpointSavingExecutionABC):
 
     def _delete_checkpoint(self, training_progress: TrainingProgress) -> None:
         folder = checkpoint_folder_path(self.checkpoint_path, self.experiment_id, training_progress)
-        # deleting the folder the on-disk resume pointer still references (k=1 ring
-        # with use_async: the deferred pointer was just flushed to folder N-1 and the
-        # ring now deletes N-1) would leave a dangling pointer for a whole interval:
-        # drain the in-flight commit so the pointer advances to the newest folder
-        # first. The drain runs on EVERY process (Orbax commits are collective).
-        if self.use_async:
-            info_path = self.checkpoint_path / LAST_CHECKPOINT_INFO_FILE_NAME
-            try:
-                current = json.loads(info_path.read_text())["checkpoint_folder_path"]
-            except (OSError, ValueError, KeyError):
-                current = None
-            if current == str(folder.absolute()):
-                self.wait_until_finished()
+        # deleting the folder the resume pointer still references (k=1 ring with
+        # use_async: the deferred pointer was just flushed to folder N-1 and the ring
+        # now deletes N-1) would leave a dangling pointer for a whole interval: drain
+        # the in-flight commit so the pointer advances to the newest folder first.
+        # Decision uses in-memory state identical on all ranks; the drain then runs
+        # on EVERY process (Orbax commits are collective).
+        if self.use_async and self._last_info_folder is not None and self._last_info_folder == folder:
+            self.wait_until_finished()
         if _process_index() != 0:
             return
         if not folder.exists():

@@ -62,6 +62,14 @@ class Gym:
             )
         finally:
             # drain async checkpoint commits (and flush the deferred resume pointer)
-            # before the process can exit
+            # before the process can exit; never let a wedged/failing drain mask the
+            # original training exception
             if checkpoint_saving is not None and hasattr(checkpoint_saving, "wait_until_finished"):
-                checkpoint_saving.wait_until_finished()
+                try:
+                    checkpoint_saving.wait_until_finished()
+                except Exception:  # noqa: BLE001
+                    import logging
+
+                    logging.getLogger(__name__).exception(
+                        "draining async checkpoint saves failed during shutdown"
+                    )
