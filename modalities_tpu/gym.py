@@ -8,6 +8,9 @@ from modalities_tpu.evaluator import Evaluator
 from modalities_tpu.trainer import Trainer
 from modalities_tpu.training.train_step import StepFunctions
 from modalities_tpu.training.training_progress import TrainingProgress
+from modalities_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
 
 
 class Gym:
@@ -52,6 +55,7 @@ class Gym:
                     app_state_handle=step_functions.app_state_handle,
                 )
 
+        training_succeeded = False
         try:
             self.trainer.train(
                 step_functions=step_functions,
@@ -60,16 +64,16 @@ class Gym:
                 evaluation_callback=evaluation_callback,
                 checkpointing_callback=checkpointing_callback,
             )
+            training_succeeded = True
         finally:
             # drain async checkpoint commits (and flush the deferred resume pointer)
-            # before the process can exit; never let a wedged/failing drain mask the
-            # original training exception
+            # before the process can exit. A failing drain must not mask an in-flight
+            # training exception — but after a SUCCESSFUL run it must fail loudly
+            # (exit 0 with a lost final checkpoint would silently break warmstart).
             if checkpoint_saving is not None and hasattr(checkpoint_saving, "wait_until_finished"):
                 try:
                     checkpoint_saving.wait_until_finished()
                 except Exception:  # noqa: BLE001
-                    import logging
-
-                    logging.getLogger(__name__).exception(
-                        "draining async checkpoint saves failed during shutdown"
-                    )
+                    logger.exception("draining async checkpoint saves failed during shutdown")
+                    if training_succeeded:
+                        raise
