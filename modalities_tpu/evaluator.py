@@ -35,7 +35,7 @@ class Evaluator:
             num_samples = 0
             for batch_id, batch in enumerate(data_loader):
                 device_batch = step_functions.put_batch(
-                    {"samples": batch.samples, "targets": batch.targets}
+                    {"samples": batch.samples, "targets": batch.targets}, has_acc_dim=False
                 )
                 metrics = step_functions.eval_step(state, device_batch)
                 losses.append(metrics["loss"])

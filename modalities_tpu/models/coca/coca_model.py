@@ -19,6 +19,7 @@ import flax.linen as nn
 import jax.numpy as jnp
 from pydantic import BaseModel, Field
 
+from modalities_tpu.dataloader.collate_fns.collate_if import CollateFnIF
 from modalities_tpu.models.model import NNModel
 from modalities_tpu.models.vision_transformer.vision_transformer_model import (
     VisionTransformerConfig,
@@ -261,7 +262,7 @@ class CoCa(NNModel):
         }
 
 
-class CoCaCollateFn:
+class CoCaCollateFn(CollateFnIF):
     """Collator for (image, text) pairs (reference: models/coca/collator.py)."""
 
     def __init__(self, sample_keys: list[str], target_keys: list[str], text_sample_key: str, text_target_key: str):

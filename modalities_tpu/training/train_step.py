@@ -395,23 +395,35 @@ class TrainStepBuilder:
 
         Single-process: device_put with the batch sharding. Multi-host: each process
         contributes the rows its devices own (jax.make_array_from_process_local_data).
-        Leading accumulation dim (if any) is replicated; batch dim is sharded.
+
+        `has_acc_dim` is explicit because it cannot be inferred from ndim: the Trainer
+        always stacks a leading gradient-accumulation dim (trainer.py), the Evaluator
+        and eval-profiler never do — and multimodal leaves (images [.., H, W, C]) make
+        ndim ambiguous. Only the token sequence dim (directly after batch) takes the
+        cp axis; all trailing feature dims stay unsharded.
         """
 
-        def put(batch_dict: dict) -> dict:
+        def put(batch_dict: dict, has_acc_dim: bool = True) -> dict:
             if data_sharding is None:
                 return jax.tree.map(jnp.asarray, batch_dict)
 
             import jax.sharding as js
 
+            spec = tuple(data_sharding.spec)
+            batch_axes = spec[0]
+            seq_axis = spec[1] if len(spec) > 1 else None
+
             def put_leaf(x):
                 x = np.asarray(x)
-                # sharding spec is for (batch, seq); with accumulation dim prepend None
-                spec = data_sharding.spec
-                if x.ndim == 3:  # (acc, batch, seq)
-                    full = js.NamedSharding(data_sharding.mesh, js.PartitionSpec(None, *spec))
+                lead = (None,) if has_acc_dim else ()
+                data_dims = x.ndim - len(lead) - 1  # dims after the batch dim
+                if data_dims == 1:  # tokens [.., batch, seq]: seq shards over cp
+                    tail = (seq_axis,)
                 else:
-                    full = data_sharding
+                    tail = (None,) * data_dims
+                full = js.NamedSharding(
+                    data_sharding.mesh, js.PartitionSpec(*lead, batch_axes, *tail)
+                )
                 if jax.process_count() == 1:
                     return jax.device_put(x, full)
                 return jax.make_array_from_process_local_data(full, x)

@@ -63,6 +63,6 @@ class SteppableForwardPass(SteppableComponentIF):
                 "samples": {k: v[0] for k, v in raw["samples"].items()},
                 "targets": {k: v[0] for k, v in raw["targets"].items()},
             }
-            batch = self.step_functions.put_batch(flat)
+            batch = self.step_functions.put_batch(flat, has_acc_dim=False)
             metrics = self.step_functions.eval_step(handle.state, batch)
             jax.block_until_ready(metrics["loss"])

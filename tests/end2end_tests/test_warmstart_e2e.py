@@ -54,3 +54,17 @@ def test_warmstart_pp_tp_to_dp_continues_training(workdir):  # noqa: F811
     assert train2[-1]["metrics"]["consumed tokens"] == 8192 + 4 * 4096
     assert train2[-1]["losses"]["train loss avg"] < phase1_last_loss
     assert all(np.isfinite(r["losses"]["train loss avg"]) for r in train2)
+
+
+def test_coca_example_config_trains(workdir):  # noqa: F811
+    """The CoCa multimodal example config (reference config_example_coca.yaml) runs
+    through the full app: dummy image+text data, CoCa collator, ViT+decoders, real
+    checkpointing — the multimodal counterpart of the GPT2 e2e run."""
+    coca_config = Path(__file__).parent.parent.parent / "configs" / "config_example_coca_tpu.yaml"
+    lines = _run(coca_config, "coca", workdir)
+    train = [r for r in lines if r["dataloader_tag"] == "train"]
+    assert train[-1]["num_train_steps_done"] == 8
+    losses = [r["losses"]["train loss avg"] for r in train]
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0]
+    assert any("seen_steps_8-" in p.name for p in (workdir / "data" / "checkpoints").iterdir())
