@@ -70,7 +70,9 @@ class StepFunctions:
 
     train_step: Callable[[AppState, Any], tuple[AppState, dict]]
     eval_step: Callable[[AppState, Any], dict]
-    put_batch: Callable[[dict], dict]
+    # put_batch(batch_dict, has_acc_dim=True): pass has_acc_dim=False for flat
+    # (batch, ...) eval batches without the leading gradient-accumulation dim
+    put_batch: Callable[..., dict]
     app_state_handle: AppStateHandle
     mesh_handle: DeviceMeshHandle
     # debugging_enriched: same step but with grads in metrics — used by the Trainer
@@ -399,9 +401,18 @@ class TrainStepBuilder:
         `has_acc_dim` is explicit because it cannot be inferred from ndim: the Trainer
         always stacks a leading gradient-accumulation dim (trainer.py), the Evaluator
         and eval-profiler never do — and multimodal leaves (images [.., H, W, C]) make
-        ndim ambiguous. Only the token sequence dim (directly after batch) takes the
-        cp axis; all trailing feature dims stay unsharded.
+        ndim ambiguous. Only the KNOWN token leaves (the model's sample key and the
+        loss's target key) take the cp axis on their sequence dim; every other leaf
+        keeps all trailing dims unsharded.
         """
+        seq_sharded_keys = {
+            k
+            for k in (
+                getattr(self.model, "sample_key", None),
+                getattr(self.loss_fn, "target_key", None),
+            )
+            if k is not None
+        }
 
         def put(batch_dict: dict, has_acc_dim: bool = True) -> dict:
             if data_sharding is None:
@@ -413,14 +424,14 @@ class TrainStepBuilder:
             batch_axes = spec[0]
             seq_axis = spec[1] if len(spec) > 1 else None
 
-            def put_leaf(x):
+            def put_leaf(path, x):
                 x = np.asarray(x)
+                leaf_key = getattr(path[-1], "key", None) if path else None
                 lead = (None,) if has_acc_dim else ()
                 data_dims = x.ndim - len(lead) - 1  # dims after the batch dim
-                if data_dims == 1:  # tokens [.., batch, seq]: seq shards over cp
-                    tail = (seq_axis,)
-                else:
-                    tail = (None,) * data_dims
+                tail = [None] * data_dims
+                if leaf_key in seq_sharded_keys and data_dims == 1:
+                    tail[0] = seq_axis  # tokens [.., batch, seq]: seq shards over cp
                 full = js.NamedSharding(
                     data_sharding.mesh, js.PartitionSpec(*lead, batch_axes, *tail)
                 )
@@ -428,6 +439,6 @@ class TrainStepBuilder:
                     return jax.device_put(x, full)
                 return jax.make_array_from_process_local_data(full, x)
 
-            return jax.tree.map(put_leaf, batch_dict)
+            return jax.tree_util.tree_map_with_path(put_leaf, batch_dict)
 
         return put

@@ -1,0 +1,110 @@
+"""A from-scratch transformer written almost entirely with jnp.einsum — the TPU
+counterpart of the reference's einsum_transformer tutorial (a teaching model that
+makes every tensor contraction explicit) — registered as a CUSTOM component through
+the library-extension hook (Main.add_custom_component), exactly like a user extending
+the framework with their own architecture.
+
+Every contraction spells out its index equation:
+    b = batch, s/t = sequence, d = model dim, h = heads, k = head dim, f = ffn dim,
+    v = vocab
+"""
+
+from __future__ import annotations
+
+import math
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from pydantic import BaseModel, Field
+
+from modalities_tpu.models.model import NNModel
+
+
+class EinsumTransformerConfig(BaseModel):
+    sample_key: str
+    prediction_key: str
+    vocab_size: int = Field(ge=1)
+    sequence_length: int = Field(ge=1)
+    n_layer: int = Field(ge=1)
+    n_head: int = Field(ge=1)
+    n_embd: int = Field(ge=1)
+    ffn_hidden: int = Field(ge=1)
+
+
+class _EinsumBlock(nn.Module):
+    n_head: int
+    n_embd: int
+    ffn_hidden: int
+
+    @nn.compact
+    def __call__(self, x):
+        b, s, d = x.shape
+        h = self.n_head
+        k = d // h
+
+        # ---- attention, one einsum per contraction -------------------------
+        w_qkv = self.param("w_qkv", nn.initializers.normal(0.02), (3, d, h, k))
+        xn = nn.RMSNorm(name="attn_norm")(x)
+        q, key, val = jnp.einsum("bsd,cdhk->cbshk", xn, w_qkv)
+        logits = jnp.einsum("bshk,bthk->bhst", q, key) / math.sqrt(k)
+        causal = jnp.tril(jnp.ones((s, s), dtype=bool))
+        logits = jnp.where(causal[None, None], logits, jnp.finfo(logits.dtype).min)
+        probs = jax.nn.softmax(logits, axis=-1)
+        ctx = jnp.einsum("bhst,bthk->bshk", probs, val)
+        w_out = self.param("w_out", nn.initializers.normal(0.02), (h, k, d))
+        x = x + jnp.einsum("bshk,hkd->bsd", ctx, w_out)
+
+        # ---- ffn -----------------------------------------------------------
+        w_up = self.param("w_up", nn.initializers.normal(0.02), (d, self.ffn_hidden))
+        w_down = self.param("w_down", nn.initializers.normal(0.02), (self.ffn_hidden, d))
+        xn2 = nn.RMSNorm(name="ffn_norm")(x)
+        hbf = jax.nn.gelu(jnp.einsum("bsd,df->bsf", xn2, w_up))
+        return x + jnp.einsum("bsf,fd->bsd", hbf, w_down)
+
+
+class _EinsumModule(nn.Module):
+    cfg: EinsumTransformerConfig
+
+    @nn.compact
+    def __call__(self, input_ids):
+        cfg = self.cfg
+        wte = self.param("wte", nn.initializers.normal(0.02), (cfg.vocab_size, cfg.n_embd))
+        x = jnp.take(wte, input_ids, axis=0)
+        for i in range(cfg.n_layer):
+            x = _EinsumBlock(cfg.n_head, cfg.n_embd, cfg.ffn_hidden, name=f"block_{i}")(x)
+        x = nn.RMSNorm(name="final_norm")(x)
+        # tied head: logits share the embedding table
+        return jnp.einsum("bsd,vd->bsv", x, wte)
+
+
+class EinsumTransformer(NNModel):
+    """NNModel wrapper so the component factory, optimizer and train step treat the
+    tutorial model exactly like a built-in one."""
+
+    def __init__(self, **kwargs):
+        cfg = EinsumTransformerConfig(**kwargs)
+        super().__init__(
+            sample_key=cfg.sample_key,
+            prediction_key=cfg.prediction_key,
+            weight_decay_groups={
+                "linear": [r".*(w_qkv|w_out|w_up|w_down).*"],
+                "embedding": [r".*wte.*"],
+                "norm": [r".*norm.*"],
+            },
+        )
+        self.cfg = cfg
+        self.sequence_length = cfg.sequence_length
+        self.vocab_size = cfg.vocab_size
+
+    @property
+    def module(self) -> _EinsumModule:
+        return _EinsumModule(self.cfg)
+
+    def init_params(self, rng):
+        dummy = jnp.zeros((1, min(8, self.sequence_length)), dtype=jnp.int32)
+        return self.module.init(rng, dummy)
+
+    def apply(self, params, inputs: dict, train: bool = False, rngs=None) -> dict:
+        logits = self.module.apply(params, inputs[self.sample_key], rngs=rngs)
+        return {self.prediction_key: logits}
