@@ -289,6 +289,16 @@ def entry_point_list_remaining_runs(sweep_dir: Path, skip_oom_configs: bool) -> 
     click.echo(json.dumps(status, indent=2, default=str))
 
 
+@benchmark.command(name="summarize_results")
+@click.option("--sweep_dir", type=click.Path(exists=True, path_type=Path), required=True)
+@_exception_handling
+def entry_point_summarize_results(sweep_dir: Path) -> None:
+    """Perf grid across a sweep: peak/last tokens-per-s, MFU, final loss per run."""
+    from modalities_tpu.utils.benchmarking.benchmarking_utils import summarize_sweep_results
+
+    click.echo(json.dumps(summarize_sweep_results(sweep_dir), indent=2, default=str))
+
+
 # ------------------------------------------------------------------------ profile
 
 

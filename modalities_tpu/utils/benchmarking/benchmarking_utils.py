@@ -35,6 +35,51 @@ def _died_with_oom(run_dir: Path) -> bool:
     return False
 
 
+def _iter_train_records(run_dir: Path) -> list[dict]:
+    """All train-tagged result records under a run dir. Malformed lines (a run
+    killed mid-write leaves a truncated tail) are skipped, not fatal."""
+    records: list[dict] = []
+    for rf in run_dir.rglob("evaluation_results.jsonl"):
+        for line in rf.read_text().splitlines():
+            if not line.strip():
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if rec.get("dataloader_tag") == "train":
+                records.append(rec)
+    return records
+
+
+def summarize_sweep_results(sweep_dir: Path) -> list[dict]:
+    """Perf summary across a sweep (the scaling-experiments grid workflow,
+    reference docs/scaling_experiments): for every run with results, report the
+    peak and last tokens/s and MFU plus the final train loss, sorted by tokens/s."""
+    rows: list[dict] = []
+    for config_path in sorted(Path(sweep_dir).rglob("config.yaml")):
+        run_dir = config_path.parent
+        records = _iter_train_records(run_dir)
+        if not records:
+            continue
+        tps = [r["throughput_metrics"].get("tokens/s") for r in records]
+        tps = [t for t in tps if t is not None]
+        mfu = [r["throughput_metrics"].get("MFU") for r in records]
+        mfu = [m for m in mfu if m is not None]
+        rows.append(
+            {
+                "run": str(run_dir),
+                "steps_logged": len(records),
+                "peak_tokens_per_s": max(tps) if tps else None,
+                "last_tokens_per_s": tps[-1] if tps else None,
+                "peak_mfu": max(mfu) if mfu else None,
+                "final_train_loss": records[-1]["losses"].get("train loss avg"),
+            }
+        )
+    rows.sort(key=lambda r: -(r["peak_tokens_per_s"] or 0.0))
+    return rows
+
+
 def get_updated_sweep_status(sweep_dir: Path, skip_oom_configs: bool = False) -> dict:
     sweep_dir = Path(sweep_dir)
     status: dict[str, list[str]] = {"done": [], "failed": [], "remaining": [], "skipped_oom": []}
@@ -43,14 +88,7 @@ def get_updated_sweep_status(sweep_dir: Path, skip_oom_configs: bool = False) ->
         with open(config_path) as f:
             config = yaml.safe_load(f)
         expected = _expected_log_lines(config)
-        results_files = list(run_dir.rglob("evaluation_results.jsonl"))
-        logged = 0
-        for rf in results_files:
-            logged += sum(
-                1
-                for line in rf.read_text().splitlines()
-                if line.strip() and json.loads(line).get("dataloader_tag") == "train"
-            )
+        logged = len(_iter_train_records(run_dir))
         if expected > 0 and logged >= expected:
             status["done"].append(str(run_dir))
         elif skip_oom_configs and _died_with_oom(run_dir):

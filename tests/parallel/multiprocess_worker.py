@@ -23,11 +23,12 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
 import numpy as np  # noqa: E402
 
 
 def build_and_step(local_rows_slice):
-    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
     from modalities_tpu.loss_functions import CLMCrossEntropyLoss
     from modalities_tpu.optimizers.optimizer_factory import OptimizerFactory
     from modalities_tpu.running_env.device_mesh import get_data_loading_info, get_device_mesh
@@ -77,6 +78,14 @@ def main() -> None:
         coordinator_address=f"127.0.0.1:{port}", num_processes=nprocs, process_id=pid
     )
     assert jax.process_count() == nprocs, jax.process_count()
+
+    # the --test_comm pre-flight: rank-stamped all_gather across BOTH processes'
+    # devices (the multi-host tier of utils/communication_test.py, SURVEY §5.8)
+    from modalities_tpu.utils.communication_test import run_communication_test
+
+    run_communication_test()
+    print("COMM OK", flush=True)
+
     loss = build_and_step(local_rows_slice=True)
     print(f"LOSS {loss:.6f}", flush=True)
 

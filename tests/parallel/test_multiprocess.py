@@ -55,6 +55,7 @@ def test_two_process_put_batch_matches_single_process():
     for p in procs:
         out, err = p.communicate(timeout=600)
         assert p.returncode == 0, err[-3000:]
+        assert "COMM OK" in out, f"multi-process communication test failed:\n{out}"
         outs.append(_parse_loss(out))
 
     # every process reports the same global loss, equal to the single-process oracle:

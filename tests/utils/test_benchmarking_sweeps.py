@@ -1,0 +1,90 @@
+"""Benchmark sweep tooling: cartesian expansion, status classification, and the
+perf-grid summary (reference utils/benchmarking + docs/scaling_experiments workflow)."""
+
+import json
+
+import yaml
+
+from modalities_tpu.utils.benchmarking.benchmarking_utils import (
+    get_updated_sweep_status,
+    summarize_sweep_results,
+)
+from modalities_tpu.utils.benchmarking.sweep_utils import SweepGenerator
+
+
+def _make_sweep(tmp_path):
+    sweep_cfg = tmp_path / "sweep.yaml"
+    sweep_cfg.write_text(
+        yaml.safe_dump(
+            {
+                "sweep": {"mbs": [2, 4], "world_size": [8]},
+                "settings": {
+                    "step_profile": {"local_train_micro_batch_size": "${sweep.mbs}"},
+                    "training_target": {"num_target_steps": 4},
+                    "training_progress": {"num_seen_steps": 0},
+                    "intervals": {"training_log_interval_in_steps": 2},
+                },
+            }
+        )
+    )
+    out = tmp_path / "sweep_out"
+    return SweepGenerator.generate_sweep_configs(sweep_cfg, out), out
+
+
+def test_sweep_expansion_and_substitution(tmp_path):
+    written, out = _make_sweep(tmp_path)
+    assert len(written) == 2  # 2 mbs x 1 world_size
+    cfgs = [yaml.safe_load(p.read_text()) for p in written]
+    mbs = sorted(c["settings"]["step_profile"]["local_train_micro_batch_size"] for c in cfgs)
+    assert mbs == [2, 4]
+    assert all("world_size_8" in str(p) for p in written)
+
+
+def _write_results(run_dir, records):
+    (run_dir / "evaluation_results.jsonl").write_text(
+        "\n".join(json.dumps(r) for r in records)
+    )
+
+
+def _train_record(step, tps, mfu, loss):
+    return {
+        "dataloader_tag": "train",
+        "num_train_steps_done": step,
+        "losses": {"train loss avg": loss},
+        "metrics": {},
+        "throughput_metrics": {"tokens/s": tps, "MFU": mfu},
+    }
+
+
+def test_sweep_status_and_summary(tmp_path):
+    written, out = _make_sweep(tmp_path)
+    done_dir, failed_dir = written[0].parent, written[1].parent
+    # run 1: both expected log lines present (4 steps / interval 2)
+    _write_results(done_dir, [_train_record(2, 1000.0, 0.3, 5.0), _train_record(4, 1200.0, 0.35, 4.0)])
+    # run 2: died after one interval
+    _write_results(failed_dir, [_train_record(2, 800.0, 0.2, 5.5)])
+
+    status = get_updated_sweep_status(out)
+    assert str(done_dir) in status["done"]
+    assert str(failed_dir) in status["failed"]
+    assert status["remaining"] == []
+
+    summary = summarize_sweep_results(out)
+    assert len(summary) == 2
+    # sorted by peak tokens/s descending; fields extracted correctly
+    assert summary[0]["run"] == str(done_dir)
+    assert summary[0]["peak_tokens_per_s"] == 1200.0
+    assert summary[0]["peak_mfu"] == 0.35
+    assert summary[0]["final_train_loss"] == 4.0
+    assert summary[1]["peak_tokens_per_s"] == 800.0
+
+
+def test_sweep_status_skip_oom(tmp_path):
+    written, out = _make_sweep(tmp_path)
+    oom_dir = written[0].parent
+    _write_results(oom_dir, [_train_record(2, 100.0, 0.1, 6.0)])
+    (oom_dir / "error_rank_0.json").write_text(
+        json.dumps({"error": "...", "stacktrace": "RESOURCE_EXHAUSTED: out of memory"})
+    )
+    status = get_updated_sweep_status(out, skip_oom_configs=True)
+    assert str(oom_dir) in status["skipped_oom"]
