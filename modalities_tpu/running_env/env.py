@@ -19,7 +19,13 @@ logger = get_logger(__name__)
 
 
 class TpuEnv:
-    """Context manager for the distributed runtime (CudaEnv equivalent)."""
+    """Context manager for the distributed runtime (CudaEnv equivalent).
+
+    Also enables JAX's persistent compilation cache (XLA first-compiles of a large
+    train step run 20-40 s+; restarts and warmstarts then reuse the compiled
+    program). Default cache dir ``~/.cache/modalities_tpu_xla``; override with
+    ``MODALITIES_TPU_COMPILATION_CACHE`` (empty string disables).
+    """
 
     def __init__(self, process_group_backend: Optional[str] = None, timeout_s: int = 600):
         # backend arg accepted for config parity; collectives are XLA's
@@ -29,6 +35,16 @@ class TpuEnv:
 
     def __enter__(self) -> "TpuEnv":
         import jax
+
+        cache_dir = os.environ.get(
+            "MODALITIES_TPU_COMPILATION_CACHE",
+            os.path.join(os.path.expanduser("~"), ".cache", "modalities_tpu_xla"),
+        )
+        if cache_dir:
+            try:
+                jax.config.update("jax_compilation_cache_dir", cache_dir)
+            except Exception:  # older jaxlib without the knob: run uncached
+                logger.warning("persistent compilation cache unavailable; continuing without")
 
         coordinator = os.environ.get("JAX_COORDINATOR_ADDRESS") or os.environ.get("COORDINATOR_ADDRESS")
         num_processes = os.environ.get("JAX_NUM_PROCESSES") or os.environ.get("NNODES")
