@@ -121,3 +121,23 @@ def test_gpt2_config_rejects_mxu_unaligned_dims():
     GPT2LLMConfig(**base)  # aligned passes
     with pytest.raises(Exception, match="divisible by 128"):
         GPT2LLMConfig(**{**base, "ffn_hidden": 120})
+
+
+def test_jsonpath_jq_subset():
+    """The native jq replacement must cover the dot-path grammar configs use and
+    reject what it cannot parse (silent mis-extraction would corrupt packed data)."""
+    import json
+
+    from modalities_tpu.utils.jsonpath import JQPatternError, compile_pattern
+
+    line = json.dumps(
+        {"text": "hello", "meta": {"content": "deep", "k-ey": "dash"},
+         "choices": [{"t": "a"}, {"t": "b"}]}
+    )
+    assert compile_pattern(".text")(line) == "hello"
+    assert compile_pattern(".meta.content")(line) == "deep"
+    assert compile_pattern(".choices[1].t")(line) == "b"
+    assert compile_pattern('.meta["k-ey"]')(line) == "dash"
+    assert compile_pattern(".")(line)["text"] == "hello"
+    with pytest.raises(JQPatternError):
+        compile_pattern(".text | ascii_downcase")

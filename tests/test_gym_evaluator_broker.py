@@ -1,0 +1,122 @@
+"""Mock-based orchestration tests (reference tier 2: tests/test_gym.py,
+test_evaluator.py, logging_broker tests — logic without device work)."""
+
+from types import SimpleNamespace
+
+import numpy as np
+
+from modalities_tpu.batch import DatasetBatch
+from modalities_tpu.evaluator import Evaluator
+from modalities_tpu.gym import Gym
+from modalities_tpu.logging_broker.message_broker import MessageBroker
+from modalities_tpu.logging_broker.messages import Message, MessageTypes
+from modalities_tpu.logging_broker.publisher import MessagePublisher
+
+
+class _Recorder:
+    def __init__(self):
+        self.messages = []
+
+    def consume_message(self, message: Message):
+        self.messages.append(message)
+
+
+def test_broker_routes_by_message_type_only():
+    broker = MessageBroker()
+    progress, results = _Recorder(), _Recorder()
+    broker.add_subscriber(MessageTypes.BATCH_PROGRESS_UPDATE, progress)
+    broker.add_subscriber(MessageTypes.EVALUATION_RESULT, results)
+    pub = MessagePublisher(broker)
+    pub.publish_message("p1", MessageTypes.BATCH_PROGRESS_UPDATE)
+    pub.publish_message("r1", MessageTypes.EVALUATION_RESULT)
+    pub.publish_message("p2", MessageTypes.BATCH_PROGRESS_UPDATE)
+    assert [m.payload for m in progress.messages] == ["p1", "p2"]
+    assert [m.payload for m in results.messages] == ["r1"]
+
+
+class _FakeLoader:
+    dataloader_tag = "val"
+
+    def __init__(self, batches):
+        self._batches = batches
+
+    def __iter__(self):
+        return iter(self._batches)
+
+    def __len__(self):
+        return len(self._batches)
+
+
+def _fake_step_functions(losses):
+    it = iter(losses)
+    return SimpleNamespace(
+        app_state_handle=SimpleNamespace(state="state"),
+        put_batch=lambda batch, has_acc_dim=True: batch,
+        eval_step=lambda state, batch: {"loss": next(it)},
+    )
+
+
+def test_evaluator_aggregates_and_publishes():
+    broker = MessageBroker()
+    results = _Recorder()
+    broker.add_subscriber(MessageTypes.EVALUATION_RESULT, results)
+    pub = MessagePublisher(broker)
+    evaluator = Evaluator(progress_publisher=pub, evaluation_result_publisher=pub)
+
+    batches = [
+        DatasetBatch(samples={"input_ids": np.zeros((2, 4))}, targets={"target_ids": np.zeros((2, 4))})
+        for _ in range(3)
+    ]
+    fns = _fake_step_functions([2.0, 4.0, 6.0])
+    out = evaluator.evaluate(fns, [_FakeLoader(batches)], num_train_steps_done=7)
+
+    result = out["val"]
+    assert result.num_train_steps_done == 7
+    assert result.losses["loss avg"].value == 4.0  # mean of 2, 4, 6
+    assert len(results.messages) == 1
+    assert results.messages[0].payload is result
+
+
+def test_gym_fires_callbacks_at_intervals():
+    """Gym wires interval gating: eval at 0 and every k steps, checkpoint every k."""
+    eval_calls, ckpt_calls = [], []
+
+    class _FakeTrainer:
+        def train(self, step_functions, train_loader, training_progress,
+                  evaluation_callback, checkpointing_callback):
+            evaluation_callback(0)  # the step "-1" initial eval
+            for step in range(1, 9):
+                training_progress.num_seen_steps_current_run += 1
+                evaluation_callback(step)
+                checkpointing_callback(training_progress)
+
+    class _FakeEvaluator:
+        def evaluate(self, step_functions, data_loaders, num_train_steps_done):
+            eval_calls.append(num_train_steps_done)
+            return {}
+
+    class _FakeSaving:
+        def save_checkpoint(self, training_progress, app_state_handle):
+            ckpt_calls.append(training_progress.num_seen_steps_total)
+
+        def wait_until_finished(self):
+            pass
+
+    from modalities_tpu.training.training_progress import TrainingProgress
+
+    progress = TrainingProgress(
+        num_seen_steps_current_run=0, num_seen_tokens_current_run=0,
+        num_target_steps=8, num_target_tokens=0,
+    )
+    gym = Gym(trainer=_FakeTrainer(), evaluator=_FakeEvaluator())
+    gym.run(
+        step_functions=SimpleNamespace(app_state_handle=None),
+        train_data_loader=_FakeLoader([]),
+        evaluation_data_loaders=[_FakeLoader([])],
+        checkpoint_saving=_FakeSaving(),
+        training_progress=progress,
+        evaluation_interval_in_steps=4,
+        checkpointing_interval_in_steps=2,
+    )
+    assert eval_calls == [0, 4, 8]
+    assert ckpt_calls == [2, 4, 6, 8]
