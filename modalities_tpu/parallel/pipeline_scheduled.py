@@ -275,12 +275,36 @@ def _scheduled_local(stacked_chunked, shared, tokens_mb, targets_mb, *, stage_fn
         hm = h_tab[t]
         hm_c = jnp.clip(hm, 0, M - 1)
 
-        # ---- F slot (uniform compute; masked writes) --------------------------
-        x0 = embed(shared, tokens_mb[m_f], embed_rng(m_f))
+        # Idle slots are genuinely idle: each slot runs under lax.cond so warmup/
+        # drain ticks cost one compute unit, not three, and the (vocab-sized) head
+        # runs only on its M scheduled ticks. INVARIANT for every cond predicate
+        # here: it must be uniform within every non-pp mesh axis group (f/b/h vary
+        # only along pp via the static tables) — tp/dp stay AUTO axes, so GSPMD
+        # inserts tp collectives inside the branches, and a predicate varying within
+        # a tp/dp group would deadlock those collectives on real hardware. The pp
+        # hops (psum/ppermute) stay outside the conds, executed uniformly each tick.
+
+        # ---- F slot -----------------------------------------------------------
         is_first_stage = (stage == 0) & (c_f == 0)
         f_slot = slot_of[c_f * M + m_f]
-        x_in = jnp.where(is_first_stage, x0, abuf[f_slot])
-        y = blocks_fwd(stacked_local, c_f, x_in, m_f)
+
+        def run_f(_):
+            # the embedding is only this device's input at global stage 0 chunk 0 —
+            # every other stage reads the received activation; gate it so the vocab
+            # gather isn't computed and discarded on P*V-1 of the stages
+            x_in = jax.lax.cond(
+                is_first_stage,
+                lambda _: embed(shared, tokens_mb[m_f], embed_rng(m_f)).astype(compute_dtype),
+                lambda _: abuf[f_slot],
+                None,
+            )
+            return x_in, blocks_fwd(stacked_local, c_f, x_in, m_f)
+
+        def skip_f(_):
+            z = jnp.zeros(x_shape.shape, compute_dtype)
+            return z, z
+
+        x_in, y = jax.lax.cond(f_valid, run_f, skip_f, None)
         xbuf = _buf_set(xbuf, f_slot, x_in, f_valid)
 
         # broadcast the last GLOBAL stage's fresh output for the (uniform) head slot
@@ -292,16 +316,37 @@ def _scheduled_local(stacked_chunked, shared, tokens_mb, targets_mb, *, stage_fn
         )
         ybuf = _buf_set(ybuf, y_slot_of[m_last], y_bc.astype(compute_dtype), is_final_output)
 
-        # ---- H slot: head + loss fwd/bwd, redundantly on every stage ----------
-        loss_h, head_pull, w_h = jax.vjp(
-            lambda sh, xx: head_loss(sh, xx, targets_mb[hm_c]),
-            shared,
-            ybuf[y_slot_of[hm_c]],
-            has_aux=True,
-        )
-        # seed with the microbatch's token weight: grads accumulate d(sum of token
-        # losses); dividing by the total weight at the end gives the global mean
-        g_shared_h, g_y_head = head_pull(w_h.astype(loss_h.dtype))
+        # ---- H slot: head + loss fwd/bwd, redundantly on every stage (the hm
+        # predicate is UNIFORM across devices — same static table entry) ----------
+        def run_h(_):
+            loss_h, head_pull, w_h = jax.vjp(
+                lambda sh, xx: head_loss(sh, xx, targets_mb[hm_c]),
+                shared,
+                ybuf[y_slot_of[hm_c]],
+                has_aux=True,
+            )
+            # seed with the microbatch's token weight: grads accumulate d(sum of
+            # token losses); dividing by the total weight at the end gives the
+            # global mean
+            g_shared_h, g_y_head = head_pull(w_h.astype(loss_h.dtype))
+            # carries are f32; cast so a bf16-returning head_loss still matches the
+            # skip branch's output types
+            return (
+                loss_h.astype(jnp.float32),
+                w_h.astype(jnp.float32),
+                g_shared_h,
+                g_y_head.astype(compute_dtype),
+            )
+
+        def skip_h(_):
+            return (
+                jnp.zeros((), jnp.float32),
+                jnp.zeros((), jnp.float32),
+                jax.tree.map(jnp.zeros_like, shared),
+                jnp.zeros(x_shape.shape, compute_dtype),
+            )
+
+        loss_h, w_h, g_shared_h, g_y_head = jax.lax.cond(hm >= 0, run_h, skip_h, None)
         losses = _buf_set(losses, hm_c, loss_h, hm >= 0)
         weights = _buf_set(weights, hm_c, w_h, hm >= 0)
         # identical on all stages: keep one stage's copy, psum at the end
@@ -313,17 +358,34 @@ def _scheduled_local(stacked_chunked, shared, tokens_mb, targets_mb, *, stage_fn
 
         # ---- B slot: recompute chunk forward under vjp (remat), pull cotangent
         b_slot = slot_of[c_b * M + m_b]
-        x_saved = xbuf[b_slot]
-        _, pull = jax.vjp(
-            lambda pv, xx: blocks_fwd(pv, c_b, xx, m_b), stacked_local, x_saved
-        )
-        g_p, g_x = pull(gbuf[b_slot].astype(compute_dtype))
-        g_stacked = _masked_add(g_stacked, g_p, b_valid)
+
+        def run_b(_):
+            _, pull = jax.vjp(
+                lambda pv, xx: blocks_fwd(pv, c_b, xx, m_b), stacked_local, xbuf[b_slot]
+            )
+            return pull(gbuf[b_slot].astype(compute_dtype))
+
+        def skip_b(_):
+            return (
+                jax.tree.map(jnp.zeros_like, stacked_local),
+                jnp.zeros(x_shape.shape, compute_dtype),
+            )
+
+        g_p, g_x = jax.lax.cond(b_valid, run_b, skip_b, None)
+        g_stacked = jax.tree.map(jnp.add, g_stacked, g_p)
 
         # embedding backward: only global stage 0's input is the embedding output
-        _, pull_e = jax.vjp(lambda sh: embed(sh, tokens_mb[m_b], embed_rng(m_b)), shared)
-        (g_shared_e,) = pull_e(g_x)
-        g_shared = _masked_add(g_shared, g_shared_e, (stage == 0) & (c_b == 0) & b_valid)
+        embed_b = (stage == 0) & (c_b == 0) & b_valid
+
+        def run_e(_):
+            _, pull_e = jax.vjp(lambda sh: embed(sh, tokens_mb[m_b], embed_rng(m_b)), shared)
+            (g_shared_e,) = pull_e(g_x)
+            return g_shared_e
+
+        g_shared_e = jax.lax.cond(
+            embed_b, run_e, lambda _: jax.tree.map(jnp.zeros_like, shared), None
+        )
+        g_shared = jax.tree.map(jnp.add, g_shared, g_shared_e)
 
         # ---- tick-end hops ----------------------------------------------------
         # activation: device s -> s+1 (same chunk); wrap P-1 -> 0 advances the chunk

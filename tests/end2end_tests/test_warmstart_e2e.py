@@ -60,6 +60,7 @@ def test_coca_example_config_trains(workdir):  # noqa: F811
     """The CoCa multimodal example config (reference config_example_coca.yaml) runs
     through the full app: dummy image+text data, CoCa collator, ViT+decoders, real
     checkpointing — the multimodal counterpart of the GPT2 e2e run."""
+    np.random.seed(0)  # DummyDataset draws from the global numpy RNG
     coca_config = Path(__file__).parent.parent.parent / "configs" / "config_example_coca_tpu.yaml"
     lines = _run(coca_config, "coca", workdir)
     train = [r for r in lines if r["dataloader_tag"] == "train"]
