@@ -172,7 +172,7 @@ class GPT2ModelSpec:
     context_parallel_axis: Optional[str] = None  # set when the mesh has cp > 1
     pipeline_axis: Optional[str] = None  # set when the mesh has pp > 1
     pp_num_microbatches: Optional[int] = None  # GPipe microbatches (default: pp degree)
-    pp_schedule: str = "gpipe"  # "gpipe" = in-module autodiff GPipe; "1f1b"/"interleaved_1f1b" = scheduled executor
+    pp_schedule: str = "gpipe"  # "gpipe" = in-module autodiff GPipe; "1f1b"/"interleaved_1f1b"/"zbv" = scheduled executor
     pp_num_virtual: int = 1  # virtual chunks per device (interleaved_1f1b)
     param_dtype: str = "float32"  # storage dtype (MixedPrecisionSpec.param_dtype)
     compute_dtype: str = "bfloat16"  # block compute dtype (MXU-native)
@@ -336,6 +336,13 @@ class CausalSelfAttention(nn.Module):
         else:
             y = sdpa_attention(q, k, v)
 
+        # named save point for selective-op remat (reference SAVE_DICT saves the SDPA
+        # output, activation_checkpointing.py:67-83): save_list=("attn_out",) stores
+        # only this tensor and recomputes the rest of the block — the backward then
+        # skips re-running the attention kernel, the block's most expensive op
+        from jax.ad_checkpoint import checkpoint_name
+
+        y = checkpoint_name(y, "attn_out")
         return self._project_out(x, y)
 
     def _decode_attention(self, x, q, k, v):

@@ -85,17 +85,23 @@ class ModelFactory:
         reference; interleaved_1f1b additionally takes num_virtual_stages chunks per
         device."""
         name = pp_schedule_name.strip().lower()
-        if name not in ("gpipe", "1f1b", "interleaved_1f1b"):
+        if name in ("zbvzerobubble", "zb_v", "zbv_zero_bubble"):  # reference class name
+            name = "zbv"
+        if name not in ("gpipe", "1f1b", "interleaved_1f1b", "zbv"):
             raise NotImplementedError(
                 f"pipeline schedule {pp_schedule_name!r} not supported yet "
-                "(have: gpipe, 1f1b, interleaved_1f1b; reference also ships "
-                "ZBVZeroBubble/DualPipeV)"
+                "(have: gpipe, 1f1b, interleaved_1f1b, zbv; reference also ships "
+                "DualPipeV)"
             )
         if name == "interleaved_1f1b":
             if num_virtual_stages is None:
                 num_virtual_stages = 2  # the schedule's minimum (and common) setting
             elif num_virtual_stages < 2:
                 raise ValueError("interleaved_1f1b requires num_virtual_stages >= 2")
+        elif name == "zbv":
+            if num_virtual_stages not in (None, 2):
+                raise ValueError("zbv uses exactly 2 virtual chunks (the V shape)")
+            num_virtual_stages = 2
         elif num_virtual_stages is not None and num_virtual_stages != 1:
             raise ValueError(
                 f"num_virtual_stages={num_virtual_stages} requires pp_schedule_name="

@@ -15,6 +15,11 @@ Design (FlashAttention-2 style, TPU-first):
 - causal blocks above the diagonal are skipped via predicated bodies (@pl.when).
 - block sizes default to 128 (MXU tile) with fallbacks for short sequences;
   interpret mode keeps CPU tests exact.
+- TPU layout: per-row statistics (lse, delta) carry a trailing singleton lane dim
+  ([B, H, S, 1] arrays, [block_q, 1] in-kernel tiles) because Mosaic requires the
+  last two block dims to tile (8, 128) or equal the array dims — a bare [S] row
+  vector does not lower (the official jax kernel lane-broadcasts to 128 instead;
+  the singleton costs 128x less HBM for identical in-kernel code).
 """
 
 from __future__ import annotations
@@ -58,12 +63,12 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
             q_pos = iq * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
             k_pos = jk * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
-        m_prev, l_prev = m_ref[:], l_ref[:]
-        m_new = jnp.maximum(m_prev, s.max(axis=-1))
+        m_prev, l_prev = m_ref[:], l_ref[:]  # [BQ, 1] column stats
+        m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
         alpha = jnp.exp(m_prev - m_new)
-        p = jnp.exp(s - m_new[:, None])
-        l_ref[:] = l_prev * alpha + p.sum(axis=-1)
-        acc_ref[:] = acc_ref[:] * alpha[:, None] + jax.lax.dot_general(
+        p = jnp.exp(s - m_new)
+        l_ref[:] = l_prev * alpha + p.sum(axis=-1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
             p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
         m_ref[:] = m_new
@@ -71,7 +76,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
     @pl.when(jk == num_kv - 1)
     def _finish():
         l_safe = jnp.maximum(l_ref[:], 1e-30)
-        o_ref[0, 0] = (acc_ref[:] / l_safe[:, None]).astype(o_ref.dtype)
+        o_ref[0, 0] = (acc_ref[:] / l_safe).astype(o_ref.dtype)
         lse_ref[0, 0] = m_ref[:] + jnp.log(l_safe)
 
 
@@ -94,8 +99,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_a
     def _compute():
         q = q_ref[0, 0].astype(jnp.float32)
         do = do_ref[0, 0].astype(jnp.float32)
-        lse = lse_ref[0, 0]
-        delta = delta_ref[0, 0]
+        lse = lse_ref[0, 0]  # [BQ, 1]
+        delta = delta_ref[0, 0]  # [BQ, 1]
         k = k_ref[0, 0].astype(jnp.float32)
         v = v_ref[0, 0].astype(jnp.float32)
         s = jax.lax.dot_general(
@@ -105,9 +110,9 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_a
             q_pos = iq * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
             k_pos = jk * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
-        p = jnp.exp(s - lse[:, None])
+        p = jnp.exp(s - lse)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
-        ds = p * (dp - delta[:, None]) * sm_scale
+        ds = p * (dp - delta) * sm_scale
         dq_acc_ref[:] += jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
@@ -139,8 +144,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_
         v = v_ref[0, 0].astype(jnp.float32)
         q = q_ref[0, 0].astype(jnp.float32)
         do = do_ref[0, 0].astype(jnp.float32)
-        lse = lse_ref[0, 0]
-        delta = delta_ref[0, 0]
+        lse = lse_ref[0, 0]  # [BQ, 1]
+        delta = delta_ref[0, 0]  # [BQ, 1]
         s = jax.lax.dot_general(
             q * sm_scale, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
@@ -148,12 +153,12 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_
             q_pos = iq * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
             k_pos = jk * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
-        p = jnp.exp(s - lse[:, None])
+        p = jnp.exp(s - lse)
         dv_acc_ref[:] += jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
-        ds = p * (dp - delta[:, None]) * sm_scale
+        ds = p * (dp - delta) * sm_scale
         dk_acc_ref[:] += jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
@@ -201,16 +206,16 @@ def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
         ],
         out_specs=[
             pl.BlockSpec((1, 1, block_q, head_dim), lambda b, h, iq, jk: (b, h, iq, 0)),
-            pl.BlockSpec((1, 1, block_q), lambda b, h, iq, jk: (b, h, iq)),
+            pl.BlockSpec((1, 1, block_q, 1), lambda b, h, iq, jk: (b, h, iq, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct(q.shape, q.dtype),
-            jax.ShapeDtypeStruct((batch, num_heads, seq_q), jnp.float32),
+            jax.ShapeDtypeStruct((batch, num_heads, seq_q, 1), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, head_dim), jnp.float32),
-            pltpu.VMEM((block_q,), jnp.float32),
-            pltpu.VMEM((block_q,), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
         ],
         interpret=interpret,
     )(q, k, v)
@@ -229,7 +234,8 @@ def _flash_bwd_vjp(sm_scale, causal, block_q, block_k, interpret, res, do):
     num_kv_heads, seq_k = k.shape[1], k.shape[2]
     group = num_heads // num_kv_heads
 
-    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)  # [B, H, Sq]
+    # [B, H, Sq, 1] — trailing singleton lane dim (see module docstring)
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1, keepdims=True)
 
     dq = pl.pallas_call(
         functools.partial(
@@ -241,8 +247,8 @@ def _flash_bwd_vjp(sm_scale, causal, block_q, block_k, interpret, res, do):
             pl.BlockSpec((1, 1, block_k, head_dim), lambda b, h, iq, jk: (b, h // group, jk, 0)),
             pl.BlockSpec((1, 1, block_k, head_dim), lambda b, h, iq, jk: (b, h // group, jk, 0)),
             pl.BlockSpec((1, 1, block_q, head_dim), lambda b, h, iq, jk: (b, h, iq, 0)),
-            pl.BlockSpec((1, 1, block_q), lambda b, h, iq, jk: (b, h, iq)),
-            pl.BlockSpec((1, 1, block_q), lambda b, h, iq, jk: (b, h, iq)),
+            pl.BlockSpec((1, 1, block_q, 1), lambda b, h, iq, jk: (b, h, iq, 0)),
+            pl.BlockSpec((1, 1, block_q, 1), lambda b, h, iq, jk: (b, h, iq, 0)),
         ],
         out_specs=pl.BlockSpec((1, 1, block_q, head_dim), lambda b, h, iq, jk: (b, h, iq, 0)),
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
@@ -261,8 +267,8 @@ def _flash_bwd_vjp(sm_scale, causal, block_q, block_k, interpret, res, do):
             pl.BlockSpec((1, 1, block_k, head_dim), lambda b, h, jk, iq: (b, h // group, jk, 0)),
             pl.BlockSpec((1, 1, block_k, head_dim), lambda b, h, jk, iq: (b, h // group, jk, 0)),
             pl.BlockSpec((1, 1, block_q, head_dim), lambda b, h, jk, iq: (b, h, iq, 0)),
-            pl.BlockSpec((1, 1, block_q), lambda b, h, jk, iq: (b, h, iq)),
-            pl.BlockSpec((1, 1, block_q), lambda b, h, jk, iq: (b, h, iq)),
+            pl.BlockSpec((1, 1, block_q, 1), lambda b, h, jk, iq: (b, h, iq, 0)),
+            pl.BlockSpec((1, 1, block_q, 1), lambda b, h, jk, iq: (b, h, iq, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, 1, block_k, head_dim), lambda b, h, jk, iq: (b, h, jk, 0)),

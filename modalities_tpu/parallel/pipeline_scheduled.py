@@ -30,6 +30,16 @@ advances the chunk. Note: at high pp degrees the greedy interleaved tables are
 correct but not tight — prefer "1f1b" with more microbatches there
 (parallel/pipeline_schedules.py).
 
+ZBV (`schedule="zbv"`, reference ScheduleZBVZeroBubble): V=2 chunks in a V shape —
+device s owns global stages s and 2P-1-s (chunk 1's rows are device-flipped before
+the shard_map), activations descend then ascend (the turn at device P-1 is a local
+write), and the first/last stage share device 0. The backward is split: the B slot
+pulls only the input-cotangent chain (params closed over — the pipeline's serial
+dependency), and ALL weight gradients are produced after the tick scan in one
+batched per-device pass over the stored (chunk input, output cotangent) pairs —
+zero-bubble by construction, at the cost of a second residual forward (see
+pipeline_schedules._build_zbv_tables for the honest cost model).
+
 Collectives per tick: one fwd ppermute (activations), one bwd ppermute (cotangents),
 one psum-broadcast (last-stage output for the head slot) — all riding ICI neighbors.
 psums/cotangent buffers are fp32 (bf16 psum inside a partial-manual region trips an
@@ -157,10 +167,19 @@ def scheduled_pipeline_loss_and_grads(
     M = min(M, batch)
     if batch % M != 0:
         raise ValueError(f"batch ({batch}) must be divisible by num_microbatches ({M})")
-    V = num_virtual
+    if schedule == "zbv" and num_virtual not in (None, 1, 2):
+        raise ValueError(f"zbv uses exactly 2 virtual chunks (got num_virtual={num_virtual})")
+    V = 2 if schedule == "zbv" else num_virtual
     tables = build_schedule_tables(schedule, num_stages, M, num_virtual=V)
-    # collision-free static slot plan sized at the true in-flight bound
-    slot_plan = _slot_assignment(tables)
+    if tables.deferred_w:
+        # zbv: the (x_in, dy_in) pairs must survive until the post-scan weight-grad
+        # pass, so buffers span the full keyspace (constant memory in M: V x [B,S,E])
+        import numpy as np
+
+        slot_plan = (np.arange(V * M), V * M, np.arange(M), M)
+    else:
+        # collision-free static slot plan sized at the true in-flight bound
+        slot_plan = _slot_assignment(tables)
 
     total_layers = jax.tree.leaves(stacked_params)[0].shape[0]
     if total_layers % (V * num_stages) != 0:
@@ -181,6 +200,14 @@ def scheduled_pipeline_loss_and_grads(
         return g.reshape(total_layers, *g.shape[3:])
 
     stacked_chunked = jax.tree.map(to_chunks, stacked_params)
+    if tables.placement == "v":
+        # V placement: device s owns global stages s (chunk 0) and 2P-1-s (chunk 1),
+        # so chunk 1's device axis is reversed relative to the [V, P, ...] layout.
+        # jnp.flip is an involution — the same map restores the grads' layout below.
+        def vflip(p):
+            return jnp.concatenate([p[:1], jnp.flip(p[1:], axis=1)], axis=0)
+
+        stacked_chunked = jax.tree.map(vflip, stacked_chunked)
     param_specs = jax.tree.map(lambda _: P(None, axis_name), stacked_chunked)
     shared_specs = jax.tree.map(lambda _: P(), shared_params)
 
@@ -201,6 +228,8 @@ def scheduled_pipeline_loss_and_grads(
         check_vma=False,
     )
     loss, g_stacked, g_shared = fn(stacked_chunked, shared_params, tokens_mb, targets_mb)
+    if tables.placement == "v":
+        g_stacked = jax.tree.map(vflip, g_stacked)
     return loss, jax.tree.map(from_chunks, g_stacked), g_shared
 
 
@@ -213,12 +242,21 @@ def _scheduled_local(stacked_chunked, shared, tokens_mb, targets_mb, *, stage_fn
     P_ = tables.num_stages
     M = tables.num_microbatches
     V = tables.num_virtual
+    deferred_w = tables.deferred_w  # zbv: B is dx-only; weight grads in a post-scan pass
+    v_placed = tables.placement == "v"
+    last_dev = 0 if v_placed else P_ - 1  # device of the last global stage
     slot_of_np, num_slots, y_slot_of_np, num_y_slots = slot_plan
     slot_of = jnp.asarray(slot_of_np)  # [V*M] -> buffer slot
     y_slot_of = jnp.asarray(y_slot_of_np)  # [M] -> head-buffer slot
     stage = jax.lax.axis_index(axis_name)
     stacked_local = jax.tree.map(lambda p: p.squeeze(1), stacked_chunked)  # [V, L_vc, ...]
     layers_per_chunk = jax.tree.leaves(stacked_local)[0].shape[1]
+
+    def my_global_stage(chunk):
+        """This device's global stage for virtual chunk `chunk` (traced int)."""
+        if v_placed:
+            return jnp.where(chunk == 0, stage, 2 * P_ - 1 - stage)
+        return chunk * P_ + stage
 
     f_tab = jnp.asarray(tables.f)  # [T, P], values c*M + m or -1
     b_tab = jnp.asarray(tables.b)
@@ -244,7 +282,7 @@ def _scheduled_local(stacked_chunked, shared, tokens_mb, targets_mb, *, stage_fn
             lambda p: jax.lax.dynamic_index_in_dim(p, chunk, axis=0, keepdims=False), params_v
         )
         mb_key = block_rng(mb_index)
-        global_stage = chunk * P_ + stage
+        global_stage = my_global_stage(chunk)
 
         def body(carry, xs):
             layer_params, local_idx = xs
@@ -269,7 +307,11 @@ def _scheduled_local(stacked_chunked, shared, tokens_mb, targets_mb, *, stage_fn
         return c, m, op >= 0
 
     def tick(carry, t):
-        abuf, xbuf, ybuf, gbuf, g_stacked, g_shared, losses, weights = carry
+        if deferred_w:
+            abuf, xbuf, ybuf, gbuf, ebuf, g_stacked, g_shared, losses, weights = carry
+        else:
+            ebuf = None
+            abuf, xbuf, ybuf, gbuf, g_stacked, g_shared, losses, weights = carry
         c_f, m_f, f_valid = decode(f_tab[t, stage])
         c_b, m_b, b_valid = decode(b_tab[t, stage])
         hm = h_tab[t]
@@ -308,11 +350,11 @@ def _scheduled_local(stacked_chunked, shared, tokens_mb, targets_mb, *, stage_fn
         xbuf = _buf_set(xbuf, f_slot, x_in, f_valid)
 
         # broadcast the last GLOBAL stage's fresh output for the (uniform) head slot
-        last_op = f_tab[t, P_ - 1]
+        last_op = f_tab[t, last_dev]
         c_last, m_last, last_valid = decode(last_op)
         is_final_output = last_valid & (c_last == V - 1)
         y_bc = jax.lax.psum(
-            jnp.where(stage == P_ - 1, y, jnp.zeros_like(y)).astype(jnp.float32), axis_name
+            jnp.where(stage == last_dev, y, jnp.zeros_like(y)).astype(jnp.float32), axis_name
         )
         ybuf = _buf_set(ybuf, y_slot_of[m_last], y_bc.astype(compute_dtype), is_final_output)
 
@@ -350,62 +392,140 @@ def _scheduled_local(stacked_chunked, shared, tokens_mb, targets_mb, *, stage_fn
         losses = _buf_set(losses, hm_c, loss_h, hm >= 0)
         weights = _buf_set(weights, hm_c, w_h, hm >= 0)
         # identical on all stages: keep one stage's copy, psum at the end
-        g_shared = _masked_add(g_shared, g_shared_h, (stage == P_ - 1) & (hm >= 0))
+        g_shared = _masked_add(g_shared, g_shared_h, (stage == last_dev) & (hm >= 0))
         # the last GLOBAL stage's backward consumes this as its incoming cotangent
         gbuf = _buf_set(
             gbuf, slot_of[(V - 1) * M + hm_c], g_y_head.astype(jnp.float32), hm >= 0
         )
 
-        # ---- B slot: recompute chunk forward under vjp (remat), pull cotangent
+        # ---- B slot: recompute chunk forward under vjp (remat), pull cotangent.
+        # deferred_w (zbv): dx-only — params are closed over, so XLA builds just the
+        # input-cotangent chain; weight grads come from the post-scan W pass reading
+        # the same xbuf/gbuf slots (identity-mapped, so the pairs survive the scan).
         b_slot = slot_of[c_b * M + m_b]
 
-        def run_b(_):
-            _, pull = jax.vjp(
-                lambda pv, xx: blocks_fwd(pv, c_b, xx, m_b), stacked_local, xbuf[b_slot]
+        if deferred_w:
+
+            def run_b(_):
+                _, pull = jax.vjp(
+                    lambda xx: blocks_fwd(stacked_local, c_b, xx, m_b), xbuf[b_slot]
+                )
+                (g_x_,) = pull(gbuf[b_slot].astype(compute_dtype))
+                return g_x_
+
+            g_x = jax.lax.cond(
+                b_valid, run_b, lambda _: jnp.zeros(x_shape.shape, compute_dtype), None
             )
-            return pull(gbuf[b_slot].astype(compute_dtype))
+        else:
 
-        def skip_b(_):
-            return (
-                jax.tree.map(jnp.zeros_like, stacked_local),
-                jnp.zeros(x_shape.shape, compute_dtype),
-            )
+            def run_b(_):
+                _, pull = jax.vjp(
+                    lambda pv, xx: blocks_fwd(pv, c_b, xx, m_b), stacked_local, xbuf[b_slot]
+                )
+                return pull(gbuf[b_slot].astype(compute_dtype))
 
-        g_p, g_x = jax.lax.cond(b_valid, run_b, skip_b, None)
-        g_stacked = jax.tree.map(jnp.add, g_stacked, g_p)
+            def skip_b(_):
+                return (
+                    jax.tree.map(jnp.zeros_like, stacked_local),
+                    jnp.zeros(x_shape.shape, compute_dtype),
+                )
 
-        # embedding backward: only global stage 0's input is the embedding output
+            g_p, g_x = jax.lax.cond(b_valid, run_b, skip_b, None)
+            g_stacked = jax.tree.map(jnp.add, g_stacked, g_p)
+
+        # embedding backward: only global stage 0's input is the embedding output.
+        # deferred_w stores the embed-output cotangent instead (weight-only grad,
+        # produced in the post-scan pass).
         embed_b = (stage == 0) & (c_b == 0) & b_valid
 
-        def run_e(_):
-            _, pull_e = jax.vjp(lambda sh: embed(sh, tokens_mb[m_b], embed_rng(m_b)), shared)
-            (g_shared_e,) = pull_e(g_x)
-            return g_shared_e
+        if deferred_w:
+            ebuf = _buf_set(ebuf, m_b, g_x, embed_b)
+        else:
 
-        g_shared_e = jax.lax.cond(
-            embed_b, run_e, lambda _: jax.tree.map(jnp.zeros_like, shared), None
-        )
-        g_shared = jax.tree.map(jnp.add, g_shared, g_shared_e)
+            def run_e(_):
+                _, pull_e = jax.vjp(lambda sh: embed(sh, tokens_mb[m_b], embed_rng(m_b)), shared)
+                (g_shared_e,) = pull_e(g_x)
+                return g_shared_e
+
+            g_shared_e = jax.lax.cond(
+                embed_b, run_e, lambda _: jax.tree.map(jnp.zeros_like, shared), None
+            )
+            g_shared = jax.tree.map(jnp.add, g_shared, g_shared_e)
 
         # ---- tick-end hops ----------------------------------------------------
-        # activation: device s -> s+1 (same chunk); wrap P-1 -> 0 advances the chunk
-        act = jax.lax.ppermute(y, axis_name, fwd_perm)
-        prev_op = f_tab[t, jnp.where(stage > 0, stage - 1, P_ - 1)]
-        c_p, m_p, p_valid = decode(prev_op)
-        c_recv = jnp.where(stage > 0, c_p, c_p + 1)
-        recv_ok = p_valid & (c_recv < V) & ~((stage == 0) & (c_p == V - 1))
-        c_recv = jnp.clip(c_recv, 0, V - 1)
-        abuf = _buf_set(abuf, slot_of[c_recv * M + m_p], act, recv_ok)
+        if v_placed:
+            # V placement: chunk-0 activations descend (s -> s+1), chunk-1 ascend
+            # (s -> s-1); the chunk-0 -> chunk-1 turn at device P-1 is a local
+            # write. Cotangents retrace each edge in reverse. Each device runs at
+            # most one F and one B per tick, so its single y / g_x payload is
+            # masked into the matching directional ppermute.
+            act_down = jax.lax.ppermute(
+                jnp.where(f_valid & (c_f == 0), y, jnp.zeros_like(y)), axis_name, fwd_perm
+            )
+            act_up = jax.lax.ppermute(
+                jnp.where(f_valid & (c_f == 1), y, jnp.zeros_like(y)), axis_name, bwd_perm
+            )
+            # local turn: my own chunk-0 output feeds my chunk-1 stage at P-1
+            turn_ok = f_valid & (c_f == 0) & (stage == P_ - 1)
+            abuf = _buf_set(abuf, slot_of[1 * M + m_f], y, turn_ok)
+            # receive chunk-0 input from device s-1 (its chunk-0 forward this tick)
+            dn_op = f_tab[t, jnp.clip(stage - 1, 0, P_ - 1)]
+            c_d, m_d, d_valid = decode(dn_op)
+            abuf = _buf_set(abuf, slot_of[0 * M + m_d], act_down, d_valid & (c_d == 0) & (stage > 0))
+            # receive chunk-1 input from device s+1 (its chunk-1 forward this tick)
+            up_op = f_tab[t, jnp.clip(stage + 1, 0, P_ - 1)]
+            c_u, m_u, u_valid = decode(up_op)
+            abuf = _buf_set(
+                abuf, slot_of[1 * M + m_u], act_up, u_valid & (c_u == 1) & (stage < P_ - 1)
+            )
 
-        # cotangent: device s -> s-1 (same chunk); wrap 0 -> P-1 retreats the chunk
-        cot = jax.lax.ppermute(g_x.astype(jnp.float32), axis_name, bwd_perm)
-        next_op = b_tab[t, jnp.where(stage < P_ - 1, stage + 1, 0)]
-        c_n, m_n, n_valid = decode(next_op)
-        c_recv_b = jnp.where(stage < P_ - 1, c_n, c_n - 1)
-        recv_b_ok = n_valid & (c_recv_b >= 0) & ~((stage == P_ - 1) & (c_n == 0))
-        c_recv_b = jnp.clip(c_recv_b, 0, V - 1)
-        gbuf = _buf_set(gbuf, slot_of[c_recv_b * M + m_n], cot, recv_b_ok)
+            cot32 = g_x.astype(jnp.float32)
+            # chunk-0 B output is the cotangent for stage s-1 (ascend);
+            # chunk-1 B output is the cotangent for the V-neighbor below (descend)
+            cot_up = jax.lax.ppermute(
+                jnp.where(b_valid & (c_b == 0), cot32, jnp.zeros_like(cot32)), axis_name, bwd_perm
+            )
+            cot_down = jax.lax.ppermute(
+                jnp.where(b_valid & (c_b == 1), cot32, jnp.zeros_like(cot32)), axis_name, fwd_perm
+            )
+            # local turn: my chunk-1 backward (global stage P at device P-1) yields
+            # the cotangent for my own chunk-0 stage P-1
+            turn_b_ok = b_valid & (c_b == 1) & (stage == P_ - 1)
+            gbuf = _buf_set(gbuf, slot_of[0 * M + m_b], cot32, turn_b_ok)
+            # receive chunk-0 cotangent from device s+1 (its chunk-0 backward)
+            upb_op = b_tab[t, jnp.clip(stage + 1, 0, P_ - 1)]
+            c_ub, m_ub, ub_valid = decode(upb_op)
+            gbuf = _buf_set(
+                gbuf, slot_of[0 * M + m_ub], cot_up, ub_valid & (c_ub == 0) & (stage < P_ - 1)
+            )
+            # receive chunk-1 cotangent from device s-1 (its chunk-1 backward)
+            dnb_op = b_tab[t, jnp.clip(stage - 1, 0, P_ - 1)]
+            c_db, m_db, db_valid = decode(dnb_op)
+            gbuf = _buf_set(
+                gbuf, slot_of[1 * M + m_db], cot_down, db_valid & (c_db == 1) & (stage > 0)
+            )
+        else:
+            # loop placement: device s -> s+1 (same chunk); wrap P-1 -> 0 advances
+            # the chunk
+            act = jax.lax.ppermute(y, axis_name, fwd_perm)
+            prev_op = f_tab[t, jnp.where(stage > 0, stage - 1, P_ - 1)]
+            c_p, m_p, p_valid = decode(prev_op)
+            c_recv = jnp.where(stage > 0, c_p, c_p + 1)
+            recv_ok = p_valid & (c_recv < V) & ~((stage == 0) & (c_p == V - 1))
+            c_recv = jnp.clip(c_recv, 0, V - 1)
+            abuf = _buf_set(abuf, slot_of[c_recv * M + m_p], act, recv_ok)
 
+            # cotangent: device s -> s-1 (same chunk); wrap 0 -> P-1 retreats the chunk
+            cot = jax.lax.ppermute(g_x.astype(jnp.float32), axis_name, bwd_perm)
+            next_op = b_tab[t, jnp.where(stage < P_ - 1, stage + 1, 0)]
+            c_n, m_n, n_valid = decode(next_op)
+            c_recv_b = jnp.where(stage < P_ - 1, c_n, c_n - 1)
+            recv_b_ok = n_valid & (c_recv_b >= 0) & ~((stage == P_ - 1) & (c_n == 0))
+            c_recv_b = jnp.clip(c_recv_b, 0, V - 1)
+            gbuf = _buf_set(gbuf, slot_of[c_recv_b * M + m_n], cot, recv_b_ok)
+
+        if deferred_w:
+            return (abuf, xbuf, ybuf, gbuf, ebuf, g_stacked, g_shared, losses, weights), None
         return (abuf, xbuf, ybuf, gbuf, g_stacked, g_shared, losses, weights), None
 
     buf = lambda n, dtype=compute_dtype: jnp.zeros((n,) + x_shape.shape, dtype)  # noqa: E731
@@ -414,13 +534,49 @@ def _scheduled_local(stacked_chunked, shared, tokens_mb, targets_mb, *, stage_fn
         buf(num_slots),  # xbuf: my stage inputs, kept for the remat backward
         buf(num_y_slots),  # ybuf: broadcast last-stage outputs awaiting their head slot
         buf(num_slots, jnp.float32),  # gbuf: cotangents
+        *((buf(M, compute_dtype),) if deferred_w else ()),  # ebuf: embed-output cotangents
         jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), stacked_local),
         jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), shared),
         jnp.zeros((M,), jnp.float32),
         jnp.zeros((M,), jnp.float32),  # per-microbatch valid-token weights
     )
     final_carry, _ = jax.lax.scan(tick, init, jnp.arange(tables.num_ticks))
-    _, _, _, _, g_stacked, g_shared, losses, weights = final_carry
+    if deferred_w:
+        _, xbuf_f, _, gbuf_f, ebuf_f, g_stacked, g_shared, losses, weights = final_carry
+
+        # ---- post-scan W pass (zbv): every (chunk, microbatch) pair's weight
+        # grads from the stored (input, output-cotangent) pairs — purely local
+        # per-device work with no cross-device dependencies, hence zero bubble. The
+        # residual forward here is the second recompute of each chunk (the B slot's
+        # dx-only vjp was the first): ~6 units per microbatch per device total vs
+        # fused 1F1B's 4, traded for the 2-unit B critical path.
+        def w_body(acc, cm):
+            c, m = cm // M, cm % M
+            _, pull = jax.vjp(
+                lambda pv: blocks_fwd(pv, c, xbuf_f[slot_of[cm]], m), stacked_local
+            )
+            (g_p,) = pull(gbuf_f[slot_of[cm]].astype(compute_dtype))
+            return jax.tree.map(lambda a, g: a + g.astype(jnp.float32), acc, g_p), None
+
+        g_stacked, _ = jax.lax.scan(w_body, g_stacked, jnp.arange(V * M))
+
+        # embedding weight grads from the stored embed-output cotangents (only
+        # device 0 holds real values; the cond predicate is uniform along non-pp
+        # axes, so the other stages genuinely skip the vocab-sized scatter)
+        def e_body(acc, m):
+            def run_e(_):
+                _, pull_e = jax.vjp(lambda sh: embed(sh, tokens_mb[m], embed_rng(m)), shared)
+                (g_e,) = pull_e(ebuf_f[m])
+                return g_e
+
+            g_e = jax.lax.cond(
+                stage == 0, run_e, lambda _: jax.tree.map(jnp.zeros_like, shared), None
+            )
+            return jax.tree.map(jnp.add, acc, g_e), None
+
+        g_shared, _ = jax.lax.scan(e_body, g_shared, jnp.arange(M))
+    else:
+        _, _, _, _, g_stacked, g_shared, losses, weights = final_carry
 
     # token-weighted mean == the unpipelined global mean, also under ignore_index
     # masking with unequal per-microbatch token counts (cotangents were seeded with

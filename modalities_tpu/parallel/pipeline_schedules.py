@@ -42,7 +42,21 @@ import numpy as np
 
 @dataclass(frozen=True)
 class ScheduleTables:
-    """Static schedule: arrays [T, P] (f/b; values chunk*M+mb or -1) and [T] (h)."""
+    """Static schedule: arrays [T, P] (f/b/w; values chunk*M+mb or -1) and [T] (h).
+
+    ``placement`` maps global stage g to its device:
+    - "loop": device = g % P, chunk = g // P; activations always hop s -> s+1
+      (the wrap P-1 -> 0 advances the chunk). GPipe/1F1B/interleaved.
+    - "v": V=2 chunks in a V shape — device = g for g < P else 2P-1-g. Chunk-0
+      activations hop down (s -> s+1), chunk-1 activations hop up (s -> s-1), and
+      the chunk transition at device P-1 is a local buffer write. ZBV.
+
+    ``deferred_w`` marks the split-backward (zero-bubble) execution mode: the B slot
+    runs only the input-cotangent chain (params closed over), and ALL weight
+    gradients are produced after the tick scan in one batched per-device pass over
+    the stored (chunk input, chunk output-cotangent) pairs — weight-grad work has no
+    cross-device dependencies, so it never occupies pipeline ticks at all.
+    """
 
     f: np.ndarray
     b: np.ndarray
@@ -50,6 +64,13 @@ class ScheduleTables:
     num_stages: int
     num_microbatches: int
     num_virtual: int = 1
+    placement: str = "loop"
+    deferred_w: bool = False
+
+    def device_of(self, g: int) -> int:
+        if self.placement == "v":
+            return g if g < self.num_stages else 2 * self.num_stages - 1 - g
+        return g % self.num_stages
 
     @property
     def num_ticks(self) -> int:
@@ -78,7 +99,7 @@ class ScheduleTables:
         return 1.0 - useful / total_slots
 
 
-SUPPORTED_SCHEDULES = ("gpipe", "1f1b", "interleaved_1f1b")
+SUPPORTED_SCHEDULES = ("gpipe", "1f1b", "interleaved_1f1b", "zbv")
 
 
 def build_schedule_tables(
@@ -100,8 +121,12 @@ def build_schedule_tables(
     if schedule not in SUPPORTED_SCHEDULES:
         raise NotImplementedError(
             f"pipeline schedule {schedule!r} not supported (have {SUPPORTED_SCHEDULES}; "
-            "reference also ships ZBVZeroBubble/DualPipeV)"
+            "reference also ships DualPipeV)"
         )
+    if schedule == "zbv":
+        if num_virtual not in (1, 2):
+            raise ValueError("zbv uses exactly 2 virtual chunks (the V shape)")
+        return _build_zbv_tables(num_stages, num_microbatches)
     if schedule != "interleaved_1f1b" and num_virtual != 1:
         raise ValueError(f"{schedule} requires num_virtual=1 (got {num_virtual})")
     if schedule == "interleaved_1f1b" and num_virtual < 2:
@@ -215,11 +240,140 @@ def build_schedule_tables(
     return tables
 
 
+def _build_zbv_tables(num_stages: int, num_microbatches: int) -> ScheduleTables:
+    """ZBVZeroBubble (reference pipeline_parallelism.py:13-20 ships torch's
+    ScheduleZBVZeroBubble; schedule family from "Zero Bubble Pipeline Parallelism",
+    Qi et al. 2023 — re-derived for the SPMD tick executor).
+
+    V placement: global stage g lives on device g (g < P) or 2P-1-g (g >= P), so
+    each device holds two ADJACENT stages of the V and the first/last stage share
+    device 0 — the loss is computed where microbatches enter. The backward is split:
+    B(g, m) runs the input-cotangent chain (storing per-layer (x, dy) pairs), W(g, m)
+    later turns the stored pairs into parameter gradients. W slots fill ticks where
+    the device would otherwise sit in a warmup/drain bubble.
+
+    Honest cost model (this executor remats): F=1 chunk-forward unit, B=2 (dx-only
+    vjp: residual forward + input-cotangent chain, params closed over). Weight
+    gradients are NOT tick-scheduled at all (``deferred_w``): after the tick scan,
+    each device turns its stored (chunk input, output cotangent) pairs into weight
+    grads in one batched local pass (cost ~3 units x V x M, bubble-free by
+    construction — it has no cross-device dependencies). Total work is ~6 units per
+    microbatch per device vs fused 1F1B's 4, but the pipeline's serial backward
+    chain costs 2 per stage hop instead of 3 and the fill/drain bubbles carry no
+    weight-grad work — ZBV wins in the bubble-dominated regime (M <~ P, deep
+    pipelines); prefer 1f1b when M >> P, where total FLOPs dominate. Pair-storage
+    memory is constant in M: V x ([B,S,E] input + [B,S,E] cotangent) per device.
+
+    Dependencies (executor in-tick slot order F -> broadcast -> H -> B -> hops):
+    - F(g, m) needs F(g-1, m) strictly earlier (hop — or the device-P-1 local
+      chunk-0 -> chunk-1 write, which also lands at tick end)
+    - H(m) needs F(2P-1, m) same tick or earlier; B(2P-1, m) needs H(m) same tick
+      or earlier; other B(g, m) need B(g+1, m) strictly earlier + F(g, m) <= tick
+    - one F and one B slot per device per tick; one H per tick
+    """
+    P, M = num_stages, num_microbatches
+    G = 2 * P
+    last_g = G - 1
+
+    def dev(g: int) -> int:
+        return g if g < P else 2 * P - 1 - g
+
+    stages_of = [[] for _ in range(P)]
+    for g in range(G):
+        stages_of[dev(g)].append(g)
+
+    f_done = -np.ones((G, M), dtype=np.int64)
+    b_done = -np.ones((G, M), dtype=np.int64)
+    h_done = -np.ones((M,), dtype=np.int64)
+
+    def f_candidate(s: int, t: int):
+        """Ready forward, deepest global stage first (advance work toward the head
+        before admitting fresh microbatches). No start cap: zbv's executor buffers
+        span the full keyspace (memory is O(V x [B,S,E]), independent of in-flight
+        count), so throttling admissions only lengthens the schedule."""
+        for g in sorted(stages_of[s], reverse=True):
+            for m in range(M):
+                if f_done[g, m] >= 0:
+                    continue
+                if g > 0 and not (0 <= f_done[g - 1, m] < t):
+                    continue
+                return g, m
+        return None
+
+    def b_candidate(s: int, t: int):
+        """Lowest-microbatch ready backward, deeper global stage first."""
+        for m in range(M):
+            for g in sorted(stages_of[s], reverse=True):
+                if b_done[g, m] >= 0:
+                    continue
+                if not (0 <= f_done[g, m] <= t):
+                    continue
+                if g == last_g:
+                    if not (0 <= h_done[m] <= t):
+                        continue
+                elif not (0 <= b_done[g + 1, m] < t):
+                    continue
+                return g, m
+        return None
+
+    f_rows, b_rows, h_rows = [], [], []
+    t = 0
+    max_ticks = 24 * (2 * M + P) + 64
+    while (b_done < 0).any() or (h_done < 0).any():
+        if t >= max_ticks:
+            raise RuntimeError(f"zbv schedule did not converge (P={P}, M={M})")
+        f_row = -np.ones(P, dtype=np.int64)
+        b_row = -np.ones(P, dtype=np.int64)
+
+        for s in range(P):
+            cand = f_candidate(s, t)
+            if cand is not None:
+                g, m = cand
+                f_row[s] = (g // P) * M + m
+                f_done[g, m] = t
+
+        # H slot sees this tick's last-stage forward (broadcast precedes it)
+        hm = next((m for m in range(M) if h_done[m] < 0 and 0 <= f_done[last_g, m] <= t), -1)
+        if hm >= 0:
+            h_done[hm] = t
+
+        for s in range(P):
+            cand = b_candidate(s, t)
+            if cand is not None:
+                g, m = cand
+                b_row[s] = (g // P) * M + m
+                b_done[g, m] = t
+
+        f_rows.append(f_row)
+        b_rows.append(b_row)
+        h_rows.append(hm)
+        t += 1
+
+    tables = ScheduleTables(
+        f=np.stack(f_rows),
+        b=np.stack(b_rows),
+        h=np.asarray(h_rows, dtype=np.int64),
+        num_stages=P,
+        num_microbatches=M,
+        num_virtual=2,
+        placement="v",
+        deferred_w=True,
+    )
+    _validate(tables)
+    return tables
+
+
 def _validate(tb: ScheduleTables) -> None:
     """Structural correctness: every op exactly once, dependencies ordered per the
-    executor's in-tick slot order (F -> broadcast -> H -> B -> hops)."""
+    executor's in-tick slot order (F -> broadcast -> H -> B -> W -> hops)."""
     P, M, V = tb.num_stages, tb.num_microbatches, tb.num_virtual
     G = V * P
+
+    def g_of(c: int, s: int) -> int:
+        if tb.placement == "v":
+            return s if c == 0 else 2 * P - 1 - s
+        return c * P + s
+
     f_at = -np.ones((G, M), dtype=np.int64)
     b_at = -np.ones((G, M), dtype=np.int64)
     h_at = -np.ones((M,), dtype=np.int64)
@@ -227,12 +381,12 @@ def _validate(tb: ScheduleTables) -> None:
         for s in range(P):
             if tb.f[t, s] >= 0:
                 c, m = divmod(int(tb.f[t, s]), M)
-                g = c * P + s
+                g = g_of(c, s)
                 assert f_at[g, m] < 0, "duplicate forward"
                 f_at[g, m] = t
             if tb.b[t, s] >= 0:
                 c, m = divmod(int(tb.b[t, s]), M)
-                g = c * P + s
+                g = g_of(c, s)
                 assert b_at[g, m] < 0, "duplicate backward"
                 b_at[g, m] = t
         if tb.h[t] >= 0:

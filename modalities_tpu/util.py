@@ -69,6 +69,19 @@ def get_total_number_of_trainable_parameters(model_or_state) -> int:
     return int(sum(np.prod(x.shape) for x in jax.tree.leaves(tree) if hasattr(x, "shape")))
 
 
+def hard_sync(x) -> float:
+    """Fetch a scalar to the host, forcing device execution to complete first.
+
+    The honest fence for timing/throughput measurement on this stack:
+    ``jax.block_until_ready`` is NOT a reliable sync on the axon relay platform (it
+    returns before remote execution finishes — a 760M train step "measured" 0.5 ms),
+    while a host transfer always is."""
+    import jax
+    import numpy as np
+
+    return float(np.asarray(jax.device_get(x)))
+
+
 class TimeRecorder:
     """Start/stop accumulating wall-clock timer (reference util.py:245)."""
 

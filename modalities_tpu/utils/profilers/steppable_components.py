@@ -7,6 +7,8 @@ from abc import ABC, abstractmethod
 
 import numpy as np
 
+from modalities_tpu.util import hard_sync
+
 
 class SteppableComponentIF(ABC):
     @abstractmethod
@@ -47,15 +49,13 @@ class SteppableForwardPass(SteppableComponentIF):
         self.gradient_accumulation_steps = gradient_accumulation_steps
 
     def step(self) -> None:
-        import jax
-
         handle = self.step_functions.app_state_handle
         if self.include_backward:
             # train_step scans over the leading accumulation dim
             raw = self.batch_generator.get_batch(self.gradient_accumulation_steps)
             batch = self.step_functions.put_batch(raw)
             handle.state, metrics = self.step_functions.train_step(handle.state, batch)
-            jax.block_until_ready(metrics["loss"])
+            hard_sync(metrics["loss"])
         else:
             # eval_step takes a flat (batch, seq) micro-batch
             raw = self.batch_generator.get_batch(1)
@@ -65,4 +65,4 @@ class SteppableForwardPass(SteppableComponentIF):
             }
             batch = self.step_functions.put_batch(flat, has_acc_dim=False)
             metrics = self.step_functions.eval_step(handle.state, batch)
-            jax.block_until_ready(metrics["loss"])
+            hard_sync(metrics["loss"])

@@ -1,0 +1,83 @@
+"""Single-chip MFU tuning sweep: run ONE named experiment per process (the axon TPU
+chip admits one claim at a time — a fresh process per run keeps claims clean) and
+print the same JSON line bench.py emits.
+
+Usage:
+    python scripts/mfu_sweep.py --list
+    python scripts/mfu_sweep.py <experiment>   # e.g. mb16_full
+    for e in $(python scripts/mfu_sweep.py --list); do \
+        python scripts/mfu_sweep.py $e; done
+
+Experiment axes: microbatch, flash block sizes (via MODALITIES_TPU_FLASH_BLOCK_Q/K),
+remat policy (full vs selective-op save lists). BENCH_ITERS trims timing iterations.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# (candidate tuple for bench._run_candidate, extra env)
+# candidate: (name, n_layer, n_embd, n_head, ffn, seq, mb, attn_impl, param_dtype, remat)
+_1B = (24, 2048, 16, 8192, 2048)
+
+
+def _cand(name, mb, attn="dao_flash", remat="full", seq=2048):
+    n_layer, n_embd, n_head, ffn, _ = _1B
+    return (name, n_layer, n_embd, n_head, ffn, seq, mb, attn, "bfloat16", remat)
+
+
+# Block sizes are pinned explicitly in every entry (the ops/attention.py default
+# moved 128 -> 1024 from this sweep's results; unpinned entries would silently stop
+# reproducing the configuration their names record).
+_B128 = {"MODALITIES_TPU_FLASH_BLOCK_Q": "128", "MODALITIES_TPU_FLASH_BLOCK_K": "128"}
+
+EXPERIMENTS: dict[str, tuple[tuple, dict[str, str]]] = {
+    "mb8_full_128": (_cand("mb8_full_128", 8), dict(_B128)),
+    "mb16_full_128": (_cand("mb16_full_128", 16), dict(_B128)),
+    "mb8_full_256": (_cand("mb8_full_256", 8), {"MODALITIES_TPU_FLASH_BLOCK_Q": "256", "MODALITIES_TPU_FLASH_BLOCK_K": "256"}),
+    "mb8_full_512": (_cand("mb8_full_512", 8), {"MODALITIES_TPU_FLASH_BLOCK_Q": "512", "MODALITIES_TPU_FLASH_BLOCK_K": "512"}),
+    "mb8_full_q256_k1024": (_cand("mb8_full_q256_k1024", 8), {"MODALITIES_TPU_FLASH_BLOCK_Q": "256", "MODALITIES_TPU_FLASH_BLOCK_K": "1024"}),
+    "mb8_full_q512_k1024": (_cand("mb8_full_q512_k1024", 8), {"MODALITIES_TPU_FLASH_BLOCK_Q": "512", "MODALITIES_TPU_FLASH_BLOCK_K": "1024"}),
+    "mb8_full_1024": (_cand("mb8_full_1024", 8), {"MODALITIES_TPU_FLASH_BLOCK_Q": "1024", "MODALITIES_TPU_FLASH_BLOCK_K": "1024"}),
+    "mb8_save_attn_512": (_cand("mb8_save_attn_512", 8, remat="selective_op:attn_out"), {"MODALITIES_TPU_FLASH_BLOCK_Q": "512", "MODALITIES_TPU_FLASH_BLOCK_K": "512"}),
+    "mb4_save_attn_512": (_cand("mb4_save_attn_512", 4, remat="selective_op:attn_out"), {"MODALITIES_TPU_FLASH_BLOCK_Q": "512", "MODALITIES_TPU_FLASH_BLOCK_K": "512"}),
+    "mb8_save_attn": (_cand("mb8_save_attn", 8, remat="selective_op:attn_out"), dict(_B128)),
+    "mb16_save_attn": (_cand("mb16_save_attn", 16, remat="selective_op:attn_out"), dict(_B128)),
+    "mb8_save_dots": (_cand("mb8_save_dots", 8, remat="selective_op:matmul"), dict(_B128)),
+    "mb8_sdpa_full": (_cand("mb8_sdpa_full", 8, attn="pytorch_flash"), {}),
+    "mb4_sdpa_full": (_cand("mb4_sdpa_full", 4, attn="pytorch_flash"), {}),
+    "mb2_noremat_1024": (_cand("mb2_noremat_1024", 2, remat=None), {"MODALITIES_TPU_FLASH_BLOCK_Q": "1024", "MODALITIES_TPU_FLASH_BLOCK_K": "1024"}),
+    "mb4_noremat_1024": (_cand("mb4_noremat_1024", 4, remat=None), {"MODALITIES_TPU_FLASH_BLOCK_Q": "1024", "MODALITIES_TPU_FLASH_BLOCK_K": "1024"}),
+    "mb8_full_q1024_k2048": (_cand("mb8_full_q1024_k2048", 8), {"MODALITIES_TPU_FLASH_BLOCK_Q": "1024", "MODALITIES_TPU_FLASH_BLOCK_K": "2048"}),
+    "mb16_full_1024": (_cand("mb16_full_1024", 16), {"MODALITIES_TPU_FLASH_BLOCK_Q": "1024", "MODALITIES_TPU_FLASH_BLOCK_K": "1024"}),
+}
+
+
+def main() -> None:
+    if len(sys.argv) != 2 or sys.argv[1] in ("-h", "--help"):
+        print(__doc__)
+        raise SystemExit(2)
+    if sys.argv[1] == "--list":
+        print("\n".join(EXPERIMENTS))
+        return
+    name = sys.argv[1]
+    cand, env = EXPERIMENTS[name]
+    os.environ.update(env)
+
+    import bench
+
+    iters = int(os.environ.get("BENCH_ITERS", "10"))
+    try:
+        result = bench._run_candidate(cand, iters)
+    except Exception as exc:  # OOM / lowering failures are sweep data, not crashes
+        result = {"experiment": name, "error": f"{type(exc).__name__}: {str(exc)[:300]}"}
+    result["experiment"] = name
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

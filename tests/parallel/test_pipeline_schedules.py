@@ -61,6 +61,43 @@ def test_interleaving_reduces_bubble_at_moderate_pp():
     assert inter.num_ticks / 2 <= onef1b.num_ticks
 
 
+@pytest.mark.parametrize("P,M", [(2, 2), (2, 4), (4, 4), (4, 8), (8, 8), (8, 16)])
+def test_zbv_tables_build_and_validate(P, M):
+    tb = build_schedule_tables("zbv", P, M)
+    assert tb.placement == "v" and tb.deferred_w and tb.num_virtual == 2
+    # V-shape: global stage g on device g (chunk 0) / 2P-1-g (chunk 1)
+    assert tb.device_of(0) == 0 and tb.device_of(P - 1) == P - 1
+    assert tb.device_of(P) == P - 1 and tb.device_of(2 * P - 1) == 0
+
+
+def test_zbv_backward_chain_is_the_short_path():
+    """The dx-only B slot is the schedule's point: at small M/P (bubble-dominated),
+    zbv's modeled wall (B=2 units, W off-path) beats 1f1b's (fused B=3)."""
+    P, M = 8, 8
+
+    def modeled_wall(tb, b_cost):
+        total = 0
+        for t in range(tb.num_ticks):
+            loads = [
+                int(tb.f[t, s] >= 0) * 1 + int(tb.b[t, s] >= 0) * b_cost + int(tb.h[t] >= 0)
+                for s in range(tb.num_stages)
+            ]
+            total += max(loads)
+        return total
+
+    tz = build_schedule_tables("zbv", P, M)
+    t1 = build_schedule_tables("1f1b", P, M)
+    # zbv stages are half-depth (V=2) -> halve its tick costs; add the off-path W
+    # block (~3 half-units x V x M / device, bubble-free)
+    zbv_wall = modeled_wall(tz, 2) / 2 + 3 * 2 * M / 2
+    assert zbv_wall < modeled_wall(t1, 3), (zbv_wall, modeled_wall(t1, 3))
+
+
+def test_zbv_rejects_bad_virtual():
+    with pytest.raises(ValueError, match="V shape"):
+        build_schedule_tables("zbv", 2, 4, num_virtual=4)
+
+
 def test_unknown_schedule_raises():
     with pytest.raises(NotImplementedError):
         build_schedule_tables("dualpipe_v", 4, 8)
