@@ -99,7 +99,8 @@ class ModelFactory:
             elif num_virtual_stages < 2:
                 raise ValueError("interleaved_1f1b requires num_virtual_stages >= 2")
         elif name == "zbv":
-            if num_virtual_stages not in (None, 2):
+            # same accepted set as the executor and table builder: unset/1 -> 2
+            if num_virtual_stages not in (None, 1, 2):
                 raise ValueError("zbv uses exactly 2 virtual chunks (the V shape)")
             num_virtual_stages = 2
         elif num_virtual_stages is not None and num_virtual_stages != 1:

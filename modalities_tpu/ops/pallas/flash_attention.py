@@ -13,8 +13,10 @@ Design (FlashAttention-2 style, TPU-first):
   the q-head group into the kv index map; dk/dv are accumulated per q-head and
   group-summed outside the kernel.
 - causal blocks above the diagonal are skipped via predicated bodies (@pl.when).
-- block sizes default to 128 (MXU tile) with fallbacks for short sequences;
-  interpret mode keeps CPU tests exact.
+- block sizes: this module's own defaults are 128 (the MXU tile), but the shipped
+  configuration is 1024x1024 via the ops/attention.py dispatch wrapper (1.8x faster
+  at 1.3B/seq-2048 on v5e — grid overhead dominates at tile-sized blocks), with
+  automatic step-down for short sequences; interpret mode keeps CPU tests exact.
 - TPU layout: per-row statistics (lse, delta) carry a trailing singleton lane dim
   ([B, H, S, 1] arrays, [block_q, 1] in-kernel tiles) because Mosaic requires the
   last two block dims to tile (8, 128) or equal the array dims — a bare [S] row

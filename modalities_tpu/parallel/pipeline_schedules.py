@@ -42,7 +42,7 @@ import numpy as np
 
 @dataclass(frozen=True)
 class ScheduleTables:
-    """Static schedule: arrays [T, P] (f/b/w; values chunk*M+mb or -1) and [T] (h).
+    """Static schedule: arrays [T, P] (f/b; values chunk*M+mb or -1) and [T] (h).
 
     ``placement`` maps global stage g to its device:
     - "loop": device = g % P, chunk = g // P; activations always hop s -> s+1

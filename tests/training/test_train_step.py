@@ -335,6 +335,34 @@ def test_dp_pp_zbv_equivalence():
     np.testing.assert_allclose(losses["dp"], losses["pp_zbv"], rtol=3e-4, atol=3e-4)
 
 
+def test_dp_pp4_zbv_equivalence():
+    """dp8 vs pp4 x dp2 under ZBV: exercises the MIDDLE devices of the V (stages
+    strictly between 0 and P-1), which pp=2 never does — simultaneous descend/ascend
+    activation receives and cotangent relays without the local turn."""
+    mesh_dp = get_device_mesh(device_type="cpu", data_parallel_shard_degree=8, world_size=8)
+    mesh_pp = get_device_mesh(
+        device_type="cpu", data_parallel_shard_degree=2, pipeline_parallel_degree=4, world_size=8
+    )
+    rng = np.random.default_rng(31)
+    raw = _batch(rng, 1, 8, 16)
+
+    losses = {}
+    for name, mesh in [("dp", mesh_dp), ("pp4_zbv", mesh_pp)]:
+        model_run = tiny_gpt2("pytorch_flash", n_layer=8)  # 8 layers = 4 devices x 2 V-chunks
+        if name == "pp4_zbv":
+            model_run.with_spec_updates(
+                pp_schedule="zbv", pp_num_microbatches=4, pp_num_virtual=2
+            )
+        fns = _builder(model_run, mesh, clip=1.0).build(seed=0)
+        state = fns.app_state_handle.state
+        ls = []
+        for _ in range(2):
+            state, metrics = fns.train_step(state, fns.put_batch(raw))
+            ls.append(float(metrics["loss"]))
+        losses[name] = ls
+    np.testing.assert_allclose(losses["dp"], losses["pp4_zbv"], rtol=3e-4, atol=3e-4)
+
+
 def test_pp_zbv_dropout_deterministic():
     """dropout > 0 under ZBV: the B-slot recompute and the post-scan W re-forward
     must fold the same per-(microbatch, layer) rng as the F pass — same seed is
@@ -362,9 +390,11 @@ def test_pp_zbv_dropout_deterministic():
     assert a[-1] < a[0], f"did not train with dropout under ZBV: {a}"
 
 
-def test_dp_pp_1f1b_equivalence_with_ignore_index():
+@pytest.mark.parametrize("schedule", ["1f1b", "zbv"])
+def test_dp_pp_equivalence_with_ignore_index(schedule):
     """Unequal valid-token counts across pp microbatches (ignore_index=-100) must not
-    skew the 1F1B loss: contributions are token-weighted, matching the global mean."""
+    skew the scheduled-executor loss: contributions are token-weighted, matching the
+    global mean — for 1F1B's fused backward and ZBV's split backward alike."""
     mesh_dp = get_device_mesh(device_type="cpu", data_parallel_shard_degree=8, world_size=8)
     mesh_pp = get_device_mesh(
         device_type="cpu", data_parallel_shard_degree=4, pipeline_parallel_degree=2, world_size=8
@@ -377,10 +407,14 @@ def test_dp_pp_1f1b_equivalence_with_ignore_index():
     raw["targets"]["target_ids"] = t
 
     losses = {}
-    for name, mesh in [("dp", mesh_dp), ("pp_1f1b", mesh_pp)]:
-        model_run = tiny_gpt2("pytorch_flash")
-        if name == "pp_1f1b":
-            model_run.with_spec_updates(pp_schedule="1f1b", pp_num_microbatches=4)
+    for name, mesh in [("dp", mesh_dp), ("pp_sched", mesh_pp)]:
+        model_run = tiny_gpt2("pytorch_flash", n_layer=4)
+        if name == "pp_sched":
+            model_run.with_spec_updates(
+                pp_schedule=schedule,
+                pp_num_microbatches=4,
+                pp_num_virtual=2 if schedule == "zbv" else 1,
+            )
         fns = _builder(model_run, mesh, clip=1.0).build(seed=0)
         state = fns.app_state_handle.state
         ls = []
@@ -388,7 +422,7 @@ def test_dp_pp_1f1b_equivalence_with_ignore_index():
             state, metrics = fns.train_step(state, fns.put_batch(raw))
             ls.append(float(metrics["loss"]))
         losses[name] = ls
-    np.testing.assert_allclose(losses["dp"], losses["pp_1f1b"], rtol=3e-4, atol=3e-4)
+    np.testing.assert_allclose(losses["dp"], losses["pp_sched"], rtol=3e-4, atol=3e-4)
 
 
 def test_loss_parallel_equivalence_and_rule():
