@@ -38,17 +38,22 @@ class CLMCrossEntropyLoss(Loss):
         self.prediction_key = prediction_key
         self.ignore_index = ignore_index
 
-    def __call__(self, predictions: dict, targets: dict):
-        logits = predictions[self.prediction_key]
-        labels = targets[self.target_key]
+    def sum_and_count(self, logits, labels):
+        """(sum of per-token CE over non-ignored positions, their count) — the
+        accumulation form used by the chunked head+loss path and the pipeline
+        executor's token-weighted mean."""
         mask = (labels != self.ignore_index).astype(jnp.float32)
         safe_labels = jnp.where(labels == self.ignore_index, 0, labels)
         token_losses = optax.softmax_cross_entropy_with_integer_labels(
             logits.astype(jnp.float32), safe_labels
         )
-        total = (token_losses * mask).sum()
-        count = jnp.maximum(mask.sum(), 1.0)
-        return total / count
+        return (token_losses * mask).sum(), mask.sum()
+
+    def __call__(self, predictions: dict, targets: dict):
+        total, count = self.sum_and_count(
+            predictions[self.prediction_key], targets[self.target_key]
+        )
+        return total / jnp.maximum(count, 1.0)
 
 
 class NCELoss(Loss):

@@ -116,11 +116,24 @@ class GPT2LLMConfig(BaseModel):
     use_weight_tying: bool
     seed: Optional[int] = None
     enforce_swiglu_hidden_dim_multiple_of: int = 256
+    # fuse lm-head + loss per sequence chunk (long-context memory: [B,S,V] fp32
+    # logits never materialize); None = whole-sequence logits
+    lm_head_chunk_size: Optional[Annotated[int, Field(strict=True, ge=1)]] = None
 
     @model_validator(mode="after")
     def check_divisibility(self) -> "GPT2LLMConfig":
         if self.n_head_q % self.n_head_kv != 0:
             raise ValueError("n_head_q must be divisible by n_head_kv")
+        if (
+            self.lm_head_chunk_size is not None
+            and self.sequence_length % self.lm_head_chunk_size != 0
+        ):
+            # a non-divisor would silently fall back to whole-sequence logits —
+            # the exact memory blowup the chunking exists to prevent
+            raise ValueError(
+                f"sequence_length ({self.sequence_length}) must be divisible by "
+                f"lm_head_chunk_size ({self.lm_head_chunk_size})"
+            )
         return self
 
     @model_validator(mode="after")
@@ -169,6 +182,10 @@ class GPT2ModelSpec:
     remat_variant: Optional[str] = None
     remat_freq: int = 1
     remat_save_list: tuple[str, ...] = ()
+    # fuse lm-head + CE per sequence chunk of this size (train/eval step): the
+    # [B,S,V] fp32 logits never materialize — at 32k ctx x 50k vocab that tensor
+    # alone is 6.6 GB, more than a v5e can give it. None = whole-sequence logits.
+    lm_head_chunk_size: Optional[int] = None
     context_parallel_axis: Optional[str] = None  # set when the mesh has cp > 1
     pipeline_axis: Optional[str] = None  # set when the mesh has pp > 1
     pp_num_microbatches: Optional[int] = None  # GPipe microbatches (default: pp degree)
@@ -207,6 +224,7 @@ class GPT2ModelSpec:
                 self.remat_variant,
                 self.remat_freq,
                 self.remat_save_list,
+                self.lm_head_chunk_size,
                 self.context_parallel_axis,
                 self.pipeline_axis,
                 self.pp_num_microbatches,
@@ -440,6 +458,43 @@ class GPT2Block(nn.Module):
         return x
 
 
+def _layer_remats(spec: "GPT2ModelSpec", layer_index: int) -> bool:
+    """Whether block `layer_index` is remat-wrapped (reference
+    ActivationCheckpointing semantics: SELECTIVE_LAYER remats every ac_freq-th
+    block; FULL/SELECTIVE_OP remat every block)."""
+    if spec.remat_variant in ("full", "selective_op"):
+        return True
+    if spec.remat_variant == "selective_layer":
+        return layer_index % max(spec.remat_freq, 1) == 0
+    return False
+
+
+def _remat_block_cls(spec: "GPT2ModelSpec"):
+    """GPT2Block wrapped in nn.remat with the spec's checkpoint policy (shared by
+    the scan body and the unrolled-blocks path so their remat behavior never
+    diverges)."""
+    policy = None
+    if spec.remat_variant == "selective_op":
+        from modalities_tpu.training.activation_checkpointing import save_list_policy
+
+        policy = save_list_policy(spec.remat_save_list)
+    return nn.remat(GPT2Block, prevent_cse=False, policy=policy)
+
+
+def head_project(spec: "GPT2ModelSpec", inner_params, h):
+    """fp32 vocab logits from post-lm_head_norm hidden `h` — the single source of
+    the tied/untied head projection for every params-based (non-module) path:
+    chunked head+loss, the scheduled pipeline's head stage. Applies the
+    vocab_logits constraint so loss-parallel (vocab over tp) works identically to
+    the in-module head."""
+    h = h.astype(jnp.float32)
+    if spec.use_weight_tying:
+        logits = jnp.einsum("bse,ve->bsv", h, inner_params["wte"].astype(jnp.float32))
+    else:
+        logits = h @ inner_params["lm_head"]["kernel"].astype(jnp.float32)
+    return with_logical_constraint(logits, ("batch", "seq", "vocab_logits"))
+
+
 class _BlockScanBody(nn.Module):
     """scan body: carry = activations; applies (optionally remat-wrapped) block."""
 
@@ -452,12 +507,15 @@ class _BlockScanBody(nn.Module):
         spec = self.spec
         block_cls = GPT2Block
         if spec.remat_variant in ("full", "selective_layer", "selective_op") and not self.decode:
-            policy = None
-            if spec.remat_variant == "selective_op":
-                from modalities_tpu.training.activation_checkpointing import save_list_policy
-
-                policy = save_list_policy(spec.remat_save_list)
-            block_cls = nn.remat(GPT2Block, prevent_cse=False, policy=policy)
+            if spec.remat_variant == "selective_layer" and spec.remat_freq > 1:
+                raise ValueError(
+                    "selective_layer activation checkpointing with ac_freq > 1 needs "
+                    "per-layer remat decisions, which the scan-over-layers "
+                    "representation cannot express (one traced body serves every "
+                    "layer). Set the model's scan_layers=False (unrolled blocks) to "
+                    "use ac_freq > 1, or use ac_freq=1 / 'full'."
+                )
+            block_cls = _remat_block_cls(spec)
         x = block_cls(spec, self.deterministic, self.decode, name="block")(carry)
         return x, None
 
@@ -466,11 +524,15 @@ class GPT2Module(nn.Module):
     """The linen module behind GPT2LLM: wte/wpe -> blocks -> lm_head_norm -> lm_head.
 
     `decode=True`: autoregressive KV-cache mode — pass tokens for NEW positions only;
-    per-layer k/v caches and the running position live in the ``cache`` collection."""
+    per-layer k/v caches and the running position live in the ``cache`` collection.
+    `output_hidden=True`: stop after lm_head_norm and return the [B,S,E] hidden
+    state instead of logits (the chunked head+loss path computes the vocab
+    projection per sequence chunk outside the module)."""
 
     spec: GPT2ModelSpec
     deterministic: bool = True
     decode: bool = False
+    output_hidden: bool = False
 
     @nn.compact
     def __call__(self, input_ids):
@@ -552,10 +614,17 @@ class GPT2Module(nn.Module):
                 x, _ = scanned(x, None)
         else:
             for i in range(spec.n_layer):
-                x = GPT2Block(spec, self.deterministic, self.decode, name=f"h_{i}")(x)
+                block_cls = (
+                    _remat_block_cls(spec)
+                    if not self.decode and _layer_remats(spec, i)
+                    else GPT2Block
+                )
+                x = block_cls(spec, self.deterministic, self.decode, name=f"h_{i}")(x)
 
         x = build_norm(spec.lm_head_norm, "lm_head_norm")(x)
         x = with_logical_constraint(x, ("batch", "seq", "embed"))
+        if self.output_hidden:
+            return x
         if spec.use_weight_tying:
             logits = jnp.einsum("bse,ve->bsv", x.astype(jnp.float32), wte.astype(jnp.float32))
         else:
@@ -597,6 +666,7 @@ class GPT2LLM(NNModel):
         use_meta_device: bool = False,
         seed: Optional[int] = None,
         enforce_swiglu_hidden_dim_multiple_of: int = 256,
+        lm_head_chunk_size: Optional[int] = None,
     ):
         super().__init__(
             sample_key=sample_key,
@@ -655,6 +725,7 @@ class GPT2LLM(NNModel):
                 if attention_config.qk_norm_config is not None
                 else None
             ),
+            lm_head_chunk_size=lm_head_chunk_size,
         )
         self.sequence_length = sequence_length
         self.vocab_size = vocab_size
@@ -681,6 +752,22 @@ class GPT2LLM(NNModel):
         module = self.train_module() if train else self.module
         logits = module.apply(params, inputs[self.sample_key], rngs=rngs)
         return {self.prediction_key: logits}
+
+    # ------------------------------------------------------- chunked head + loss
+    def apply_hidden(self, params, inputs: dict, train: bool = False, rngs=None):
+        """Backbone through lm_head_norm -> [B, S, E] hidden state (no logits).
+        Pair with `head_logits` per sequence chunk so the [B,S,V] fp32 logits
+        tensor never materializes (spec.lm_head_chunk_size; consumed by
+        TrainStepBuilder)."""
+        module = GPT2Module(
+            self.config_spec, deterministic=not train, output_hidden=True
+        )
+        return module.apply(params, inputs[self.sample_key], rngs=rngs)
+
+    def head_logits(self, params, hidden_chunk):
+        """fp32 logits for a [B, C, E] hidden chunk (weight-tied or lm_head),
+        vocab-constrained like the in-module head (loss parallel works)."""
+        return head_project(self.config_spec, params["params"], hidden_chunk)
 
     # ----------------------------------------------------------- KV-cache decoding
     def init_decode_cache(self, params, batch_size: int):
@@ -755,12 +842,7 @@ class GPT2LLM(NNModel):
             h = build_norm(spec.lm_head_norm, "lm_head_norm").apply(
                 {"params": p.get("lm_head_norm", {})}, x
             )
-            if spec.use_weight_tying:
-                logits = jnp.einsum(
-                    "bse,ve->bsv", h.astype(jnp.float32), p["wte"].astype(jnp.float32)
-                )
-            else:
-                logits = h.astype(jnp.float32) @ p["lm_head"]["kernel"].astype(jnp.float32)
+            logits = head_project(spec, p, h)
             loss = loss_fn({prediction_key: logits}, {target_key: targets})
             if ignore_index is None:
                 weight = jnp.asarray(targets.size, jnp.float32)

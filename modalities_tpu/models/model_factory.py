@@ -89,9 +89,13 @@ class ModelFactory:
             name = "zbv"
         if name not in ("gpipe", "1f1b", "interleaved_1f1b", "zbv"):
             raise NotImplementedError(
-                f"pipeline schedule {pp_schedule_name!r} not supported yet "
-                "(have: gpipe, 1f1b, interleaved_1f1b, zbv; reference also ships "
-                "DualPipeV)"
+                f"pipeline schedule {pp_schedule_name!r} not supported "
+                "(have: gpipe, 1f1b, interleaved_1f1b, zbv). The reference also "
+                "ships DualPipeV; its distinguishing property — overlapping each "
+                "forward with another microbatch's backward to hide comm — is "
+                "already realized by this executor's tick model (every tick runs "
+                "an F and a B slot in one compiled SPMD program, hops at tick "
+                "end), so use 'zbv' for the V-placement zero-bubble schedule."
             )
         if name == "interleaved_1f1b":
             if num_virtual_stages is None:

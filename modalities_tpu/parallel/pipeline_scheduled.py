@@ -26,9 +26,9 @@ Interleaved 1F1B (`num_virtual` > 1): each device owns V layer chunks; global st
 ``g = chunk*P + device``. The stacked [L, ...] params are viewed as
 [V, P, L/(V*P), ...] with axis 1 sharded over pp, so device s holds chunks
 {c*P + s}. Activations still hop device -> device+1; the wrap from device P-1 to 0
-advances the chunk. Note: at high pp degrees the greedy interleaved tables are
-correct but not tight — prefer "1f1b" with more microbatches there
-(parallel/pipeline_schedules.py).
+advances the chunk. When M is divisible by P the tables follow the canonical
+Megatron/torch interleaved op ordering (tight: beats 1f1b wall-clock at pp >= 8);
+other M fall back to a greedy simulator that is correct but looser.
 
 ZBV (`schedule="zbv"`, reference ScheduleZBVZeroBubble): V=2 chunks in a V shape —
 device s owns global stages s and 2P-1-s (chunk 1's rows are device-flipped before

@@ -131,6 +131,10 @@ def build_schedule_tables(
         raise ValueError(f"{schedule} requires num_virtual=1 (got {num_virtual})")
     if schedule == "interleaved_1f1b" and num_virtual < 2:
         raise ValueError("interleaved_1f1b requires num_virtual >= 2")
+    if schedule == "interleaved_1f1b" and num_microbatches % num_stages == 0:
+        # the canonical ordered schedule is tight; the greedy below remains the
+        # fallback for microbatch counts that don't fill whole groups of P
+        return _build_interleaved_ordered(num_stages, num_microbatches, num_virtual)
 
     P, M, V = num_stages, num_microbatches, num_virtual
     G = V * P  # global stages; g's device is g % P, chunk is g // P
@@ -219,6 +223,103 @@ def build_schedule_tables(
             f_done[g, m] = t
 
         # H slot: sees this tick's last-stage forward (broadcast precedes it)
+        hm = next((m for m in range(M) if h_done[m] < 0 and 0 <= f_done[last_g, m] <= t), -1)
+        if hm >= 0:
+            h_done[hm] = t
+
+        f_rows.append(f_row)
+        b_rows.append(b_row)
+        h_rows.append(hm)
+        t += 1
+
+    tables = ScheduleTables(
+        f=np.stack(f_rows),
+        b=np.stack(b_rows),
+        h=np.asarray(h_rows, dtype=np.int64),
+        num_stages=P,
+        num_microbatches=M,
+        num_virtual=V,
+    )
+    _validate(tables)
+    return tables
+
+
+def _build_interleaved_ordered(num_stages: int, num_microbatches: int, num_virtual: int) -> ScheduleTables:
+    """Canonical interleaved-1F1B op ordering (the Megatron-LM / torch
+    Interleaved1F1B pattern, reference pipeline_parallelism.py:13-20), simulated
+    onto tick tables. Each device works through its (chunk, microbatch) ops in the
+    fixed order "groups of P microbatches, cycling chunks" —
+    F: (c0, m0..m_{P-1}), (c1, m0..m_{P-1}), (c0, m_P..), ... and B the same with
+    chunks reversed — with a warmup of 2*(P-s-1) + (V-1)*P forwards, then strict
+    1F-1B alternation. Requires M % P == 0 (whole groups); the greedy builder
+    handles other M. Tighter than the greedy at every (P, M) tested: e.g. P=8 M=16
+    V=2 drops from 117 ticks to 55."""
+    P, M, V = num_stages, num_microbatches, num_virtual
+
+    def op_order(reverse_chunks: bool):
+        order = []
+        for j in range((M // P) * V):
+            c = j % V
+            if reverse_chunks:
+                c = V - 1 - c
+            base = (j // V) * P
+            order.extend((c, base + i) for i in range(P))
+        return order
+
+    f_order = op_order(False)
+    b_order = op_order(True)
+    G = V * P
+    last_g = G - 1
+    f_done = -np.ones((G, M), dtype=np.int64)
+    b_done = -np.ones((G, M), dtype=np.int64)
+    h_done = -np.ones((M,), dtype=np.int64)
+    f_ptr = [0] * P
+    b_ptr = [0] * P
+    warmup = [min(len(f_order), 2 * (P - s - 1) + (V - 1) * P) for s in range(P)]
+
+    f_rows, b_rows, h_rows = [], [], []
+    t = 0
+    max_ticks = 16 * (V * M + P) + 32
+    while (b_done < 0).any() or (h_done < 0).any():
+        if t >= max_ticks:
+            raise RuntimeError(f"ordered interleaved schedule did not converge (P={P}, M={M}, V={V})")
+        f_row = -np.ones(P, dtype=np.int64)
+        b_row = -np.ones(P, dtype=np.int64)
+
+        # B slots (deps strictly earlier; H from earlier ticks only — the executor's
+        # same-tick H->B ordering makes this conservative, never wrong)
+        for s in range(P):
+            if b_ptr[s] >= len(b_order):
+                continue
+            c, m = b_order[b_ptr[s]]
+            g = c * P + s
+            if not (0 <= f_done[g, m] < t):
+                continue
+            if g == last_g:
+                if not (0 <= h_done[m] < t):
+                    continue
+            elif not (0 <= b_done[g + 1, m] < t):
+                continue
+            b_row[s] = c * M + m
+            b_done[g, m] = t
+            b_ptr[s] += 1
+
+        # F slots: warmup forwards freely, then strict 1F-1B pacing — at most one
+        # forward beyond warmup per completed backward (Megatron's steady-state
+        # "forward_step; backward_step" iteration expressed as a count bound)
+        for s in range(P):
+            if f_ptr[s] >= len(f_order):
+                continue
+            if f_ptr[s] >= warmup[s] + b_ptr[s] + 1:
+                continue
+            c, m = f_order[f_ptr[s]]
+            g = c * P + s
+            if g > 0 and not (0 <= f_done[g - 1, m] < t):
+                continue
+            f_row[s] = c * M + m
+            f_done[g, m] = t
+            f_ptr[s] += 1
+
         hm = next((m for m in range(M) if h_done[m] < 0 and 0 <= f_done[last_g, m] <= t), -1)
         if hm >= 0:
             h_done[hm] = t

@@ -3,10 +3,13 @@
 
 Reference variants -> TPU equivalents:
 - FULL: remat every transformer block (``nn.remat`` around the scanned block).
-- SELECTIVE_LAYER (every ac_freq-th block): remat wrapper applied inside the scan with
-  a static block-index predicate.
+- SELECTIVE_LAYER (every ac_freq-th block): honored on the unrolled-blocks model
+  (``scan_layers=False``) where each layer gets its own remat decision; the
+  scan-over-layers representation traces ONE body for every layer, so ac_freq > 1
+  there raises with instructions rather than silently rematting everything.
 - SELECTIVE_OP (save-list over ops: mm/SDPA/max/reduce_scatter): a jax.checkpoint
-  policy built from `save_only_these_names` / `dots_with_no_batch_dims_saveable`.
+  policy built from `save_only_these_names` / `dots_with_no_batch_dims_saveable`;
+  the attention output carries a ``checkpoint_name("attn_out")`` save point.
 """
 
 from __future__ import annotations

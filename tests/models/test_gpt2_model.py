@@ -180,3 +180,35 @@ def test_swiglu_hidden_dim():
 
     assert swiglu_hidden_dim(1024) == 768  # 2/3*1024=682.67 -> round up to 768
     assert swiglu_hidden_dim(768, 256) == 512
+
+
+def test_selective_layer_remat_honored_on_unrolled_blocks():
+    """SELECTIVE_LAYER ac_freq > 1 (remat every freq-th block) needs per-layer remat
+    decisions: honored on the unrolled-blocks model, numerics identical to no-remat;
+    the scanned model raises with instructions instead of silently ignoring ac_freq."""
+    tokens = {"input_ids": jnp.asarray(np.random.default_rng(0).integers(0, 128, (2, 16)), jnp.int32)}
+
+    unrolled = tiny_gpt2(n_layer=4).with_spec_updates(
+        scan_layers=False, remat_variant="selective_layer", remat_freq=2
+    )
+    params = unrolled.init_params(jax.random.PRNGKey(0))
+
+    def loss(p):
+        return unrolled.apply(p, tokens)["logits"].astype(jnp.float32).mean()
+
+    val, grads = jax.value_and_grad(loss)(params)
+    assert np.isfinite(float(val))
+    assert all(np.isfinite(np.asarray(g)).all() for g in jax.tree.leaves(grads))
+
+    plain = tiny_gpt2(n_layer=4).with_spec_updates(scan_layers=False)
+    params_plain = plain.init_params(jax.random.PRNGKey(0))
+    np.testing.assert_array_equal(
+        np.asarray(unrolled.apply(params, tokens)["logits"]),
+        np.asarray(plain.apply(params_plain, tokens)["logits"]),
+    )
+
+    scanned = tiny_gpt2(n_layer=4).with_spec_updates(
+        remat_variant="selective_layer", remat_freq=2
+    )
+    with pytest.raises(ValueError, match="scan_layers=False"):
+        scanned.init_params(jax.random.PRNGKey(0))

@@ -479,3 +479,36 @@ def test_dp_pp_interleaved_1f1b_equivalence():
             ls.append(float(metrics["loss"]))
         losses[name] = ls
     np.testing.assert_allclose(losses["dp"], losses["pp_interleaved"], rtol=3e-4, atol=3e-4)
+
+
+def test_chunked_lm_head_loss_equivalence():
+    """lm_head_chunk_size fuses head+CE per sequence chunk so [B,S,V] logits never
+    materialize; losses (train AND eval) must equal the full-logits path, including
+    under ignore_index masking."""
+    mesh = get_device_mesh(device_type="cpu", data_parallel_shard_degree=8, world_size=8)
+    rng = np.random.default_rng(37)
+    raw = _batch(rng, 1, 8, 32)
+    t = raw["targets"]["target_ids"]
+    t[:, :3, 5:] = -100  # unequal valid counts across chunks
+    raw["targets"]["target_ids"] = t
+
+    losses, evals = {}, {}
+    for chunk in (None, 8):
+        model_run = tiny_gpt2("pytorch_flash")
+        if chunk is not None:
+            model_run.with_spec_updates(lm_head_chunk_size=chunk)
+        fns = _builder(model_run, mesh, clip=1.0).build(seed=0)
+        state = fns.app_state_handle.state
+        ev_batch = fns.put_batch(
+            {"samples": {k: v[0] for k, v in raw["samples"].items()},
+             "targets": {k: v[0] for k, v in raw["targets"].items()}},
+            has_acc_dim=False,
+        )
+        evals[chunk] = float(fns.eval_step(state, ev_batch)["loss"])
+        ls = []
+        for _ in range(3):
+            state, metrics = fns.train_step(state, fns.put_batch(raw))
+            ls.append(float(metrics["loss"]))
+        losses[chunk] = ls
+    np.testing.assert_allclose(losses[None], losses[8], rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(evals[None], evals[8], rtol=2e-5, atol=2e-5)
