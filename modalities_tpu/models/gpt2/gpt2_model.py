@@ -189,7 +189,7 @@ class GPT2ModelSpec:
     context_parallel_axis: Optional[str] = None  # set when the mesh has cp > 1
     pipeline_axis: Optional[str] = None  # set when the mesh has pp > 1
     pp_num_microbatches: Optional[int] = None  # GPipe microbatches (default: pp degree)
-    pp_schedule: str = "gpipe"  # "gpipe" = in-module autodiff GPipe; "1f1b"/"interleaved_1f1b"/"zbv" = scheduled executor
+    pp_schedule: str = "gpipe"  # "gpipe" = in-module autodiff GPipe; "1f1b"/"interleaved_1f1b"/"zbv"/"dualpipev" = scheduled executor
     pp_num_virtual: int = 1  # virtual chunks per device (interleaved_1f1b)
     param_dtype: str = "float32"  # storage dtype (MixedPrecisionSpec.param_dtype)
     compute_dtype: str = "bfloat16"  # block compute dtype (MXU-native)
@@ -832,22 +832,65 @@ class GPT2LLM(NNModel):
                 rngs={"dropout": rng} if rng is not None else None,
             )
 
-        ignore_index = getattr(loss_fn, "ignore_index", None)
+        has_sum_count = hasattr(loss_fn, "sum_and_count")
+        head_chunk = spec.lm_head_chunk_size if has_sum_count else None
+
+        def _norm_head_sum(p, xc, lc):
+            """(sum of token losses, valid-token count) for one sequence chunk —
+            the lm-head norm is per-token, so chunking before it is exact."""
+            h = build_norm(spec.lm_head_norm, "lm_head_norm").apply(
+                {"params": p.get("lm_head_norm", {})}, xc
+            )
+            return loss_fn.sum_and_count(head_project(spec, p, h), lc)
+
+        # backward recomputes each chunk's logits instead of storing them — same
+        # remat trade as the unpipelined fused chunked head+loss in train_step
+        chunk_sum_count = jax.checkpoint(_norm_head_sum, prevent_cse=False)
 
         def head_loss(shared, x, targets):
             """Returns (mean loss over this microbatch, valid-token weight). The weight
             lets the executor reproduce the GLOBAL token mean exactly even when
-            ignore_index masking makes microbatch token counts unequal."""
+            ignore_index masking makes microbatch token counts unequal. Honors
+            spec.lm_head_chunk_size: the [B,S,V] logits never materialize — the
+            head+loss run per sequence chunk, accumulating (sum, count)."""
             p = shared["params"]
-            h = build_norm(spec.lm_head_norm, "lm_head_norm").apply(
-                {"params": p.get("lm_head_norm", {})}, x
-            )
-            logits = head_project(spec, p, h)
-            loss = loss_fn({prediction_key: logits}, {target_key: targets})
-            if ignore_index is None:
-                weight = jnp.asarray(targets.size, jnp.float32)
+            seq = x.shape[1]
+            if head_chunk is not None and seq > head_chunk and seq % head_chunk != 0:
+                # falling back would materialize the [B,S,V] logits the chunking
+                # exists to avoid — fail fast instead (mirrors train_step)
+                raise ValueError(
+                    f"sequence length {seq} is not divisible by "
+                    f"lm_head_chunk_size {head_chunk}"
+                )
+            if head_chunk is not None and seq > head_chunk:
+                num_chunks = seq // head_chunk
+
+                def body(acc, i):
+                    xc = jax.lax.dynamic_slice_in_dim(x, i * head_chunk, head_chunk, 1)
+                    lc = jax.lax.dynamic_slice_in_dim(targets, i * head_chunk, head_chunk, 1)
+                    s, c = chunk_sum_count(p, xc, lc)
+                    return (acc[0] + s, acc[1] + c), None
+
+                (total, count), _ = jax.lax.scan(
+                    body,
+                    (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32)),
+                    jnp.arange(num_chunks),
+                )
+            elif has_sum_count:
+                total, count = _norm_head_sum(p, x, targets)
             else:
-                weight = jnp.maximum((targets != ignore_index).sum().astype(jnp.float32), 1.0)
-            return loss, weight
+                # loss fns without the accumulation form: whole-sequence logits;
+                # the valid-token weight still honors an ignore_index if exposed
+                h = build_norm(spec.lm_head_norm, "lm_head_norm").apply(
+                    {"params": p.get("lm_head_norm", {})}, x
+                )
+                loss = loss_fn({prediction_key: head_project(spec, p, h)}, {target_key: targets})
+                ignore_index = getattr(loss_fn, "ignore_index", None)
+                if ignore_index is None:
+                    return loss, jnp.asarray(targets.size, jnp.float32)
+                weight = (targets != ignore_index).sum().astype(jnp.float32)
+                return loss, jnp.maximum(weight, 1.0)
+            weight = jnp.maximum(count, 1.0)
+            return total / weight, weight
 
         return PipelineStageFns(embed=embed, block=block, head_loss=head_loss)

@@ -87,25 +87,23 @@ class ModelFactory:
         name = pp_schedule_name.strip().lower()
         if name in ("zbvzerobubble", "zb_v", "zbv_zero_bubble"):  # reference class name
             name = "zbv"
-        if name not in ("gpipe", "1f1b", "interleaved_1f1b", "zbv"):
+        if name in ("dualpipe_v", "dual_pipe_v", "scheduledualpipev"):  # reference class name
+            name = "dualpipev"
+        if name not in ("gpipe", "1f1b", "interleaved_1f1b", "zbv", "dualpipev"):
             raise NotImplementedError(
                 f"pipeline schedule {pp_schedule_name!r} not supported "
-                "(have: gpipe, 1f1b, interleaved_1f1b, zbv). The reference also "
-                "ships DualPipeV; its distinguishing property — overlapping each "
-                "forward with another microbatch's backward to hide comm — is "
-                "already realized by this executor's tick model (every tick runs "
-                "an F and a B slot in one compiled SPMD program, hops at tick "
-                "end), so use 'zbv' for the V-placement zero-bubble schedule."
+                "(have: gpipe, 1f1b, interleaved_1f1b, zbv, dualpipev — all five "
+                "reference schedules, pipeline_parallelism.py:13-20)"
             )
         if name == "interleaved_1f1b":
             if num_virtual_stages is None:
                 num_virtual_stages = 2  # the schedule's minimum (and common) setting
             elif num_virtual_stages < 2:
                 raise ValueError("interleaved_1f1b requires num_virtual_stages >= 2")
-        elif name == "zbv":
+        elif name in ("zbv", "dualpipev"):
             # same accepted set as the executor and table builder: unset/1 -> 2
             if num_virtual_stages not in (None, 1, 2):
-                raise ValueError("zbv uses exactly 2 virtual chunks (the V shape)")
+                raise ValueError(f"{name} uses exactly 2 virtual chunks (the V shape)")
             num_virtual_stages = 2
         elif num_virtual_stages is not None and num_virtual_stages != 1:
             raise ValueError(

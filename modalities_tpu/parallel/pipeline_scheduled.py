@@ -30,7 +30,9 @@ advances the chunk. When M is divisible by P the tables follow the canonical
 Megatron/torch interleaved op ordering (tight: beats 1f1b wall-clock at pp >= 8);
 other M fall back to a greedy simulator that is correct but looser.
 
-ZBV (`schedule="zbv"`, reference ScheduleZBVZeroBubble): V=2 chunks in a V shape —
+ZBV / DualPipeV (`schedule="zbv"` / `"dualpipev"`, reference ScheduleZBVZeroBubble /
+ScheduleDualPipeV — identical tables here; see pipeline_schedules._build_zbv_tables
+for why the two collapse in this tick model): V=2 chunks in a V shape —
 device s owns global stages s and 2P-1-s (chunk 1's rows are device-flipped before
 the shard_map), activations descend then ascend (the turn at device P-1 is a local
 write), and the first/last stage share device 0. The backward is split: the B slot
@@ -167,9 +169,11 @@ def scheduled_pipeline_loss_and_grads(
     M = min(M, batch)
     if batch % M != 0:
         raise ValueError(f"batch ({batch}) must be divisible by num_microbatches ({M})")
-    if schedule == "zbv" and num_virtual not in (None, 1, 2):
-        raise ValueError(f"zbv uses exactly 2 virtual chunks (got num_virtual={num_virtual})")
-    V = 2 if schedule == "zbv" else num_virtual
+    if schedule in ("zbv", "dualpipev") and num_virtual not in (None, 1, 2):
+        raise ValueError(
+            f"{schedule} uses exactly 2 virtual chunks (got num_virtual={num_virtual})"
+        )
+    V = 2 if schedule in ("zbv", "dualpipev") else num_virtual
     tables = build_schedule_tables(schedule, num_stages, M, num_virtual=V)
     if tables.deferred_w:
         # zbv: the (x_in, dy_in) pairs must survive until the post-scan weight-grad

@@ -99,7 +99,7 @@ class ScheduleTables:
         return 1.0 - useful / total_slots
 
 
-SUPPORTED_SCHEDULES = ("gpipe", "1f1b", "interleaved_1f1b", "zbv")
+SUPPORTED_SCHEDULES = ("gpipe", "1f1b", "interleaved_1f1b", "zbv", "dualpipev")
 
 
 def build_schedule_tables(
@@ -120,12 +120,11 @@ def build_schedule_tables(
     """
     if schedule not in SUPPORTED_SCHEDULES:
         raise NotImplementedError(
-            f"pipeline schedule {schedule!r} not supported (have {SUPPORTED_SCHEDULES}; "
-            "reference also ships DualPipeV)"
+            f"pipeline schedule {schedule!r} not supported (have {SUPPORTED_SCHEDULES})"
         )
-    if schedule == "zbv":
+    if schedule in ("zbv", "dualpipev"):
         if num_virtual not in (1, 2):
-            raise ValueError("zbv uses exactly 2 virtual chunks (the V shape)")
+            raise ValueError(f"{schedule} uses exactly 2 virtual chunks (the V shape)")
         return _build_zbv_tables(num_stages, num_microbatches)
     if schedule != "interleaved_1f1b" and num_virtual != 1:
         raise ValueError(f"{schedule} requires num_virtual=1 (got {num_virtual})")
@@ -342,9 +341,27 @@ def _build_interleaved_ordered(num_stages: int, num_microbatches: int, num_virtu
 
 
 def _build_zbv_tables(num_stages: int, num_microbatches: int) -> ScheduleTables:
-    """ZBVZeroBubble (reference pipeline_parallelism.py:13-20 ships torch's
-    ScheduleZBVZeroBubble; schedule family from "Zero Bubble Pipeline Parallelism",
-    Qi et al. 2023 — re-derived for the SPMD tick executor).
+    """ZBVZeroBubble AND DualPipeV (reference pipeline_parallelism.py:13-20 ships
+    torch's ScheduleZBVZeroBubble and ScheduleDualPipeV; schedule families from
+    "Zero Bubble Pipeline Parallelism", Qi et al. 2023, and DeepSeek-V3's DualPipe —
+    re-derived for the SPMD tick executor).
+
+    Both names resolve to these tables because the two schedules' distinguishing
+    features collapse in this executor's tick model:
+
+    - DualPipeV's signature op — overlapping one chunk's forward with the other
+      chunk's backward in a single fused compute/comm unit — is how EVERY tick here
+      executes: each tick is one compiled SPMD program running an F slot and a B
+      slot per device, with the directional hops issued at tick end (XLA overlaps
+      the ppermutes with the next tick's compute). The steady-state ticks of these
+      tables carry exactly that F+B pairing (asserted by test).
+    - ZB-V's signature op placement — W (weight-grad) slots filled into bubble
+      ticks — is dominated here by deferring ALL weight grads to one bubble-free
+      post-scan pass per device (``deferred_w``); there is no W work left to
+      schedule into ticks at all. A dependency-greedy fill is then near-optimal
+      for both schedules and they emit identical tables (verified empirically for
+      an alternating-chunk DualPipeV forward policy at every (P, M) tried — the
+      hop dependencies, not the policy, determine the fill).
 
     V placement: global stage g lives on device g (g < P) or 2P-1-g (g >= P), so
     each device holds two ADJACENT stages of the V and the first/last stage share

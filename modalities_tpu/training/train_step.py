@@ -232,6 +232,15 @@ class TrainStepBuilder:
             and hasattr(model, "apply_hidden")
             and hasattr(loss_fn, "sum_and_count")
         )
+        if head_chunk is not None and not chunked_loss:
+            # silently materializing the [B,S,V] logits would be the exact memory
+            # blowup the chunking exists to prevent — refuse loudly instead
+            raise ValueError(
+                f"lm_head_chunk_size={head_chunk} requires a model exposing "
+                "apply_hidden/head_logits and a loss with the sum_and_count "
+                f"accumulation form (got loss {type(loss_fn).__name__}); unset the "
+                "chunk size or use a CLM-style loss"
+            )
 
         if chunked_loss:
             # fused head + CE per sequence chunk: the [B,S,V] fp32 logits never

@@ -56,6 +56,43 @@ EXPERIMENTS: dict[str, tuple[tuple, dict[str, str]]] = {
     "mb16_full_1024": (_cand("mb16_full_1024", 16), {"MODALITIES_TPU_FLASH_BLOCK_Q": "1024", "MODALITIES_TPU_FLASH_BLOCK_K": "1024"}),
 }
 
+# --- round-2 late sweep: context-length ladder + chunked-head variants ----------
+# 680M dims (the 32k headline model) at longer contexts, and the 1.3B at 4k/8k with
+# and without the fused chunked lm-head+loss (which at mb8/seq2048 otherwise
+# materializes [8,2048,50304] fp32 logits = 3.3 GB).
+_680M = (24, 1536, 12, 6144)
+
+
+def _cand680(name, seq, chunk, mb=1):
+    n_layer, n_embd, n_head, ffn = _680M
+    return (name, n_layer, n_embd, n_head, ffn, seq, mb, "dao_flash", "bfloat16", "full", chunk)
+
+
+def _cand1b_chunk(name, seq, mb, chunk):
+    n_layer, n_embd, n_head, ffn, _ = _1B
+    return (name, n_layer, n_embd, n_head, ffn, seq, mb, "dao_flash", "bfloat16", "full", chunk)
+
+
+# every entry pins its flash block sizes (the file rule above): the ladder ran at
+# the 1024 default, so 1024 is what these names record
+_B1024 = {"MODALITIES_TPU_FLASH_BLOCK_Q": "1024", "MODALITIES_TPU_FLASH_BLOCK_K": "1024"}
+
+EXPERIMENTS.update(
+    {
+        "680m_48k_chunk2048": (_cand680("680m_48k_chunk2048", 49152, 2048), dict(_B1024)),
+        "680m_96k_chunk2048": (_cand680("680m_96k_chunk2048", 98304, 2048), dict(_B1024)),
+        "680m_64k_chunk2048": (_cand680("680m_64k_chunk2048", 65536, 2048), dict(_B1024)),
+        "680m_32k_chunk4096": (_cand680("680m_32k_chunk4096", 32768, 4096), dict(_B1024)),
+        "680m_32k_chunk1024": (_cand680("680m_32k_chunk1024", 32768, 1024), dict(_B1024)),
+        "680m_32k_mb2_chunk2048": (_cand680("680m_32k_mb2_chunk2048", 32768, 2048, mb=2), dict(_B1024)),
+        "1.3b_4096_mb4": (_cand("1.3b_4096_mb4", 4, seq=4096), dict(_B1024)),
+        "1.3b_4096_mb4_chunk1024": (_cand1b_chunk("1.3b_4096_mb4_chunk1024", 4096, 4, 1024), dict(_B1024)),
+        "1.3b_8192_mb2_chunk2048": (_cand1b_chunk("1.3b_8192_mb2_chunk2048", 8192, 2, 2048), dict(_B1024)),
+        "1.3b_2048_mb8_chunk512": (_cand1b_chunk("1.3b_2048_mb8_chunk512", 2048, 8, 512), dict(_B1024)),
+        "1.3b_2048_mb8_chunk1024": (_cand1b_chunk("1.3b_2048_mb8_chunk1024", 2048, 8, 1024), dict(_B1024)),
+    }
+)
+
 
 def main() -> None:
     if len(sys.argv) != 2 or sys.argv[1] in ("-h", "--help"):

@@ -303,14 +303,23 @@ def test_pipelined_model_variant_selects_schedule():
     ModelFactory.get_pipelined_model(m, "1F1B", batch_size=8, microbatch_size=2)
     assert m.config_spec.pp_schedule == "1f1b"
     assert m.config_spec.pp_num_microbatches == 4
-    with pytest.raises(NotImplementedError, match="dualpipe_v"):
-        ModelFactory.get_pipelined_model(m, "dualpipe_v")
+    # reference class names normalize onto the five supported schedules
+    ModelFactory.get_pipelined_model(m, "DualPipeV", batch_size=8, microbatch_size=2)
+    assert m.config_spec.pp_schedule == "dualpipev"
+    assert m.config_spec.pp_num_virtual == 2
+    ModelFactory.get_pipelined_model(m, "ZBVZeroBubble", batch_size=8, microbatch_size=2)
+    assert m.config_spec.pp_schedule == "zbv"
+    with pytest.raises(NotImplementedError, match="no_such_schedule"):
+        ModelFactory.get_pipelined_model(m, "no_such_schedule")
 
 
-def test_dp_pp_zbv_equivalence():
-    """dp8 vs pp2 x dp4 under ZBVZeroBubble: V-shaped chunk placement (device 0
-    holds the first AND last stage), direction-aware hops, dx-only B slots, and the
-    post-scan weight-grad pass must reproduce pure-DP losses exactly."""
+@pytest.mark.parametrize("schedule", ["zbv", "dualpipev"])
+def test_dp_pp_zbv_equivalence(schedule):
+    """dp8 vs pp2 x dp4 under ZBVZeroBubble / DualPipeV (identical V-placement
+    tables — see pipeline_schedules._build_zbv_tables): V-shaped chunk placement
+    (device 0 holds the first AND last stage), direction-aware hops, dx-only B
+    slots, and the post-scan weight-grad pass must reproduce pure-DP losses
+    exactly."""
     mesh_dp = get_device_mesh(device_type="cpu", data_parallel_shard_degree=8, world_size=8)
     mesh_pp = get_device_mesh(
         device_type="cpu", data_parallel_shard_degree=4, pipeline_parallel_degree=2, world_size=8
@@ -323,7 +332,7 @@ def test_dp_pp_zbv_equivalence():
         model_run = tiny_gpt2("pytorch_flash", n_layer=4)  # 4 layers = 2 devices x 2 V-chunks
         if name == "pp_zbv":
             model_run.with_spec_updates(
-                pp_schedule="zbv", pp_num_microbatches=4, pp_num_virtual=2
+                pp_schedule=schedule, pp_num_microbatches=4, pp_num_virtual=2
             )
         fns = _builder(model_run, mesh, clip=1.0).build(seed=0)
         state = fns.app_state_handle.state
@@ -512,3 +521,61 @@ def test_chunked_lm_head_loss_equivalence():
         losses[chunk] = ls
     np.testing.assert_allclose(losses[None], losses[8], rtol=2e-5, atol=2e-5)
     np.testing.assert_allclose(evals[None], evals[8], rtol=2e-5, atol=2e-5)
+
+
+def test_chunked_lm_head_under_scheduled_pp():
+    """lm_head_chunk_size must be honored INSIDE the scheduled pipeline executor's
+    head slot (per-chunk head+CE under jax.checkpoint, no [B,S,V] logits) — losses
+    equal the unchunked scheduled-pp run, under ignore_index masking."""
+    mesh_pp = get_device_mesh(
+        device_type="cpu", data_parallel_shard_degree=4, pipeline_parallel_degree=2, world_size=8
+    )
+    rng = np.random.default_rng(41)
+    raw = _batch(rng, 1, 8, 32)
+    t = raw["targets"]["target_ids"]
+    t[:, :3, 5:] = -100  # unequal valid counts across chunks AND microbatches
+    raw["targets"]["target_ids"] = t
+
+    losses = {}
+    for chunk in (None, 8):
+        model_run = tiny_gpt2("pytorch_flash", n_layer=4)
+        updates = {"pp_schedule": "1f1b", "pp_num_microbatches": 4}
+        if chunk is not None:
+            updates["lm_head_chunk_size"] = chunk
+        model_run.with_spec_updates(**updates)
+        fns = _builder(model_run, mesh_pp, clip=1.0).build(seed=0)
+        state = fns.app_state_handle.state
+        ls = []
+        for _ in range(3):
+            state, metrics = fns.train_step(state, fns.put_batch(raw))
+            ls.append(float(metrics["loss"]))
+        losses[chunk] = ls
+    np.testing.assert_allclose(losses[None], losses[8], rtol=2e-5, atol=2e-5)
+
+
+def test_head_chunk_without_sum_and_count_raises():
+    """A loss without the sum_and_count accumulation form cannot honor
+    lm_head_chunk_size — the builder must refuse loudly, not silently materialize
+    the [B,S,V] logits the chunking exists to avoid."""
+    from modalities_tpu.training.train_step import TrainStepBuilder
+    from modalities_tpu.optimizers.optimizer_factory import OptimizerFactory
+
+    class NoAccLoss:
+        target_key = "target_ids"
+        prediction_key = "logits"
+
+        def __call__(self, predictions, targets):  # pragma: no cover - never built
+            raise AssertionError
+
+    mesh = get_device_mesh(device_type="cpu", data_parallel_shard_degree=8, world_size=8)
+    model = tiny_gpt2("pytorch_flash")
+    model.with_spec_updates(lm_head_chunk_size=8)
+    opt = OptimizerFactory.get_adam_w(
+        lr=1e-3, betas=(0.9, 0.95), eps=1e-8, weight_decay=0.0,
+        weight_decay_groups_excluded=[], wrapped_model=model,
+    )
+    with pytest.raises(ValueError, match="sum_and_count"):
+        TrainStepBuilder(
+            model=model, loss_fn=NoAccLoss(), optimizer_spec=opt,
+            mesh_handle=mesh, gradient_acc_steps=1, grad_clip_norm=1.0,
+        ).build(seed=0)
