@@ -109,6 +109,7 @@ _TPU_CANDIDATES = [
     # (name, n_layer, n_embd, n_head, ffn, seq, mb, attn_impl, param_dtype, remat[, chunk])
     ("680m_64k_flash_chunked", 24, 1536, 12, 6144, 65536, 1, "dao_flash", "bfloat16", "full", 2048),
     ("680m_32k_flash_chunked", 24, 1536, 12, 6144, 32768, 1, "dao_flash", "bfloat16", "full", 2048),
+    ("1.3b_16k_flash_chunked", 24, 2048, 16, 8192, 16384, 1, "dao_flash", "bfloat16", "full", 2048),
     ("1.3b_flash_mb8", 24, 2048, 16, 8192, 2048, 8, "dao_flash", "bfloat16", "full"),
     ("1.3b_sdpa_mb8", 24, 2048, 16, 8192, 2048, 8, "pytorch_flash", "bfloat16", "full"),
     ("1.3b_flash_mb4", 24, 2048, 16, 8192, 2048, 4, "dao_flash", "bfloat16", "full"),

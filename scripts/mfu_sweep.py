@@ -88,6 +88,8 @@ EXPERIMENTS.update(
         "1.3b_4096_mb4": (_cand("1.3b_4096_mb4", 4, seq=4096), dict(_B1024)),
         "1.3b_4096_mb4_chunk1024": (_cand1b_chunk("1.3b_4096_mb4_chunk1024", 4096, 4, 1024), dict(_B1024)),
         "1.3b_8192_mb2_chunk2048": (_cand1b_chunk("1.3b_8192_mb2_chunk2048", 8192, 2, 2048), dict(_B1024)),
+        "1.3b_16k_mb1_chunk2048": (_cand1b_chunk("1.3b_16k_mb1_chunk2048", 16384, 1, 2048), dict(_B1024)),
+        "1.3b_32k_mb1_chunk2048": (_cand1b_chunk("1.3b_32k_mb1_chunk2048", 32768, 1, 2048), dict(_B1024)),
         "1.3b_2048_mb8_chunk512": (_cand1b_chunk("1.3b_2048_mb8_chunk512", 2048, 8, 512), dict(_B1024)),
         "1.3b_2048_mb8_chunk1024": (_cand1b_chunk("1.3b_2048_mb8_chunk1024", 2048, 8, 1024), dict(_B1024)),
     }
