@@ -15,8 +15,11 @@ import sys
 
 os.environ["PALLAS_AXON_POOL_IPS"] = ""
 os.environ["JAX_PLATFORMS"] = "cpu"
+# devices per process: 4 by default; the single-process oracle must recreate the
+# GLOBAL mesh (same shape -> bit-comparable reductions), so the test passes 8
+_n_dev = os.environ.get("MP_WORKER_DEVICES", "4")
 os.environ["XLA_FLAGS"] = (
-    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=4"
+    os.environ.get("XLA_FLAGS", "") + f" --xla_force_host_platform_device_count={_n_dev}"
 ).strip()
 
 import jax  # noqa: E402
@@ -28,7 +31,7 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
 import numpy as np  # noqa: E402
 
 
-def build_and_step(local_rows_slice):
+def build_and_step(local_rows_slice, mode="dp"):
     from modalities_tpu.loss_functions import CLMCrossEntropyLoss
     from modalities_tpu.optimizers.optimizer_factory import OptimizerFactory
     from modalities_tpu.running_env.device_mesh import get_data_loading_info, get_device_mesh
@@ -36,10 +39,28 @@ def build_and_step(local_rows_slice):
     from tests.models.test_gpt2_model import tiny_gpt2
 
     world = len(jax.devices())
-    mesh = get_device_mesh(device_type="cpu", data_parallel_shard_degree=world, world_size=world)
+    if mode == "pp":
+        # pp2 x dp(world/2): the pp axis is outermost, so with 2 processes the
+        # scheduled executor's ppermute/psum hops CROSS the process boundary (the
+        # DCN-shaped tier); every process owns ALL dp coordinates, so the per-host
+        # loader must report ONE loading rank and each process feeds the full batch
+        mesh = get_device_mesh(
+            device_type="cpu",
+            pipeline_parallel_degree=2,
+            data_parallel_shard_degree=world // 2,
+            world_size=world,
+        )
+    else:
+        mesh = get_device_mesh(
+            device_type="cpu", data_parallel_shard_degree=world, world_size=world
+        )
     num_ranks, rank = get_data_loading_info(mesh)
+    if mode == "pp" and jax.process_count() > 1:
+        assert (num_ranks, rank) == (1, 0), (num_ranks, rank)
 
-    model = tiny_gpt2("pytorch_flash")
+    model = tiny_gpt2("pytorch_flash", n_layer=4)
+    if mode == "pp":
+        model.with_spec_updates(pp_schedule="1f1b", pp_num_microbatches=2)
     opt = OptimizerFactory.get_adam_w(
         lr=1e-3, betas=(0.9, 0.95), eps=1e-8, weight_decay=0.1,
         weight_decay_groups_excluded=["norm", "embedding"], wrapped_model=model,
@@ -71,9 +92,11 @@ def build_and_step(local_rows_slice):
 
 def main() -> None:
     if sys.argv[1] == "single":
-        print(f"LOSS {build_and_step(local_rows_slice=False):.6f}", flush=True)
+        mode = sys.argv[2] if len(sys.argv) > 2 else "dp"
+        print(f"LOSS {build_and_step(local_rows_slice=False, mode=mode):.6f}", flush=True)
         return
     port, pid, nprocs = int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3])
+    mode = sys.argv[4] if len(sys.argv) > 4 else "dp"
     jax.distributed.initialize(
         coordinator_address=f"127.0.0.1:{port}", num_processes=nprocs, process_id=pid
     )
@@ -86,7 +109,7 @@ def main() -> None:
     run_communication_test()
     print("COMM OK", flush=True)
 
-    loss = build_and_step(local_rows_slice=True)
+    loss = build_and_step(local_rows_slice=True, mode=mode)
     print(f"LOSS {loss:.6f}", flush=True)
 
 

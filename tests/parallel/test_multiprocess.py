@@ -34,11 +34,12 @@ def _parse_loss(out: str) -> float:
     raise AssertionError(f"no LOSS line in output:\n{out}")
 
 
-def test_two_process_put_batch_matches_single_process():
+def _run_two_process_vs_single(mode: str):
     env = _clean_env()
+    # the oracle recreates the GLOBAL 8-device mesh in one process (2 x 4 below)
     single = subprocess.run(
-        [sys.executable, str(WORKER), "single"],
-        capture_output=True, text=True, timeout=600, env=env,
+        [sys.executable, str(WORKER), "single", mode],
+        capture_output=True, text=True, timeout=600, env={**env, "MP_WORKER_DEVICES": "8"},
     )
     assert single.returncode == 0, single.stderr[-3000:]
     oracle = _parse_loss(single.stdout)
@@ -46,7 +47,7 @@ def test_two_process_put_batch_matches_single_process():
     port = _free_port()
     procs = [
         subprocess.Popen(
-            [sys.executable, str(WORKER), str(port), str(pid), "2"],
+            [sys.executable, str(WORKER), str(port), str(pid), "2", mode],
             stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env,
         )
         for pid in range(2)
@@ -58,7 +59,21 @@ def test_two_process_put_batch_matches_single_process():
         assert "COMM OK" in out, f"multi-process communication test failed:\n{out}"
         outs.append(_parse_loss(out))
 
-    # every process reports the same global loss, equal to the single-process oracle:
-    # each fed only its own rows, so agreement proves the local-shard assembly is right
+    # every process reports the same global loss, equal to the single-process oracle
     assert outs[0] == outs[1]
     assert abs(outs[0] - oracle) < 1e-5, (outs, oracle)
+
+
+def test_two_process_put_batch_matches_single_process():
+    # each process fed only its own rows, so agreement proves the local-shard
+    # assembly (make_array_from_process_local_data) is right
+    _run_two_process_vs_single("dp")
+
+
+def test_two_process_pipeline_mesh_crosses_process_boundary():
+    """pp2 x dp2 spanning two jax.distributed processes: the scheduled executor's
+    activation/cotangent ppermutes and the head psum-broadcast cross the process
+    boundary (the DCN tier of SURVEY §5.8), and get_data_loading_info must report
+    ONE loading rank — every process owns all dp coordinates, so each feeds the
+    full batch (asserted inside the worker)."""
+    _run_two_process_vs_single("pp")
