@@ -28,7 +28,9 @@ from modalities_tpu.config.pydantic_if_types import (
     PydanticModelIFType,
     PydanticModelInitializationIFType,
     PydanticOptimizerIFType,
+    PydanticPipelineIFType,
     PydanticSamplerIFType,
+    PydanticStagesGeneratorIFType,
     PydanticTokenizerIFType,
 )
 
@@ -515,3 +517,61 @@ class SteppableForwardPassConfig(BaseModel):
     device_mesh: Optional[PydanticDeviceMeshIFType] = None
     include_backward: bool = True
     gradient_accumulation_steps: Annotated[int, Field(strict=True, ge=1)] = 1
+
+
+# ------------------------------------------------------- reference pipeline surface
+# (reference: pipeline_parallelism_configs.py — the pipeline.{staged, scheduled,
+# selector, builder} registry nodes; see parallel/pipeline_components.py for the
+# SPMD re-expression)
+
+class StagedPipelineConfig(BaseModel):
+    whole_model: PydanticModelIFType
+    stages_generator: PydanticStagesGeneratorIFType
+    device_mesh: PydanticDeviceMeshIFType
+    pp_schedule_name: str
+    num_layers_per_stage: Annotated[int, Field(strict=True, ge=1)]
+    local_rank: Annotated[int, Field(strict=True, ge=0)] = 0
+
+
+class ScheduledPipelineConfig(BaseModel):
+    loss_fn: PydanticLossIFType
+    pp_schedule_name: str
+    batch_size: Annotated[int, Field(strict=True, ge=1)]
+    microbatch_size: Annotated[int, Field(strict=True, ge=1)]
+    pp_degree: Annotated[int, Field(strict=True, ge=1)]
+    pipeline: PydanticPipelineIFType
+
+
+class ComponentSelectorFromPipelineConfig(BaseModel):
+    pipeline: PydanticPipelineIFType
+    selection_type: str  # PP_STAGE | MODEL_PART | PP_SCHEDULE
+
+
+class PipelineBuilderConfig(BaseModel):
+    pp_stages: list[Any]
+    model_parts: list[Any]
+    pp_schedule: Optional[Any] = None
+
+
+# ------------------------------------------------------------- debugging components
+# (reference: utils/debugging_configs.py)
+
+
+class NaNHookConfig(BaseModel):
+    model: Optional[PydanticModelIFType] = None  # check is process-wide under jit
+    raise_exception: bool = True
+
+
+class PrintForwardHookConfig(BaseModel):
+    model: PydanticModelIFType
+    print_shape_only: bool = False
+
+
+class DebuggingConfig(BaseModel):
+    forward_hooks: list[Any] = []
+    enable_determinism: bool = False
+
+
+class ParallelDegreeConfig(BaseModel):
+    device_mesh: PydanticDeviceMeshIFType
+    parallelism_methods: list[str]

@@ -80,8 +80,8 @@ PydanticMessageSubscriberIFType = _lazy("modalities_tpu.logging_broker.subscribe
 PydanticGradientClipperIFType = _lazy("modalities_tpu.training.gradient_clipping", "GradientClipperIF")
 PydanticMFUCalculatorIFType = _lazy("modalities_tpu.utils.mfu", "MFUCalculatorIF")
 PydanticProfilerIFType = _lazy("modalities_tpu.utils.profilers.profilers", "SteppableProfilerIF")
-PydanticPipelineIFType = _lazy("modalities_tpu.parallel.pipeline", "Pipeline")
-PydanticStagesGeneratorIFType = _lazy("modalities_tpu.parallel.stages_generator", "StagesGeneratorIF")
+PydanticPipelineIFType = _lazy("modalities_tpu.parallel.pipeline_components", "Pipeline")
+PydanticStagesGeneratorIFType = _lazy("modalities_tpu.parallel.pipeline_components", "StagesGenerator")
 PydanticModelInitializationIFType = _lazy(
     "modalities_tpu.nn.model_initialization.initialization_if", "ModelInitializationIF"
 )

@@ -114,3 +114,40 @@ try:  # define lazily-importable module class at module scope
 
 except ImportError:  # pragma: no cover
     RMSNormWithBias = None
+
+
+# Registry builders for the `layer_norm` component entities (reference
+# components.py:396-398 registers nn.LayerNorm / RMSLayerNorm / nn.RMSNorm; here a
+# layer_norm component node resolves to the NormSpec the linen modules consume —
+# usable by custom models registered through Main.add_custom_component).
+
+
+def build_rms_norm_spec(ndim: int, epsilon: float = 1e-6, bias: bool = True) -> NormSpec:
+    return NormSpec.from_wrapper_config(
+        {"norm_type": "rms_norm", "config": {"ndim": ndim, "epsilon": epsilon, "bias": bias}},
+        default_dim=ndim,
+    )
+
+
+def build_layer_norm_spec(
+    normalized_shape: int, eps: float = 1e-5, elementwise_affine: bool = True, bias: bool = True
+) -> NormSpec:
+    return NormSpec.from_wrapper_config(
+        {
+            "norm_type": "layer_norm",
+            "config": {
+                "normalized_shape": normalized_shape,
+                "eps": eps,
+                "elementwise_affine": elementwise_affine,
+                "bias": bias,
+            },
+        },
+        default_dim=normalized_shape,
+    )
+
+
+def build_pytorch_rms_norm_spec(normalized_shape: int, eps: float = 1e-6) -> NormSpec:
+    return NormSpec.from_wrapper_config(
+        {"norm_type": "pytorch_rms_norm", "config": {"normalized_shape": normalized_shape, "eps": eps}},
+        default_dim=normalized_shape,
+    )

@@ -193,6 +193,10 @@ class GPT2ModelSpec:
     pp_num_virtual: int = 1  # virtual chunks per device (interleaved_1f1b)
     param_dtype: str = "float32"  # storage dtype (MixedPrecisionSpec.param_dtype)
     compute_dtype: str = "bfloat16"  # block compute dtype (MXU-native)
+    # "stats" | "shape" | None — compiles a jax.debug.print of each block output
+    # into the forward (model_debugging_hook.print_forward_hook; the jit-native
+    # analogue of the reference's eager print hook, debug_components.py:50-70)
+    debug_print_activations: Optional[str] = None
 
     @property
     def head_dim(self) -> int:
@@ -232,6 +236,7 @@ class GPT2ModelSpec:
                 self.pp_num_virtual,
                 self.param_dtype,
                 self.compute_dtype,
+                self.debug_print_activations,
             )
         )
 
@@ -455,6 +460,18 @@ class GPT2Block(nn.Module):
         x = x + CausalSelfAttention(spec, self.deterministic, self.decode, name="attn")(h)
         h2 = build_norm(spec.ffn_norm, "ffn_norm", dtype=x.dtype)(x)
         x = x + MLP(spec, self.deterministic, name="mlp")(h2)
+        if spec.debug_print_activations == "shape":
+            jax.debug.print(
+                "block out shape=" + str(tuple(x.shape)) + " dtype=" + str(x.dtype)
+            )
+        elif spec.debug_print_activations == "stats":
+            xf = x.astype(jnp.float32)
+            jax.debug.print(
+                "block out mean={m:.6f} std={s:.6f} nan={n}",
+                m=jnp.mean(xf),
+                s=jnp.std(xf),
+                n=jnp.isnan(xf).sum(),
+            )
         return x
 
 

@@ -17,8 +17,9 @@ Schedule: classic GPipe over M microbatches inside one shard_map region:
         (last stage) collects y into outputs
 
 Autodiff of this loop IS the backward schedule: JAX reverses the scan and transposes
-every ppermute, yielding the symmetric reverse-staged backward (1F1B-style overlap is
-a later optimization; DualPipeV/ZBV out of scope this round, as SURVEY.md §7 plans).
+every ppermute, yielding the symmetric reverse-staged backward. The explicitly
+scheduled 1F1B / interleaved-1F1B / ZBV / DualPipeV executor lives in
+parallel/pipeline_scheduled.py; this module remains the autodiff "gpipe" default.
 
 The loop runs as `lax.scan` over schedule ticks (static shapes, one compiled body).
 """
@@ -26,20 +27,10 @@ The loop runs as `lax.scan` over schedule ticks (static shapes, one compiled bod
 from __future__ import annotations
 
 import functools
-from dataclasses import dataclass
 from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
-
-
-@dataclass
-class Pipeline:
-    """Holder mirroring the reference's Pipeline (stages, schedule) surface."""
-
-    pp_degree: int
-    num_microbatches: int
-    schedule: str = "gpipe"
 
 
 def _gpipe_local(stacked_params, x_microbatches, *, axis_name: str, num_stages: int,

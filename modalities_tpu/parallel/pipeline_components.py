@@ -1,0 +1,245 @@
+"""Reference-shaped pipeline component surface (reference:
+src/modalities/models/parallelism/pipeline_parallelism.py:31-337 and
+pipeline_parallelism_configs.py — the `pipeline.{staged, scheduled, selector,
+builder}` registry nodes), re-expressed for SPMD.
+
+The torch implementation SPLITS the model: `get_staged_pipeline` deepcopies and
+prunes modules per rank into `PipelineStage`s, and downstream components (FSDP
+wrapping, optimizers, checkpointing) consume the per-rank `model_parts` list.
+Under GSPMD none of that exists — the stage split is a *sharding fact* (the
+stacked layer axis is sharded over the `pp` mesh axis) and every process runs the
+same program. These adapters keep the reference's CONFIG GRAPH working:
+
+- `pipeline.staged` validates the stage geometry (layers divide evenly over
+  pp x virtual stages, via the stages generator) and records it on a `Pipeline`
+  descriptor — the model object is untouched (one "part" per process).
+- `pipeline.scheduled` APPLIES the schedule: it calls
+  `ModelFactory.get_pipelined_model` on the descriptor's model, which updates the
+  model spec (pp_schedule / num_microbatches / num_virtual) that
+  TrainStepBuilder compiles into the scheduled shard_map executor. This is the
+  observable step — after it, the train step runs 1F1B/interleaved/ZBV/DualPipeV.
+- `pipeline.selector` exposes the descriptor's facets as separate config nodes
+  (`MODEL_PART` -> the whole model — exactly one part per process under SPMD;
+  `PP_SCHEDULE` -> the schedule-applied model the trainer consumes;
+  `PP_STAGE` -> the stage descriptors).
+- `pipeline.builder` assembles a descriptor from parts (config-graph parity with
+  the reference's `PipelineConfig`).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import Any, Optional
+
+from modalities_tpu.exceptions import ConfigError
+
+
+class PipelineSelectionTypes(Enum):
+    """reference pipeline_parallelism.py:67-72"""
+
+    PP_STAGE = "PP_STAGE"
+    MODEL_PART = "MODEL_PART"
+    PP_SCHEDULE = "PP_SCHEDULE"
+
+
+@dataclass(frozen=True)
+class StageDescriptor:
+    """One global pipeline stage: which contiguous layer block it owns. Under SPMD
+    this is descriptive (the layers axis is sharded over `pp`); the reference's
+    PipelineStage additionally holds the pruned submodule, which has no analogue."""
+
+    stage_index: int
+    num_stages: int
+    first_layer: int
+    num_layers: int
+
+    @property
+    def is_first(self) -> bool:
+        return self.stage_index == 0
+
+    @property
+    def is_last(self) -> bool:
+        return self.stage_index == self.num_stages - 1
+
+
+class StagesGenerator:
+    """Equal-depth stage splitter (reference StagesGenerator, stages_generator.py:15-66,
+    bin-packs by computational weight; the SPMD executor requires equal-depth stages —
+    the stacked-parameter layer axis is sharded evenly over pp — so the TPU version
+    validates divisibility instead of bin-packing)."""
+
+    def get_stage_layer_counts(self, total_layers: int, num_global_stages: int) -> list[int]:
+        if num_global_stages <= 0:
+            raise ConfigError(f"num_global_stages must be positive (got {num_global_stages})")
+        if total_layers % num_global_stages != 0:
+            raise ConfigError(
+                f"n_layer ({total_layers}) must divide evenly into {num_global_stages} "
+                "global stages (pp_degree x virtual stages) — the SPMD executor shards "
+                "the stacked layer axis uniformly over the pp mesh axis"
+            )
+        return [total_layers // num_global_stages] * num_global_stages
+
+
+class GPT2LLMStagesGenerator(StagesGenerator):
+    """reference GPT2LLMStagesGenerator (stages_generator.py:107-114): split points =
+    embedding block, each transformer layer, lm-head block. Under SPMD the
+    embedding/head are pp-replicated (computed where needed, psum-merged), so only
+    the transformer layers are staged."""
+
+
+@dataclass
+class Pipeline:
+    """TPU-native analogue of the reference `Pipeline` holder
+    (pipeline_parallelism.py:31-61): model_parts collapses to ONE whole model per
+    process; pp_stages are descriptors; the "schedule" is the model with its
+    pipeline spec applied (consumed by TrainStepBuilder)."""
+
+    model: Any
+    pp_stages: list[StageDescriptor] = field(default_factory=list)
+    pp_schedule_name: Optional[str] = None
+    num_virtual: int = 1
+    scheduled_model: Any = None
+
+    @property
+    def model_parts(self) -> list:
+        return [self.model]
+
+    @property
+    def has_first_pp_stage(self) -> bool:
+        # SPMD: every process's program computes all stages (sharded) — always True
+        return True
+
+    @property
+    def has_last_pp_stage(self) -> bool:
+        return True
+
+    @property
+    def pp_schedule(self):
+        return self.scheduled_model
+
+
+class PipelineFactory:
+    """reference PipelineFactory (pipeline_parallelism.py:100-337)."""
+
+    @staticmethod
+    def get_staged_pipeline(
+        whole_model,
+        stages_generator: StagesGenerator,
+        device_mesh,
+        pp_schedule_name: str,
+        num_layers_per_stage: int,
+        local_rank: int = 0,
+    ) -> Pipeline:
+        """Validate stage geometry and wrap the (unsplit) model in a Pipeline
+        descriptor. `num_layers_per_stage` determines the virtual-stage count:
+        num_virtual = n_layer / (pp_degree * num_layers_per_stage) — the same
+        relation the reference's stage generator encodes. `local_rank` is accepted
+        for config parity; SPMD programs are rank-uniform."""
+        del local_rank
+        pp_degree = device_mesh.degrees.get("pp", 1)
+        total_layers = getattr(getattr(whole_model, "config_spec", None), "n_layer", None)
+        if total_layers is None:
+            raise ConfigError("staged pipeline requires a model exposing config_spec.n_layer")
+        if num_layers_per_stage <= 0 or total_layers % num_layers_per_stage != 0:
+            raise ConfigError(
+                f"num_layers_per_stage ({num_layers_per_stage}) must divide n_layer ({total_layers})"
+            )
+        num_global_stages = total_layers // num_layers_per_stage
+        if num_global_stages % max(pp_degree, 1) != 0:
+            raise ConfigError(
+                f"global stage count ({num_global_stages}) must be a multiple of the "
+                f"pp degree ({pp_degree})"
+            )
+        counts = stages_generator.get_stage_layer_counts(total_layers, num_global_stages)
+        first = 0
+        stages = []
+        for i, n in enumerate(counts):
+            stages.append(
+                StageDescriptor(
+                    stage_index=i, num_stages=num_global_stages, first_layer=first, num_layers=n
+                )
+            )
+            first += n
+        return Pipeline(
+            model=whole_model,
+            pp_stages=stages,
+            pp_schedule_name=pp_schedule_name,
+            num_virtual=num_global_stages // max(pp_degree, 1),
+        )
+
+    @staticmethod
+    def get_scheduled_pipeline(
+        loss_fn,
+        pp_schedule_name: str,
+        batch_size: int,
+        microbatch_size: int,
+        pp_degree: int,
+        pipeline: Pipeline,
+    ) -> Pipeline:
+        """Apply the schedule to the descriptor's model (the observable step: the
+        model spec gains pp_schedule/num_microbatches/num_virtual, which
+        TrainStepBuilder compiles into the scheduled executor). `loss_fn` is
+        accepted for config parity — the executor computes the loss in-region from
+        the training components' loss (train_step.py), which the instantiation
+        model guarantees is the same object. `pp_degree` is validated against the
+        descriptor's geometry."""
+        del loss_fn
+        if pipeline.pp_stages and len(pipeline.pp_stages) % max(pp_degree, 1) != 0:
+            raise ConfigError(
+                f"pp_degree ({pp_degree}) does not divide the staged pipeline's "
+                f"global stage count ({len(pipeline.pp_stages)})"
+            )
+        from modalities_tpu.models.model_factory import ModelFactory
+
+        # pass the staged geometry through unconditionally: a mismatch (e.g.
+        # interleaved_1f1b over a 1-virtual staged split) must fail loudly in
+        # get_pipelined_model's own validation, not silently re-derive a default
+        scheduled = ModelFactory.get_pipelined_model(
+            pipeline.model,
+            pp_schedule_name=pp_schedule_name,
+            batch_size=batch_size,
+            microbatch_size=microbatch_size,
+            num_virtual_stages=pipeline.num_virtual,
+        )
+        return Pipeline(
+            model=pipeline.model,
+            pp_stages=pipeline.pp_stages,
+            pp_schedule_name=pp_schedule_name,
+            num_virtual=pipeline.num_virtual,
+            scheduled_model=scheduled,
+        )
+
+    @staticmethod
+    def get_pipeline(pp_stages: list, model_parts: list, pp_schedule=None) -> Pipeline:
+        """Builder form (reference PipelineConfig): assemble a descriptor from
+        parts. SPMD has exactly one model part per process."""
+        if len(model_parts) != 1:
+            raise ConfigError(
+                f"SPMD pipelines have exactly ONE model part per process (got "
+                f"{len(model_parts)}); the stage split is a sharding fact, not a "
+                "module split"
+            )
+        return Pipeline(model=model_parts[0], pp_stages=list(pp_stages), scheduled_model=pp_schedule)
+
+
+class ComponentSelectorFromPipeline:
+    """reference ComponentSelectorFromPipeline.select (pipeline_parallelism.py:75-97)."""
+
+    @staticmethod
+    def select(pipeline: Pipeline, selection_type: PipelineSelectionTypes):
+        if isinstance(selection_type, str):
+            selection_type = PipelineSelectionTypes(selection_type)
+        if selection_type == PipelineSelectionTypes.PP_STAGE:
+            return pipeline.pp_stages
+        if selection_type == PipelineSelectionTypes.MODEL_PART:
+            # the reference returns the per-rank module list; SPMD has one part
+            return pipeline.model
+        if selection_type == PipelineSelectionTypes.PP_SCHEDULE:
+            if pipeline.scheduled_model is None:
+                raise ConfigError(
+                    "PP_SCHEDULE selected from a pipeline without a schedule — wire "
+                    "pipeline.scheduled (get_scheduled_pipeline) first"
+                )
+            return pipeline.scheduled_model
+        raise ConfigError(f"unknown selection_type {selection_type}")

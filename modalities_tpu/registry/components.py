@@ -35,6 +35,7 @@ from modalities_tpu.logging_broker.subscriber_impl.results_subscriber import (
     RichResultSubscriber,
     WandBEvaluationResultSubscriber,
 )
+from modalities_tpu.models.components import layer_norms as _ln
 from modalities_tpu.models.gpt2.collator import GPT2LLMCollateFn
 from modalities_tpu.models.gpt2.gpt2_model import GPT2LLM, GPT2LLMConfig
 from modalities_tpu.models.huggingface.huggingface_model import HuggingFacePretrainedModel
@@ -50,8 +51,10 @@ from modalities_tpu.optimizers.scheduler_factory import (
     OneCycleLRScheduler,
     StepLRScheduler,
 )
+from modalities_tpu.parallel import pipeline_components as _pl
 from modalities_tpu.registry.registry import ComponentEntity
 from modalities_tpu.running_env.device_mesh import get_device_mesh
+from modalities_tpu.utils.debug_components import Debugging, HookRegistration
 from modalities_tpu.tokenization.tokenizer_wrapper import PreTrainedHFTokenizer, PreTrainedSPTokenizer
 from modalities_tpu.training.gradient_clipping import (
     DummyGradientClipper,
@@ -77,6 +80,19 @@ from modalities_tpu.utils.profilers.profilers import (
     SteppableMemoryProfiler,
     SteppableNoProfiler,
 )
+
+
+def _fsdp1_checkpointed_guard(**kwargs):
+    """reference model/optimizer `fsdp1_checkpointed` variants load FSDP1-era state
+    at build time; whole-state restore here is `app_state` variant `dcp` with
+    `checkpoint_loading` variant `orbax` (see configs/config_lorem_ipsum_tpu_warmstart.yaml)."""
+    from modalities_tpu.exceptions import ConfigError
+
+    raise ConfigError(
+        "fsdp1_checkpointed has no SPMD analogue: restore model+optimizer state via "
+        "app_state.dcp + checkpoint_loading.orbax (warmstart path), not a build-time "
+        "FSDP1 state load. See configs/config_lorem_ipsum_tpu_warmstart.yaml."
+    )
 
 
 def _random_batch_generator(**kwargs):
@@ -372,4 +388,97 @@ COMPONENTS: list[ComponentEntity] = [
         NumberConversion.get_num_steps_from_raw_dataset_index,
         NumStepsFromRawDatasetIndexConfig,
     ),
+    ComponentEntity(
+        "number_conversion",
+        "parallel_degree",
+        NumberConversion.get_parallel_degree,
+        cfg.ParallelDegreeConfig,
+    ),
+    # ---------------- reference pipeline config surface (pipeline_components.py:
+    # the torch module-splitting graph re-expressed as SPMD descriptors; the
+    # scheduled node is the observable one — it applies the schedule to the model
+    # spec that TrainStepBuilder compiles)
+    ComponentEntity(
+        "pipeline", "staged", _pl.PipelineFactory.get_staged_pipeline, cfg.StagedPipelineConfig
+    ),
+    ComponentEntity(
+        "pipeline", "scheduled", _pl.PipelineFactory.get_scheduled_pipeline, cfg.ScheduledPipelineConfig
+    ),
+    ComponentEntity(
+        "pipeline",
+        "selector",
+        _pl.ComponentSelectorFromPipeline.select,
+        cfg.ComponentSelectorFromPipelineConfig,
+    ),
+    ComponentEntity("pipeline", "builder", _pl.PipelineFactory.get_pipeline, cfg.PipelineBuilderConfig),
+    ComponentEntity("stages_generator", "gpt2_stages_generator", _pl.GPT2LLMStagesGenerator, None),
+    # ---------------- layer norms (reference components.py:396-398; resolve to the
+    # NormSpec the linen modules consume — for custom-model component graphs)
+    ComponentEntity("layer_norm", "rms_norm", _ln.build_rms_norm_spec, _ln.RMSLayerNormConfig),
+    ComponentEntity("layer_norm", "layer_norm", _ln.build_layer_norm_spec, _ln.LayerNormConfig),
+    ComponentEntity(
+        "layer_norm", "pytorch_rms_norm", _ln.build_pytorch_rms_norm_spec, _ln.PytorchRMSLayerNormConfig
+    ),
+    # ---------------- debugging components (reference debug_components.py)
+    ComponentEntity("debugging", "settings", Debugging, cfg.DebuggingConfig),
+    ComponentEntity(
+        "model_debugging_hook", "nan_hook", HookRegistration.register_nan_hooks, cfg.NaNHookConfig
+    ),
+    ComponentEntity(
+        "model_debugging_hook",
+        "print_forward_hook",
+        HookRegistration.register_print_forward_hooks,
+        cfg.PrintForwardHookConfig,
+    ),
+    # ---------------- reference-name aliases (same machinery, reference variant
+    # names, so reference YAMLs resolve unchanged)
+    ComponentEntity("steppable_profiler", "no_profiler", SteppableNoProfiler, None),
+    ComponentEntity(
+        "steppable_profiler", "kernel_tracing", SteppableKernelProfiler, cfg.SteppableKernelProfilerConfig
+    ),
+    ComponentEntity(
+        "steppable_profiler", "memory_tracing", SteppableMemoryProfiler, cfg.SteppableMemoryProfilerConfig
+    ),
+    ComponentEntity(
+        "steppable_profiler", "combined", SteppableCombinedProfiler, cfg.SteppableCombinedProfilerConfig
+    ),
+    ComponentEntity(
+        "dataset_batch_generator",
+        "random",
+        _random_batch_generator,
+        cfg.RandomDatasetBatchGeneratorConfig,
+    ),
+    ComponentEntity(
+        "results_subscriber",
+        "to_disc",
+        EvaluationResultToDiscSubscriber,
+        cfg.EvaluationResultToDiscSubscriberConfig,
+    ),
+    # the reference's plain (non-resumable) DistributedSampler is the resumable one
+    # with skip_num_global_samples=0 (its config default)
+    ComponentEntity(
+        "sampler",
+        "distributed_sampler",
+        SamplerFactory.create_resumable_sampler,
+        cfg.ResumableDistributedSamplerConfig,
+    ),
+    ComponentEntity(
+        "gradient_clipper",
+        "fsdp1_logging_only",
+        LoggingOnlyGradientClipper,
+        cfg.LoggingOnlyGradientClipperConfig,
+    ),
+    # FSDP1/torch checkpoint IO names: the checkpoint format in this framework is
+    # Orbax regardless of the sharding era the name comes from — the aliases load/
+    # save the same sharded checkpoints (reference fsdp_checkpoint_saving.py:32-176,
+    # torch_checkpoint_loading.py)
+    ComponentEntity("checkpoint_loading", "fsdp1", OrbaxCheckpointLoading, cfg.OrbaxCheckpointLoadingConfig),
+    ComponentEntity("checkpoint_loading", "torch", OrbaxCheckpointLoading, cfg.OrbaxCheckpointLoadingConfig),
+    ComponentEntity(
+        "checkpoint_saving_execution", "fsdp1", OrbaxCheckpointSaving, cfg.OrbaxCheckpointSavingConfig
+    ),
+    # FSDP1 build-time state loading has no SPMD analogue — whole-state restore is
+    # app_state.dcp + checkpoint_loading.orbax; fail loudly with that guidance
+    ComponentEntity("model", "fsdp1_checkpointed", _fsdp1_checkpointed_guard, None),
+    ComponentEntity("optimizer", "fsdp1_checkpointed", _fsdp1_checkpointed_guard, None),
 ]

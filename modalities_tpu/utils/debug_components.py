@@ -141,3 +141,99 @@ def has_nonfinite(tree) -> bool:
     """Cheap device-side check used by gradient_clipper.error_if_nonfinite
     (reference fsdp_gradient_clipper.py:118)."""
     return bool(_nonfinite_check_fn()(tree))
+
+
+# --------------------------------------------------------------------- hook surface
+# reference: utils/debug_components.py HookRegistration/Debugging — eager
+# forward-hook handles. Under jit there are no module hooks; the TPU-native
+# equivalents act at the two layers that exist here: the jax_debug_nans config
+# (re-runs the failing op un-jitted, raises at the first NaN primitive) and a
+# model-spec flag that compiles jax.debug.print activation stats into each block.
+
+
+class DebugHookHandle:
+    """Removable-handle analogue: undoes the registration it came from."""
+
+    def __init__(self, remove_fn):
+        self._remove_fn = remove_fn
+
+    def remove(self) -> None:
+        if self._remove_fn is not None:
+            self._remove_fn()
+            self._remove_fn = None
+
+
+class HookRegistration:
+    """reference HookRegistration (debug_components.py:25-70), jit-native."""
+
+    @staticmethod
+    def register_nan_hooks(model=None, raise_exception: bool = True) -> list[DebugHookHandle]:
+        """TPU nan hook = jax_debug_nans: every jitted computation (the whole train
+        step) is checked and the first NaN-producing primitive raises with its
+        location — strictly stronger than the reference's per-module output check.
+        `raise_exception=False` maps to leaving the check off (the reference's
+        non-raising variant only logs; use the `debugging_enriched` model variant
+        for stats-logging without failing)."""
+        import jax
+
+        del model  # the check is process-wide, not per-module
+        prior = bool(jax.config.jax_debug_nans)
+        if raise_exception:
+            enable_nan_checks(True)
+        # raise_exception=False (the reference's log-only variant) leaves any
+        # existing check untouched — use the `debugging_enriched` model variant for
+        # stats logging without failing. remove() restores the PRIOR state, so
+        # stacked registrations / env-enabled checks survive.
+        return [DebugHookHandle(lambda: enable_nan_checks(prior))]
+
+    @staticmethod
+    def register_print_forward_hooks(model, print_shape_only: bool = False) -> list[DebugHookHandle]:
+        """Compile per-block activation printing into the model: sets the model
+        spec's `debug_print_activations` flag, which GPT2Block lowers to a
+        jax.debug.print of the block output's mean/std/nan-count (or shape only)
+        on every forward — the jit-native analogue of the reference's print hook."""
+        mode = "shape" if print_shape_only else "stats"
+        if not hasattr(model, "with_spec_updates"):
+            raise TypeError(
+                f"print_forward_hook requires a spec-carrying model (got {type(model).__name__})"
+            )
+        model.with_spec_updates(debug_print_activations=mode)
+        return [
+            DebugHookHandle(lambda: model.with_spec_updates(debug_print_activations=None))
+        ]
+
+
+class Debugging:
+    """reference Debugging (debug_components.py:9-22): owns hook handles +
+    a determinism toggle. XLA:TPU execution is run-to-run deterministic already
+    (the torch knob targets cudnn autotune); the reproducibility lever that DOES
+    exist here is matmul precision — `enable_determinism` pins
+    jax_default_matmul_precision to "highest" so numerics stop depending on the
+    backend's default precision choice."""
+
+    def __init__(self, *, forward_hooks: Optional[list] = None, enable_determinism: bool = False):
+        import jax
+
+        self.forward_hooks = forward_hooks or []
+        self.enable_determinism = enable_determinism
+        self._prior_precision = None
+        if enable_determinism:
+            self._prior_precision = jax.config.jax_default_matmul_precision
+            jax.config.update("jax_default_matmul_precision", "highest")
+
+    def close(self) -> None:
+        import jax
+
+        for hook_group in self.forward_hooks:
+            group = hook_group if isinstance(hook_group, list) else [hook_group]
+            for handle in group:
+                handle.remove()
+        if self.enable_determinism:
+            jax.config.update("jax_default_matmul_precision", self._prior_precision)
+            self.enable_determinism = False
+
+    # NOTE: no __del__ — this component mutates process-global jax config, and the
+    # reference's hooks-die-with-the-component GC semantics would revert the
+    # precision pin at an unpredictable collection time if nothing retains the
+    # built node. Lifecycle is explicit: the pin holds for the process unless the
+    # owner calls close().

@@ -145,6 +145,16 @@ class NumberConversion:
         )
 
     @staticmethod
+    def get_parallel_degree(device_mesh, parallelism_methods: list[str]) -> int:
+        """Product of the mesh degrees of the given parallelism methods (reference:
+        running_env/fsdp/device_mesh.py:148-162, registered as
+        number_conversion.parallel_degree) — e.g. ["dp_replicate", "dp_shard"]
+        yields the data-parallel world used in tokens-per-step arithmetic."""
+        import math
+
+        return math.prod(device_mesh.get_parallel_degree(m) for m in parallelism_methods)
+
+    @staticmethod
     def get_num_steps_from_raw_dataset_index(
         raw_index_path: Path,
         num_ranks: int,
