@@ -229,7 +229,13 @@ class ComponentSelectorFromPipeline:
     @staticmethod
     def select(pipeline: Pipeline, selection_type: PipelineSelectionTypes):
         if isinstance(selection_type, str):
-            selection_type = PipelineSelectionTypes(selection_type)
+            try:
+                selection_type = PipelineSelectionTypes(selection_type)
+            except ValueError as exc:  # config-layer error contract: ConfigError
+                raise ConfigError(
+                    f"unknown selection_type {selection_type!r} (valid: "
+                    f"{[t.value for t in PipelineSelectionTypes]})"
+                ) from exc
         if selection_type == PipelineSelectionTypes.PP_STAGE:
             return pipeline.pp_stages
         if selection_type == PipelineSelectionTypes.MODEL_PART:

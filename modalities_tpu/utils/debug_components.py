@@ -197,9 +197,12 @@ class HookRegistration:
             raise TypeError(
                 f"print_forward_hook requires a spec-carrying model (got {type(model).__name__})"
             )
+        # remove() restores the PRIOR value (like the nan hook), so stacked
+        # registrations unwind correctly instead of force-clearing the flag
+        prior = getattr(model.config_spec, "debug_print_activations", None)
         model.with_spec_updates(debug_print_activations=mode)
         return [
-            DebugHookHandle(lambda: model.with_spec_updates(debug_print_activations=None))
+            DebugHookHandle(lambda: model.with_spec_updates(debug_print_activations=prior))
         ]
 
 

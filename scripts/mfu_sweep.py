@@ -82,6 +82,14 @@ EXPERIMENTS.update(
         "680m_48k_chunk2048": (_cand680("680m_48k_chunk2048", 49152, 2048), dict(_B1024)),
         "680m_96k_chunk2048": (_cand680("680m_96k_chunk2048", 98304, 2048), dict(_B1024)),
         "680m_64k_chunk2048": (_cand680("680m_64k_chunk2048", 65536, 2048), dict(_B1024)),
+        "680m_64k_q512_k2048": (
+            _cand680("680m_64k_q512_k2048", 65536, 2048),
+            {"MODALITIES_TPU_FLASH_BLOCK_Q": "512", "MODALITIES_TPU_FLASH_BLOCK_K": "2048"},
+        ),
+        "680m_64k_q2048_k512": (
+            _cand680("680m_64k_q2048_k512", 65536, 2048),
+            {"MODALITIES_TPU_FLASH_BLOCK_Q": "2048", "MODALITIES_TPU_FLASH_BLOCK_K": "512"},
+        ),
         "680m_32k_chunk4096": (_cand680("680m_32k_chunk4096", 32768, 4096), dict(_B1024)),
         "680m_32k_chunk1024": (_cand680("680m_32k_chunk1024", 32768, 1024), dict(_B1024)),
         "680m_32k_mb2_chunk2048": (_cand680("680m_32k_mb2_chunk2048", 32768, 2048, mb=2), dict(_B1024)),
