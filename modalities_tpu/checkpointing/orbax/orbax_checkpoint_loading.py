@@ -56,3 +56,27 @@ class OrbaxCheckpointLoading(CheckpointLoadingIF):
         app_state_handle.state = restored
         logger.info("Checkpoint restored at step %d.", int(restored.step))
         return restored
+
+
+def restore_tree_single_device(checkpoint_dir_path: Path):
+    """Restore an Orbax checkpoint with a target built from the checkpoint's OWN
+    metadata, every leaf on this host's first device.
+
+    A targetless restore would pin the SAVING topology (fails when restoring on
+    fewer devices than trained on); the metadata-driven target makes the restore
+    topology-free. Shared by the export path (conversion/gpt2/convert_gpt2.py) and
+    config-driven generation (inference/inference.py) — training checkpoints hold
+    the full AppState tree {params, opt_state, step}; callers pull the subtree
+    they need."""
+    import jax
+    import orbax.checkpoint as ocp
+
+    checkpointer = ocp.StandardCheckpointer()
+    path = Path(checkpoint_dir_path).absolute()
+    ckpt_meta = checkpointer.metadata(path)
+    tree_meta = getattr(ckpt_meta, "item_metadata", ckpt_meta)
+    sharding = jax.sharding.SingleDeviceSharding(jax.devices()[0])
+    abstract = jax.tree.map(
+        lambda m: jax.ShapeDtypeStruct(m.shape, m.dtype, sharding=sharding), tree_meta
+    )
+    return checkpointer.restore(path, abstract)

@@ -150,21 +150,11 @@ def convert_gpt2(config_file_path: Path, output_hf_checkpoint_dir: Path, num_tes
     checkpoint_path = components.settings.get("checkpoint_folder_path") or components.settings.get("model_path")
     params = meta.unbox(model.init_params(jax.random.PRNGKey(model.seed)))
     if checkpoint_path:
-        import orbax.checkpoint as ocp
-
-        # training checkpoints hold the full AppState (params/opt_state/step). A
-        # targetless restore would pin the SAVING topology (fails when converting on
-        # fewer devices than trained on), so build the target from the checkpoint's
-        # own metadata with every leaf placed on this host's first device.
-        checkpointer = ocp.StandardCheckpointer()
-        path = Path(checkpoint_path).absolute()
-        meta = checkpointer.metadata(path)
-        tree_meta = getattr(meta, "item_metadata", meta)
-        sharding = jax.sharding.SingleDeviceSharding(jax.devices()[0])
-        abstract = jax.tree.map(
-            lambda m: jax.ShapeDtypeStruct(m.shape, m.dtype, sharding=sharding), tree_meta
+        from modalities_tpu.checkpointing.orbax.orbax_checkpoint_loading import (
+            restore_tree_single_device,
         )
-        restored = checkpointer.restore(path, abstract)
+
+        restored = restore_tree_single_device(Path(checkpoint_path))
         params = restored["params"]
 
     hf_model, _ = convert_model_checkpoint(model, params)

@@ -29,16 +29,21 @@ def generate_text(config_file_path: Path) -> None:
 
     import jax
 
-    params = model.init_params(jax.random.PRNGKey(0))
     checkpoint_path = settings.get("checkpoint_folder_path") or settings.get("model_path")
     if checkpoint_path:
-        import orbax.checkpoint as ocp
-
-        restored = ocp.StandardCheckpointer().restore(
-            Path(checkpoint_path).absolute(),
-            jax.tree.map(lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), _unboxed(params)),
+        from modalities_tpu.checkpointing.orbax.orbax_checkpoint_loading import (
+            restore_tree_single_device,
         )
-        params = restored
+
+        restored = restore_tree_single_device(Path(checkpoint_path))
+        # AppState checkpoints restore as {"params", "opt_state", "step"}; a
+        # params-only export is already the {"params": module_tree} variables dict
+        if isinstance(restored, dict) and "opt_state" in restored:
+            params = restored["params"]
+        else:
+            params = restored
+    else:
+        params = _unboxed(model.init_params(jax.random.PRNGKey(0)))
 
     component = TextInferenceComponent(
         model=model,
