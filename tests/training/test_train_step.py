@@ -553,6 +553,31 @@ def test_chunked_lm_head_under_scheduled_pp():
     np.testing.assert_allclose(losses[None], losses[8], rtol=2e-5, atol=2e-5)
 
 
+def test_chunked_lm_head_under_gpipe_pp():
+    """lm_head_chunk_size composes with the autodiff GPipe path too: apply_hidden
+    (output_hidden=True) runs the in-module pipeline before the head cut, and the
+    chunked head+CE sits outside it — losses equal pure DP."""
+    mesh_dp = get_device_mesh(device_type="cpu", data_parallel_shard_degree=8, world_size=8)
+    mesh_pp = get_device_mesh(
+        device_type="cpu", data_parallel_shard_degree=4, pipeline_parallel_degree=2, world_size=8
+    )
+    rng = np.random.default_rng(43)
+    raw = _batch(rng, 1, 8, 32)
+
+    losses = {}
+    for name, mesh in [("dp", mesh_dp), ("pp_gpipe_chunk", mesh_pp)]:
+        model_run = tiny_gpt2("pytorch_flash", n_layer=4)
+        model_run.with_spec_updates(lm_head_chunk_size=8)  # gpipe stays the default
+        fns = _builder(model_run, mesh, clip=1.0).build(seed=0)
+        state = fns.app_state_handle.state
+        ls = []
+        for _ in range(2):
+            state, metrics = fns.train_step(state, fns.put_batch(raw))
+            ls.append(float(metrics["loss"]))
+        losses[name] = ls
+    np.testing.assert_allclose(losses["dp"], losses["pp_gpipe_chunk"], rtol=3e-4, atol=3e-4)
+
+
 def test_head_chunk_without_sum_and_count_raises():
     """A loss without the sum_and_count accumulation form cannot honor
     lm_head_chunk_size — the builder must refuse loudly, not silently materialize
