@@ -8,11 +8,12 @@ implementations, documented per-field.
 
 from __future__ import annotations
 
+import warnings
 from enum import Enum
 from pathlib import Path
 from typing import Annotated, Any, Literal, Optional
 
-from pydantic import BaseModel, Field
+from pydantic import AliasChoices, BaseModel, Field, field_validator, model_validator
 
 from modalities_tpu.config.pydantic_if_types import (
     PydanticAppStateType,
@@ -89,6 +90,35 @@ class FSDP2WrappedModelConfig(BaseModel):
     reshard_after_forward: bool = True  # torch knob; XLA schedules resharding
 
 
+class FSDP1WrappedModelConfig(BaseModel):
+    """reference FSDPWrappedModelConfig (config.py:264-285) — the deprecated FSDP1
+    wrap schema its fsdp1/coca YAMLs still use. The enum *names* are validated here;
+    the mapping onto the GSPMD path (strategy → mesh rules, MixedPrecisionSettings →
+    param/reduce dtypes, fp16 → bf16 on TPU) happens in
+    ModelFactory.get_fsdp1_wrapped_model. `sync_module_states` is torch-only
+    (GSPMD's jitted init is identical across ranks by construction) and ignored."""
+
+    model: PydanticModelIFType
+    sync_module_states: bool = False
+    mixed_precision_settings: Optional[str] = None
+    sharding_strategy: str = "FULL_SHARD"
+    block_names: Optional[list[str]] = None
+
+    @model_validator(mode="after")
+    def _validate_enum_names(self) -> "FSDP1WrappedModelConfig":
+        known_mp = {"FP_16", "BF_16", "BF_16_WORKING", "MIXED_PRECISION_MEGATRON", "FP_32", "NO_MIXED_PRECISION"}
+        if self.mixed_precision_settings is not None and self.mixed_precision_settings not in known_mp:
+            raise ValueError(
+                f"unknown mixed_precision_settings {self.mixed_precision_settings!r}; known: {sorted(known_mp)}"
+            )
+        known_strategies = {"FULL_SHARD", "SHARD_GRAD_OP", "NO_SHARD", "HYBRID_SHARD", "_HYBRID_SHARD_ZERO2"}
+        if self.sharding_strategy not in known_strategies:
+            raise ValueError(
+                f"unknown sharding_strategy {self.sharding_strategy!r}; known: {sorted(known_strategies)}"
+            )
+        return self
+
+
 class CompiledModelConfig(BaseModel):
     model: PydanticModelIFType
     block_names: Optional[list[str]] = None
@@ -155,6 +185,24 @@ class ComposedInitializationConfig(BaseModel):
     std: float | str = 0.02
     num_layers: Optional[int] = None
     hidden_dim: Optional[int] = None
+
+
+class GPT2LLMStagesGeneratorConfig(BaseModel):
+    """reference GPT2LLMStagesGeneratorConfig (stages_generator_configs.py:10-13).
+    `num_model_layers` is optional here (the staged model's n_layer is authoritative;
+    when given it is cross-checked), accepting both reference YAMLs and bare nodes."""
+
+    num_model_layers: Optional[Annotated[int, Field(strict=True, ge=1)]] = None
+    input_layer_equivalence: Annotated[int, Field(strict=True, ge=1)] = 1
+    output_layer_equivalence: Annotated[int, Field(strict=True, ge=1)] = 1
+
+
+class Llama3InitializerConfig(BaseModel):
+    """reference Llama3InitializerConfig (llama3_like_initialization.py:15-18)."""
+
+    num_layers: Annotated[int, Field(strict=True, gt=0)]
+    n_embd: Annotated[int, Field(strict=True, gt=0)]
+    depth_init: bool = True
 
 
 # ---------------------------------------------------------------------- optimizers
@@ -408,6 +456,57 @@ class OrbaxCheckpointLoadingConfig(BaseModel):
     global_rank: Annotated[int, Field(strict=True, ge=0)] = 0
 
 
+class FSDP1CheckpointedGuardConfig(BaseModel):
+    """Accepts the union of the reference's FSDP1CheckpointedModelConfig /
+    FSDP1CheckpointedOptimizerConfig fields so the build reaches the
+    fsdp1_checkpointed guard, which raises the actionable no-SPMD-analogue
+    ConfigError instead of a generic invalid-keys failure."""
+
+    model: Optional[Any] = None
+    optimizer: Optional[Any] = None
+    wrapped_model: Optional[Any] = None
+    checkpoint_loading: Optional[Any] = None
+    checkpoint_path: Optional[Path] = None
+
+
+class FSDP1AliasCheckpointLoadingConfig(OrbaxCheckpointLoadingConfig):
+    """Config for the `checkpoint_loading.fsdp1` alias (reference
+    FSDP1CheckpointLoadingConfig: global_rank, block_names, mixed_precision_settings,
+    sharding_strategy). The torch-era knobs describe how to REBUILD the FSDP1 wrapper
+    at load time; Orbax restores into the existing sharded state, so they are
+    accepted for YAML compatibility and unused."""
+
+    block_names: Optional[list[str]] = None
+    mixed_precision_settings: Optional[str] = None
+    sharding_strategy: Optional[str] = None
+
+
+class TorchAliasCheckpointLoadingConfig(OrbaxCheckpointLoadingConfig):
+    """Config for the `checkpoint_loading.torch` alias (reference
+    TorchCheckpointLoadingConfig, config.py:95-101). The checkpoint format in this
+    framework is Orbax regardless of the alias name, so the reference's torch-only
+    knobs (`device`, `precision`) are accepted for YAML compatibility but have no
+    effect — sharding/placement comes from the mesh, dtypes from the model's mixed-
+    precision spec. A torch `.bin` checkpoint cannot be restored through this alias;
+    the warning makes that surface at config time instead of as an Orbax error."""
+
+    device: Optional[Any] = None
+    precision: Optional[Any] = None
+
+    @model_validator(mode="after")
+    def _warn_ignored_torch_fields(self) -> "TorchAliasCheckpointLoadingConfig":
+        ignored = [name for name in ("device", "precision") if getattr(self, name) is not None]
+        if ignored:
+            warnings.warn(
+                f"checkpoint_loading.torch: field(s) {ignored} are torch-specific and "
+                "ignored — checkpoints are Orbax-format (device placement comes from "
+                "the mesh, dtype from the mixed-precision spec). A torch .bin "
+                "checkpoint cannot be restored through this alias.",
+                stacklevel=2,
+            )
+        return self
+
+
 class RawAppStateConfig(BaseModel):
     model: PydanticModelIFType
     optimizer: PydanticOptimizerIFType
@@ -424,12 +523,24 @@ class DCPAppStateConfig(BaseModel):
 
 
 class GradientClipperConfig(BaseModel):
+    """Covers the reference's FSDP1 and FSDP2 clipper schemas
+    (fsdp_gradient_clipper_config.py): `wrapped_model`/`device_mesh` are torch
+    handles for its per-shard norm walk + PP-mesh all-reduce; the jitted global
+    norm here spans all mesh axes by construction, so both are accepted and unused."""
+
     max_norm: float
     norm_type: str = "p2_norm"
     error_if_nonfinite: bool = False
+    wrapped_model: Optional[PydanticModelIFType] = None
+    device_mesh: Optional[PydanticDeviceMeshIFType] = None
 
 
 class LoggingOnlyGradientClipperConfig(BaseModel):
+    """reference FSDP1DummyGradientClipperConfig (fsdp_gradient_clipper_config.py:61):
+    carries the wrapped model for torch's per-shard norm walk; the jit global-norm
+    computation here needs no model handle, so the field is accepted and unused."""
+
+    wrapped_model: Optional[PydanticModelIFType] = None
     norm_type: str = "p2_norm"
 
 
@@ -437,8 +548,14 @@ class LoggingOnlyGradientClipperConfig(BaseModel):
 
 
 class RichProgressSubscriberConfig(BaseModel):
-    eval_splits_num_steps: Optional[dict[str, int]] = None
-    train_split_num_steps: Optional[dict[str, tuple[int, int]]] = None
+    """reference RichProgressSubscriberConfig (config.py:477-482): dataloader-level
+    fields the factory converts into per-tag progress-bar specs."""
+
+    eval_dataloaders: Optional[list[PydanticLLMDataLoaderIFType]] = Field(default_factory=list)
+    train_dataloader_tag: str
+    num_seen_steps: Annotated[int, Field(strict=True, ge=0)]
+    num_target_steps: Annotated[int, Field(strict=True, gt=0)]
+    global_rank: Annotated[int, Field(strict=True, ge=0)]
 
 
 class RichResultSubscriberConfig(BaseModel):
@@ -451,11 +568,23 @@ class EvaluationResultToDiscSubscriberConfig(BaseModel):
 
 
 class WandBEvaluationResultSubscriberConfig(BaseModel):
+    """reference WandBEvaluationResultSubscriberConfig (config.py:493-500), plus the
+    legacy `experiment_path` alias for `directory` kept for earlier TPU configs."""
+
+    global_rank: Annotated[int, Field(strict=True, ge=0)] = 0
+    entity: Optional[str] = None
     project: str
     experiment_id: str
     mode: str = "OFFLINE"
+    directory: Optional[Path] = None
     experiment_path: Optional[Path] = None
     config_file_path: Optional[Path] = None
+
+    @model_validator(mode="after")
+    def _validate_mode(self) -> "WandBEvaluationResultSubscriberConfig":
+        if self.mode.upper() not in ("ONLINE", "OFFLINE", "DISABLED"):
+            raise ValueError(f"unknown wandb mode {self.mode!r} (ONLINE | OFFLINE | DISABLED)")
+        return self
 
 
 # -------------------------------------------------------------------------- MFU
@@ -548,9 +677,19 @@ class ComponentSelectorFromPipelineConfig(BaseModel):
 
 
 class PipelineBuilderConfig(BaseModel):
-    pp_stages: list[Any]
-    model_parts: list[Any]
+    """reference PipelineConfig (pipeline_parallelism_configs.py:44-49): the
+    deprecated singular aliases (`pp_stage`, `model_part`) accept a single item and
+    lift it to a list — the reference's add_deprecated_alias + maybe_list pattern,
+    which its own pp_tp YAML uses."""
+
+    pp_stages: list[Any] = Field(validation_alias=AliasChoices("pp_stages", "pp_stage"))
+    model_parts: list[Any] = Field(validation_alias=AliasChoices("model_parts", "model_part"))
     pp_schedule: Optional[Any] = None
+
+    @field_validator("pp_stages", "model_parts", mode="before")
+    @classmethod
+    def _lift_single_to_list(cls, value: Any) -> Any:
+        return value if isinstance(value, list) else [value]
 
 
 # ------------------------------------------------------------- debugging components

@@ -77,7 +77,11 @@ class TrainingProgressSettings(BaseModel):
 class Paths(BaseModel):
     model_config = {"extra": "allow"}
 
-    experiments_root_path: Path
+    # Optional here although the reference's Paths model requires it: the reference's
+    # own shipped config_files/training YAMLs omit it (only the tutorial configs set
+    # `${modalities_env:experiments_root_path}`), and Main tracks the experiments
+    # root independently — requiring it would reject the reference's own configs.
+    experiments_root_path: Optional[Path] = None
 
     @model_validator(mode="before")
     @classmethod

@@ -143,8 +143,37 @@ class _DocumentResolver:
             return self._memo[dot_path]
         if dot_path in self._in_progress:
             raise ConfigError(f"Circular interpolation detected at '{dot_path}' (referenced from {from_path})")
+        # mark the full path in progress BEFORE walking: intermediate-node
+        # resolution below can re-enter _lookup, and a cycle routed through an
+        # intermediate interpolation (a: ${b.x}, b: ${a.x}) must surface as the
+        # clean ConfigError, not a RecursionError
+        self._in_progress.add(dot_path)
+        try:
+            node = self._walk(dot_path, from_path)
+            value = self._resolve_node(node, dot_path)
+        finally:
+            self._in_progress.discard(dot_path)
+        self._memo[dot_path] = value
+        return value
+
+    def _walk(self, dot_path: str, from_path: str) -> Any:
         node: Any = self._root
+        walked: list[str] = []
         for key in dot_path.split("."):
+            if isinstance(node, str) and _find_interpolation(node) is not None:
+                # an intermediate node is itself an interpolation (e.g. warmstart's
+                # `paths: ${warmstart_env:checkpoint_paths}` resolving to a dict) —
+                # resolve it before indexing further (omegaconf does this natively)
+                partial = ".".join(walked)
+                if partial in self._in_progress:
+                    raise ConfigError(
+                        f"Circular interpolation detected at '{partial}' (referenced from {from_path})"
+                    )
+                self._in_progress.add(partial)
+                try:
+                    node = self._resolve_node(node, partial)
+                finally:
+                    self._in_progress.discard(partial)
             if isinstance(node, list):
                 try:
                     node = node[int(key)]
@@ -156,13 +185,8 @@ class _DocumentResolver:
                 node = node[key]
             else:
                 raise ConfigError(f"Cannot resolve '${{{dot_path}}}': {key!r} is not indexable (from {from_path})")
-        self._in_progress.add(dot_path)
-        try:
-            value = self._resolve_node(node, dot_path)
-        finally:
-            self._in_progress.discard(dot_path)
-        self._memo[dot_path] = value
-        return value
+            walked.append(key)
+        return node
 
 
 def resolve_config_dict(config: Any, resolvers: Optional[dict[str, Resolver]] = None) -> Any:

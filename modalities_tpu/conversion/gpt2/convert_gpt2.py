@@ -31,15 +31,26 @@ def _to_torch(x: np.ndarray):
 
 
 def convert_model_checkpoint(model: GPT2LLM, params) -> tuple:
-    """Map GPT2LLM params onto a LlamaForCausalLM state dict. Returns (hf_model, config)."""
+    """Map GPT2LLM params onto a stock HF architecture. Returns (hf_model, config).
+
+    Two layouts cover both reference architecture families
+    (reference conversion_model.py:134-171 + modeling_gpt2.py):
+    - SwiGLU(+RoPE+RMSNorm, GQA) -> ``LlamaForCausalLM``
+    - GELU+ABSOLUTE+LayerNorm (the getting-started arch) -> ``GPT2LMHeadModel``
+    Either way the export loads with vanilla ``AutoModelForCausalLM`` — no custom
+    HF modeling code, no trust_remote_code.
+    """
     import torch
     from transformers import LlamaConfig, LlamaForCausalLM
 
     spec = model.config_spec
+    if spec.activation == "gelu":
+        return _convert_to_hf_gpt2(model, params)
     if spec.activation not in ("swiglu", "fused_swiglu"):
         raise NotImplementedError(
-            "HF export currently supports the SwiGLU(+RoPE+RMSNorm) configuration, "
-            "which maps onto the stock Llama architecture."
+            "HF export supports the SwiGLU(+RoPE+RMSNorm) configuration (stock Llama "
+            "layout) and the GELU+ABSOLUTE+LayerNorm configuration (stock GPT-2 layout); "
+            f"got activation {spec.activation!r}."
         )
     head_dim = spec.head_dim
     config = LlamaConfig(
@@ -104,6 +115,114 @@ def convert_model_checkpoint(model: GPT2LLM, params) -> tuple:
         hf_model = LlamaForCausalLM(config)
     missing, unexpected = hf_model.load_state_dict(sd, strict=False)
     real_missing = [m for m in missing if "rotary_emb" not in m and not (spec.use_weight_tying and m == "lm_head.weight")]
+    if real_missing or unexpected:
+        raise RuntimeError(f"Weight mapping mismatch: missing={real_missing}, unexpected={unexpected}")
+    if spec.use_weight_tying:
+        hf_model.tie_weights()
+    return hf_model, config
+
+
+def _convert_to_hf_gpt2(model: GPT2LLM, params) -> tuple:
+    """GELU+ABSOLUTE+LayerNorm GPT2LLM -> stock ``GPT2LMHeadModel``. HF GPT-2 uses
+    Conv1D ([in, out] weights — flax kernel orientation, so no transposes) and the
+    tanh-approximate GELU (flax ``nn.gelu`` default == HF ``gelu_new``)."""
+    import torch
+    from transformers import GPT2Config, GPT2LMHeadModel
+
+    spec = model.config_spec
+    blockers = []
+    if spec.poe_type != "ABSOLUTE":
+        blockers.append(f"poe_type must be ABSOLUTE (got {spec.poe_type!r})")
+    if spec.use_rope:
+        blockers.append("RoPE has no GPT-2-layout equivalent")
+    if spec.use_qk_norm:
+        blockers.append("QK-norm has no GPT-2-layout equivalent")
+    if spec.n_head_kv != spec.n_head_q:
+        blockers.append(f"GQA (n_head_kv={spec.n_head_kv} != n_head_q={spec.n_head_q}) is not GPT-2")
+    for name, norm in (("attention", spec.attn_norm), ("ffn", spec.ffn_norm), ("lm_head", spec.lm_head_norm)):
+        if norm.kind.value != "layer_norm":
+            blockers.append(f"{name}_norm must be layer_norm (got {norm.kind.value})")
+    eps_values = {spec.attn_norm.eps, spec.ffn_norm.eps, spec.lm_head_norm.eps}
+    if len(eps_values) > 1:
+        blockers.append(
+            f"HF GPT-2 has ONE layer_norm_epsilon; norms disagree ({sorted(eps_values)})"
+        )
+    if spec.head_dim * spec.n_head_q != spec.n_embd:
+        blockers.append(
+            f"head_dim*n_head_q ({spec.head_dim}*{spec.n_head_q}) must equal n_embd ({spec.n_embd})"
+        )
+    if blockers:
+        raise NotImplementedError(
+            "config does not map onto the stock GPT-2 layout: " + "; ".join(blockers)
+        )
+
+    config = GPT2Config(
+        vocab_size=spec.vocab_size,
+        n_positions=spec.sequence_length,
+        n_embd=spec.n_embd,
+        n_layer=spec.n_layer,
+        n_head=spec.n_head_q,
+        n_inner=spec.ffn_hidden,
+        activation_function="gelu_new",
+        resid_pdrop=0.0,
+        embd_pdrop=0.0,
+        attn_pdrop=0.0,
+        layer_norm_epsilon=spec.attn_norm.eps,
+        tie_word_embeddings=spec.use_weight_tying,
+    )
+
+    p = params["params"]
+    blocks = p["blocks"]["block"]
+    e = spec.n_embd
+    sd: dict = {}
+    sd["transformer.wte.weight"] = _to_torch(np.asarray(p["wte"]))
+    sd["transformer.wpe.weight"] = _to_torch(np.asarray(p["wpe"]))
+    sd["transformer.ln_f.weight"] = _to_torch(np.asarray(p["lm_head_norm"]["scale"]))
+    if spec.lm_head_norm.use_bias:
+        sd["transformer.ln_f.bias"] = _to_torch(np.asarray(p["lm_head_norm"]["bias"]))
+    if not spec.use_weight_tying:
+        sd["lm_head.weight"] = _to_torch(np.asarray(p["lm_head"]["kernel"]).T)
+
+    attn, mlp = blocks["attn"], blocks["mlp"]
+    for layer in range(spec.n_layer):
+        prefix = f"transformer.h.{layer}"
+        for hf_norm, ours, norm_spec in (
+            ("ln_1", "attention_norm", spec.attn_norm),
+            ("ln_2", "ffn_norm", spec.ffn_norm),
+        ):
+            sd[f"{prefix}.{hf_norm}.weight"] = _to_torch(np.asarray(blocks[ours]["scale"])[layer])
+            if norm_spec.use_bias:
+                sd[f"{prefix}.{hf_norm}.bias"] = _to_torch(np.asarray(blocks[ours]["bias"])[layer])
+        # qkv: [E, H, D] each -> concatenated Conv1D weight [E, 3E] (head-major, like
+        # HF's split+view); attention c_proj: [H, D, E] -> [E_in, E_out]
+        qkv = [np.asarray(attn[k]["kernel"])[layer].reshape(e, e) for k in ("q_attn", "k_attn", "v_attn")]
+        sd[f"{prefix}.attn.c_attn.weight"] = _to_torch(np.concatenate(qkv, axis=1))
+        sd[f"{prefix}.attn.c_proj.weight"] = _to_torch(np.asarray(attn["c_proj"]["kernel"])[layer].reshape(e, e))
+        # mlp: flax kernels are already [in, out] = Conv1D orientation
+        sd[f"{prefix}.mlp.c_fc.weight"] = _to_torch(np.asarray(mlp["c_fc"]["kernel"])[layer])
+        sd[f"{prefix}.mlp.c_proj.weight"] = _to_torch(np.asarray(mlp["c_proj"]["kernel"])[layer])
+        if spec.bias:
+            qkv_b = [np.asarray(attn[k]["bias"])[layer].reshape(e) for k in ("q_attn", "k_attn", "v_attn")]
+            sd[f"{prefix}.attn.c_attn.bias"] = _to_torch(np.concatenate(qkv_b))
+            sd[f"{prefix}.attn.c_proj.bias"] = _to_torch(np.asarray(attn["c_proj"]["bias"])[layer])
+            sd[f"{prefix}.mlp.c_fc.bias"] = _to_torch(np.asarray(mlp["c_fc"]["bias"])[layer])
+            sd[f"{prefix}.mlp.c_proj.bias"] = _to_torch(np.asarray(mlp["c_proj"]["bias"])[layer])
+
+    with torch.device("cpu"):
+        hf_model = GPT2LMHeadModel(config)
+    missing, unexpected = hf_model.load_state_dict(sd, strict=False)
+    # Conv1D biases default to zeros and ln biases to zeros in HF's init, which IS
+    # the bias=False semantics; non-persistent attn.bias/masked_bias buffers are
+    # never in a state dict
+    allowed_missing = {m for m in missing if m.endswith((".attn.bias", ".attn.masked_bias"))}
+    if not spec.bias:
+        allowed_missing |= {m for m in missing if m.endswith(".bias")}
+    for hf_norm, norm_spec in (("ln_1", spec.attn_norm), ("ln_2", spec.ffn_norm), ("ln_f", spec.lm_head_norm)):
+        if not norm_spec.use_bias:
+            allowed_missing |= {m for m in missing if m.endswith(f"{hf_norm}.bias")}
+    if spec.use_weight_tying:
+        allowed_missing.add("lm_head.weight")
+    real_missing = [m for m in missing if m not in allowed_missing]
     if real_missing or unexpected:
         raise RuntimeError(f"Weight mapping mismatch: missing={real_missing}, unexpected={unexpected}")
     if spec.use_weight_tying:

@@ -13,6 +13,30 @@ class DummyProgressSubscriber(MessageSubscriberIF[ProgressUpdate]):
         pass
 
 
+class ProgressSubscriberFactory:
+    """reference ProgressSubscriberFactory (subscriber_factory.py:21-44): converts
+    dataloader-level config into per-tag progress-bar specs; non-zero ranks get the
+    dummy subscriber so only one process renders bars."""
+
+    @staticmethod
+    def get_rich_progress_subscriber(
+        eval_dataloaders,
+        train_dataloader_tag: str,
+        num_seen_steps: int,
+        num_target_steps: int,
+        global_rank: int,
+    ) -> MessageSubscriberIF:
+        if global_rank != 0:
+            return DummyProgressSubscriber()
+        train_split_num_steps = {train_dataloader_tag: (num_target_steps, num_seen_steps)}
+        eval_splits_num_steps = {dl.dataloader_tag: len(dl) for dl in (eval_dataloaders or [])}
+        return RichProgressSubscriber(train_split_num_steps, eval_splits_num_steps)
+
+    @staticmethod
+    def get_dummy_progress_subscriber() -> DummyProgressSubscriber:
+        return DummyProgressSubscriber()
+
+
 class RichProgressSubscriber(MessageSubscriberIF[ProgressUpdate]):
     """Live progress bars keyed by dataloader tag."""
 

@@ -68,6 +68,48 @@ class EvaluationResultToDiscSubscriber(MessageSubscriberIF[EvaluationResultBatch
             f.write(json.dumps(self._serialize(message.payload)) + "\n")
 
 
+def get_wandb_result_subscriber(
+    project: str,
+    experiment_id: str,
+    global_rank: int = 0,
+    entity: Optional[str] = None,
+    mode: str = "OFFLINE",
+    directory: Optional[Path] = None,
+    experiment_path: Optional[Path] = None,
+    config_file_path: Optional[Path] = None,
+) -> MessageSubscriberIF:
+    """reference SubscriberFactory.get_wandb_result_subscriber
+    (subscriber_factory.py:64-100): only rank 0 logs, DISABLED yields a no-op
+    subscriber, and `directory` pins wandb's cache/data dirs via env vars.
+    `experiment_path` is the legacy TPU-config alias for `directory`."""
+    import os
+
+    if global_rank != 0 or mode.upper() == "DISABLED":
+        return DummyResultSubscriber()
+    logging_dir = directory if directory is not None else experiment_path
+    if logging_dir is not None:
+        absolute_dir = Path(logging_dir).absolute()
+        (absolute_dir / "wandb").mkdir(parents=True, exist_ok=True)
+        for var in (
+            "WANDB_CACHE_DIR",
+            "WANDB_DIR",
+            "WANDB_DATA_DIR",
+            "WANDB_ARTIFACT_LOCATION",
+            "WANDB_ARTIFACT_DIR",
+            "WANDB_CONFIG_DIR",
+        ):
+            os.environ[var] = str(absolute_dir)
+        logging_dir = absolute_dir
+    return WandBEvaluationResultSubscriber(
+        project=project,
+        experiment_id=experiment_id,
+        mode=mode,
+        experiment_path=logging_dir,
+        config_file_path=config_file_path,
+        entity=entity,
+    )
+
+
 class WandBEvaluationResultSubscriber(MessageSubscriberIF[EvaluationResultBatch]):
     """wandb logger; degrades to a warning when wandb is not installed."""
 
@@ -78,13 +120,14 @@ class WandBEvaluationResultSubscriber(MessageSubscriberIF[EvaluationResultBatch]
         mode: str = "offline",
         experiment_path: Optional[Path] = None,
         config_file_path: Optional[Path] = None,
+        entity: Optional[str] = None,
     ):
         try:
             import wandb
 
             self._wandb = wandb
             self._run = wandb.init(
-                project=project, name=experiment_id, mode=mode.lower(), dir=experiment_path
+                project=project, name=experiment_id, mode=mode.lower(), dir=experiment_path, entity=entity
             )
             if config_file_path is not None and Path(config_file_path).exists():
                 artifact = wandb.Artifact(name=f"config-{experiment_id}", type="config")

@@ -690,9 +690,11 @@ class GPT2LLM(NNModel):
             prediction_key=prediction_key,
             seed=seed,
             weight_decay_groups={
+                # group names match the reference (gpt2_model.py:871-875) so its
+                # YAMLs' weight_decay_groups_excluded lists resolve unchanged
                 "linear": [r".*(q_attn|k_attn|v_attn|c_proj|c_fc|W|V|W_2|lm_head).*kernel.*"],
                 "embedding": [r".*(wte|wpe).*"],
-                "norm": [r".*(norm).*"],
+                "layernorm": [r".*(norm).*"],
             },
         )
         if n_head_q % n_head_kv != 0:

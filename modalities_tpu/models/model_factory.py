@@ -20,6 +20,15 @@ from modalities_tpu.utils.logging import get_logger
 logger = get_logger(__name__)
 
 
+def _parse_dtype_name(name) -> str:
+    """Accept jax dtype names ("bfloat16"), torch-qualified names ("torch.bfloat16"),
+    and the reference's PyTorchDtypes enum spellings ("BF_16", env_utils.py:81-88).
+    FP_16 maps to bfloat16 — the MXU has no fp16 path."""
+    text = str(name).split(".")[-1]
+    enum_names = {"BF_16": "bfloat16", "FP_16": "bfloat16", "FP_32": "float32"}
+    return enum_names.get(text.upper(), text.lower())
+
+
 class ModelFactory:
     @staticmethod
     def get_fsdp2_wrapped_model(
@@ -35,15 +44,48 @@ class ModelFactory:
         the mixed-precision policy (param/reduce dtype, reference model_factory.py:201)."""
         if mixed_precision_settings:
             mp = MixedPrecisionSpec(
-                param_dtype=str(mixed_precision_settings.get("param_dtype", "float32")).split(".")[-1].lower(),
-                reduce_dtype=str(mixed_precision_settings.get("reduce_dtype", "float32")).split(".")[-1].lower(),
+                param_dtype=_parse_dtype_name(mixed_precision_settings.get("param_dtype", "float32")),
+                reduce_dtype=_parse_dtype_name(mixed_precision_settings.get("reduce_dtype", "float32")),
             )
             model.update_train_spec(mixed_precision=mp)
         model.device_mesh = device_mesh
         return model
 
-    # config-compat alias: FSDP1 path collapses onto the GSPMD sharding too
-    get_fsdp1_wrapped_model = get_fsdp2_wrapped_model
+    # reference MixedPrecisionSettings (env_utils.py:34-68) → (param, reduce) dtypes.
+    # FP_16 maps to bfloat16: the MXU has no fp16 path and bf16 needs no grad scaler.
+    _FSDP1_MIXED_PRECISION = {
+        "FP_16": ("bfloat16", "bfloat16"),
+        "BF_16": ("bfloat16", "bfloat16"),
+        "BF_16_WORKING": ("float32", "bfloat16"),
+        "MIXED_PRECISION_MEGATRON": ("bfloat16", "float32"),
+        "FP_32": ("float32", "float32"),
+        "NO_MIXED_PRECISION": (None, None),
+    }
+
+    @staticmethod
+    def get_fsdp1_wrapped_model(
+        model: NNModel,
+        sync_module_states: bool = False,
+        mixed_precision_settings: Optional[str] = None,
+        sharding_strategy: str = "FULL_SHARD",
+        block_names: Optional[list[str]] = None,
+        device_mesh: Optional[DeviceMeshHandle] = None,
+    ) -> NNModel:
+        """FSDP1 wrap with the reference's own schema (FSDPWrappedModelConfig,
+        reference config.py:264-285). Sharding collapses onto the GSPMD rule set —
+        FULL_SHARD/HYBRID_SHARD are expressed by the mesh's dp_shard/dp_replicate
+        degrees, not by the wrapper (SURVEY §2.3 sanctions this) — while the enum
+        mixed-precision names map onto param/reduce dtypes. `sync_module_states`
+        is a no-op: jitted init is rank-identical by construction."""
+        del sync_module_states, block_names
+        if mixed_precision_settings is not None:
+            param_dtype, reduce_dtype = ModelFactory._FSDP1_MIXED_PRECISION[mixed_precision_settings]
+            if param_dtype is not None:
+                model.update_train_spec(
+                    mixed_precision=MixedPrecisionSpec(param_dtype=param_dtype, reduce_dtype=reduce_dtype)
+                )
+        model.device_mesh = device_mesh
+        return model
 
     @staticmethod
     def get_compiled_model(

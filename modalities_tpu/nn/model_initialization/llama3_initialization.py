@@ -1,0 +1,148 @@
+"""Llama3/TorchTitan-style weight initialization (reference:
+src/modalities/models/gpt2/llama3_like_initialization.py:15-147).
+
+Reference semantics, re-expressed as a pure JAX param-tree transform:
+
+- ``transformer.wte.weight``            → N(0, 1)
+- ``transformer.lm_head.weight``        → truncN(0, 1/√n_embd) truncated at ±3/√n_embd
+  (exactly ±3σ)
+- q/k/v projections, ``mlp.W``          → truncN(0, 0.02) truncated at ±2 *absolute*
+  (±100σ — statistically a plain normal)
+- ``attn.c_proj``, ``mlp.V``, ``mlp.W_2`` (residual-out + gated-mlp value/out) →
+  truncN(0, std_l) truncated at ±2, with the depth-scaled
+  ``std_l = 0.02/√(2·(l+1))`` when ``depth_init`` else the constant
+  ``0.02/√(2·num_layers)``
+
+Where the reference walks eager FQNs and extracts the layer id from
+``transformer.h.{l}.``, this build's GPT2 stacks all layers on a leading scan axis,
+so the depth-scaled groups sample with a per-layer std *vector* broadcast over that
+axis — one sampling op per parameter, no Python loop over layers.
+
+The reference's structural checks are preserved: any bias parameter is an error
+(Llama3 has none), every regex group must match at least one parameter (otherwise
+the model is not Llama3-shaped — e.g. a GELU MLP has no ``W/V/W_2``, and weight
+tying removes the separate ``lm_head`` parameter), and a parameter matching two
+groups is an error.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+
+from modalities_tpu.nn.model_initialization.initialization_if import ModelInitializationIF
+from modalities_tpu.utils.logging import get_logger
+
+logger = get_logger(name="llama3 initialization")
+
+# beyond this many σ, truncation is statistically a no-op but erfinv-based samplers
+# lose precision — fall back to a plain normal
+_TRUNC_SIGMA_CAP = 10.0
+
+
+def _param_name(path) -> str:
+    return "/".join(str(getattr(p, "key", getattr(p, "idx", p))) for p in path)
+
+
+def _trunc_normal(key, shape, dtype, std, a: float, b: float):
+    """Sample N(0, std) truncated to the *absolute* interval [a, b] (reference
+    trunc_normal_, llama3_like_initialization.py:150-181). `std` may be a per-layer
+    vector broadcastable against `shape` (the scan-stacked depth axis)."""
+    import jax
+    import jax.numpy as jnp
+
+    std = jnp.asarray(std, jnp.float32)
+    lower = jnp.maximum(a / std, -_TRUNC_SIGMA_CAP)
+    upper = jnp.minimum(b / std, _TRUNC_SIGMA_CAP)
+    # sample in f32 (the reference always inits in f32 then casts back) in σ units
+    sample = jax.random.truncated_normal(key, lower, upper, shape, jnp.float32)
+    return (sample * std).astype(dtype)
+
+
+class Llama3Initializer(ModelInitializationIF):
+    """Llama3/TorchTitan init for the GPT2 (SwiGLU) architecture."""
+
+    def __init__(self, num_layers: int, n_embd: int, depth_init: bool = True) -> None:
+        self.num_layers = int(num_layers)
+        self.n_embd = int(n_embd)
+        self.depth_init = bool(depth_init)
+
+    # group name -> (path regex over this build's param tree, sampler kind)
+    # paths (scan-over-layers linen): params/wte/.value, params/lm_head/kernel/.value,
+    # params/blocks/block/{attn/{q,k,v}_attn,attn/c_proj,mlp/{W,V,W_2}}/kernel/.value
+    _GROUPS = {
+        # trailing segment optional everywhere: boxed trees end in "/.value"
+        # (logically-annotated params), unboxed trees (the jitted init path,
+        # train_step.py init_state) end at the param name itself
+        "embedding": r".*/wte(/[^/]*)?$",
+        "lm_head": r".*/lm_head/kernel(/[^/]*)?$",
+        "qkv": r".*/attn/(q_attn|k_attn|v_attn)/kernel(/[^/]*)?$",
+        "attn_out": r".*/attn/c_proj/kernel(/[^/]*)?$",
+        "mlp_in": r".*/mlp/W/kernel(/[^/]*)?$",
+        "mlp_scaled": r".*/mlp/(V|W_2)/kernel(/[^/]*)?$",
+    }
+
+    def _depth_stds(self, leaf):
+        """Per-layer std vector for residual-out projections, shaped to broadcast
+        over the leading scan (depth) axis of a stacked parameter."""
+        import jax.numpy as jnp
+
+        depth = leaf.shape[0]
+        if depth != self.num_layers:
+            raise ValueError(
+                f"stacked depth axis ({depth}) does not match num_layers ({self.num_layers})"
+            )
+        if self.depth_init:
+            stds = 0.02 / jnp.sqrt(2.0 * (jnp.arange(depth, dtype=jnp.float32) + 1.0))
+        else:
+            stds = jnp.full((depth,), 0.02 / math.sqrt(2.0 * self.num_layers), jnp.float32)
+        return stds.reshape((depth,) + (1,) * (leaf.ndim - 1))
+
+    def initialize_in_place(self, params, rng):
+        import jax
+
+        compiled = {name: re.compile(pat) for name, pat in self._GROUPS.items()}
+        hits = {name: 0 for name in self._GROUPS}
+        flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+
+        new_leaves = []
+        for counter, (path, leaf) in enumerate(flat):
+            name = _param_name(path)
+            if re.search(r"(^|/)bias(/|$)", name):
+                raise ValueError(
+                    "Bias initialization is not allowed for Llama3Initializer. "
+                    f"Found bias parameter: {name}"
+                )
+            matches = [g for g, c in compiled.items() if c.search(name)]
+            if len(matches) > 1:
+                raise ValueError(
+                    f"Parameter {name} matched multiple init groups ({matches}), which is not allowed"
+                )
+            if not matches:
+                logger.warning(f"Parameter {name} did not match any regex for initialization")
+                new_leaves.append(leaf)
+                continue
+            group = matches[0]
+            hits[group] += 1
+            key = jax.random.fold_in(rng, counter)
+            if group == "embedding":
+                new_leaves.append(
+                    jax.random.normal(key, leaf.shape, jax.numpy.float32).astype(leaf.dtype)
+                )
+            elif group == "lm_head":
+                s = 1.0 / math.sqrt(self.n_embd)
+                new_leaves.append(_trunc_normal(key, leaf.shape, leaf.dtype, s, -3.0 * s, 3.0 * s))
+            elif group in ("qkv", "mlp_in"):
+                new_leaves.append(_trunc_normal(key, leaf.shape, leaf.dtype, 0.02, -2.0, 2.0))
+            else:  # attn_out | mlp_scaled — depth-scaled residual-out projections
+                stds = self._depth_stds(leaf)
+                new_leaves.append(_trunc_normal(key, leaf.shape, leaf.dtype, stds, -2.0, 2.0))
+
+        for group, count in hits.items():
+            if count == 0:
+                raise ValueError(
+                    f"Init group {group!r} ({self._GROUPS[group]}) did not match any parameter. "
+                    "The model specification probably does not match Llama3 "
+                    "(requires SwiGLU MLP, separate q/k/v projections, and untied lm_head)."
+                )
+        return jax.tree_util.tree_unflatten(treedef, new_leaves)

@@ -39,6 +39,13 @@ def build_weight_decay_mask(params, model: NNModel, weight_decay_groups_excluded
         return jax.tree.map(lambda _: True, params)
 
     groups = model.weight_decay_groups
+    # "norm" (earlier TPU configs) and "layernorm" (reference YAMLs) name the same
+    # group; resolve either spelling against whichever the model declares
+    aliases = {"norm": "layernorm", "layernorm": "norm"}
+    weight_decay_groups_excluded = [
+        g if g in groups else aliases.get(g, g) if aliases.get(g, g) in groups else g
+        for g in weight_decay_groups_excluded
+    ]
     for g in weight_decay_groups_excluded:
         if g not in groups:
             raise ValueError(

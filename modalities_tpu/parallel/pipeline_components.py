@@ -69,14 +69,23 @@ class StagesGenerator:
     the stacked-parameter layer axis is sharded evenly over pp — so the TPU version
     validates divisibility instead of bin-packing)."""
 
+    def get_num_global_stages(self, total_layers: int, num_layers_per_stage: int) -> int:
+        """Stage count from the per-stage layer budget. Subclasses weigh in their
+        input/output layer-equivalents (reference stages_generator.py:28-31)."""
+        return -(-total_layers // num_layers_per_stage)  # ceil
+
     def get_stage_layer_counts(self, total_layers: int, num_global_stages: int) -> list[int]:
         if num_global_stages <= 0:
             raise ConfigError(f"num_global_stages must be positive (got {num_global_stages})")
         if total_layers % num_global_stages != 0:
             raise ConfigError(
                 f"n_layer ({total_layers}) must divide evenly into {num_global_stages} "
-                "global stages (pp_degree x virtual stages) — the SPMD executor shards "
-                "the stacked layer axis uniformly over the pp mesh axis"
+                "global stages (pp_degree x virtual stages) — every SPMD program is "
+                "rank-uniform, so the stacked layer axis shards uniformly over the pp "
+                "mesh axis (uneven eager-torch stage splits have no SPMD analogue). "
+                "Adapt n_layer, pp degree, or num_layers_per_stage so the division is "
+                "even (e.g. the reference's 6-layer pp config runs at pp=2 with "
+                "num_layers_per_stage=4)."
             )
         return [total_layers // num_global_stages] * num_global_stages
 
@@ -85,7 +94,36 @@ class GPT2LLMStagesGenerator(StagesGenerator):
     """reference GPT2LLMStagesGenerator (stages_generator.py:107-114): split points =
     embedding block, each transformer layer, lm-head block. Under SPMD the
     embedding/head are pp-replicated (computed where needed, psum-merged), so only
-    the transformer layers are staged."""
+    the transformer layers are staged. The reference schema's bin-packing weights
+    (`input/output_layer_equivalence`) therefore have nothing to weigh — the layer
+    axis is sharded uniformly — but `num_model_layers` is kept as a cross-check
+    against the staged model."""
+
+    def __init__(
+        self,
+        num_model_layers: Optional[int] = None,
+        input_layer_equivalence: int = 0,
+        output_layer_equivalence: int = 0,
+    ):
+        # Python default 0: the SPMD executor pp-replicates embedding/lm-head, so
+        # they carry no stage weight here. The pydantic schema
+        # (GPT2LLMStagesGeneratorConfig) defaults to 1 like the reference, so
+        # reference YAMLs get the reference's weighted stage arithmetic either way.
+        self.num_model_layers = num_model_layers
+        self.input_layer_equivalence = input_layer_equivalence
+        self.output_layer_equivalence = output_layer_equivalence
+
+    def get_num_global_stages(self, total_layers: int, num_layers_per_stage: int) -> int:
+        weighted = total_layers + self.input_layer_equivalence + self.output_layer_equivalence
+        return -(-weighted // num_layers_per_stage)  # ceil (reference stages_generator.py:28-31)
+
+    def get_stage_layer_counts(self, total_layers: int, num_global_stages: int) -> list[int]:
+        if self.num_model_layers is not None and self.num_model_layers != total_layers:
+            raise ConfigError(
+                f"stages_generator num_model_layers ({self.num_model_layers}) does not "
+                f"match the staged model's n_layer ({total_layers})"
+            )
+        return super().get_stage_layer_counts(total_layers, num_global_stages)
 
 
 @dataclass
@@ -100,6 +138,9 @@ class Pipeline:
     pp_schedule_name: Optional[str] = None
     num_virtual: int = 1
     scheduled_model: Any = None
+    # set by get_scheduled_pipeline; guards against applying two schedules through
+    # one staged descriptor (the apply mutates the shared model spec in place)
+    schedule_applied: Optional[str] = None
 
     @property
     def model_parts(self) -> list:
@@ -141,11 +182,12 @@ class PipelineFactory:
         total_layers = getattr(getattr(whole_model, "config_spec", None), "n_layer", None)
         if total_layers is None:
             raise ConfigError("staged pipeline requires a model exposing config_spec.n_layer")
-        if num_layers_per_stage <= 0 or total_layers % num_layers_per_stage != 0:
-            raise ConfigError(
-                f"num_layers_per_stage ({num_layers_per_stage}) must divide n_layer ({total_layers})"
-            )
-        num_global_stages = total_layers // num_layers_per_stage
+        if num_layers_per_stage <= 0:
+            raise ConfigError(f"num_layers_per_stage must be positive (got {num_layers_per_stage})")
+        # stage count uses the reference's weighted arithmetic (stages_generator.py:28-31):
+        # embedding/lm-head count as input/output layer-equivalents, so e.g. 2 layers at
+        # 2-per-stage over pp=2 yields (1+2+1)/2 = 2 stages (the pp_tp reference config)
+        num_global_stages = stages_generator.get_num_global_stages(total_layers, num_layers_per_stage)
         if num_global_stages % max(pp_degree, 1) != 0:
             raise ConfigError(
                 f"global stage count ({num_global_stages}) must be a multiple of the "
@@ -185,6 +227,18 @@ class PipelineFactory:
         model guarantees is the same object. `pp_degree` is validated against the
         descriptor's geometry."""
         del loss_fn
+        # get_pipelined_model updates the descriptor's SHARED model spec in place;
+        # applying a second schedule to the same staged descriptor would silently
+        # overwrite the first scheduled pipeline's behavior — fail loudly instead.
+        # The marker lives on the DESCRIPTOR (not the model, which may legitimately
+        # be re-staged later; not the spec, where an explicit "gpipe" is
+        # indistinguishable from the default) and records the apply.
+        if pipeline.schedule_applied is not None:
+            raise ConfigError(
+                f"this staged pipeline already had schedule {pipeline.schedule_applied!r} "
+                "applied; build one scheduled pipeline per staged descriptor (the "
+                "schedule is applied to the shared model spec in place)"
+            )
         if pipeline.pp_stages and len(pipeline.pp_stages) % max(pp_degree, 1) != 0:
             raise ConfigError(
                 f"pp_degree ({pp_degree}) does not divide the staged pipeline's "
@@ -202,12 +256,14 @@ class PipelineFactory:
             microbatch_size=microbatch_size,
             num_virtual_stages=pipeline.num_virtual,
         )
+        pipeline.schedule_applied = pp_schedule_name
         return Pipeline(
             model=pipeline.model,
             pp_stages=pipeline.pp_stages,
             pp_schedule_name=pp_schedule_name,
             num_virtual=pipeline.num_virtual,
             scheduled_model=scheduled,
+            schedule_applied=pp_schedule_name,  # the result is schedule-carrying too
         )
 
     @staticmethod

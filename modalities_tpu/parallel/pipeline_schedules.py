@@ -123,6 +123,13 @@ def build_schedule_tables(
             f"pipeline schedule {schedule!r} not supported (have {SUPPORTED_SCHEDULES})"
         )
     if schedule in ("zbv", "dualpipev"):
+        # INTENTIONALLY byte-identical tables for both names: DualPipeV's signature
+        # F+B overlap unit is this executor's native tick and ZB-V's bubble-filling
+        # W slots are subsumed by the deferred bubble-free W pass (see
+        # _build_zbv_tables rationale), so the two schedules' distinct torch op
+        # orderings collapse to one optimal SPMD table here. Consequence: a
+        # benchmark comparing `zbv` vs `dualpipev` in this framework measures the
+        # same program by construction.
         if num_virtual not in (1, 2):
             raise ValueError(f"{schedule} uses exactly 2 virtual chunks (the V shape)")
         return _build_zbv_tables(num_stages, num_microbatches)

@@ -27,13 +27,15 @@ from modalities_tpu.dataloader.samplers import RandomSampler, SequentialSampler
 from modalities_tpu.loss_functions import CLMCrossEntropyLoss, NCELoss
 from modalities_tpu.logging_broker.subscriber_impl.progress_subscriber import (
     DummyProgressSubscriber,
+    ProgressSubscriberFactory,
     RichProgressSubscriber,
 )
 from modalities_tpu.logging_broker.subscriber_impl.results_subscriber import (
     DummyResultSubscriber,
     EvaluationResultToDiscSubscriber,
     RichResultSubscriber,
-    WandBEvaluationResultSubscriber,
+    WandBEvaluationResultSubscriber,  # noqa: F401 — re-exported for library users
+    get_wandb_result_subscriber,
 )
 from modalities_tpu.models.components import layer_norms as _ln
 from modalities_tpu.models.gpt2.collator import GPT2LLMCollateFn
@@ -41,6 +43,7 @@ from modalities_tpu.models.gpt2.gpt2_model import GPT2LLM, GPT2LLMConfig
 from modalities_tpu.models.huggingface.huggingface_model import HuggingFacePretrainedModel
 from modalities_tpu.models.model_factory import ModelFactory
 from modalities_tpu.nn.model_initialization.composed_initialization import ComposedModelInitialization
+from modalities_tpu.nn.model_initialization.llama3_initialization import Llama3Initializer
 from modalities_tpu.optimizers.optimizer_factory import OptimizerFactory
 from modalities_tpu.optimizers.scheduler_factory import (
     ConstantLRScheduler,
@@ -93,6 +96,24 @@ def _fsdp1_checkpointed_guard(**kwargs):
         "app_state.dcp + checkpoint_loading.orbax (warmstart path), not a build-time "
         "FSDP1 state load. See configs/config_lorem_ipsum_tpu_warmstart.yaml."
     )
+
+
+def _fsdp1_alias_checkpoint_loading(
+    global_rank=0, block_names=None, mixed_precision_settings=None, sharding_strategy=None
+):
+    """checkpoint_loading.fsdp1: Orbax loader behind the reference's name; the
+    FSDP1 wrapper-rebuild knobs are config-parity only (see
+    FSDP1AliasCheckpointLoadingConfig)."""
+    del block_names, mixed_precision_settings, sharding_strategy
+    return OrbaxCheckpointLoading(global_rank=global_rank)
+
+
+def _torch_alias_checkpoint_loading(global_rank=0, device=None, precision=None):
+    """checkpoint_loading.torch: Orbax loader behind the reference's name; the
+    torch-only device/precision knobs were already warned about at config
+    validation (TorchAliasCheckpointLoadingConfig) and are dropped here."""
+    del device, precision
+    return OrbaxCheckpointLoading(global_rank=global_rank)
 
 
 def _random_batch_generator(**kwargs):
@@ -174,7 +195,7 @@ COMPONENTS: list[ComponentEntity] = [
     ComponentEntity("model", "coca", _coca, _coca_config()),
     ComponentEntity("model", "vision_transformer", _vision_transformer, _vit_config()),
     ComponentEntity("model", "fsdp2_wrapped", ModelFactory.get_fsdp2_wrapped_model, cfg.FSDP2WrappedModelConfig),
-    ComponentEntity("model", "fsdp1_wrapped", ModelFactory.get_fsdp2_wrapped_model, cfg.FSDP2WrappedModelConfig),
+    ComponentEntity("model", "fsdp1_wrapped", ModelFactory.get_fsdp1_wrapped_model, cfg.FSDP1WrappedModelConfig),
     ComponentEntity("model", "model_initialized", ModelFactory.get_weight_initialized_model, cfg.WeightInitializedModelConfig),
     ComponentEntity(
         "model", "activation_checkpointed", ModelFactory.get_activation_checkpointed_model, cfg.ActivationCheckpointedModelConfig
@@ -192,7 +213,7 @@ COMPONENTS: list[ComponentEntity] = [
     # model initialization
     ComponentEntity("model_initialization", "composed", ComposedModelInitialization, cfg.ComposedInitializationConfig),
     ComponentEntity(
-        "model_initialization", "gpt2_llama3_like", ComposedModelInitialization, cfg.ComposedInitializationConfig
+        "model_initialization", "gpt2_llama3_like", Llama3Initializer, cfg.Llama3InitializerConfig
     ),
     # losses
     ComponentEntity("loss", "clm_cross_entropy_loss", CLMCrossEntropyLoss, cfg.CLMCrossEntropyLossConfig),
@@ -283,7 +304,12 @@ COMPONENTS: list[ComponentEntity] = [
     ComponentEntity("gradient_clipper", "dummy", DummyGradientClipper, None),
     # progress subscribers
     ComponentEntity("progress_subscriber", "dummy", DummyProgressSubscriber, None),
-    ComponentEntity("progress_subscriber", "rich", RichProgressSubscriber, cfg.RichProgressSubscriberConfig),
+    ComponentEntity(
+        "progress_subscriber",
+        "rich",
+        ProgressSubscriberFactory.get_rich_progress_subscriber,
+        cfg.RichProgressSubscriberConfig,
+    ),
     # results subscribers
     ComponentEntity("results_subscriber", "dummy", DummyResultSubscriber, None),
     ComponentEntity("results_subscriber", "rich", RichResultSubscriber, cfg.RichResultSubscriberConfig),
@@ -294,7 +320,7 @@ COMPONENTS: list[ComponentEntity] = [
         cfg.EvaluationResultToDiscSubscriberConfig,
     ),
     ComponentEntity(
-        "results_subscriber", "wandb", WandBEvaluationResultSubscriber, cfg.WandBEvaluationResultSubscriberConfig
+        "results_subscriber", "wandb", get_wandb_result_subscriber, cfg.WandBEvaluationResultSubscriberConfig
     ),
     # layer norms (referenced via norm wrapper configs inside model configs)
     # mfu
@@ -411,7 +437,12 @@ COMPONENTS: list[ComponentEntity] = [
         cfg.ComponentSelectorFromPipelineConfig,
     ),
     ComponentEntity("pipeline", "builder", _pl.PipelineFactory.get_pipeline, cfg.PipelineBuilderConfig),
-    ComponentEntity("stages_generator", "gpt2_stages_generator", _pl.GPT2LLMStagesGenerator, None),
+    ComponentEntity(
+        "stages_generator",
+        "gpt2_stages_generator",
+        _pl.GPT2LLMStagesGenerator,
+        cfg.GPT2LLMStagesGeneratorConfig,
+    ),
     # ---------------- layer norms (reference components.py:396-398; resolve to the
     # NormSpec the linen modules consume — for custom-model component graphs)
     ComponentEntity("layer_norm", "rms_norm", _ln.build_rms_norm_spec, _ln.RMSLayerNormConfig),
@@ -472,13 +503,27 @@ COMPONENTS: list[ComponentEntity] = [
     # Orbax regardless of the sharding era the name comes from — the aliases load/
     # save the same sharded checkpoints (reference fsdp_checkpoint_saving.py:32-176,
     # torch_checkpoint_loading.py)
-    ComponentEntity("checkpoint_loading", "fsdp1", OrbaxCheckpointLoading, cfg.OrbaxCheckpointLoadingConfig),
-    ComponentEntity("checkpoint_loading", "torch", OrbaxCheckpointLoading, cfg.OrbaxCheckpointLoadingConfig),
+    ComponentEntity(
+        "checkpoint_loading",
+        "fsdp1",
+        _fsdp1_alias_checkpoint_loading,
+        cfg.FSDP1AliasCheckpointLoadingConfig,
+    ),
+    # `torch` alias: accepts the reference's device/precision fields but warns that
+    # they are ignored (format is Orbax) — see TorchAliasCheckpointLoadingConfig
+    ComponentEntity(
+        "checkpoint_loading",
+        "torch",
+        _torch_alias_checkpoint_loading,
+        cfg.TorchAliasCheckpointLoadingConfig,
+    ),
     ComponentEntity(
         "checkpoint_saving_execution", "fsdp1", OrbaxCheckpointSaving, cfg.OrbaxCheckpointSavingConfig
     ),
     # FSDP1 build-time state loading has no SPMD analogue — whole-state restore is
     # app_state.dcp + checkpoint_loading.orbax; fail loudly with that guidance
-    ComponentEntity("model", "fsdp1_checkpointed", _fsdp1_checkpointed_guard, None),
-    ComponentEntity("optimizer", "fsdp1_checkpointed", _fsdp1_checkpointed_guard, None),
+    ComponentEntity("model", "fsdp1_checkpointed", _fsdp1_checkpointed_guard, cfg.FSDP1CheckpointedGuardConfig),
+    ComponentEntity(
+        "optimizer", "fsdp1_checkpointed", _fsdp1_checkpointed_guard, cfg.FSDP1CheckpointedGuardConfig
+    ),
 ]

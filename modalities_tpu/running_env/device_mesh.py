@@ -153,8 +153,25 @@ def get_device_mesh(
         enable_loss_parallel=enable_loss_parallel,
         world_size=world_size,
     )
-    if world_size != len(devices):
-        raise ConfigError(f"world_size ({world_size}) != number of devices ({len(devices)})")
+    if world_size > len(devices):
+        raise ConfigError(f"world_size ({world_size}) > number of devices ({len(devices)})")
+    if world_size < len(devices):
+        # Single-host only: a config written for a smaller world (e.g. a reference
+        # YAML for 2 GPUs) runs on the leading world_size devices; the rest idle.
+        # Multi-host must not slice — the leading devices all live on host 0, and a
+        # mesh excluding another process's local devices fails mid-run instead of
+        # here, so keep the old clear config-time error.
+        if jax.process_count() > 1:
+            raise ConfigError(
+                f"world_size ({world_size}) != number of devices ({len(devices)}) — on a "
+                "multi-host run the mesh must span every process's devices"
+            )
+        logger.warning(
+            "world_size (%d) < available devices (%d): building the mesh on the first "
+            "%d devices; the remaining %d stay idle",
+            world_size, len(devices), world_size, len(devices) - world_size,
+        )
+        devices = devices[:world_size]
 
     degrees = {
         "pp": cfg.pipeline_parallel_degree,

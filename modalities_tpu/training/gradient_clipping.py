@@ -22,6 +22,23 @@ class GradientClippingMode(str, Enum):
     P1_NORM = "p1_norm"
     MAX_NORM = "max_norm"  # infinity norm
 
+    @classmethod
+    def parse(cls, value) -> "GradientClippingMode":
+        """Accept the enum itself, the lowercase value, or the reference's YAML
+        spelling (the enum NAME, e.g. `P2_NORM` — config.py GradientClippingMode)."""
+        if isinstance(value, cls):
+            return value
+        try:
+            return cls(value)
+        except ValueError:
+            try:
+                return cls[str(value).upper()]
+            except KeyError:
+                raise ValueError(
+                    f"{value!r} is not a valid GradientClippingMode "
+                    f"(names: {[m.name for m in cls]}, values: {[m.value for m in cls]})"
+                ) from None
+
 
 def global_norm_by_mode(tree, mode: GradientClippingMode):
     """Global gradient norm across the whole (sharded) tree for the given mode."""
@@ -83,18 +100,27 @@ class GradientClipper(GradientClipperIF):
     max_norm: float = 1.0
     norm_type: GradientClippingMode = GradientClippingMode.P2_NORM
     error_if_nonfinite: bool = False
+    # torch handles from the reference schemas (per-shard norm walk / PP-mesh
+    # all-reduce); the jit global norm spans all mesh axes, so both are unused
+    wrapped_model: Optional[object] = None
+    device_mesh: Optional[object] = None
 
     def __post_init__(self):
-        if isinstance(self.norm_type, str):
-            self.norm_type = GradientClippingMode(self.norm_type)
+        self.norm_type = GradientClippingMode.parse(self.norm_type)
 
 
 @dataclass
 class LoggingOnlyGradientClipper(GradientClipperIF):
-    """Report the grad norm without clipping (reference FSDP2LoggingOnlyGradientClipper)."""
+    """Report the grad norm without clipping (reference FSDP2LoggingOnlyGradientClipper).
+    `wrapped_model` is the reference FSDP1 schema's model handle (needed there for
+    torch's per-shard norm walk); the jit global norm needs no model, so it is unused."""
 
     max_norm: Optional[float] = None
     norm_type: GradientClippingMode = GradientClippingMode.P2_NORM
+    wrapped_model: Optional[object] = None
+
+    def __post_init__(self):
+        self.norm_type = GradientClippingMode.parse(self.norm_type)
 
 
 @dataclass

@@ -191,7 +191,14 @@ class HookRegistration:
         """Compile per-block activation printing into the model: sets the model
         spec's `debug_print_activations` flag, which GPT2Block lowers to a
         jax.debug.print of the block output's mean/std/nan-count (or shape only)
-        on every forward — the jit-native analogue of the reference's print hook."""
+        on every forward — the jit-native analogue of the reference's print hook.
+
+        Ordering constraint (unlike the reference's eager hooks, which take effect
+        immediately): the flag only affects forwards traced AFTER registration. A
+        train/inference step already jitted against this model captured the old
+        spec and will keep printing nothing — register the hook BEFORE building
+        the step (the registry's `model.debugging_enriched` node does this by
+        construction, since hooks apply during the component build)."""
         mode = "shape" if print_shape_only else "stats"
         if not hasattr(model, "with_spec_updates"):
             raise TypeError(

@@ -232,7 +232,7 @@ class NumTokensFromPackedMemMapDatasetContinuousConfig(BaseModel):
     dp_degree: PositiveInt
     local_micro_batch_size: PositiveInt
     gradient_accumulation_steps: PositiveInt
-    sample_key: str
+    sample_key: str = "text"  # reference default (number_conversion.py:61)
     reuse_last_target: bool = True
 
 

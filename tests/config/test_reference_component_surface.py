@@ -279,7 +279,9 @@ def test_pipeline_graph_through_component_factory():
                 },
                 "device_mesh": {"instance_key": "device_mesh", "pass_type": "BY_REFERENCE"},
                 "pp_schedule_name": "1f1b",
-                "num_layers_per_stage": 2,
+                # (4 layers + 1 input-eq + 1 output-eq) / 3 = 2 stages = pp degree
+                # (reference weighted stage arithmetic, stages_generator.py:28-31)
+                "num_layers_per_stage": 3,
             },
         },
         "scheduled_pipeline": {
