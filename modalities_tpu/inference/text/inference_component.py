@@ -62,14 +62,64 @@ class TextInferenceComponent:
         return self._jitted_decode
 
     def _sample(self, logits: np.ndarray, rng):
+        # same sampling math as the fused device loop (jax.random.categorical with
+        # the same key-split sequence), so the cached loop and the re-forward
+        # fallback emit identical continuations
         import jax
+        import jax.numpy as jnp
 
         if self.temperature > 0:
-            probs = np.exp((logits / self.temperature) - np.max(logits / self.temperature))
-            probs = probs / probs.sum()
             rng, sub = jax.random.split(rng)
-            return int(np.random.default_rng(int(sub[0])).choice(len(probs), p=probs)), rng
+            return int(jax.random.categorical(sub, jnp.asarray(logits) / self.temperature)), rng
         return int(np.argmax(logits)), rng
+
+    def _decode_many(self):
+        """One jitted lax.while_loop generating up to `max_steps` tokens in a single
+        dispatch (VERDICT r2 #10: the per-token host round-trip dominated at ~10 ms/
+        token on a 680M model). `max_steps` and `eod_id` are traced scalars and the
+        output buffer is sized by the static cache capacity, so ONE compilation
+        serves every prompt/budget. Returns (out [capacity], count, rng): tokens
+        out[:count]; count < max_steps means the eod token stopped generation."""
+        import jax
+        import jax.numpy as jnp
+
+        if getattr(self, "_jitted_decode_many", None) is None:
+            model = self.model
+            temperature = self.temperature
+
+            def loop(params, cache, last_logits, rng, eod_id, max_steps):
+                # cache capacity from the kv buffers ([.., B, S, H, D]; index
+                # counters in the tree are scalars, so filter by rank)
+                capacity = max(x.shape[-3] for x in jax.tree.leaves(cache) if x.ndim >= 4)
+                out = jnp.zeros((capacity,), jnp.int32)
+
+                def cond(carry):
+                    _, _, _, _, count, stop = carry
+                    return (~stop) & (count < max_steps)
+
+                def body(carry):
+                    cache, logits, rng, out, count, _ = carry
+                    if temperature > 0:
+                        rng, sub = jax.random.split(rng)
+                        tok = jax.random.categorical(sub, logits / temperature)
+                    else:
+                        tok = jnp.argmax(logits, axis=-1)
+                    tok = tok.astype(jnp.int32)[0]
+                    is_eod = tok == eod_id
+                    out = jnp.where(is_eod, out, out.at[count].set(tok))
+                    count = count + jnp.where(is_eod, 0, 1)
+                    new_logits, cache = model.decode_step(params, cache, tok[None, None])
+                    return cache, new_logits[:, -1, :], rng, out, count, is_eod
+
+                carry = (cache, last_logits, rng, out, jnp.zeros((), jnp.int32), jnp.zeros((), bool))
+                _, _, rng, out, count, _ = jax.lax.while_loop(cond, body, carry)
+                return out, count, rng
+
+            # no donation: the cache is consumed inside the loop and not returned,
+            # so donated kv buffers would be unusable (and warn) — XLA reuses the
+            # while-carry buffers internally regardless
+            self._jitted_decode_many = jax.jit(loop)
+        return self._jitted_decode_many
 
     def generate_tokens(self, context: str, max_new_tokens: Optional[int] = None) -> str:
         import jax
@@ -107,21 +157,24 @@ class TextInferenceComponent:
             toks = np.asarray([window[pos : pos + chunk]], dtype=np.int32)
             logits, cache = step(self.params, cache, toks)
             pos += chunk
-        generated: list[int] = []
         consumed = len(window)
-        while len(generated) < budget:
-            next_id, rng = self._sample(np.asarray(logits)[0, -1], rng)
-            if next_id == eod_id:
-                return generated
-            generated.append(next_id)
-            consumed += 1
-            if consumed >= capacity:
-                # cache full: continue with the sliding-window fallback for parity
-                generated += self._generate_reforward(
-                    window + generated, eod_id, budget - len(generated), rng
-                )
-                return generated
-            logits, cache = step(self.params, cache, np.asarray([[next_id]], dtype=np.int32))
+        # one fused device loop for the whole budget (or until the cache fills);
+        # a single dispatch replaces budget-many per-token host round-trips
+        max_steps = min(budget, capacity - consumed)
+        out, count, rng = self._decode_many()(
+            self.params, cache, logits[:, -1, :], rng,
+            np.int32(eod_id), np.int32(max_steps),
+        )
+        count = int(count)
+        generated = [int(t) for t in np.asarray(out)[:count]]
+        if count < max_steps:  # stopped at the eod token
+            return generated
+        consumed += count
+        if consumed >= capacity and len(generated) < budget:
+            # cache full: continue with the sliding-window fallback for parity
+            generated += self._generate_reforward(
+                window + generated, eod_id, budget - len(generated), rng
+            )
         return generated
 
     def _generate_reforward(self, token_ids: list[int], eod_id: int, budget: int, rng) -> list[int]:

@@ -39,7 +39,7 @@ from modalities_tpu.models.components.layer_norms import (
 from modalities_tpu.models.model import NNModel
 
 
-def with_logical_constraint(x, axes, spec=None):
+def with_logical_constraint(x, axes, spec=None, explicit=False):
     """Sharding hint over logical axis names; resolved by parallel/sharding.py rules
     (active only when the train step installs an axis_rules context). Skipped for
     blocks running under the pp pipeline (spec.pipeline_axis set): inside that manual
@@ -48,7 +48,7 @@ def with_logical_constraint(x, axes, spec=None):
         return x
     from modalities_tpu.parallel.sharding import constrain_activation
 
-    return constrain_activation(x, axes)
+    return constrain_activation(x, axes, explicit=explicit)
 
 
 class PositionTypes(str, Enum):
@@ -241,14 +241,34 @@ class GPT2ModelSpec:
         )
 
 
-def _rope_tables(head_dim: int, seq_len: int, base_freq: int, dtype=jnp.float32):
+def _rope_tables(head_dim: int, seq_len: int, base_freq: int, dtype=jnp.float32, offset=0):
     """cos/sin tables, rotate-half convention matching the reference RotaryTransform
-    (gpt2_model.py:114-229)."""
+    (gpt2_model.py:114-229). `offset` (int or traced scalar) shifts positions to
+    `offset .. offset+seq_len-1` — required inside manual cp regions where the local
+    sequence chunk starts at a nonzero global position."""
     inv_freq = 1.0 / (base_freq ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
-    t = jnp.arange(seq_len, dtype=jnp.float32)
+    t = jnp.asarray(offset, jnp.float32) + jnp.arange(seq_len, dtype=jnp.float32)
     freqs = jnp.einsum("i,j->ij", t, inv_freq)
     emb = jnp.concatenate([freqs, freqs], axis=-1)
     return jnp.cos(emb).astype(dtype), jnp.sin(emb).astype(dtype)
+
+
+def _manual_axis_active(axis_name: Optional[str]) -> bool:
+    """True when tracing inside a shard_map region that binds `axis_name` manually."""
+    if axis_name is None:
+        return False
+    ambient = jax.sharding.get_abstract_mesh()
+    return ambient is not None and axis_name in getattr(ambient, "manual_axes", ())
+
+
+def cp_shard_offset(axis_name: Optional[str], local_seq_len: int):
+    """Global position offset of this shard's sequence chunk, when running inside a
+    shard_map region that binds `axis_name` manually (e.g. the pp×cp pipeline body);
+    0 otherwise. Positions are global semantics — RoPE phases and absolute position
+    embeddings must use the shard's true offset, not restart at 0 per chunk."""
+    if _manual_axis_active(axis_name):
+        return jax.lax.axis_index(axis_name) * local_seq_len
+    return 0
 
 
 def _rotate_half(x):
@@ -337,7 +357,11 @@ class CausalSelfAttention(nn.Module):
             return self._decode_attention(x, q, k, v)
 
         if spec.use_rope:
-            cos, sin = _rope_tables(head_dim, x.shape[1], spec.rope_base_freq, dtype=x.dtype)
+            # inside a manual cp region (pp×cp pipeline body) x holds a LOCAL chunk:
+            # phases must use the chunk's global offset or cross-chunk relative
+            # positions in the ring come out shifted by cp_rank * S_local
+            offset = cp_shard_offset(spec.context_parallel_axis, x.shape[1])
+            cos, sin = _rope_tables(head_dim, x.shape[1], spec.rope_base_freq, dtype=x.dtype, offset=offset)
             q = apply_rope(q, cos, sin)
             k = apply_rope(k, cos, sin)
 
@@ -562,7 +586,15 @@ class GPT2Module(nn.Module):
             (spec.vocab_size, spec.n_embd),
             param_dtype,
         )
-        x = jnp.take(wte, input_ids, axis=0).astype(compute_dtype)
+        # FSDP-gather the table's embed dim BEFORE the lookup (keep vocab on tp for
+        # the vocab-parallel gather+psum): if the gather output inherits wte's
+        # embed-over-dp_shard sharding, GSPMD can only reach the (batch, seq)
+        # activation layout via an involuntary full rematerialization of the
+        # activations (spmd_partitioner.cc:652 warnings in the pp×dp×cp dryrun) —
+        # at scale that all-gathers [B,S,E] per step instead of the [V,E] table
+        wte_lookup = with_logical_constraint(wte, ("vocab", "embed_lookup"), explicit=True)
+        x = jnp.take(wte_lookup, input_ids, axis=0).astype(compute_dtype)
+        x = with_logical_constraint(x, ("batch", "seq", "embed"))
         if spec.poe_type == PositionTypes.ABSOLUTE.value:
             wpe = self.param(
                 "wpe",
@@ -833,11 +865,16 @@ class GPT2LLM(NNModel):
         prediction_key = self.prediction_key
         target_key = loss_fn.target_key
 
+        cp_axis = spec.context_parallel_axis
+
         def embed(shared, tokens, rng):
             p = shared["params"]
             x = jnp.take(p["wte"], tokens, axis=0).astype(compute_dtype)
             if spec.poe_type == PositionTypes.ABSOLUTE.value:
-                x = x + p["wpe"][None, : tokens.shape[1], :].astype(compute_dtype)
+                # tokens are a LOCAL seq chunk under cp: slice wpe at the global offset
+                offset = cp_shard_offset(cp_axis, tokens.shape[1])
+                wpe = jax.lax.dynamic_slice_in_dim(p["wpe"], offset, tokens.shape[1], 0)
+                x = x + wpe[None].astype(compute_dtype)
             if spec.dropout > 0.0 and rng is not None:
                 keep = jax.random.bernoulli(rng, 1.0 - spec.dropout, x.shape)
                 x = jnp.where(keep, x / (1.0 - spec.dropout), jnp.zeros_like(x))
@@ -906,9 +943,16 @@ class GPT2LLM(NNModel):
                 loss = loss_fn({prediction_key: head_project(spec, p, h)}, {target_key: targets})
                 ignore_index = getattr(loss_fn, "ignore_index", None)
                 if ignore_index is None:
-                    return loss, jnp.asarray(targets.size, jnp.float32)
-                weight = (targets != ignore_index).sum().astype(jnp.float32)
-                return loss, jnp.maximum(weight, 1.0)
+                    count = jnp.asarray(targets.size, jnp.float32)
+                else:
+                    count = (targets != ignore_index).sum().astype(jnp.float32)
+                total = loss * jnp.maximum(count, 1.0)
+            # under cp the chunk's (sum, count) are partial along the sequence: reduce
+            # over the ring so every shard sees the microbatch-global mean and weight
+            # (the psum transpose routes each shard its own local cotangent slice)
+            if _manual_axis_active(cp_axis):
+                total = jax.lax.psum(total, cp_axis)
+                count = jax.lax.psum(count, cp_axis)
             weight = jnp.maximum(count, 1.0)
             return total / weight, weight
 

@@ -34,7 +34,7 @@ import jax.numpy as jnp
 
 
 def _gpipe_local(stacked_params, x_microbatches, *, axis_name: str, num_stages: int,
-                 block_apply: Callable, compute_dtype, dropout_rng=None):
+                 block_apply: Callable, compute_dtype, dropout_rng=None, seq_axis=None):
     """Runs on one pp shard. stacked_params: [L/P, ...] pytree; x_microbatches:
     [M, B, S, E] f32 at the boundary (replicated over pp — its cotangent psum must be
     f32: bf16 psum in a partial-manual region trips an XLA check). Compute runs in
@@ -44,6 +44,10 @@ def _gpipe_local(stacked_params, x_microbatches, *, axis_name: str, num_stages: 
     independent mask (reference schedules draw fresh masks per microbatch)."""
     x_microbatches = x_microbatches.astype(compute_dtype)
     stage = jax.lax.axis_index(axis_name)
+    if dropout_rng is not None and seq_axis is not None:
+        # each cp shard holds a different sequence chunk: fold the cp rank in so
+        # dropout masks are independent per chunk instead of repeating
+        dropout_rng = jax.random.fold_in(dropout_rng, jax.lax.axis_index(seq_axis))
     num_micro = x_microbatches.shape[0]
     num_local_layers = jax.tree.leaves(stacked_params)[0].shape[0]
     perm = [(i, (i + 1) % num_stages) for i in range(num_stages)]
@@ -147,7 +151,9 @@ def pipeline_blocks(
 
     manual_axes = {axis_name}
     x_spec = P()
+    seq_axis = None
     if seq_shard_axis is not None and seq_shard_axis in mesh.axis_names and mesh.shape[seq_shard_axis] > 1:
+        seq_axis = seq_shard_axis
         manual_axes.add(seq_shard_axis)
         x_spec = P(None, None, seq_shard_axis)  # [M, B, S, ...]: seq sharded over cp
 
@@ -160,6 +166,7 @@ def pipeline_blocks(
             block_apply=block_apply,
             compute_dtype=compute_dtype,
             dropout_rng=dropout_rng,
+            seq_axis=seq_axis,
         ),
         mesh=mesh,
         in_specs=(param_specs, x_spec),

@@ -133,6 +133,16 @@ def _masked_add(acc, update, mask):
     return jax.tree.map(lambda a, u: a + jnp.where(mask, u, jnp.zeros_like(u)), acc, update)
 
 
+def _masked_cond(pred, true_fn, false_fn, operand):
+    """lax.cond-shaped but UNCONDITIONAL: runs both branches and selects by `pred`.
+    Used when the true branch contains manual-axis collectives (cp ring hops) that
+    every device must execute even on its idle ticks — a real cond would strand the
+    collective's rendezvous when validity differs across pp stages."""
+    t = true_fn(operand)
+    f = false_fn(operand)
+    return jax.tree.map(lambda a, b: jnp.where(pred, a, b), t, f)
+
+
 def _buf_set(buf, index, value, mask):
     """buf.at[index].set(value) where mask else buf."""
     new = buf.at[index].set(value)
@@ -152,12 +162,17 @@ def scheduled_pipeline_loss_and_grads(
     num_microbatches: Optional[int] = None,
     num_virtual: int = 1,
     rng=None,
+    seq_shard_axis: Optional[str] = None,
 ):
     """Run one pipelined fwd+bwd over the global batch; returns
     (mean_loss, stacked_grads, shared_grads).
 
     tokens/targets: [B, S] (batch split into microbatches along B).
     stacked_params: leading layers axis, sharded over `axis_name`.
+    `seq_shard_axis` (e.g. "cp"): bind that axis manually too, with the sequence dim
+    of tokens/targets sharded over it — in-block ring attention then composes with
+    the schedule (stage fns must be cp-aware: global RoPE/wpe offsets, head_loss
+    psums its (sum, count) over cp; see GPT2LLM.pp_stage_fns).
     Differentiation is hand-rolled (schedule tables + jax.vjp per slot); do not wrap
     this in jax.grad.
     """
@@ -215,20 +230,33 @@ def scheduled_pipeline_loss_and_grads(
     param_specs = jax.tree.map(lambda _: P(None, axis_name), stacked_chunked)
     shared_specs = jax.tree.map(lambda _: P(), shared_params)
 
+    manual_axes = {axis_name}
+    token_spec = P()
+    seq_axis = None
+    if (
+        seq_shard_axis is not None
+        and seq_shard_axis in mesh.axis_names
+        and mesh.shape[seq_shard_axis] > 1
+    ):
+        seq_axis = seq_shard_axis
+        manual_axes.add(seq_axis)
+        token_spec = P(None, None, seq_axis)  # [M, B/M, S]: seq sharded over cp
+
     local = functools.partial(
         _scheduled_local,
         stage_fns=stage_fns,
         tables=tables,
         slot_plan=slot_plan,
         axis_name=axis_name,
+        seq_axis=seq_axis,
         rng=rng,
     )
     fn = jax.shard_map(
         local,
         mesh=mesh,
-        in_specs=(param_specs, shared_specs, P(), P()),
+        in_specs=(param_specs, shared_specs, token_spec, token_spec),
         out_specs=(P(), param_specs, shared_specs),
-        axis_names=frozenset({axis_name}),
+        axis_names=frozenset(manual_axes),
         check_vma=False,
     )
     loss, g_stacked, g_shared = fn(stacked_chunked, shared_params, tokens_mb, targets_mb)
@@ -238,7 +266,7 @@ def scheduled_pipeline_loss_and_grads(
 
 
 def _scheduled_local(stacked_chunked, shared, tokens_mb, targets_mb, *, stage_fns, tables,
-                     slot_plan, axis_name, rng):
+                     slot_plan, axis_name, seq_axis, rng):
     """Per-pp-shard tick loop. stacked_chunked local shape: [V, 1, L_vc, ...] (axis 1
     was the pp shard). All buffers are static-shape; schedule tables are baked-in
     constants indexed by (tick, device); table values encode chunk*M + microbatch."""
@@ -269,16 +297,22 @@ def _scheduled_local(stacked_chunked, shared, tokens_mb, targets_mb, *, stage_fn
     fwd_perm = [(i, (i + 1) % P_) for i in range(P_)]
     bwd_perm = [(i, (i - 1) % P_) for i in range(P_)]
 
+    # under cp each shard holds a DIFFERENT sequence chunk: fold the cp rank in so
+    # dropout masks are independent per chunk rather than repeating per shard
+    cp_fold = (lambda r: r) if seq_axis is None else (
+        lambda r: jax.random.fold_in(r, jax.lax.axis_index(seq_axis))
+    )
+
     def block_rng(mb_index):
         """Per-microbatch per-layer dropout keys, disjoint from the embed key."""
         if rng is None:
             return None
-        return jax.random.fold_in(jax.random.fold_in(rng, 1), mb_index)
+        return cp_fold(jax.random.fold_in(jax.random.fold_in(rng, 1), mb_index))
 
     def embed_rng(mb_index):
         if rng is None:
             return None
-        return jax.random.fold_in(jax.random.fold_in(rng, 2), mb_index)
+        return cp_fold(jax.random.fold_in(jax.random.fold_in(rng, 2), mb_index))
 
     def blocks_fwd(params_v, chunk, x, mb_index):
         """Apply this device's chunk `chunk` (global stage chunk*P + stage)."""
@@ -329,6 +363,14 @@ def _scheduled_local(stacked_chunked, shared, tokens_mb, targets_mb, *, stage_fn
         # inserts tp collectives inside the branches, and a predicate varying within
         # a tp/dp group would deadlock those collectives on real hardware. The pp
         # hops (psum/ppermute) stay outside the conds, executed uniformly each tick.
+        # EXCEPTION — cp in the manual region (seq_axis set): the ring-attention
+        # ppermutes inside the stage forward/backward are collectives whose lowered
+        # op every device must execute, but f/b validity varies along pp — so the F
+        # and B slots run UNCONDITIONALLY (gpipe-style masked selects) when cp is
+        # on, trading idle-tick compute for a deadlock-free uniform program. The H
+        # slot keeps its cond: hm is the same static table entry on every device,
+        # so its cp psum executes all-or-none.
+        slot_cond = jax.lax.cond if seq_axis is None else _masked_cond
 
         # ---- F slot -----------------------------------------------------------
         is_first_stage = (stage == 0) & (c_f == 0)
@@ -350,7 +392,7 @@ def _scheduled_local(stacked_chunked, shared, tokens_mb, targets_mb, *, stage_fn
             z = jnp.zeros(x_shape.shape, compute_dtype)
             return z, z
 
-        x_in, y = jax.lax.cond(f_valid, run_f, skip_f, None)
+        x_in, y = slot_cond(f_valid, run_f, skip_f, None)
         xbuf = _buf_set(xbuf, f_slot, x_in, f_valid)
 
         # broadcast the last GLOBAL stage's fresh output for the (uniform) head slot
@@ -417,7 +459,7 @@ def _scheduled_local(stacked_chunked, shared, tokens_mb, targets_mb, *, stage_fn
                 (g_x_,) = pull(gbuf[b_slot].astype(compute_dtype))
                 return g_x_
 
-            g_x = jax.lax.cond(
+            g_x = slot_cond(
                 b_valid, run_b, lambda _: jnp.zeros(x_shape.shape, compute_dtype), None
             )
         else:
@@ -434,7 +476,7 @@ def _scheduled_local(stacked_chunked, shared, tokens_mb, targets_mb, *, stage_fn
                     jnp.zeros(x_shape.shape, compute_dtype),
                 )
 
-            g_p, g_x = jax.lax.cond(b_valid, run_b, skip_b, None)
+            g_p, g_x = slot_cond(b_valid, run_b, skip_b, None)
             g_stacked = jax.tree.map(jnp.add, g_stacked, g_p)
 
         # embedding backward: only global stage 0's input is the embedding output.
@@ -584,9 +626,16 @@ def _scheduled_local(stacked_chunked, shared, tokens_mb, targets_mb, *, stage_fn
 
     # token-weighted mean == the unpipelined global mean, also under ignore_index
     # masking with unequal per-microbatch token counts (cotangents were seeded with
-    # each microbatch's weight, so grads currently hold d(sum of token losses))
+    # each microbatch's weight, so grads currently hold d(sum of token losses));
+    # under cp, head_loss already psum'd each microbatch's (sum, count) over the
+    # ring, so losses/weights are identical on every cp shard
     total_weight = jnp.maximum(weights.sum(), 1.0)
     loss = (losses * weights).sum() / total_weight
+    if seq_axis is not None:
+        # each cp shard's block/embed/head grads cover only its sequence chunk:
+        # reduce so the (cp-replicated) param grads are the full-sequence grads
+        g_stacked = jax.tree.map(lambda g: jax.lax.psum(g, seq_axis), g_stacked)
+        g_shared = jax.tree.map(lambda g: jax.lax.psum(g, seq_axis), g_shared)
     g_stacked = jax.tree.map(
         lambda g, p: (g / total_weight).astype(p.dtype)[:, None], g_stacked, stacked_local
     )

@@ -139,9 +139,13 @@ class activation_rules:
         return False
 
 
-def constrain_activation(x, logical_axes):
+def constrain_activation(x, logical_axes, explicit: bool = False):
     """Apply a sharding constraint for logical axis names, if rules are installed;
-    no-op inside manual shard_map regions (pp/cp) and outside any rules context."""
+    no-op inside manual shard_map regions (pp/cp) and outside any rules context.
+    `explicit=True` applies the constraint even when every dim resolves to None —
+    an explicit "replicated here" directive to GSPMD (used to force the FSDP
+    all-gather of the embedding table BEFORE the token lookup, so the gather's
+    output never carries the table's sharding)."""
     state = getattr(_ACTIVATION_RULES, "state", None)
     if not state:
         return x
@@ -150,7 +154,7 @@ def constrain_activation(x, logical_axes):
     if ambient is not None and getattr(ambient, "manual_axes", ()):
         return x
     spec = logical_to_mesh_spec(tuple(logical_axes), rules)
-    if all(s is None for s in spec):
+    if not explicit and all(s is None for s in spec):
         return x
     try:
         return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))

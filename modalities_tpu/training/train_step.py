@@ -115,7 +115,13 @@ class TrainStepBuilder:
         )
 
     # ------------------------------------------------------------------ build
-    def build(self, seed: Optional[int] = None) -> StepFunctions:
+    def build(self, seed: Optional[int] = None, materialize: bool = True) -> StepFunctions:
+        """`materialize=False`: compile-only mode — the AppState stays an abstract
+        ShapeDtypeStruct tree (no parameter buffers allocated) and the returned
+        StepFunctions carries `lower_train_step(batch_abstract)` for AOT
+        lowering/compilation. Validates that XLA can partition and compile the
+        full-size step program (v5p readiness checks for configs too large to
+        materialize on the host)."""
         model = self.model
         mesh_handle = self.mesh_handle
         seed = seed if seed is not None else model.seed
@@ -208,14 +214,21 @@ class TrainStepBuilder:
             state_shardings = AppState(
                 params=param_shardings, opt_state=opt_shardings, step=replicated_sharding
             )
-            with mesh:
-                state = jax.jit(init_state, out_shardings=state_shardings)(rng)
+            if materialize:
+                with mesh:
+                    state = jax.jit(init_state, out_shardings=state_shardings)(rng)
+            else:
+                state = abstract_state
         else:
             state_shardings = None
-            state = jax.jit(init_state)(rng)
+            if materialize:
+                state = jax.jit(init_state)(rng)
+            else:
+                state = jax.eval_shape(init_state, rng)
 
         logger.info(
-            "initialized AppState: %d params",
+            "%s AppState: %d params",
+            "initialized" if materialize else "abstract (compile-only)",
             sum(int(np.prod(x.shape)) for x in jax.tree.leaves(state.params)),
         )
 
@@ -306,11 +319,6 @@ class TrainStepBuilder:
             and hasattr(model, "pp_stage_fns")
         )
         if pp_scheduled:
-            if mesh_handle.degrees.get("cp", 1) > 1:
-                raise NotImplementedError(
-                    "scheduled pipeline (pp_schedule != 'gpipe') does not compose with "
-                    "context parallelism yet; use the default gpipe schedule with cp"
-                )
             from modalities_tpu.parallel.pipeline_scheduled import (
                 scheduled_pipeline_loss_and_grads,
             )
@@ -319,6 +327,9 @@ class TrainStepBuilder:
             target_key = loss_fn.target_key
             pp_mesh = mesh_handle.mesh
             model_dropout = getattr(model_spec, "dropout", 0.0)
+            # ring attention composes with the scheduled executor: cp joins the
+            # manual region, stage fns are cp-aware (global positions, psum'd loss)
+            pp_seq_axis = "cp" if mesh_handle.degrees.get("cp", 1) > 1 else None
 
             def loss_and_grads(params, samples, targets, dropout_rng):
                 stacked, shared = model.split_pp_params(params)
@@ -333,6 +344,7 @@ class TrainStepBuilder:
                     num_microbatches=model_spec.pp_num_microbatches,
                     num_virtual=getattr(model_spec, "pp_num_virtual", 1),
                     rng=dropout_rng if model_dropout > 0.0 else None,
+                    seq_shard_axis=pp_seq_axis,
                 )
                 return loss, model.merge_pp_grads(g_stacked, g_shared)
 

@@ -121,6 +121,47 @@ def test_params_actually_sharded():
     assert big and any(not x.sharding.is_fully_replicated for x in big)
 
 
+def test_dp_hsdp_equivalence():
+    """dp8 vs HSDP (dp_replicate2 x dp_shard4): the reference's HYBRID_SHARD
+    headline layout (model_factory.py:205-211, BASELINE.md HYBRID rows) — params
+    shard over dp_shard and replicate over dp_replicate, the batch spans BOTH axes,
+    grads all-reduce across replicas. Losses must match pure FSDP exactly."""
+    mesh_dp = get_device_mesh(device_type="cpu", data_parallel_shard_degree=8, world_size=8)
+    mesh_hsdp = get_device_mesh(
+        device_type="cpu", data_parallel_replicate_degree=2,
+        data_parallel_shard_degree=4, world_size=8,
+    )
+    assert dict(zip(mesh_hsdp.axis_names, mesh_hsdp.mesh.devices.shape)) == {
+        "dp_replicate": 2, "dp_shard": 4,
+    }
+    rng = np.random.default_rng(11)
+    raw = _batch(rng, 1, 8, 16)
+
+    losses = {}
+    for name, mesh in [("dp", mesh_dp), ("hsdp", mesh_hsdp)]:
+        fns = _builder(tiny_gpt2("pytorch_flash"), mesh, clip=1.0).build(seed=0)
+        state = fns.app_state_handle.state
+        if name == "hsdp":
+            # batch spans both dp axes: 8 rows -> 2x4 device grid, one row each
+            batch = fns.put_batch(raw)
+            tok_shard = batch["samples"]["input_ids"].sharding
+            assert set(tok_shard.spec[1]) == {"dp_replicate", "dp_shard"}
+            # params: sharded over dp_shard only, REPLICATED over dp_replicate
+            leaves = [x for x in jax.tree.leaves(state.params) if x.ndim >= 2]
+            assert any(
+                "dp_shard" in jax.tree.leaves(tuple(x.sharding.spec)) for x in leaves
+            )
+            assert all(
+                "dp_replicate" not in jax.tree.leaves(tuple(x.sharding.spec)) for x in leaves
+            )
+        ls = []
+        for _ in range(3):
+            state, metrics = fns.train_step(state, fns.put_batch(raw))
+            ls.append(float(metrics["loss"]))
+        losses[name] = ls
+    np.testing.assert_allclose(losses["dp"], losses["hsdp"], rtol=3e-4, atol=3e-4)
+
+
 def test_weight_decay_mask():
     from modalities_tpu.optimizers.optimizer_factory import build_weight_decay_mask
 
@@ -210,6 +251,91 @@ def test_dp_vs_pp_cp_combined_equivalence():
     losses = {}
     for name, mesh in [("dp", mesh_dp), ("mix", mesh_mix)]:
         fns = _builder(tiny_gpt2("pytorch_flash"), mesh, clip=1.0).build(seed=0)
+        state = fns.app_state_handle.state
+        ls = []
+        for _ in range(2):
+            state, metrics = fns.train_step(state, fns.put_batch(raw))
+            ls.append(float(metrics["loss"]))
+        losses[name] = ls
+    np.testing.assert_allclose(losses["dp"], losses["mix"], rtol=5e-4, atol=5e-4)
+
+
+def test_rope_global_positions_under_pp_cp():
+    """Positionwise f32 logit equality: single-device vs pp2 x cp2 x dp2 forward.
+    Inside the pipeline's manual region each cp shard holds a LOCAL sequence chunk,
+    so RoPE phases must use the chunk's global offset — with local (restart-at-0)
+    positions, cross-chunk relative positions in the ring come out shifted and the
+    logits of every position on cp rank > 0 are wrong (caught live: ~2e-2 error on
+    positions S/2.. while 0..S/2-1 matched exactly)."""
+    tokens = np.random.default_rng(0).integers(0, 128, size=(8, 32)).astype(np.int32)
+
+    m1 = tiny_gpt2("pytorch_flash")
+    m1.with_spec_updates(compute_dtype="float32", param_dtype="float32")
+    p1 = m1.init_params(jax.random.PRNGKey(0))
+    ref = m1.apply(p1, {"input_ids": jnp.asarray(tokens)}, train=False)["logits"]
+
+    mesh = get_device_mesh(
+        device_type="cpu", data_parallel_shard_degree=2, context_parallel_degree=2,
+        pipeline_parallel_degree=2, world_size=8,
+    )
+    m2 = tiny_gpt2("pytorch_flash")
+    m2.with_spec_updates(
+        context_parallel_axis="cp", pipeline_axis="pp",
+        compute_dtype="float32", param_dtype="float32",
+    )
+    p2 = m2.init_params(jax.random.PRNGKey(0))
+    with mesh.mesh:
+        out = jax.jit(lambda p, t: m2.apply(p, {"input_ids": t}, train=False)["logits"])(
+            p2, jnp.asarray(tokens)
+        )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-4, rtol=1e-4)
+
+
+@pytest.mark.parametrize("schedule", ["1f1b", "zbv"])
+def test_dp_pp_cp_scheduled_equivalence(schedule):
+    """dp8 vs pp2 x dp2 x cp2 under the SCHEDULED executors: ring attention runs
+    inside the 1F1B/ZBV shard_map region (cp joins the manual axes; F/B slots go
+    unconditional so the ring's collectives execute uniformly — VERDICT r2 #4)."""
+    mesh_dp = get_device_mesh(device_type="cpu", data_parallel_shard_degree=8, world_size=8)
+    mesh_mix = get_device_mesh(
+        device_type="cpu", data_parallel_shard_degree=2, context_parallel_degree=2,
+        pipeline_parallel_degree=2, world_size=8,
+    )
+    rng = np.random.default_rng(9)
+    raw = _batch(rng, 1, 8, 32)
+
+    losses = {}
+    for name, mesh in [("dp", mesh_dp), ("mix", mesh_mix)]:
+        model_run = tiny_gpt2("pytorch_flash", n_layer=4)
+        if name == "mix":
+            model_run.with_spec_updates(pp_schedule=schedule)
+        fns = _builder(model_run, mesh, clip=1.0).build(seed=0)
+        state = fns.app_state_handle.state
+        ls = []
+        for _ in range(3):
+            state, metrics = fns.train_step(state, fns.put_batch(raw))
+            ls.append(float(metrics["loss"]))
+        losses[name] = ls
+    np.testing.assert_allclose(losses["dp"], losses["mix"], rtol=5e-4, atol=5e-4)
+
+
+def test_absolute_positions_under_scheduled_pp_cp():
+    """ABSOLUTE position embeddings under 1F1B x cp: the embed stage slices wpe at
+    the shard's global offset (local chunks restart at 0 otherwise)."""
+    mesh_dp = get_device_mesh(device_type="cpu", data_parallel_shard_degree=8, world_size=8)
+    mesh_mix = get_device_mesh(
+        device_type="cpu", data_parallel_shard_degree=2, context_parallel_degree=2,
+        pipeline_parallel_degree=2, world_size=8,
+    )
+    rng = np.random.default_rng(10)
+    raw = _batch(rng, 1, 8, 32)
+
+    losses = {}
+    for name, mesh in [("dp", mesh_dp), ("mix", mesh_mix)]:
+        model_run = tiny_gpt2("pytorch_flash", poe_type="ABSOLUTE")
+        if name == "mix":
+            model_run.with_spec_updates(pp_schedule="1f1b")
+        fns = _builder(model_run, mesh, clip=1.0).build(seed=0)
         state = fns.app_state_handle.state
         ls = []
         for _ in range(2):
