@@ -87,23 +87,16 @@ def _reexec_on_cpu() -> None:
 
 
 def peak_flops_per_chip() -> float:
-    """bf16 peak FLOP/s by TPU generation (BASELINE.md: v5p 459e12)."""
+    """bf16 peak FLOP/s by TPU generation (BASELINE.md: v5p 459e12).
+
+    Delegates to the library table so the bench and the MFU subscriber can never
+    disagree about a chip's peak; unknown kinds warn there before falling back.
+    """
     import jax
 
-    kind = jax.devices()[0].device_kind.lower()
-    table = {
-        "v6e": 918e12,
-        "v6": 918e12,
-        "v5p": 459e12,
-        "v5e": 197e12,  # TPU v5 lite
-        "v5 lite": 197e12,
-        "v4": 275e12,
-        "cpu": 1e12,  # nominal, CI only
-    }
-    for key, val in table.items():
-        if key in kind:
-            return val
-    return 197e12
+    from modalities_tpu.utils.mfu import get_peak_flops
+
+    return get_peak_flops(jax.devices()[0].device_kind)
 
 
 # Candidate configs, best-tuned first, with OOM step-down. Each entry: model dims +

@@ -6,6 +6,7 @@ table (:17) becomes a TPU-generation table keyed off the device kind.
 
 from __future__ import annotations
 
+import warnings
 from abc import ABC, abstractmethod
 from typing import Optional
 
@@ -35,6 +36,12 @@ def get_peak_flops(device_kind: Optional[str] = None) -> float:
     for key, val in TPU_PEAK_FLOPS.items():
         if key in kind:
             return val
+    warnings.warn(
+        f"Unknown accelerator kind {device_kind!r}: no entry in TPU_PEAK_FLOPS; "
+        f"falling back to the v5e peak ({_DEFAULT_PEAK:.0f} FLOP/s). MFU computed "
+        "against this peak may be wrong for your chip — add the correct entry.",
+        stacklevel=2,
+    )
     return _DEFAULT_PEAK
 
 

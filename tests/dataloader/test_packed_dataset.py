@@ -86,7 +86,7 @@ def test_continuous_packing(tmp_path, reuse_last_target):
 
 def test_continuous_block_size_too_large_raises(tmp_path):
     p = make_pbin(tmp_path / "d.pbin", [[1, 2, 3]], token_size=2)
-    with pytest.raises(ValueError, match="Block size"):
+    with pytest.raises(ValueError, match="fewer than"):
         PackedMemMapDatasetContinuous(p, sample_key="x", block_size=10, reuse_last_target=True)
 
 

@@ -15,3 +15,23 @@ def test_mfu_calculator():
     assert calc.compute(tokens_per_sec) == pytest.approx(expected)
 
 
+def test_peak_flops_known_kinds_no_warning(recwarn):
+    from modalities_tpu.utils.mfu import TPU_PEAK_FLOPS, get_peak_flops
+
+    assert get_peak_flops("TPU v5p") == 459e12
+    assert get_peak_flops("TPU v5e") == 197e12
+    assert get_peak_flops("TPU v4") == 275e12
+    assert get_peak_flops("cpu") == 1e12
+    assert get_peak_flops("TPU v6e") == TPU_PEAK_FLOPS["v6e"]
+    assert len(recwarn) == 0
+
+
+def test_peak_flops_unknown_kind_warns():
+    """An unrecognized chip must warn, never silently score MFU against the v5e peak."""
+    from modalities_tpu.utils.mfu import get_peak_flops
+
+    with pytest.warns(UserWarning, match="Unknown accelerator kind"):
+        peak = get_peak_flops("TPU v99x")
+    assert peak == 197e12  # documented fallback, but loudly
+
+
