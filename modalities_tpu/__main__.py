@@ -289,6 +289,34 @@ def entry_point_list_remaining_runs(sweep_dir: Path, skip_oom_configs: bool) -> 
     click.echo(json.dumps(status, indent=2, default=str))
 
 
+@benchmark.command(name="validate_recipe")
+@click.option("--config_file_path", type=click.Path(exists=True, path_type=Path), required=True)
+@click.option("--hbm_budget_gib", type=float, default=95.0, help="Per-chip HBM budget (v5p: 95).")
+@click.option(
+    "--warmstart_checkpoint_folder",
+    type=str,
+    default=None,
+    help="Real checkpoint folder for warmstart recipes (default: a synthetic name).",
+)
+@_exception_handling
+def entry_point_validate_recipe(
+    config_file_path: Path, hbm_budget_gib: float, warmstart_checkpoint_folder: Optional[str]
+) -> None:
+    """Compile-only v5p readiness check: lower the recipe's full sharded train step
+    over a virtual mesh of its world_size and report the per-chip HBM budget
+    (BASELINE.md acceptance recipes; runs in a CPU subprocess, no TPU touched)."""
+    from modalities_tpu.utils.recipe_validation import run_validation_subprocess
+
+    report = run_validation_subprocess(
+        config_file_path,
+        hbm_budget_bytes=int(hbm_budget_gib * 1024**3),
+        warmstart_checkpoint_folder=warmstart_checkpoint_folder,
+    )
+    click.echo(json.dumps(report, indent=2))
+    if report["lowering"] != "ok" or not report["fits_budget"]:
+        raise SystemExit(1)
+
+
 @benchmark.command(name="summarize_results")
 @click.option("--sweep_dir", type=click.Path(exists=True, path_type=Path), required=True)
 @_exception_handling

@@ -422,7 +422,9 @@ class PreTrainedHFTokenizerConfig(BaseModel):
     truncation: Optional[bool] = False
     padding: Optional[bool | str] = False
     max_length: Optional[int] = None
-    special_tokens: Optional[dict[str, str]] = None
+    # reference config.py:397: values may be a single token or a list/tuple
+    # (additional_special_tokens)
+    special_tokens: Optional[dict[str, str | list[str] | tuple[str, ...]]] = None
 
 
 class PreTrainedSPTokenizerConfig(BaseModel):
@@ -564,7 +566,21 @@ class RichResultSubscriberConfig(BaseModel):
 
 
 class EvaluationResultToDiscSubscriberConfig(BaseModel):
-    output_folder_path: Path
+    """Either this repo's output_folder_path (results land in
+    <folder>/evaluation_results.jsonl) or the reference's output_file_path
+    (subscriber_factory.py:60 — an explicit jsonl file)."""
+
+    output_folder_path: Optional[Path] = None
+    output_file_path: Optional[Path] = None
+
+    @model_validator(mode="after")
+    def _exactly_one(self) -> "EvaluationResultToDiscSubscriberConfig":
+        if (self.output_folder_path is None) == (self.output_file_path is None):
+            raise ValueError(
+                "results_subscriber to_disc/save_to_disc needs exactly one of "
+                "output_folder_path (repo form) or output_file_path (reference form)"
+            )
+        return self
 
 
 class WandBEvaluationResultSubscriberConfig(BaseModel):
@@ -606,12 +622,49 @@ class GPT2MFUCalculatorConfig(BaseModel):
 
 
 class SteppableKernelProfilerConfig(BaseModel):
+    """Accepts both this repo's field names and the reference's
+    (profiler_configs.py:14-27: num_wait_steps/num_warmup_steps/num_active_steps +
+    torch.profiler knobs). Torch-only knobs are accepted and ignored with a warning
+    — the kernel trace here is a jax.profiler trace, which always records device
+    kernels, shapes, and flops."""
+
+    model_config = {"populate_by_name": True}
+
     output_folder_path: Path
-    wait_steps: int = 1
-    warmup_steps: int = 1
-    active_steps: int = 3
+    wait_steps: int = Field(1, validation_alias="num_wait_steps")
+    warmup_steps: int = Field(1, validation_alias="num_warmup_steps")
+    active_steps: int = Field(3, validation_alias="num_active_steps")
     repeat: int = 1
-    with_python_stack: bool = False
+    with_python_stack: bool = Field(False, validation_alias="with_stack")
+    # torch-only (reference) knobs — validated, then ignored
+    profiler_activities: Optional[list[str]] = None
+    profile_memory: Optional[bool] = None
+    record_shapes: Optional[bool] = None
+    with_flops: Optional[bool] = None
+    with_modules: Optional[bool] = None
+    tracked_ranks: Optional[list[int]] = None
+
+    @model_validator(mode="after")
+    def _warn_torch_only(self) -> "SteppableKernelProfilerConfig":
+        ignored = [
+            n
+            for n in (
+                "profiler_activities",
+                "profile_memory",
+                "record_shapes",
+                "with_flops",
+                "with_modules",
+                "tracked_ranks",
+            )
+            if getattr(self, n) is not None
+        ]
+        if ignored:
+            warnings.warn(
+                f"steppable_profiler.kernel_tracing: field(s) {ignored} are torch.profiler-"
+                "specific and ignored — the jax.profiler trace always includes device "
+                "kernels, shapes and flops."
+            )
+        return self
 
 
 class SteppableMemoryProfilerConfig(BaseModel):
@@ -627,24 +680,50 @@ class SteppableCombinedProfilerConfig(BaseModel):
 
 
 class RandomDatasetBatchGeneratorConfig(BaseModel):
-    sample_key: str
-    target_key: str
-    micro_batch_size: Annotated[int, Field(strict=True, gt=0)]
-    sequence_length: Annotated[int, Field(strict=True, gt=0)]
-    vocab_size: Annotated[int, Field(strict=True, gt=0)]
+    """Two accepted shapes: the repo's named-field token-batch schema, or the
+    reference's dims-style schema (batch_generator.py:21-25 — dims/data_type/
+    min_val/max_val) used by the profiling tutorial configs."""
+
+    # named-field schema
+    sample_key: str = "input_ids"
+    target_key: str = "target_ids"
+    micro_batch_size: Annotated[int, Field(strict=True, gt=0)] = 1
+    sequence_length: Annotated[int, Field(strict=True, gt=0)] = 128
+    vocab_size: Annotated[int, Field(strict=True, gt=0)] = 256
     seed: int = 0
+    # reference dims-style schema
+    dims: Optional[dict[str, int]] = None
+    data_type: Optional[str] = None
+    min_val: int = 0
+    max_val: int = 256
+
+    @model_validator(mode="after")
+    def _one_schema_explicit(self) -> "RandomDatasetBatchGeneratorConfig":
+        named = {"micro_batch_size", "sequence_length", "vocab_size"}
+        if self.dims is None and not named <= self.model_fields_set:
+            raise ValueError(
+                "dataset_batch_generator.random needs either the reference dims-style "
+                "schema (dims/data_type/min_val/max_val) or ALL of the named fields "
+                f"{sorted(named)} — got only {sorted(self.model_fields_set & named)}; "
+                "a typo'd field name would otherwise silently profile a default-shaped batch"
+            )
+        return self
 
 
 class SteppableForwardPassConfig(BaseModel):
     """Builds a jitted train/eval step over random batches for the profiler harness
-    (reference steppable_components.py:12)."""
+    (reference steppable_components.py:12; its schema steppable_component_configs.py:11-15
+    names the generator `dataset_batch_generator` and makes loss_fn/optimizer
+    optional — forward-only profiling when no optimizer is given)."""
+
+    model_config = {"populate_by_name": True}
 
     model: PydanticModelIFType
-    loss_fn: PydanticLossIFType
-    optimizer: PydanticOptimizerIFType
-    batch_generator: Any
+    batch_generator: Any = Field(validation_alias="dataset_batch_generator")
+    loss_fn: Optional[PydanticLossIFType] = None
+    optimizer: Optional[PydanticOptimizerIFType] = None
     device_mesh: Optional[PydanticDeviceMeshIFType] = None
-    include_backward: bool = True
+    include_backward: Optional[bool] = None
     gradient_accumulation_steps: Annotated[int, Field(strict=True, ge=1)] = 1
 
 

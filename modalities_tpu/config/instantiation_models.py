@@ -191,6 +191,23 @@ class TrainingComponentsInstantiationModel(BaseModel):
         return self
 
 
+class RecipeValidationInstantiationModel(BaseModel):
+    """Compile-only surface for the v5p acceptance recipes (BASELINE.md): exactly the
+    components TrainStepBuilder needs — mesh, model/optimizer/scheduler specs, loss,
+    clipper — and nothing that touches disk (no dataloaders, no checkpoint IO), so a
+    64-chip recipe validates on a virtual mesh with no corpus present.
+
+    The declarative component graph makes this free: app_state carries SPECS
+    (deferred init — params are never materialized here), so building this model is
+    cheap even for a 7B config."""
+
+    settings: TrainingSettings
+    app_state: PydanticAppStateType
+    loss_fn: PydanticLossIFType
+    gradient_clipper: PydanticGradientClipperIFType
+    device_mesh: PydanticDeviceMeshIFType
+
+
 class PackedDatasetComponentsInstantiationModel(BaseModel):
     class PackedDatasetSettings(BaseModel):
         src_path: Path
@@ -210,7 +227,8 @@ class PackedDatasetComponentsInstantiationModel(BaseModel):
 class TextGenerationSettings(BaseModel):
     model_path: Path
     sequence_length: int
-    device: str = "tpu"
+    # the reference's YAMLs put a torch device ordinal here (e.g. `device: 0`)
+    device: str | int = "tpu"
     referencing_keys: dict[str, str] = {}
 
 

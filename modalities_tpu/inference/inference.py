@@ -18,6 +18,15 @@ def generate_text(config_file_path: Path) -> None:
 
     config_dict = load_app_config_dict(config_file_path)
 
+    if "text_inference_component" in config_dict:
+        # reference config shape (inference/inference.py:18-44): a declarative
+        # inference_component.text node built through the registry
+        components = build_text_inference_components(config_dict)
+        component = components.text_inference_component
+        _resolve_component_params(component, getattr(components.settings, "model_path", None))
+        component.run()
+        return
+
     class _TextGenModel(BaseModel):
         model: PydanticModelIFType
         tokenizer: PydanticTokenizerIFType
@@ -55,6 +64,48 @@ def generate_text(config_file_path: Path) -> None:
         eod_token=settings.get("eod_token", "<eod>"),
     )
     component.run()
+
+
+def _resolve_component_params(component, model_path) -> None:
+    """Give a built TextInferenceComponent its parameters: restore the checkpoint at
+    settings.model_path when one exists on disk, else materialize the model's own
+    params (HF pretrained models carry their loaded weights through init_params)."""
+    if component.params is not None:
+        return
+    import jax
+
+    if model_path is not None and Path(model_path).exists():
+        from modalities_tpu.checkpointing.orbax.orbax_checkpoint_loading import (
+            restore_tree_single_device,
+        )
+
+        restored = restore_tree_single_device(Path(model_path))
+        component.params = (
+            restored["params"] if isinstance(restored, dict) and "opt_state" in restored else restored
+        )
+    else:
+        component.params = _unboxed(component.model.init_params(jax.random.PRNGKey(0)))
+
+
+def build_text_inference_components(config_dict: dict):
+    """Build the reference-shaped text-generation graph: registers
+    `inference_component.text` exactly as the reference's generate_text does
+    (reference inference/inference.py:23-28) and validates against
+    TextGenerationInstantiationModel."""
+    from modalities_tpu.config.component_factory import ComponentFactory
+    from modalities_tpu.config.instantiation_models import TextGenerationInstantiationModel
+    from modalities_tpu.inference.text.inference_component import (
+        TextInferenceComponent,
+        TextInferenceComponentConfig,
+    )
+    from modalities_tpu.registry.components import COMPONENTS
+    from modalities_tpu.registry.registry import ComponentEntity, Registry
+
+    registry = Registry(COMPONENTS)
+    registry.add_entity(
+        ComponentEntity("inference_component", "text", TextInferenceComponent, TextInferenceComponentConfig)
+    )
+    return ComponentFactory(registry).build_components(config_dict, TextGenerationInstantiationModel)
 
 
 def _unboxed(tree):

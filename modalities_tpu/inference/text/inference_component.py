@@ -10,9 +10,25 @@ from __future__ import annotations
 from typing import Optional
 
 import numpy as np
+from pydantic import BaseModel
 
+from modalities_tpu.config.pydantic_if_types import PydanticModelIFType, PydanticTokenizerIFType
 from modalities_tpu.models.model import NNModel
 from modalities_tpu.tokenization.tokenizer_wrapper import TokenizerWrapper
+
+
+class TextInferenceComponentConfig(BaseModel):
+    """Schema of the reference's `inference_component.text` node
+    (reference inference/text/config.py:13-24); `device` is the torch device id,
+    accepted for config parity (placement is the mesh's job here)."""
+
+    model: PydanticModelIFType
+    tokenizer: PydanticTokenizerIFType
+    prompt_template: str
+    sequence_length: int
+    temperature: Optional[float] = 1.0
+    eod_token: Optional[str] = "<eod>"
+    device: Optional[int | str] = None
 
 
 class TextInferenceComponent:

@@ -45,10 +45,21 @@ class RichResultSubscriber(MessageSubscriberIF[EvaluationResultBatch]):
 
 
 class EvaluationResultToDiscSubscriber(MessageSubscriberIF[EvaluationResultBatch]):
-    def __init__(self, output_folder_path: Path):
-        self.output_folder_path = Path(output_folder_path)
+    def __init__(
+        self, output_folder_path: Optional[Path] = None, output_file_path: Optional[Path] = None
+    ):
+        if output_file_path is not None:  # reference form: an explicit jsonl file
+            self._out_file = Path(output_file_path)
+            self.output_folder_path = self._out_file.parent
+        elif output_folder_path is not None:
+            self.output_folder_path = Path(output_folder_path)
+            self._out_file = self.output_folder_path / "evaluation_results.jsonl"
+        else:
+            raise ValueError(
+                "EvaluationResultToDiscSubscriber needs output_folder_path (results land "
+                "in <folder>/evaluation_results.jsonl) or output_file_path (explicit file)"
+            )
         self.output_folder_path.mkdir(parents=True, exist_ok=True)
-        self._out_file = self.output_folder_path / "evaluation_results.jsonl"
 
     @staticmethod
     def _serialize(result: EvaluationResultBatch) -> dict:

@@ -122,20 +122,45 @@ def _random_batch_generator(**kwargs):
     return RandomDatasetBatchGenerator(**kwargs)
 
 
-def _steppable_forward_pass(model, loss_fn, optimizer, batch_generator, device_mesh=None,
-                            include_backward=True, gradient_accumulation_steps=1):
+def _steppable_kernel_profiler(**kwargs):
+    """Drops the torch.profiler-only knobs the config accepted (and warned about)
+    for reference-YAML compat before constructing the jax.profiler-backed tracer."""
+    for torch_only in ("profiler_activities", "profile_memory", "record_shapes", "with_flops",
+                       "with_modules", "tracked_ranks"):
+        kwargs.pop(torch_only, None)
+    return SteppableKernelProfiler(**kwargs)
+
+
+def _steppable_forward_pass(model, batch_generator, loss_fn=None, optimizer=None, device_mesh=None,
+                            include_backward=None, gradient_accumulation_steps=1):
     from modalities_tpu.training.train_step import TrainStepBuilder
     from modalities_tpu.utils.profilers.steppable_components import SteppableForwardPass
 
-    step_functions = TrainStepBuilder(
-        model=model,
-        loss_fn=loss_fn,
-        optimizer_spec=optimizer,
-        mesh_handle=device_mesh,
-        gradient_acc_steps=gradient_accumulation_steps,
-    ).build()
+    # reference semantics (steppable_components.py:12): no optimizer -> forward-only
+    if include_backward is None:
+        include_backward = optimizer is not None
+    if loss_fn is None:
+        loss_fn = CLMCrossEntropyLoss(
+            target_key=getattr(batch_generator, "target_key", "target_ids"),
+            prediction_key=model.prediction_key,
+        )
+    if optimizer is None:
+        # state init needs an optimizer tree even when only the forward is stepped
+        optimizer = OptimizerFactory.get_adam_w(
+            lr=1e-4, betas=(0.9, 0.95), eps=1e-8, weight_decay=0.0,
+            weight_decay_groups_excluded=[], wrapped_model=model,
+        )
+    def build_step_functions():
+        return TrainStepBuilder(
+            model=model,
+            loss_fn=loss_fn,
+            optimizer_spec=optimizer,
+            mesh_handle=device_mesh,
+            gradient_acc_steps=gradient_accumulation_steps,
+        ).build()
+
     return SteppableForwardPass(
-        step_functions,
+        build_step_functions,  # thunk: state materializes at the first profiled step
         batch_generator,
         include_backward=include_backward,
         gradient_accumulation_steps=gradient_accumulation_steps,
@@ -332,7 +357,7 @@ COMPONENTS: list[ComponentEntity] = [
                     cfg.SteppableForwardPassConfig),
     # profilers
     ComponentEntity("profiler", "no_profiler", SteppableNoProfiler, None),
-    ComponentEntity("profiler", "kernel_profiler", SteppableKernelProfiler, cfg.SteppableKernelProfilerConfig),
+    ComponentEntity("profiler", "kernel_profiler", _steppable_kernel_profiler, cfg.SteppableKernelProfilerConfig),
     ComponentEntity("profiler", "memory_profiler", SteppableMemoryProfiler, cfg.SteppableMemoryProfilerConfig),
     ComponentEntity("profiler", "combined_profiler", SteppableCombinedProfiler, cfg.SteppableCombinedProfilerConfig),
     # number conversion (13 variants, reference components.py number_conversion section)
@@ -465,7 +490,7 @@ COMPONENTS: list[ComponentEntity] = [
     # names, so reference YAMLs resolve unchanged)
     ComponentEntity("steppable_profiler", "no_profiler", SteppableNoProfiler, None),
     ComponentEntity(
-        "steppable_profiler", "kernel_tracing", SteppableKernelProfiler, cfg.SteppableKernelProfilerConfig
+        "steppable_profiler", "kernel_tracing", _steppable_kernel_profiler, cfg.SteppableKernelProfilerConfig
     ),
     ComponentEntity(
         "steppable_profiler", "memory_tracing", SteppableMemoryProfiler, cfg.SteppableMemoryProfilerConfig

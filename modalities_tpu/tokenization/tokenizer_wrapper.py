@@ -39,7 +39,7 @@ class PreTrainedHFTokenizer(TokenizerWrapper):
         truncation: Optional[bool] = False,
         padding: Optional[bool | str] = False,
         max_length: Optional[int] = None,
-        special_tokens: Optional[dict[str, str]] = None,
+        special_tokens: Optional[dict[str, str | list[str] | tuple[str, ...]]] = None,
     ) -> None:
         from transformers import AutoTokenizer
 

@@ -78,6 +78,10 @@ class StepFunctions:
     # debugging_enriched: same step but with grads in metrics — used by the Trainer
     # ONLY on logging ticks so the grad tree isn't materialized on every step
     train_step_debug: Optional[Callable[[AppState, Any], tuple[AppState, dict]]] = None
+    # lower_train_step(batch_abstract) -> jax.stages.Lowered for the full sharded
+    # step program (AOT partitioning check without executing); present whenever a
+    # mesh is attached, and the only executable surface in materialize=False mode
+    lower_train_step: Optional[Callable[[Any], Any]] = None
 
 
 class TrainStepBuilder:
@@ -442,6 +446,12 @@ class TrainStepBuilder:
                 with mesh, activation_rules(rules, mesh):
                     return eval_step_j(state, batch)
 
+            def lower_train_step(batch_abstract):
+                # `state` is the abstract tree in materialize=False mode and the real
+                # one otherwise; jit.lower accepts either
+                with mesh, activation_rules(rules, mesh):
+                    return train_step_j.lower(state, batch_abstract)
+
             train_step_debug_c = None
             if expose_grads:
                 debug_metrics_shardings = dict(metrics_shardings, grads=param_shardings)
@@ -462,6 +472,7 @@ class TrainStepBuilder:
             train_step_debug_c = (
                 jax.jit(make_train_step(True), donate_argnums=(0,)) if expose_grads else None
             )
+            lower_train_step = lambda batch_abstract: train_step_c.lower(state, batch_abstract)  # noqa: E731
 
         put_batch = self._make_put_batch(data_sharding)
 
@@ -473,6 +484,7 @@ class TrainStepBuilder:
             app_state_handle=handle,
             mesh_handle=mesh_handle,
             train_step_debug=train_step_debug_c,
+            lower_train_step=lower_train_step,
         )
 
     # ------------------------------------------------------------------ data

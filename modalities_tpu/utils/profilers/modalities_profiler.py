@@ -6,8 +6,9 @@ len(profiler) times inside the profiler context.
 
 from __future__ import annotations
 
+from dataclasses import dataclass
 from pathlib import Path
-from typing import Any
+from typing import Any, Optional
 
 from pydantic import BaseModel
 
@@ -15,7 +16,7 @@ from modalities_tpu.config.component_factory import ComponentFactory
 from modalities_tpu.config.pydantic_if_types import PydanticProfilerIFType
 from modalities_tpu.config.yaml_interp import load_app_config_dict
 from modalities_tpu.registry.components import COMPONENTS
-from modalities_tpu.registry.registry import Registry
+from modalities_tpu.registry.registry import ComponentEntity, Registry
 from modalities_tpu.utils.profilers.steppable_components import SteppableComponentIF
 
 
@@ -24,18 +25,58 @@ class ProfilerInstantiationModel(BaseModel):
     profiler: PydanticProfilerIFType
 
 
+@dataclass
+class CustomComponentRegisterable:
+    """A user-supplied component to register before building the profiling graph
+    (reference modalities_profiler.py:25-29 — how the rms-norm tutorial injects its
+    SteppableNorm)."""
+
+    component_key: str
+    variant_key: str
+    custom_component: type
+    custom_config: type
+
+
+def _registry_with(custom_component_registerables) -> Registry:
+    registry = Registry(COMPONENTS)
+    for reg in custom_component_registerables or ():
+        registry.add_entity(
+            ComponentEntity(reg.component_key, reg.variant_key, reg.custom_component, reg.custom_config)
+        )
+    return registry
+
+
 class ModalitiesProfilerStarter:
     @staticmethod
-    def run_distributed(config_file_path: Path) -> None:
+    def run_distributed(
+        config_file_path: Path,
+        experiment_root_path: Optional[Path] = None,
+        experiment_id: Optional[str] = None,
+        custom_component_registerables: Optional[list[CustomComponentRegisterable]] = None,
+    ) -> None:
         from modalities_tpu.running_env.env import TpuEnv
 
         with TpuEnv():
-            ModalitiesProfilerStarter.run_single_process(config_file_path)
+            ModalitiesProfilerStarter.run_single_process(
+                config_file_path,
+                experiment_root_path=experiment_root_path,
+                experiment_id=experiment_id,
+                custom_component_registerables=custom_component_registerables,
+            )
 
     @staticmethod
-    def run_single_process(config_file_path: Path) -> None:
-        config_dict = load_app_config_dict(Path(config_file_path))
-        components = ComponentFactory(Registry(COMPONENTS)).build_components(
+    def run_single_process(
+        config_file_path: Path,
+        experiment_root_path: Optional[Path] = None,
+        experiment_id: Optional[str] = None,
+        custom_component_registerables: Optional[list[CustomComponentRegisterable]] = None,
+    ) -> None:
+        config_dict = load_app_config_dict(
+            Path(config_file_path),
+            experiments_root_path=experiment_root_path,
+            experiment_id=experiment_id,
+        )
+        components = ComponentFactory(_registry_with(custom_component_registerables)).build_components(
             config_dict, ProfilerInstantiationModel
         )
         component: SteppableComponentIF = components.steppable_component

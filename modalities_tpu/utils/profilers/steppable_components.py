@@ -16,37 +16,99 @@ class SteppableComponentIF(ABC):
 
 
 class RandomDatasetBatchGenerator:
-    """Random token batches with fixed shapes (reference batch_generator.py)."""
+    """Random batches with fixed shapes. Two config shapes, both supported:
 
-    def __init__(self, sample_key: str, target_key: str, micro_batch_size: int, sequence_length: int,
-                 vocab_size: int, seed: int = 0):
+    - named-field (this repo): sample_key/target_key/micro_batch_size/
+      sequence_length/vocab_size — token batches for the train/eval step drivers.
+    - dims-style (reference batch_generator.py:21-25): dims (ordered name->size),
+      data_type (int64 | float32 | bfloat16), min_val, max_val — arbitrary-shape
+      arrays under the fixed keys input_ids/target_ids (reference :55-62), used by
+      the profiling tutorials (e.g. a [batch, seq, hidden] float batch for norms).
+    """
+
+    def __init__(self, sample_key: str = "input_ids", target_key: str = "target_ids",
+                 micro_batch_size: int = 1, sequence_length: int = 128,
+                 vocab_size: int = 256, seed: int = 0, dims=None, data_type=None,
+                 min_val: int = 0, max_val: int = 256):
         self.sample_key = sample_key
         self.target_key = target_key
         self.micro_batch_size = micro_batch_size
         self.sequence_length = sequence_length
         self.vocab_size = vocab_size
+        self.dims = dict(dims) if dims else None
+        self.data_type = str(data_type) if data_type is not None else None
+        self.min_val = min_val
+        self.max_val = max_val
         self._rng = np.random.default_rng(seed)
 
     def get_batch(self, num_microbatches: int = 1) -> dict:
-        tokens = self._rng.integers(
-            0, self.vocab_size, size=(num_microbatches, self.micro_batch_size, self.sequence_length + 1)
-        )
+        if self.dims is not None:
+            # dims-style: derive token batches from the declared batch/seq sizes
+            size = tuple(self.dims.values())
+            batch, seq = size[0], size[1] if len(size) > 1 else self.sequence_length
+            tokens = self._rng.integers(
+                self.min_val, self.max_val, size=(num_microbatches, batch, seq + 1)
+            )
+        else:
+            tokens = self._rng.integers(
+                0, self.vocab_size,
+                size=(num_microbatches, self.micro_batch_size, self.sequence_length + 1),
+            )
         return {
             "samples": {self.sample_key: tokens[:, :, :-1].astype(np.int32)},
             "targets": {self.target_key: tokens[:, :, 1:].astype(np.int32)},
         }
 
+    def get_dataset_batch(self):
+        """Reference surface (batch_generator.py:36): one DatasetBatch of shape
+        tuple(dims.values()) under the fixed input_ids/target_ids keys."""
+        from modalities_tpu.batch import DatasetBatch
+
+        if self.dims is not None:
+            size = tuple(self.dims.values())
+        else:
+            size = (self.micro_batch_size, self.sequence_length)
+        dtype = self.data_type or "int64"
+        if "int" in dtype:
+            inputs = self._rng.integers(self.min_val, self.max_val, size=size)
+            targets = self._rng.integers(self.min_val, self.max_val, size=size)
+        elif dtype in ("float32", "bfloat16", "float16"):
+            span = self.max_val - self.min_val
+            inputs = (self._rng.random(size=size) * span + self.min_val).astype(np.float32)
+            targets = (self._rng.random(size=size) * span + self.min_val).astype(np.float32)
+            if dtype != "float32":
+                import jax.numpy as jnp
+
+                inputs, targets = np.asarray(inputs), np.asarray(targets)
+                inputs = jnp.asarray(inputs, dtype=dtype)
+                targets = jnp.asarray(targets, dtype=dtype)
+        else:
+            raise ValueError(f"Unsupported data type: {self.data_type}")
+        return DatasetBatch(samples={"input_ids": inputs}, targets={"target_ids": targets})
+
 
 class SteppableForwardPass(SteppableComponentIF):
     """Forward (and optionally backward+update) over random batches — the fwd-only
-    driver for kernel profiling (reference steppable_components.py:12)."""
+    driver for kernel profiling (reference steppable_components.py:12).
+
+    `step_functions` may be a StepFunctions instance or a zero-arg thunk producing
+    one: the thunk defers state materialization (jitted sharded init) to the first
+    profiled step, so building a pod-scale profiling config graph stays spec-level
+    cheap (deferred init, the same discipline as Main.run)."""
 
     def __init__(self, step_functions, batch_generator: RandomDatasetBatchGenerator,
                  include_backward: bool = True, gradient_accumulation_steps: int = 1):
-        self.step_functions = step_functions
+        self._step_functions = step_functions if not callable(step_functions) else None
+        self._step_functions_thunk = step_functions if callable(step_functions) else None
         self.batch_generator = batch_generator
         self.include_backward = include_backward
         self.gradient_accumulation_steps = gradient_accumulation_steps
+
+    @property
+    def step_functions(self):
+        if self._step_functions is None:
+            self._step_functions = self._step_functions_thunk()
+        return self._step_functions
 
     def step(self) -> None:
         handle = self.step_functions.app_state_handle

@@ -1,0 +1,294 @@
+"""Compile-only validation of the v5p acceptance recipes (BASELINE.md).
+
+Answers two questions about a pod-scale training config without a pod (or any
+hardware — a virtual CPU mesh suffices):
+
+1. Does the full sharded train-step program LOWER? `jax.jit(...).lower(...)` over the
+   config's real mesh/shardings runs XLA's SPMD partitioner front-end: any
+   shape/sharding mismatch, invalid collective layout, or tracing error in the
+   pp/dp/tp/cp composition surfaces here, exactly as it would on chips.
+2. Does the state FIT? Params / optimizer state / gradients are measured exactly from
+   the abstract state tree and its NamedShardings (`sharding.shard_shape`); activations
+   and the lm-head working set are estimated with a documented formula keyed to the
+   remat mode. The result is a per-chip HBM budget report against the v5p's 95 GB.
+
+No parameter buffer is ever allocated: the component graph is declarative and
+TrainStepBuilder.build(materialize=False) keeps the state abstract, so a 7B recipe
+validates in seconds on a laptop-class host.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Optional
+
+import numpy as np
+
+# bf16 TPU v5p: 95 GB usable HBM per chip (96 GB minus runtime reservation)
+V5P_HBM_BUDGET_BYTES = 95 * 1024**3
+
+# Synthetic checkpoint folder accepted by every number_conversion regex — used when a
+# warmstart recipe is validated without a real checkpoint on disk. The numbers MUST
+# stay consistent with the warmstart recipe's training_target (the instantiation-model
+# validators recompute tokens-per-step from them).
+_FAKE_WARMSTART_FOLDER = (
+    "data/checkpoints/validation/eid-seen_steps_100000-seen_tokens_13107200000"
+    "-target_steps_100000-target_tokens_13107200000"
+)
+
+
+def _per_device_bytes(abstract_leaf, sharding) -> int:
+    """Exact bytes one device holds for a (possibly sharded) array."""
+    shape = tuple(abstract_leaf.shape)
+    itemsize = np.dtype(abstract_leaf.dtype).itemsize
+    if sharding is not None and hasattr(sharding, "shard_shape") and shape:
+        shape = sharding.shard_shape(shape)
+    return int(np.prod(shape, dtype=np.int64)) * itemsize if shape else itemsize
+
+
+def _tree_per_device_bytes(abstract_tree, sharding_tree) -> int:
+    import jax
+
+    leaves = jax.tree.leaves(abstract_tree)
+    shardings = jax.tree.leaves(sharding_tree) if sharding_tree is not None else [None] * len(leaves)
+    if len(shardings) != len(leaves):  # sharding tree may collapse Nones
+        shardings = [None] * len(leaves)
+    return sum(_per_device_bytes(x, s) for x, s in zip(leaves, shardings))
+
+
+def _estimate_activation_bytes(model, mesh_handle, step_profile) -> dict:
+    """Documented per-chip activation estimate for the GPT2LLM family.
+
+    Let b = local microbatch rows, s_l = seq / cp, d_l = n_embd / tp, f_l = ffn / tp,
+    act = 2 bytes (bf16 compute). Per layer the live set during backward is:
+      - full remat: only the block input residual stream survives the forward
+        (b*s_l*d_l) plus ONE block's recompute working set (counted once, not per
+        layer): ~ b*s_l*(4*d_l + 3*f_l).
+      - no remat: qkv+attn-out+norms+residuals ~ 10*d_l plus swiglu gate/up/act
+        ~ 3*f_l per token, all stored for backward.
+    Flash/ring attention never materializes the [s, s] score matrix, so no s^2 term.
+    The lm head adds b*s_l*vocab/tp fp32 logits UNLESS lm_head_chunk_size caps it at
+    b*chunk*vocab/tp.
+    """
+    spec = model.config_spec
+    degrees = mesh_handle.degrees
+    tp = max(1, degrees.get("tp", 1))
+    cp = max(1, degrees.get("cp", 1))
+    pp = max(1, degrees.get("pp", 1))
+
+    b = step_profile.local_train_micro_batch_size
+    s_l = step_profile.sequence_length // cp
+    d_l = spec.n_embd // tp
+    ffn = spec.swiglu_hidden if spec.activation == "swiglu" else spec.ffn_hidden
+    f_l = (ffn or 4 * spec.n_embd) // tp
+    n_layer_local = -(-spec.n_layer // pp)
+    act = 2  # bf16
+
+    mode = str(getattr(spec, "remat_variant", None) or "none")
+    tokens = b * s_l
+    if "full" in mode:
+        per_layer = tokens * d_l * act
+        working_set = tokens * (4 * d_l + 3 * f_l) * act  # one block recompute
+        layer_bytes = n_layer_local * per_layer + working_set
+    elif "selective" in mode:
+        # between full and none; assume half the no-remat live set
+        layer_bytes = n_layer_local * tokens * (10 * d_l + 3 * f_l) * act // 2
+    else:
+        layer_bytes = n_layer_local * tokens * (10 * d_l + 3 * f_l) * act
+
+    chunk = getattr(spec, "lm_head_chunk_size", None)
+    vocab_l = spec.vocab_size // tp if mesh_handle.enable_loss_parallel else spec.vocab_size
+    head_rows = b * (chunk if chunk else s_l)
+    head_bytes = head_rows * vocab_l * 4  # fp32 logits for the live chunk / sequence
+
+    return {
+        "remat_mode": mode,
+        "layer_activation_bytes": int(layer_bytes),
+        "lm_head_bytes": int(head_bytes),
+        "total": int(layer_bytes + head_bytes),
+    }
+
+
+def validate_recipe(
+    config_file_path: Path,
+    hbm_budget_bytes: int = V5P_HBM_BUDGET_BYTES,
+    warmstart_checkpoint_folder: Optional[str] = None,
+) -> dict:
+    """Build the recipe's train step over its real mesh, lower it, and report the
+    per-chip memory budget. Requires jax.device_count() >= the config's world_size
+    (use XLA_FLAGS=--xla_force_host_platform_device_count=N JAX_PLATFORMS=cpu, or let
+    the `benchmark validate_recipe` CLI re-exec with them set)."""
+    import jax
+
+    from modalities_tpu.config.instantiation_models import RecipeValidationInstantiationModel
+    from modalities_tpu.main import Main
+    from modalities_tpu.parallel.sharding import batch_sharding
+    from modalities_tpu.training.train_step import TrainStepBuilder
+
+    config_file_path = Path(config_file_path)
+
+    def warmstart_env(key: str):
+        if key in ("checkpoint_paths", "checkpoint_folder_path"):
+            return warmstart_checkpoint_folder or _FAKE_WARMSTART_FOLDER
+        raise ValueError(f"Unknown warmstart_env variable {key!r}")
+
+    main_obj = Main(
+        config_file_path,
+        additional_resolver_funs={"warmstart_env": warmstart_env},
+        experiment_id="recipe_validation",
+    )
+    components = main_obj.build_components(RecipeValidationInstantiationModel)
+
+    mesh_handle = components.device_mesh
+    world_size = int(np.prod(list(mesh_handle.mesh.shape.values())))
+    if jax.device_count() < world_size:
+        raise RuntimeError(
+            f"recipe needs {world_size} devices but only {jax.device_count()} are "
+            "visible — run under JAX_PLATFORMS=cpu "
+            f"XLA_FLAGS=--xla_force_host_platform_device_count={world_size}"
+        )
+
+    app_state_spec = components.app_state
+    step_profile = components.settings.step_profile
+    clipper = components.gradient_clipper
+
+    fns = TrainStepBuilder(
+        model=app_state_spec.model,
+        loss_fn=components.loss_fn,
+        optimizer_spec=app_state_spec.optimizer,
+        scheduler_spec=app_state_spec.lr_scheduler,
+        mesh_handle=mesh_handle,
+        gradient_acc_steps=step_profile.gradient_accumulation_steps,
+        grad_clip_norm=getattr(clipper, "max_norm", None),
+        grad_clipper=clipper if hasattr(clipper, "build_transform") else None,
+    ).build(materialize=False)
+
+    # --- abstract global batch with the real data sharding
+    acc = step_profile.gradient_accumulation_steps
+    rows = step_profile.local_train_micro_batch_size * mesh_handle.dp_degree
+    seq = step_profile.sequence_length
+    data_sharding = batch_sharding(mesh_handle)
+    import jax.sharding as js
+
+    spec3 = js.NamedSharding(
+        data_sharding.mesh, js.PartitionSpec(None, *tuple(data_sharding.spec))
+    )
+    tok = jax.ShapeDtypeStruct((acc, rows, seq), np.int32, sharding=spec3)
+    model = fns.app_state_handle.model
+    batch_abstract = {
+        "samples": {model.sample_key: tok},
+        "targets": {components.loss_fn.target_key: tok},
+    }
+
+    try:
+        fns.lower_train_step(batch_abstract)
+        lowering = "ok"
+    except Exception as e:  # report the partitioning/tracing failure, don't crash
+        lowering = f"failed: {type(e).__name__}: {str(e)[:500]}"
+
+    # --- exact per-chip state bytes from the shardings
+    state = fns.app_state_handle.state
+    shardings = fns.app_state_handle.state_shardings
+    params_pd = _tree_per_device_bytes(state.params, shardings.params)
+    opt_pd = _tree_per_device_bytes(state.opt_state, shardings.opt_state)
+    # gradients mirror the param shardings; accumulated in reduce_dtype (fp32)
+    param_count_pd = sum(
+        int(np.prod(s.shard_shape(tuple(x.shape)) if hasattr(s, "shard_shape") else x.shape))
+        for x, s in zip(jax.tree.leaves(state.params), jax.tree.leaves(shardings.params))
+    )
+    grads_pd = param_count_pd * 4
+    act = _estimate_activation_bytes(model, mesh_handle, step_profile)
+    total_pd = params_pd + opt_pd + grads_pd + act["total"]
+
+    num_params = sum(int(np.prod(x.shape)) for x in jax.tree.leaves(state.params))
+    report = {
+        "config": str(config_file_path),
+        "world_size": world_size,
+        "mesh": {k: v for k, v in mesh_handle.degrees.items()},
+        "num_params": num_params,
+        "lowering": lowering,
+        "per_device": {
+            "params_bytes": params_pd,
+            "optimizer_bytes": opt_pd,
+            "gradient_bytes": grads_pd,
+            "activation_estimate": act,
+            "total_bytes": total_pd,
+            "total_gib": round(total_pd / 1024**3, 3),
+        },
+        "hbm_budget_bytes": int(hbm_budget_bytes),
+        "fits_budget": bool(total_pd < hbm_budget_bytes),
+    }
+    return report
+
+
+def run_validation_subprocess(
+    config_file_path: Path,
+    hbm_budget_bytes: int = V5P_HBM_BUDGET_BYTES,
+    warmstart_checkpoint_folder: Optional[str] = None,
+) -> dict:
+    """Spawn `python -m modalities_tpu.utils.recipe_validation` in a child process
+    with the CPU backend forced and world_size virtual devices, so validation works
+    from any ambient environment (including one whose JAX already claimed a TPU or
+    was initialized with too few devices). Returns the parsed report."""
+    import json
+    import os
+    import re
+    import subprocess
+    import sys
+
+    import yaml
+
+    with open(config_file_path) as f:
+        raw = yaml.safe_load(f)
+    try:
+        world_size = int(raw["device_mesh"]["config"]["world_size"])
+    except (KeyError, TypeError, ValueError) as e:
+        raise ValueError(
+            f"{config_file_path}: could not read a literal device_mesh.config.world_size "
+            "— recipe validation needs it to size the virtual device pool"
+        ) from e
+
+    env = os.environ.copy()
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "", env.get("XLA_FLAGS", ""))
+    env["XLA_FLAGS"] = (flags + f" --xla_force_host_platform_device_count={world_size}").strip()
+
+    cmd = [
+        sys.executable,
+        "-m",
+        "modalities_tpu.utils.recipe_validation",
+        str(config_file_path),
+        "--hbm_budget_bytes",
+        str(int(hbm_budget_bytes)),
+    ]
+    if warmstart_checkpoint_folder:
+        cmd += ["--warmstart_checkpoint_folder", warmstart_checkpoint_folder]
+    proc = subprocess.run(cmd, env=env, capture_output=True, text=True)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"recipe validation failed for {config_file_path} (exit {proc.returncode}):\n"
+            f"{proc.stdout[-2000:]}\n{proc.stderr[-4000:]}"
+        )
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+def _main() -> None:
+    import argparse
+    import json
+
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("config_file_path", type=Path)
+    parser.add_argument("--hbm_budget_bytes", type=int, default=V5P_HBM_BUDGET_BYTES)
+    parser.add_argument("--warmstart_checkpoint_folder", default=None)
+    args = parser.parse_args()
+    report = validate_recipe(
+        args.config_file_path,
+        hbm_budget_bytes=args.hbm_budget_bytes,
+        warmstart_checkpoint_folder=args.warmstart_checkpoint_folder,
+    )
+    print(json.dumps(report))
+
+
+if __name__ == "__main__":
+    _main()
