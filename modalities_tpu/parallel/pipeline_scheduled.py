@@ -31,8 +31,8 @@ Megatron/torch interleaved op ordering (tight: beats 1f1b wall-clock at pp >= 8)
 other M fall back to a greedy simulator that is correct but looser.
 
 ZBV / DualPipeV (`schedule="zbv"` / `"dualpipev"`, reference ScheduleZBVZeroBubble /
-ScheduleDualPipeV — identical tables here; see pipeline_schedules._build_zbv_tables
-for why the two collapse in this tick model): V=2 chunks in a V shape —
+ScheduleDualPipeV — distinct tables: dualpipev enforces its dual-direction F+B
+pairing, see pipeline_schedules._build_dualpipev_tables): V=2 chunks in a V shape —
 device s owns global stages s and 2P-1-s (chunk 1's rows are device-flipped before
 the shard_map), activations descend then ascend (the turn at device P-1 is a local
 write), and the first/last stage share device 0. The backward is split: the B slot
@@ -40,7 +40,8 @@ pulls only the input-cotangent chain (params closed over — the pipeline's seri
 dependency), and ALL weight gradients are produced after the tick scan in one
 batched per-device pass over the stored (chunk input, output cotangent) pairs —
 zero-bubble by construction, at the cost of a second residual forward (see
-pipeline_schedules._build_zbv_tables for the honest cost model).
+pipeline_schedules._build_zbv_tables; the dual-pairing TPU cost note lives in
+pipeline_schedules._build_dualpipev_tables).
 
 Collectives per tick: one fwd ppermute (activations), one bwd ppermute (cotangents),
 one psum-broadcast (last-stage output for the head slot) — all riding ICI neighbors.

@@ -123,15 +123,10 @@ def build_schedule_tables(
             f"pipeline schedule {schedule!r} not supported (have {SUPPORTED_SCHEDULES})"
         )
     if schedule in ("zbv", "dualpipev"):
-        # INTENTIONALLY byte-identical tables for both names: DualPipeV's signature
-        # F+B overlap unit is this executor's native tick and ZB-V's bubble-filling
-        # W slots are subsumed by the deferred bubble-free W pass (see
-        # _build_zbv_tables rationale), so the two schedules' distinct torch op
-        # orderings collapse to one optimal SPMD table here. Consequence: a
-        # benchmark comparing `zbv` vs `dualpipev` in this framework measures the
-        # same program by construction.
         if num_virtual not in (1, 2):
             raise ValueError(f"{schedule} uses exactly 2 virtual chunks (the V shape)")
+        if schedule == "dualpipev":
+            return _build_dualpipev_tables(num_stages, num_microbatches)
         return _build_zbv_tables(num_stages, num_microbatches)
     if schedule != "interleaved_1f1b" and num_virtual != 1:
         raise ValueError(f"{schedule} requires num_virtual=1 (got {num_virtual})")
@@ -348,27 +343,17 @@ def _build_interleaved_ordered(num_stages: int, num_microbatches: int, num_virtu
 
 
 def _build_zbv_tables(num_stages: int, num_microbatches: int) -> ScheduleTables:
-    """ZBVZeroBubble AND DualPipeV (reference pipeline_parallelism.py:13-20 ships
-    torch's ScheduleZBVZeroBubble and ScheduleDualPipeV; schedule families from
-    "Zero Bubble Pipeline Parallelism", Qi et al. 2023, and DeepSeek-V3's DualPipe —
-    re-derived for the SPMD tick executor).
+    """ZBVZeroBubble (reference pipeline_parallelism.py:13-20 ships torch's
+    ScheduleZBVZeroBubble; schedule family from "Zero Bubble Pipeline Parallelism",
+    Qi et al. 2023 — re-derived for the SPMD tick executor).
 
-    Both names resolve to these tables because the two schedules' distinguishing
-    features collapse in this executor's tick model:
-
-    - DualPipeV's signature op — overlapping one chunk's forward with the other
-      chunk's backward in a single fused compute/comm unit — is how EVERY tick here
-      executes: each tick is one compiled SPMD program running an F slot and a B
-      slot per device, with the directional hops issued at tick end (XLA overlaps
-      the ppermutes with the next tick's compute). The steady-state ticks of these
-      tables carry exactly that F+B pairing (asserted by test).
-    - ZB-V's signature op placement — W (weight-grad) slots filled into bubble
-      ticks — is dominated here by deferring ALL weight grads to one bubble-free
-      post-scan pass per device (``deferred_w``); there is no W work left to
-      schedule into ticks at all. A dependency-greedy fill is then near-optimal
-      for both schedules and they emit identical tables (verified empirically for
-      an alternating-chunk DualPipeV forward policy at every (P, M) tried — the
-      hop dependencies, not the policy, determine the fill).
+    ZB-V's signature op placement — W (weight-grad) slots filled into bubble
+    ticks — is dominated here by deferring ALL weight grads to one bubble-free
+    post-scan pass per device (``deferred_w``); there is no W work left to
+    schedule into ticks, and a dependency-greedy fill of the F/B slots is then
+    near-optimal. `dualpipev` shares this V placement and split backward but
+    enforces its own dual-direction F+B pairing — see _build_dualpipev_tables for
+    the distinct tables and the TPU cost note.
 
     V placement: global stage g lives on device g (g < P) or 2P-1-g (g >= P), so
     each device holds two ADJACENT stages of the V and the first/last stage share
@@ -396,6 +381,33 @@ def _build_zbv_tables(num_stages: int, num_microbatches: int) -> ScheduleTables:
       or earlier; other B(g, m) need B(g+1, m) strictly earlier + F(g, m) <= tick
     - one F and one B slot per device per tick; one H per tick
     """
+    return _build_v_tables(num_stages, num_microbatches, dual_overlap=False)
+
+
+def _build_dualpipev_tables(num_stages: int, num_microbatches: int) -> ScheduleTables:
+    """DualPipeV (reference pipeline_parallelism.py:13-20 ships torch's
+    ScheduleDualPipeV; schedule from DeepSeek-V3's DualPipe, halved to its "V"
+    form): the same V placement and split backward as ZB-V, plus the schedule's
+    signature property — in the overlap zone each device pairs a FORWARD of one
+    direction (chunk) with a BACKWARD of the other direction in the same unit.
+
+    These are genuinely DISTINCT tables from `zbv` (asserted by test): the greedy
+    zbv fill pairs same-chunk F+B exclusively; this builder swaps each same-chunk
+    pairing to the opposite chunk whenever a ready forward exists there.
+
+    Honest TPU cost note: dual-direction pairing exists to hide cross-device
+    communication under compute in an eager multi-stream runtime (each direction's
+    send/recv overlaps the other's kernels). In this single-program SPMD executor
+    the hops are XLA collectives already overlapped with the next tick's compute,
+    so the pairing buys nothing here and typically COSTS ~2 ticks over zbv's
+    greedy fill (the swap perturbs the optimal admission order). Ship `dualpipev`
+    for parity and comparison; prefer `zbv` on TPU — and know that a zbv-vs-
+    dualpipev benchmark in this framework measures exactly this op-order delta.
+    """
+    return _build_v_tables(num_stages, num_microbatches, dual_overlap=True)
+
+
+def _build_v_tables(num_stages: int, num_microbatches: int, dual_overlap: bool) -> ScheduleTables:
     P, M = num_stages, num_microbatches
     G = 2 * P
     last_g = G - 1
@@ -411,17 +423,24 @@ def _build_zbv_tables(num_stages: int, num_microbatches: int) -> ScheduleTables:
     b_done = -np.ones((G, M), dtype=np.int64)
     h_done = -np.ones((M,), dtype=np.int64)
 
+    def f_ready(g: int, t: int):
+        """First microbatch with a ready forward at global stage g, else None."""
+        for m in range(M):
+            if f_done[g, m] >= 0:
+                continue
+            if g > 0 and not (0 <= f_done[g - 1, m] < t):
+                continue
+            return m
+        return None
+
     def f_candidate(s: int, t: int):
         """Ready forward, deepest global stage first (advance work toward the head
         before admitting fresh microbatches). No start cap: zbv's executor buffers
         span the full keyspace (memory is O(V x [B,S,E]), independent of in-flight
         count), so throttling admissions only lengthens the schedule."""
         for g in sorted(stages_of[s], reverse=True):
-            for m in range(M):
-                if f_done[g, m] >= 0:
-                    continue
-                if g > 0 and not (0 <= f_done[g - 1, m] < t):
-                    continue
+            m = f_ready(g, t)
+            if m is not None:
                 return g, m
         return None
 
@@ -446,15 +465,17 @@ def _build_zbv_tables(num_stages: int, num_microbatches: int) -> ScheduleTables:
     max_ticks = 24 * (2 * M + P) + 64
     while (b_done < 0).any() or (h_done < 0).any():
         if t >= max_ticks:
-            raise RuntimeError(f"zbv schedule did not converge (P={P}, M={M})")
+            raise RuntimeError(f"V schedule did not converge (P={P}, M={M})")
         f_row = -np.ones(P, dtype=np.int64)
         b_row = -np.ones(P, dtype=np.int64)
+        f_slot: dict[int, tuple[int, int]] = {}
+        b_slot: dict[int, tuple[int, int]] = {}
 
         for s in range(P):
             cand = f_candidate(s, t)
             if cand is not None:
                 g, m = cand
-                f_row[s] = (g // P) * M + m
+                f_slot[s] = (g, m)
                 f_done[g, m] = t
 
         # H slot sees this tick's last-stage forward (broadcast precedes it)
@@ -466,9 +487,39 @@ def _build_zbv_tables(num_stages: int, num_microbatches: int) -> ScheduleTables:
             cand = b_candidate(s, t)
             if cand is not None:
                 g, m = cand
-                b_row[s] = (g // P) * M + m
+                b_slot[s] = (g, m)
                 b_done[g, m] = t
 
+        if dual_overlap:
+            # DualPipeV pairing pass: where a device filled BOTH slots from the
+            # SAME chunk, re-point the F slot at the opposite chunk if a ready
+            # forward exists there. Guards keep the swap sound: never steal an F
+            # this tick's H or B already consumed (their same-tick deps).
+            for s in range(P):
+                if s not in f_slot or s not in b_slot:
+                    continue
+                (gf, mf), (gb, mb) = f_slot[s], b_slot[s]
+                if (gf >= P) != (gb >= P):
+                    continue  # already opposite directions
+                if (gf, mf) == (gb, mb):
+                    continue  # this B consumed this F (loss-stage same-tick chain)
+                if gf == last_g and hm == mf:
+                    continue  # this H consumed this F
+                # (f_ready never reads (gf, mf): g_alt is the other chunk's stage,
+                # and the one aliasing case — device P-1, g_alt-1 == gf — fails the
+                # strict `< t` dep check whether the entry reads t or -1)
+                g_alt = (2 * P - 1 - s) if gf < P else s
+                m_alt = f_ready(g_alt, t)
+                if m_alt is None:
+                    continue  # nothing ready opposite: keep the original pairing
+                f_done[gf, mf] = -1
+                f_slot[s] = (g_alt, m_alt)
+                f_done[g_alt, m_alt] = t
+
+        for s, (g, m) in f_slot.items():
+            f_row[s] = (g // P) * M + m
+        for s, (g, m) in b_slot.items():
+            b_row[s] = (g // P) * M + m
         f_rows.append(f_row)
         b_rows.append(b_row)
         h_rows.append(hm)

@@ -441,11 +441,11 @@ def test_pipelined_model_variant_selects_schedule():
 
 @pytest.mark.parametrize("schedule", ["zbv", "dualpipev"])
 def test_dp_pp_zbv_equivalence(schedule):
-    """dp8 vs pp2 x dp4 under ZBVZeroBubble / DualPipeV (identical V-placement
-    tables — see pipeline_schedules._build_zbv_tables): V-shaped chunk placement
-    (device 0 holds the first AND last stage), direction-aware hops, dx-only B
-    slots, and the post-scan weight-grad pass must reproduce pure-DP losses
-    exactly."""
+    """dp8 vs pp2 x dp4 under ZBVZeroBubble and DualPipeV (each with its OWN
+    tables — dualpipev's dual-direction pairing included): V-shaped chunk
+    placement (device 0 holds the first AND last stage), direction-aware hops,
+    dx-only B slots, and the post-scan weight-grad pass must reproduce pure-DP
+    losses exactly."""
     mesh_dp = get_device_mesh(device_type="cpu", data_parallel_shard_degree=8, world_size=8)
     mesh_pp = get_device_mesh(
         device_type="cpu", data_parallel_shard_degree=4, pipeline_parallel_degree=2, world_size=8
