@@ -54,10 +54,15 @@ def _probe_tpu(timeout_s: int = 180) -> str:
     its own session and is abandoned (not reaped) if it cannot be killed — a child
     stuck in uninterruptible sleep on a wedged driver must not take the bench down
     with it."""
+    import tempfile
+
+    # stderr goes to a temp file, not a pipe: a wedged child spewing runtime
+    # warnings must never block on a full pipe and masquerade as a hang
+    err_file = tempfile.TemporaryFile(mode="w+", errors="replace")
     proc = subprocess.Popen(
         [sys.executable, "-c", "import jax; d = jax.devices()[0]; print(d.platform)"],
         stdout=subprocess.PIPE,
-        stderr=subprocess.DEVNULL,
+        stderr=err_file,
         text=True,
         start_new_session=True,
     )
@@ -65,9 +70,17 @@ def _probe_tpu(timeout_s: int = 180) -> str:
     while True:
         if proc.poll() is not None:
             out = proc.stdout.read() if proc.stdout else ""
+            err_file.seek(0)
+            err = err_file.read()
             if proc.returncode == 0:
                 return "tpu" if "tpu" in out else "no_tpu"
-            return "wedged"
+            # crash, not hang: a wedged claim raises UNAVAILABLE/DEADLINE-style TPU
+            # runtime errors (transient — retry); anything else (ImportError, ABI
+            # mismatch) is a broken install the ladder can never fix
+            transient = any(
+                marker in err for marker in ("UNAVAILABLE", "DEADLINE", "tpu", "TPU", "libtpu")
+            )
+            return "wedged" if transient else "no_tpu"
         if time.monotonic() >= deadline:
             break
         time.sleep(min(1.0, max(0.0, deadline - time.monotonic())))

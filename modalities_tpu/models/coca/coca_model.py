@@ -61,7 +61,12 @@ class CoCaConfig(BaseModel):
 
 
 class AttentionPooling(nn.Module):
-    """Learned-query cross-attention pooling (reference attention_pooling.py:7)."""
+    """Learned-query cross-attention pooling (reference attention_pooling.py:7):
+    ln_1 normalizes the CONTEXT (the queries enter raw), ln_2 the pooled output.
+    The attention projections always carry bias — the reference constructs its
+    MultiHeadAttention without forwarding `bias`, so torch's default (True)
+    applies regardless of bias_attn_pool (attention_pooling.py:27-32); `bias`
+    here governs only the two layer norms, exactly as there."""
 
     n_embd: int
     n_head: int
@@ -70,16 +75,17 @@ class AttentionPooling(nn.Module):
 
     @nn.compact
     def __call__(self, queries, context):
-        x = nn.LayerNorm(epsilon=self.epsilon, name="ln_1", dtype=queries.dtype)(queries)
-        context = nn.LayerNorm(epsilon=self.epsilon, name="ln_context", dtype=context.dtype)(context)
+        context = nn.LayerNorm(
+            epsilon=self.epsilon, use_bias=self.bias, name="ln_1", dtype=context.dtype
+        )(context)
         x = MultiHeadAttention(
             n_embd=self.n_embd,
             n_head=self.n_head,
-            bias=self.bias,
+            bias=True,
             attention_type=AttentionType.CROSS_ATTENTION,
             name="attn",
-        )(x, context=context)
-        return nn.LayerNorm(epsilon=self.epsilon, name="ln_2", dtype=x.dtype)(x)
+        )(queries, context=context)
+        return nn.LayerNorm(epsilon=self.epsilon, use_bias=self.bias, name="ln_2", dtype=x.dtype)(x)
 
 
 class _DecoderBlock(nn.Module):
@@ -96,20 +102,20 @@ class _DecoderBlock(nn.Module):
 
     @nn.compact
     def __call__(self, x, context=None):
-        h = nn.LayerNorm(epsilon=self.epsilon, name="ln_1", dtype=x.dtype)(x)
+        h = nn.LayerNorm(epsilon=self.epsilon, use_bias=self.bias, name="ln_1", dtype=x.dtype)(x)
         x = x + MultiHeadAttention(
             n_embd=self.n_embd, n_head=self.n_head, bias=self.bias, dropout=self.dropout,
             attention_type=AttentionType.CAUSAL_SELF_ATTENTION,
             deterministic=self.deterministic, name="attn",
         )(h)
         if self.with_cross_attention:
-            hc = nn.LayerNorm(epsilon=self.epsilon, name="ln_cross", dtype=x.dtype)(x)
+            hc = nn.LayerNorm(epsilon=self.epsilon, use_bias=self.bias, name="ln_cross", dtype=x.dtype)(x)
             x = x + MultiHeadAttention(
                 n_embd=self.n_embd, n_head=self.n_head, bias=self.bias, dropout=self.dropout,
                 attention_type=AttentionType.CROSS_ATTENTION,
                 deterministic=self.deterministic, name="cross_attn",
             )(hc, context=context)
-        h2 = nn.LayerNorm(epsilon=self.epsilon, name="ln_2", dtype=x.dtype)(x)
+        h2 = nn.LayerNorm(epsilon=self.epsilon, use_bias=self.bias, name="ln_2", dtype=x.dtype)(x)
         x = x + MLP(
             in_features=self.n_embd, hidden_features=self.ffn_hidden, bias=self.bias,
             dropout=self.dropout, deterministic=self.deterministic, name="mlp",
@@ -146,13 +152,16 @@ class _CoCaModule(nn.Module):
         x = jnp.take(wte, text_ids, axis=0)
         x = jnp.concatenate([x, jnp.broadcast_to(text_cls_token, (b, 1, td["n_embd"]))], axis=1)
         x = x + wpe[None, : x.shape[1], :]
+        x = nn.Dropout(td["dropout"])(x, deterministic=self.deterministic or td["dropout"] == 0.0)
         for i in range(td["n_layer_text"]):
             x = _DecoderBlock(
                 n_embd=td["n_embd"], n_head=td["n_head"], ffn_hidden=td["ffn_hidden"],
                 bias=td["bias"], dropout=td["dropout"], epsilon=td["epsilon"],
                 deterministic=self.deterministic, name=f"text_block_{i}",
             )(x)
-        x = nn.LayerNorm(epsilon=td["epsilon"], name="text_ln_f", dtype=x.dtype)(x)
+        # NO final norm on the unimodal text output — the reference's TextDecoder
+        # ends at its last block (text_decoder.py forward; the cls split happens on
+        # the raw stream, coca_model.py _forward_encode_text)
         text_embd, text_cls = x[:, :-1, :], x[:, -1:, :]
 
         # ---- multimodal decoder with cross-attention over pooled vision tokens
@@ -164,7 +173,7 @@ class _CoCaModule(nn.Module):
                 with_cross_attention=True, deterministic=self.deterministic,
                 name=f"multimodal_block_{i}",
             )(y, context=vision_context)
-        y = nn.LayerNorm(epsilon=td["epsilon"], name="mm_ln_f", dtype=y.dtype)(y)
+        y = nn.LayerNorm(epsilon=td["epsilon"], use_bias=td["bias"], name="mm_ln_f", dtype=y.dtype)(y)
         # weight tying: lm head shares wte (reference coca_model.py:171-173)
         logits = jnp.einsum("bse,ve->bsv", y.astype(jnp.float32), wte.astype(jnp.float32))
         return logits, vision_cls.squeeze(1), text_cls.squeeze(1)
@@ -212,22 +221,10 @@ class CoCa(NNModel):
 
         from modalities_tpu.models.vision_transformer.vision_transformer_model import VisionTransformer as _VT
 
-        vision_spec = {
-            "ffn_hidden": vision_encoder_config.ffn_hidden or 4 * vision_encoder_config.n_embd,
-            "block_size": _VT.get_block_size(
-                self.img_size, vision_encoder_config.patch_size, vision_encoder_config.patch_stride,
-                vision_encoder_config.add_cls_token,
-            ),
-            "n_embd": vision_encoder_config.n_embd,
-            "n_head": vision_encoder_config.n_head,
-            "n_layer": vision_encoder_config.n_layer,
-            "n_classes": None,  # encoder mode: emit patch embeddings
-            "dropout": vision_encoder_config.dropout,
-            "patch_size": vision_encoder_config.patch_size,
-            "patch_stride": vision_encoder_config.patch_stride,
-            "add_cls_token": vision_encoder_config.add_cls_token,
-            "bias": vision_encoder_config.bias,
-        }
+        # reuse VisionTransformer's own spec builder (single source of the
+        # ffn_hidden/block_size defaults), forced into encoder mode — the reference
+        # composes exactly this way, CoCa(VisionTransformer(**dict(config)))
+        vision_spec = _VT(**{**dict(vision_encoder_config), "n_classes": None})._spec
         self._cfg = {
             "vision_spec": vision_spec,
             "vision_n_embd": vision_encoder_config.n_embd,

@@ -33,7 +33,7 @@ class VisionTransformerConfig(BaseModel):
     n_img_channels: Annotated[int, Field(ge=1)] = 3
     add_cls_token: bool = True
     bias: bool = True
-    ffn_hidden: Optional[Annotated[int, Field(ge=1)]] = None  # default 4*n_embd
+    ffn_hidden: Optional[Annotated[int, Field(ge=1)]] = None  # default 3072 (see below)
 
 
 class ImagePatchEmbedding(nn.Module):
@@ -74,17 +74,21 @@ class VisionTransformerBlock(nn.Module):
 
     @nn.compact
     def __call__(self, x):
-        h = nn.LayerNorm(name="norm1", dtype=x.dtype)(x)
+        h = nn.LayerNorm(epsilon=1e-5, name="norm1", dtype=x.dtype)(x)  # torch LN default eps
+        # attention projections always carry bias: the reference's block constructs
+        # MultiHeadAttention without forwarding `bias` (vision_transformer_model.py:
+        # VisionTransformerBlock), so torch's default True applies; `bias` governs
+        # only the MLP there — logit-parity tested
         x = x + MultiHeadAttention(
             n_embd=self.n_embd,
             n_head=self.n_head,
-            bias=self.bias,
+            bias=True,
             dropout=self.dropout,
             attention_type=AttentionType.NON_CAUSAL_SELF_ATTENTION,
             deterministic=self.deterministic,
             name="attention",
         )(h)
-        h2 = nn.LayerNorm(name="norm2", dtype=x.dtype)(x)
+        h2 = nn.LayerNorm(epsilon=1e-5, name="norm2", dtype=x.dtype)(x)
         x = x + MLP(
             in_features=self.n_embd,
             hidden_features=self.ffn_hidden,
@@ -127,12 +131,12 @@ class _VisionTransformerModule(nn.Module):
                 deterministic=self.deterministic,
                 name=f"blocks_{i}",
             )(x)
-        x = nn.LayerNorm(name="norm", dtype=x.dtype)(x)
         if s["n_classes"] is not None:
-            if s["add_cls_token"]:
-                pooled = x[:, 0]
-            else:
-                pooled = x.mean(axis=1)
+            # classification path: pool, then norm, then head — and the norm exists
+            # ONLY here; the reference's forward_images (the CoCa encoder path)
+            # returns the raw block output (vision_transformer_model.py:240-246,272-279)
+            pooled = x[:, 0] if s["add_cls_token"] else x.mean(axis=1)
+            pooled = nn.LayerNorm(epsilon=1e-5, name="norm", dtype=pooled.dtype)(pooled)
             return nn.Dense(s["n_classes"], use_bias=s["bias"], name="head")(pooled)
         return x
 
@@ -169,7 +173,10 @@ class VisionTransformer(NNModel):
         self.img_size = img_size
         self.n_img_channels = n_img_channels
         self._spec = {
-            "ffn_hidden": ffn_hidden or 4 * n_embd,
+            # unset -> 3072: the reference never forwards ffn_hidden into its
+            # VisionTransformer (its config has no such field), so torch's
+            # constructor default 3072 ALWAYS applies (vision_transformer_model.py:184)
+            "ffn_hidden": ffn_hidden or 3072,
             "block_size": self.get_block_size(img_size, patch_size, patch_stride, add_cls_token),
             "n_embd": n_embd,
             "n_head": n_head,

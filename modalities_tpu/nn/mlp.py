@@ -20,6 +20,8 @@ class MLP(nn.Module):
         hidden = self.hidden_features or 4 * self.in_features
         out = self.out_features or self.in_features
         x = nn.Dense(hidden, use_bias=self.bias, name="fc1", dtype=x.dtype)(x)
-        x = nn.gelu(x)
+        # exact (erf) gelu: the reference's nn.GELU() default — logit-parity tested
+        x = nn.gelu(x, approximate=False)
+        x = nn.Dropout(self.dropout)(x, deterministic=self.deterministic or self.dropout == 0.0)
         x = nn.Dense(out, use_bias=self.bias, name="fc2", dtype=x.dtype)(x)
         return nn.Dropout(self.dropout)(x, deterministic=self.deterministic or self.dropout == 0.0)
