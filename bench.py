@@ -75,12 +75,13 @@ def _probe_tpu(timeout_s: int = 180) -> str:
             if proc.returncode == 0:
                 return "tpu" if "tpu" in out else "no_tpu"
             # crash, not hang: a wedged claim raises UNAVAILABLE/DEADLINE-style TPU
-            # runtime errors (transient — retry); anything else (ImportError, ABI
-            # mismatch) is a broken install the ladder can never fix
-            transient = any(
-                marker in err for marker in ("UNAVAILABLE", "DEADLINE", "tpu", "TPU", "libtpu")
-            )
-            return "wedged" if transient else "no_tpu"
+            # runtime errors (transient — retry); any other crash (ImportError,
+            # libtpu ABI mismatch) is a broken install the ladder can never fix —
+            # report it loudly instead of masquerading as a clean no-TPU probe
+            if any(marker in err for marker in ("UNAVAILABLE", "DEADLINE_EXCEEDED", "DEADLINE")):
+                return "wedged"
+            print(f"bench: TPU probe child crashed:\n{err[-1500:]}", file=sys.stderr)
+            return "probe_error"
         if time.monotonic() >= deadline:
             break
         time.sleep(min(1.0, max(0.0, deadline - time.monotonic())))
@@ -119,6 +120,13 @@ def _probe_tpu_ladder() -> bool:
             return True
         if status == "no_tpu":
             print("bench: no TPU on this host (clean probe) — CPU fallback, no retry", file=sys.stderr)
+            return False
+        if status == "probe_error":
+            print(
+                "bench: probe child crashed with a non-TPU-runtime error (broken install?) "
+                "— CPU fallback, no retry; stderr above",
+                file=sys.stderr,
+            )
             return False
         if i < len(ladder) - 1:
             print(

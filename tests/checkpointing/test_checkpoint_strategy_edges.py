@@ -1,0 +1,132 @@
+"""Checkpoint-strategy edge cases (reference tests/checkpointing/
+test_checkpoint_strategies.py — the k matrix with pre-seeded history, the
+deepcopy-isolation guarantee, and the ring behavior under ASYNC saves; multi-week
+runs die in exactly these margins)."""
+
+import json
+from pathlib import Path
+
+import pytest
+
+from modalities_tpu.checkpointing.checkpoint_saving import CheckpointSaving
+from modalities_tpu.checkpointing.checkpoint_saving_strategies import (
+    SaveEveryKStepsCheckpointingStrategy,
+    SaveKMostRecentCheckpointsStrategy,
+)
+from modalities_tpu.checkpointing.orbax.orbax_checkpoint_saving import OrbaxCheckpointSaving
+from modalities_tpu.training.training_progress import TrainingProgress
+
+
+def _tp(steps, tokens=None, target_steps=20, target_tokens=40):
+    return TrainingProgress(
+        num_seen_steps_current_run=steps,
+        num_seen_tokens_current_run=tokens if tokens is not None else steps,
+        num_target_steps=target_steps,
+        num_target_tokens=target_tokens,
+    )
+
+
+@pytest.mark.parametrize(
+    "k,pre_seeded,expect_deleted_steps,expect_save",
+    [
+        # k=2 with two already saved: the oldest ([steps=1]) is evicted
+        (2, [_tp(2, 2), _tp(1, 1)], [1], True),
+        # k=0: never save, never delete
+        (0, [], [], False),
+        # k=2 but only one saved so far: save without eviction
+        (2, [_tp(1, 1)], [], True),
+        # k=-1: keep everything forever
+        (-1, [_tp(3, 3), _tp(2, 2), _tp(1, 1)], [], True),
+        # k=1: every save evicts the single predecessor
+        (1, [_tp(5, 5)], [5], True),
+    ],
+)
+def test_k_most_recent_matrix_with_preseeded_history(k, pre_seeded, expect_deleted_steps, expect_save):
+    strategy = SaveKMostRecentCheckpointsStrategy(k=k)
+    strategy.saved_step_checkpoints = list(pre_seeded)
+    instruction = strategy.get_checkpoint_instruction(_tp(10, 10))
+    assert instruction.savable is expect_save
+    assert [p.num_seen_steps_total for p in instruction.checkpoints_to_delete] == expect_deleted_steps
+
+
+def test_saved_history_isolated_from_caller_mutation():
+    """The strategy must deep-copy the TrainingProgress it records: the Trainer
+    mutates its progress object in place every step, and a shared reference would
+    corrupt the eviction bookkeeping (reference test_checkpoint_strategies.py:44-46)."""
+    strategy = SaveKMostRecentCheckpointsStrategy(k=2)
+    progress = _tp(10, 10)
+    strategy.get_checkpoint_instruction(progress)
+    progress.num_seen_steps_current_run = 100
+    assert strategy.saved_step_checkpoints[0].num_seen_steps_total == 10
+
+
+def test_k_zero_records_no_history():
+    strategy = SaveKMostRecentCheckpointsStrategy(k=0)
+    for step in range(1, 5):
+        assert not strategy.get_checkpoint_instruction(_tp(step)).savable
+    assert strategy.saved_step_checkpoints == []
+
+
+def test_every_k_steps_counts_total_steps_across_warmstarts():
+    """SaveEveryKSteps keys on num_seen_steps_TOTAL (previous run + current), so a
+    warmstarted run keeps the same global cadence."""
+    strategy = SaveEveryKStepsCheckpointingStrategy(k=4)
+    resumed = TrainingProgress(
+        num_seen_steps_current_run=1,
+        num_seen_tokens_current_run=1,
+        num_target_steps=20,
+        num_target_tokens=40,
+        num_seen_steps_previous_run=3,
+        num_seen_tokens_previous_run=3,
+    )
+    assert strategy.get_checkpoint_instruction(resumed).savable  # 3 + 1 = 4
+    resumed.num_seen_steps_current_run = 2
+    assert not strategy.get_checkpoint_instruction(resumed).savable  # 5
+
+
+def test_every_k_steps_nonpositive_k_never_saves():
+    for k in (0, -1):
+        strategy = SaveEveryKStepsCheckpointingStrategy(k=k)
+        assert not strategy.get_checkpoint_instruction(_tp(0)).savable
+        assert not strategy.get_checkpoint_instruction(_tp(4)).savable
+
+
+@pytest.fixture
+def trained_handle():
+    from modalities_tpu.running_env.device_mesh import get_device_mesh
+    from tests.models.test_gpt2_model import tiny_gpt2
+    from tests.training.test_train_step import _builder
+
+    mesh = get_device_mesh(device_type="cpu", data_parallel_shard_degree=8, world_size=8)
+    fns = _builder(tiny_gpt2("pytorch_flash"), mesh).build(seed=0)
+    return fns.app_state_handle
+
+
+@pytest.mark.parametrize("k,expected_folders", [(2, 2), (-1, 4), (1, 1)])
+def test_ring_on_disk_under_async_saves(tmp_path, trained_handle, k, expected_folders):
+    """The k ring must hold with use_async=True: deletions of evicted checkpoints
+    and the committed-pointer discipline interleave with pending commits."""
+    execution = OrbaxCheckpointSaving(tmp_path, experiment_id="async_ring", use_async=True)
+    saving = CheckpointSaving(SaveKMostRecentCheckpointsStrategy(k=k), execution)
+    for step in (1, 2, 3, 4):
+        saving.save_checkpoint(_tp(step, step * 100), trained_handle)
+    saving.wait_until_finished()
+
+    folders = sorted(p.name for p in tmp_path.iterdir() if p.is_dir())
+    assert len(folders) == expected_folders
+    # the newest checkpoint always survives, and the resume pointer names it
+    assert any("seen_steps_4-" in f for f in folders)
+    info = json.loads((tmp_path / "last_checkpoint_info.json").read_text())
+    assert "seen_steps_4-" in info["checkpoint_folder_path"]
+    assert Path(info["checkpoint_folder_path"]).exists()
+
+
+def test_k_zero_strategy_writes_nothing_to_disk(tmp_path, trained_handle):
+    saving = CheckpointSaving(
+        SaveKMostRecentCheckpointsStrategy(k=0), OrbaxCheckpointSaving(tmp_path, "noop")
+    )
+    for step in (1, 2):
+        saving.save_checkpoint(_tp(step), trained_handle)
+    saving.wait_until_finished()
+    assert not any(p.is_dir() for p in tmp_path.iterdir())
+    assert not (tmp_path / "last_checkpoint_info.json").exists()

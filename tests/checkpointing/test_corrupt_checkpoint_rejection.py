@@ -1,0 +1,136 @@
+"""Corrupt / partial / mismatched checkpoints must be REJECTED, never silently
+half-loaded (VERDICT r3 #4; reference guards this via DCP's metadata validation —
+here Orbax's). A warmstart that silently resumes from a torn checkpoint corrupts a
+multi-week run irrecoverably, so every failure mode below must raise."""
+
+import shutil
+
+import numpy as np
+import pytest
+
+from modalities_tpu.checkpointing.checkpoint_saving import CheckpointSaving
+from modalities_tpu.checkpointing.checkpoint_saving_strategies import (
+    SaveKMostRecentCheckpointsStrategy,
+)
+from modalities_tpu.checkpointing.orbax.orbax_checkpoint_loading import (
+    OrbaxCheckpointLoading,
+    restore_tree_single_device,
+)
+from modalities_tpu.checkpointing.orbax.orbax_checkpoint_saving import (
+    OrbaxCheckpointSaving,
+    checkpoint_folder_path,
+)
+from modalities_tpu.running_env.device_mesh import get_device_mesh
+from modalities_tpu.training.training_progress import TrainingProgress
+from tests.models.test_gpt2_model import tiny_gpt2
+from tests.training.test_train_step import _builder
+
+PROGRESS = TrainingProgress(
+    num_seen_steps_current_run=3,
+    num_seen_tokens_current_run=300,
+    num_target_steps=100,
+    num_target_tokens=10000,
+)
+
+
+@pytest.fixture(scope="module")
+def saved_checkpoint(tmp_path_factory):
+    """One committed checkpoint + a fresh builder factory for restore targets."""
+    root = tmp_path_factory.mktemp("ckpt")
+    mesh = get_device_mesh(device_type="cpu", data_parallel_shard_degree=8, world_size=8)
+    model = tiny_gpt2("pytorch_flash")
+    fns = _builder(model, mesh).build(seed=0)
+    saving = CheckpointSaving(
+        SaveKMostRecentCheckpointsStrategy(k=-1), OrbaxCheckpointSaving(root, "corrupt")
+    )
+    saving.save_checkpoint(PROGRESS, fns.app_state_handle)
+    folder = checkpoint_folder_path(root, "corrupt", PROGRESS)
+    assert folder.exists()
+
+    def fresh_handle():
+        return _builder(model, mesh).build(seed=99).app_state_handle
+
+    return folder, fresh_handle
+
+
+def test_missing_checkpoint_folder_raises_with_path(saved_checkpoint, tmp_path):
+    _, fresh_handle = saved_checkpoint
+    missing = tmp_path / "never_saved"
+    with pytest.raises(FileNotFoundError, match="never_saved"):
+        OrbaxCheckpointLoading().load_app_state(fresh_handle(), missing)
+
+
+def test_partial_checkpoint_missing_data_blob_rejected(saved_checkpoint, tmp_path):
+    """Delete the largest OCDBT data blob (the parameter payload) from a copy of a
+    committed checkpoint — a torn rsync/preemption artifact. The restore must
+    raise, not return a half-materialized state."""
+    folder, fresh_handle = saved_checkpoint
+    torn = tmp_path / folder.name
+    shutil.copytree(folder, torn)
+    blobs = sorted(
+        (p for p in torn.rglob("d/*") if p.is_file()), key=lambda p: p.stat().st_size
+    )
+    assert blobs, "checkpoint layout changed: no OCDBT data blobs found to remove"
+    blobs[-1].unlink()
+    with pytest.raises(Exception):
+        OrbaxCheckpointLoading().load_app_state(fresh_handle(), torn)
+
+
+def test_truncated_array_data_rejected(saved_checkpoint, tmp_path):
+    """Truncate every array-data file — bit-rot / partial upload. Must raise."""
+    folder, fresh_handle = saved_checkpoint
+    torn = tmp_path / folder.name
+    shutil.copytree(folder, torn)
+    data_files = [
+        p for p in torn.rglob("*") if p.is_file() and p.stat().st_size > 64 and "zarray" not in p.name
+    ]
+    assert data_files, "checkpoint layout changed: no data files found to truncate"
+    for p in data_files:
+        p.write_bytes(p.read_bytes()[: p.stat().st_size // 3])
+    with pytest.raises(Exception):
+        OrbaxCheckpointLoading().load_app_state(fresh_handle(), torn)
+
+
+def test_missing_metadata_rejected(saved_checkpoint, tmp_path):
+    """A checkpoint folder with its metadata stripped is unidentifiable — reject."""
+    folder, fresh_handle = saved_checkpoint
+    torn = tmp_path / folder.name
+    shutil.copytree(folder, torn)
+    stripped = 0
+    for p in list(torn.rglob("*")):
+        if p.is_file() and ("metadata" in p.name.lower() or p.name.startswith("_")):
+            p.unlink()
+            stripped += 1
+    assert stripped, "checkpoint layout changed: no metadata files found to strip"
+    with pytest.raises(Exception):
+        OrbaxCheckpointLoading().load_app_state(fresh_handle(), torn)
+
+
+def test_architecture_mismatch_rejected(saved_checkpoint):
+    """Restoring into a DIFFERENT architecture (wrong shapes) must raise, not
+    truncate/broadcast silently."""
+    folder, _ = saved_checkpoint
+    mesh = get_device_mesh(device_type="cpu", data_parallel_shard_degree=8, world_size=8)
+    bigger = tiny_gpt2("pytorch_flash", n_embd=64)  # saved model used a smaller width
+    handle = _builder(bigger, mesh).build(seed=0).app_state_handle
+    with pytest.raises(Exception):
+        OrbaxCheckpointLoading().load_app_state(handle, folder)
+
+
+def test_empty_folder_rejected_by_single_device_restore(tmp_path):
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    with pytest.raises(Exception):
+        restore_tree_single_device(empty)
+
+
+def test_intact_checkpoint_still_restores(saved_checkpoint):
+    """Control: the same checkpoint the corruption tests copy from restores fine
+    (proves the rejections above come from the injected damage, not the fixture)."""
+    import jax
+
+    folder, fresh_handle = saved_checkpoint
+    handle = fresh_handle()
+    restored = OrbaxCheckpointLoading().load_app_state(handle, folder)
+    assert int(restored.step) == 0  # fixture saved an un-stepped state
+    assert all(np.all(np.isfinite(np.asarray(x))) for x in jax.tree.leaves(restored.params))

@@ -77,3 +77,60 @@ def test_warmup_cosine():
     assert float(fn(100)) == pytest.approx(0.001, rel=1e-2)
     values = [float(fn(t)) for t in range(10, 101, 10)]
     assert all(a >= b for a, b in zip(values, values[1:]))  # monotone decay after warmup
+
+
+def test_onecycle_shape_and_extremes():
+    """torch OneCycleLR semantics: start at max_lr/div_factor, peak max_lr at
+    pct_start, end at initial/final_div_factor (reference test_lr_scheduler.py)."""
+    sched = OneCycleLRScheduler(
+        name="oc", optimizer=_opt(lr=1.0), max_lr=0.4, total_steps=100,
+        pct_start=0.25, div_factor=10, final_div_factor=100,
+    )
+    fn = sched.absolute_lr_schedule()
+    assert float(fn(0)) == pytest.approx(0.04, rel=1e-3)  # max_lr / div_factor
+    assert float(fn(25)) == pytest.approx(0.4, rel=1e-3)  # peak at pct_start
+    assert float(fn(100)) == pytest.approx(0.0004, rel=1e-2)  # initial / final_div
+    # monotone up then down
+    ups = [float(fn(s)) for s in range(0, 26, 5)]
+    downs = [float(fn(s)) for s in range(25, 101, 25)]
+    assert all(a <= b + 1e-9 for a, b in zip(ups, ups[1:]))
+    assert all(a >= b - 1e-9 for a, b in zip(downs, downs[1:]))
+
+
+def test_onecycle_linear_anneal_and_epoch_form():
+    sched = OneCycleLRScheduler(
+        name="oc", optimizer=_opt(lr=1.0), max_lr=0.2, epochs=4, steps_per_epoch=25,
+        pct_start=0.5, anneal_strategy="linear", div_factor=4, final_div_factor=10,
+    )
+    fn = sched.absolute_lr_schedule()
+    # linear warmup: exactly halfway between initial (0.05) and max (0.2) at step 25
+    assert float(fn(25)) == pytest.approx(0.125, rel=1e-3)
+    assert float(fn(50)) == pytest.approx(0.2, rel=1e-3)
+
+
+def test_onecycle_requires_a_step_budget():
+    with pytest.raises(ValueError, match="total_steps"):
+        OneCycleLRScheduler(name="oc", optimizer=_opt()).absolute_lr_schedule()(0)
+
+
+def test_warmup_cosine_resume_is_pure_function_of_step():
+    """Warmstart correctness: the schedule is a pure function of the ABSOLUTE step,
+    so resuming at step 50 yields the identical tail to an uninterrupted run (the
+    reference replays last_epoch for the same effect)."""
+    make = lambda: LinearWarmupCosineAnnealingLRScheduler(  # noqa: E731
+        name="wc", optimizer=_opt(lr=1.0), warmup_steps=10, total_steps=100,
+        initial_lr=0.0, final_lr=0.01, max_lr=0.1,
+    ).absolute_lr_schedule()
+    fresh, resumed = make(), make()
+    for step in (50, 60, 99, 100):
+        assert float(fresh(step)) == pytest.approx(float(resumed(step)))
+
+
+def test_warmup_cosine_clamps_beyond_total_steps():
+    fn = LinearWarmupCosineAnnealingLRScheduler(
+        name="wc", optimizer=_opt(lr=1.0), warmup_steps=10, total_steps=100,
+        initial_lr=0.0, final_lr=0.01, max_lr=0.1,
+    ).absolute_lr_schedule()
+    # overshooting the budget (extra steps after target) stays pinned at final_lr
+    assert float(fn(150)) == pytest.approx(0.01, rel=1e-4)
+    assert float(fn(100)) == pytest.approx(0.01, rel=1e-4)

@@ -77,3 +77,13 @@ def test_last_verified_tpu_provenance(bench):
     source = Path(__file__).parents[1] / info["source"].split(" ")[0]
     assert source.is_file(), info["source"]
     assert str(info["mfu"]) in source.read_text()
+
+
+def test_probe_error_short_circuits_without_retry(bench):
+    """A crashed probe child WITHOUT TPU-runtime markers (broken venv, libtpu ABI
+    mismatch) is permanent: fall back immediately and loudly, never sleep the
+    ladder against it."""
+    calls = []
+    bench._probe_tpu = lambda timeout_s=180: (calls.append(1), "probe_error")[1]
+    assert bench._probe_tpu_ladder() is False
+    assert len(calls) == 1

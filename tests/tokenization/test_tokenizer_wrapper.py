@@ -1,0 +1,97 @@
+"""Tokenizer-wrapper padding/truncation matrix (reference: tests/test_tokenization.py
+— 328 LoC of padding/truncation semantics; SFT packing depends on these exactly).
+Uses the GPT-2-style tokenizer the reference ships with its tutorials (local files,
+no hub access)."""
+
+from pathlib import Path
+
+import pytest
+
+from modalities_tpu.tokenization.tokenizer_wrapper import PreTrainedHFTokenizer
+
+TOKENIZER_DIR = Path("/root/reference/tutorials/getting_started/tokenizer")
+
+pytestmark = pytest.mark.skipif(
+    not TOKENIZER_DIR.is_dir(), reason="reference tutorial tokenizer not mounted"
+)
+
+# "AAAAAAAA" is a single GPT-2 token; repeating it gives exact token counts
+SIX_TOKENS = "AAAAAAAA" * 6
+# a token the vocab already knows, markable as pad without growing the embedding
+SPECIAL = {"pad_token": "°"}
+
+
+def _tok(**kwargs) -> PreTrainedHFTokenizer:
+    return PreTrainedHFTokenizer(pretrained_model_name_or_path=str(TOKENIZER_DIR), **kwargs)
+
+
+def _num_pad(tokenizer: PreTrainedHFTokenizer, ids: list[int]) -> int:
+    pad_id = tokenizer.tokenizer.pad_token_id
+    return sum(1 for i in ids if i == pad_id)
+
+
+@pytest.mark.parametrize(
+    "truncation,padding,max_length,expected_len,expected_pad",
+    [
+        # shorter than max_length, padding="max_length": padded up regardless of truncation
+        (False, "max_length", 10, 10, 4),
+        (True, "max_length", 10, 10, 4),
+        # longer than max_length with truncation: cut to max_length, no padding
+        (True, "max_length", 4, 4, 0),
+        (True, True, 4, 4, 0),
+        # no padding, no truncation: exact token count survives any max_length
+        (False, False, 10, 6, 0),
+        (False, False, 4, 6, 0),
+        # truncation without padding: cut, not padded
+        (True, False, 4, 4, 0),
+        # padding=False with truncation and text shorter than max: untouched
+        (True, False, 10, 6, 0),
+    ],
+)
+def test_padding_truncation_matrix(truncation, padding, max_length, expected_len, expected_pad):
+    tokenizer = _tok(
+        truncation=truncation, padding=padding, max_length=max_length, special_tokens=SPECIAL
+    )
+    ids = tokenizer.tokenize(SIX_TOKENS)
+    assert len(ids) == expected_len
+    assert _num_pad(tokenizer, ids) == expected_pad
+
+
+def test_no_options_tokenize_roundtrips():
+    tokenizer = _tok()
+    text = "This is a test sentence."
+    ids = tokenizer.tokenize(text)
+    assert len(ids) > 0
+    assert tokenizer.decode(ids) == text
+
+
+def test_vocab_size_and_special_token_lookup():
+    tokenizer = _tok(special_tokens=SPECIAL)
+    assert tokenizer.vocab_size == 50257
+    pad_id = tokenizer.get_token_id("°")
+    assert tokenizer.is_special_token_id(pad_id)
+    # an ordinary token is not special
+    ordinary = tokenizer.tokenize("hello")[0]
+    assert not tokenizer.is_special_token_id(ordinary)
+
+
+def test_unknown_vocab_growth_rejected():
+    """Adding genuinely new tokens would require resizing the embedding matrix —
+    both frameworks refuse (reference tokenizer_wrapper.py:118)."""
+    with pytest.raises(NotImplementedError, match="vocabulary"):
+        _tok(special_tokens={"additional_special_tokens": ["<|definitely-not-in-vocab-xyz|>"]})
+
+
+def test_special_tokens_list_values_accepted():
+    """additional_special_tokens as a LIST (the instruction-tuning configs' form)
+    must validate and register, provided the tokens are in-vocab."""
+    tokenizer = _tok(
+        special_tokens={"pad_token": "°", "additional_special_tokens": ["°"]}
+    )
+    assert "°" in str(tokenizer.special_tokens)
+
+
+def test_unk_token_collision_warns():
+    tokenizer = _tok()
+    with pytest.warns(UserWarning, match="unk token"):
+        tokenizer.get_token_id("<|this_makes_unk|>")

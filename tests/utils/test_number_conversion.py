@@ -1,64 +1,210 @@
+"""NumberConversion matrix (reference: tests/utils/test_number_conversion.py — the
+full parametrized value grid; checkpoint folder names are the metadata store, so the
+parse-back arithmetic and its rejection modes are load-bearing for warmstarts)."""
+
+import pickle
+
+import numpy as np
 import pytest
 
 from modalities_tpu.utils.number_conversion import NumberConversion as NC
 
-
-def test_local_num_batches_from_num_samples():
-    assert NC.get_local_num_batches_from_num_samples(num_ranks=2, global_num_samples=100, local_micro_batch_size=5) == 10
-    assert NC.get_local_num_batches_from_num_samples(num_ranks=3, global_num_samples=100, local_micro_batch_size=5) == 6
-
-
-def test_num_samples_from_num_tokens():
-    assert NC.get_num_samples_from_num_tokens(num_tokens=1000, sequence_length=100) == 10
-    assert NC.get_num_samples_from_num_tokens(num_tokens=1099, sequence_length=100) == 10
-
-
-def test_local_num_batches_from_num_tokens():
-    assert (
-        NC.get_local_num_batches_from_num_tokens(
-            num_ranks=2, global_num_tokens=4000, sequence_length=100, local_micro_batch_size=5
-        )
-        == 4
-    )
+# a reference-convention checkpoint name (model/optimizer file variants) and the
+# folder-name convention this repo's orbax execution writes — both must parse
+REF_MODEL = (
+    "/ckpt/2026-07-29__10-00-00_ab12cd34/eid_2026-07-29__10-00-00_ab12cd34-model"
+    "-seen_steps_250-seen_tokens_65536000-target_tokens_1310720000.bin"
+)
+REF_OPTIM = REF_MODEL.replace("-model-", "-optimizer-")
+REPO_FOLDER = "/exp/eid-2026/seen_steps_64-seen_tokens_524288-target_steps_128-target_tokens_1048576"
+# two seen_steps_ hits -> ambiguous, must be rejected
+AMBIGUOUS = "/ckpt/seen_steps_1234-eid-optimizer-seen_steps_250-seen_tokens_650-target_tokens_1300.bin"
+# no seen_steps_ hit at all
+UNPARSEABLE = "/ckpt/eid-optimizer-abc_250-seen_tokens_650-target_tokens_1300.bin"
 
 
-def test_num_steps_from_num_samples():
+@pytest.mark.parametrize(
+    "num_ranks,global_num_samples,mbs,expected",
+    [(2, 100, 10, 5), (2, 110, 10, 5), (4, 100, 10, 2), (4, 100, 5, 5), (2, 100, 5, 10), (3, 100, 5, 6)],
+)
+def test_local_num_batches_from_num_samples(num_ranks, global_num_samples, mbs, expected):
+    assert NC.get_local_num_batches_from_num_samples(num_ranks, global_num_samples, mbs) == expected
+
+
+@pytest.mark.parametrize(
+    "num_ranks,global_num_tokens,seq,mbs,expected",
+    [(2, 100, 2, 10, 2), (2, 110, 2, 10, 2), (2, 120, 2, 10, 3), (4, 100, 3, 4, 2), (2, 4000, 100, 5, 4)],
+)
+def test_local_num_batches_from_num_tokens(num_ranks, global_num_tokens, seq, mbs, expected):
+    assert NC.get_local_num_batches_from_num_tokens(num_ranks, global_num_tokens, seq, mbs) == expected
+
+
+@pytest.mark.parametrize(
+    "num_tokens,seq,expected", [(1000, 100, 10), (1099, 100, 10), (99, 100, 0), (0, 7, 0)]
+)
+def test_num_samples_from_num_tokens(num_tokens, seq, expected):
+    assert NC.get_num_samples_from_num_tokens(num_tokens=num_tokens, sequence_length=seq) == expected
+
+
+@pytest.mark.parametrize(
+    "dp,mbs,global_num_samples,acc,expected",
+    [
+        (2, 2, 10, 1, 2),
+        (2, 2, 11, 1, 2),
+        (2, 2, 12, 1, 3),
+        (2, 2, 20, 2, 2),
+        (2, 2, 22, 2, 2),
+        (2, 2, 48, 4, 3),
+        (2, 4, 64, 2, 4),
+    ],
+)
+def test_num_steps_from_num_samples(dp, mbs, global_num_samples, acc, expected):
     assert (
         NC.get_num_steps_from_num_samples(
-            dp_degree=2, local_micro_batch_size=4, global_num_samples=64, gradient_accumulation_steps=2
+            dp_degree=dp,
+            local_micro_batch_size=mbs,
+            global_num_samples=global_num_samples,
+            gradient_accumulation_steps=acc,
         )
-        == 4
+        == expected
     )
 
 
-def test_num_steps_tokens_roundtrip():
+@pytest.mark.parametrize(
+    "dp,mbs,global_num_tokens,seq,acc,expected",
+    [
+        (2, 2, 20, 2, 1, 2),
+        (2, 2, 21, 2, 1, 2),
+        (2, 2, 22, 2, 1, 2),
+        (2, 2, 24, 2, 1, 3),
+        (2, 2, 40, 2, 2, 2),
+        (2, 2, 42, 2, 2, 2),
+        (2, 2, 88, 2, 4, 2),
+        (2, 2, 48, 2, 2, 3),
+        (2, 4, 8192, 128, 1, 8),
+    ],
+)
+def test_num_steps_from_num_tokens(dp, mbs, global_num_tokens, seq, acc, expected):
+    assert (
+        NC.get_num_steps_from_num_tokens(
+            dp_degree=dp,
+            local_micro_batch_size=mbs,
+            global_num_tokens=global_num_tokens,
+            sequence_length=seq,
+            gradient_accumulation_steps=acc,
+        )
+        == expected
+    )
+
+
+@pytest.mark.parametrize(
+    "num_steps,dp,mbs,seq,acc,expected",
+    [(2, 3, 20, 2, 1, 240), (2, 3, 21, 2, 1, 252), (3, 4, 88, 2, 4, 8448), (3, 4, 48, 2, 2, 2304)],
+)
+def test_num_tokens_from_num_steps(num_steps, dp, mbs, seq, acc, expected):
+    assert (
+        NC.get_num_tokens_from_num_steps(
+            num_steps=num_steps,
+            dp_degree=dp,
+            local_micro_batch_size=mbs,
+            sequence_length=seq,
+            gradient_accumulation_steps=acc,
+        )
+        == expected
+    )
+
+
+def test_steps_tokens_roundtrip_floors_partial_steps():
     steps = NC.get_num_steps_from_num_tokens(
-        dp_degree=2, local_micro_batch_size=4, global_num_tokens=8192, sequence_length=128, gradient_accumulation_steps=1
+        dp_degree=2,
+        local_micro_batch_size=4,
+        global_num_tokens=9000,
+        sequence_length=128,
+        gradient_accumulation_steps=1,
     )
     tokens = NC.get_num_tokens_from_num_steps(
-        num_steps=steps, dp_degree=2, local_micro_batch_size=4, sequence_length=128, gradient_accumulation_steps=1
+        num_steps=steps,
+        dp_degree=2,
+        local_micro_batch_size=4,
+        sequence_length=128,
+        gradient_accumulation_steps=1,
     )
-    assert tokens <= 8192
-    assert steps == 8
+    assert steps == 8 and tokens == 8192 and tokens <= 9000
 
 
-def test_checkpoint_path_parsing():
-    p = "/exp/eid-2026/seen_steps_64-seen_tokens_524288-target_steps_128-target_tokens_1048576"
-    assert NC.get_num_seen_steps_from_checkpoint_path(p) == 64
-    assert NC.get_last_step_from_checkpoint_path(p) == 63
-    assert NC.get_global_num_seen_tokens_from_checkpoint_path(p) == 524288
-    assert NC.get_global_num_target_tokens_from_checkpoint_path(p) == 1048576
-    assert NC.get_num_target_steps_from_checkpoint_path(p) == 128
+# ------------------------------------------------- checkpoint-path parse-back
 
 
-def test_checkpoint_path_parsing_no_match_raises():
-    with pytest.raises(ValueError, match="No match"):
-        NC.get_num_seen_steps_from_checkpoint_path("/tmp/nothing_here")
+@pytest.mark.parametrize("path", [REF_MODEL, REF_OPTIM])
+def test_seen_steps_and_last_step_from_reference_names(path):
+    assert NC.get_num_seen_steps_from_checkpoint_path(path) == 250
+    assert NC.get_last_step_from_checkpoint_path(path) == 249
 
 
-def test_checkpoint_path_parsing_multiple_matches_raises():
-    with pytest.raises(ValueError, match="single group"):
-        NC.get_num_seen_steps_from_checkpoint_path("/x/seen_steps_1/seen_steps_2")
+@pytest.mark.parametrize("path", [REF_MODEL, REF_OPTIM])
+def test_token_counts_from_reference_names(path):
+    assert NC.get_global_num_seen_tokens_from_checkpoint_path(path) == 65536000
+    assert NC.get_global_num_target_tokens_from_checkpoint_path(path) == 1310720000
+
+
+@pytest.mark.parametrize("path", [REF_MODEL, REF_OPTIM])
+def test_target_steps_derived_from_reference_names(path):
+    # no target_steps_ field in the reference name: derived as
+    # target_tokens // (seen_tokens / seen_steps) = 1310720000 // 262144
+    assert NC.get_num_target_steps_from_checkpoint_path(path) == 5000
+
+
+def test_repo_folder_name_convention_parses():
+    assert NC.get_num_seen_steps_from_checkpoint_path(REPO_FOLDER) == 64
+    assert NC.get_last_step_from_checkpoint_path(REPO_FOLDER) == 63
+    assert NC.get_global_num_seen_tokens_from_checkpoint_path(REPO_FOLDER) == 524288
+    assert NC.get_global_num_target_tokens_from_checkpoint_path(REPO_FOLDER) == 1048576
+    assert NC.get_num_target_steps_from_checkpoint_path(REPO_FOLDER) == 128
+
+
+@pytest.mark.parametrize(
+    "getter",
+    [
+        NC.get_num_seen_steps_from_checkpoint_path,
+        NC.get_last_step_from_checkpoint_path,
+    ],
+)
+def test_ambiguous_step_fields_rejected(getter):
+    with pytest.raises(ValueError):
+        getter(AMBIGUOUS)
+
+
+@pytest.mark.parametrize(
+    "getter",
+    [
+        NC.get_num_seen_steps_from_checkpoint_path,
+        NC.get_last_step_from_checkpoint_path,
+        NC.get_num_target_steps_from_checkpoint_path,
+    ],
+)
+def test_unparseable_step_fields_rejected(getter):
+    with pytest.raises(ValueError):
+        getter(UNPARSEABLE)
+
+
+def test_ambiguous_token_fields_rejected():
+    twice = "/ckpt/seen_tokens_65-eid-optimizer-seen_steps_250-seen_tokens_650-target_tokens_1300.bin"
+    with pytest.raises(ValueError):
+        NC.get_global_num_seen_tokens_from_checkpoint_path(twice)
+    twice_target = "/ckpt/target_tokens_65-eid-seen_steps_250-seen_tokens_650-target_tokens_1300.bin"
+    with pytest.raises(ValueError):
+        NC.get_global_num_target_tokens_from_checkpoint_path(twice_target)
+
+
+def test_fractional_target_steps_floor():
+    # tokens/step = 650/250 = 2.6; target 1303 tokens is not a whole number of
+    # steps — the floor-divide yields 501 (same arithmetic as the reference's
+    # number_conversion.py; its is_integer() guard is unreachable after `//`)
+    path = "/ckpt/eid-seen_steps_250-seen_tokens_650-target_tokens_1303"
+    assert NC.get_num_target_steps_from_checkpoint_path(path) == 501
+
+
+# ------------------------------------------------------ dataset-backed variants
 
 
 def test_num_tokens_from_packed_mem_map_dataset_continuous(tmp_path):
@@ -66,8 +212,6 @@ def test_num_tokens_from_packed_mem_map_dataset_continuous(tmp_path):
     steps (reference number_conversion.py:288-341): 1000 tokens, seq 10 with
     reuse_last_target -> 99 windows; dp2 x mbs4 x acc1 = 8 samples/step -> 96
     samples -> 960 tokens."""
-    import numpy as np
-
     from modalities_tpu.dataloader.packed_data import write_pbin_file
 
     p = tmp_path / "d.pbin"
@@ -81,8 +225,6 @@ def test_num_tokens_from_packed_mem_map_dataset_continuous(tmp_path):
         sample_key="input_ids",
     )
     assert tokens == 960
-    # disjoint blocks (SFT windowing): 100 windows -> 12 steps -> 960 again, but
-    # the window count differs (100 vs 99) — check via a seq that tells them apart
     tokens_sft = NC.get_num_tokens_from_packed_mem_map_dataset_continuous(
         dataset_path=p,
         sequence_length=100,
@@ -105,12 +247,59 @@ def test_num_tokens_from_packed_mem_map_dataset_continuous(tmp_path):
     assert tokens_pre == 900  # overlap windowing: (1000-1)//100 = 9 windows
 
 
-def test_num_steps_from_raw_dataset_index(tmp_path):
-    import pickle
+@pytest.mark.parametrize(
+    "seq,dp,mbs,acc",
+    [(10, 2, 2, 2), (25, 2, 2, 2), (50, 3, 4, 2), (100, 3, 4, 1)],
+)
+def test_num_tokens_from_dataset_matches_manual_arithmetic(tmp_path, seq, dp, mbs, acc):
+    """The dataset-backed count must equal the hand computation over the window
+    index for every (seq, dp, mbs, acc) combination — the reference's grid shape."""
+    from modalities_tpu.dataloader.dataset_factory import DatasetFactory
+    from modalities_tpu.dataloader.packed_data import write_pbin_file
 
+    p = tmp_path / "d.pbin"
+    write_pbin_file(p, iter([np.arange(2111) % 256]), token_size_in_bytes=2)
+    dataset = DatasetFactory.get_packed_mem_map_dataset_continuous(
+        raw_data_path=p, sequence_length=seq, sample_key="x", reuse_last_target=True
+    )
+    num_steps = len(dataset) // dp // mbs // acc
+    expected = num_steps * dp * mbs * acc * seq
+    assert (
+        NC.get_num_tokens_from_packed_mem_map_dataset_continuous(
+            dataset_path=p,
+            sequence_length=seq,
+            dp_degree=dp,
+            local_micro_batch_size=mbs,
+            gradient_accumulation_steps=acc,
+            sample_key="x",
+        )
+        == expected
+    )
+
+
+@pytest.mark.parametrize("num_ranks,mbs,acc", [(2, 3, 2), (3, 4, 2), (2, 4, 1), (5, 2, 3)])
+def test_num_steps_from_raw_dataset_index(tmp_path, num_ranks, mbs, acc):
     p = tmp_path / "d.idx"
     p.write_bytes(pickle.dumps([(0, 10)] * 100))
-    steps = NC.get_num_steps_from_raw_dataset_index(
-        raw_index_path=p, num_ranks=2, local_micro_batch_size=4, gradient_accumulation_steps=2
+    assert NC.get_num_steps_from_raw_dataset_index(
+        raw_index_path=p, num_ranks=num_ranks, local_micro_batch_size=mbs, gradient_accumulation_steps=acc
+    ) == 100 // num_ranks // mbs // acc
+
+
+def test_parallel_degree_from_device_mesh():
+    """number_conversion.parallel_degree (the dp_degree node the sweep/instruct
+    configs build BY_REFERENCE) multiplies the requested mesh axes."""
+    import jax
+
+    from modalities_tpu.running_env.device_mesh import get_device_mesh
+
+    mesh = get_device_mesh(
+        device_type="cpu",
+        data_parallel_shard_degree=4,
+        data_parallel_replicate_degree=2,
+        world_size=8,
+        devices=jax.devices()[:8],
     )
-    assert steps == 6  # 100 samples // (2*4*2)
+    assert NC.get_parallel_degree(mesh, ["dp_shard", "dp_replicate"]) == 8
+    assert NC.get_parallel_degree(mesh, ["dp_shard"]) == 4
+    assert NC.get_parallel_degree(mesh, ["tp"]) == 1
