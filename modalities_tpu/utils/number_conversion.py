@@ -237,7 +237,12 @@ class NumTokensFromPackedMemMapDatasetContinuousConfig(BaseModel):
 
 
 class NumStepsFromRawDatasetIndexConfig(BaseModel):
+    model_config = {"populate_by_name": True}
+
     raw_index_path: Path
-    num_ranks: PositiveInt
+    # `dp_degree` alias: the reference's library_usage tutorial YAML passes
+    # dp_degree here although the reference schema (number_conversion.py:65-69)
+    # requires num_ranks — accept both so the shipped tutorial builds
+    num_ranks: PositiveInt = Field(validation_alias="dp_degree")
     local_micro_batch_size: PositiveInt
     gradient_accumulation_steps: PositiveInt

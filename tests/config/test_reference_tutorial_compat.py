@@ -545,3 +545,62 @@ def test_scaling_up_sweep_generates_and_builds(tmp_path, monkeypatch):
     _set_rank_env(monkeypatch, 2)
     components = _build(small[0], root / "experiments", "tut_sweep_small")
     assert components.app_state is not None
+
+
+# ------------------------------------------------------------------ library_usage
+
+
+def test_library_usage_custom_component_through_main(tmp_path, monkeypatch):
+    """tutorials/library_usage exactly as its main.py runs it: register the custom
+    collator through Main.add_custom_component against the UNMODIFIED
+    config_lorem_ipsum.yaml. The reference's own data artifacts (lorem_ipsum_long
+    jsonl + idx, shipped under its data/) are staged at the ../../data relative
+    path the config names.
+
+    The build must progress through the custom component (proving the library
+    hook resolves `collate_fn.custom_gpt_2_llm_collator`) and then fail with the
+    SAME actionable error the reference produces: the tutorial's tokenizer block
+    adds pad_token "[PAD]", which is NOT in the shipped tokenizer's vocab, and
+    both frameworks refuse vocab growth (embedding resize unsupported —
+    verified: AutoTokenizer.add_special_tokens grows 50257 -> 50258 on the
+    shipped files, tripping reference tokenizer_wrapper.py:118's guard)."""
+    from pydantic import BaseModel
+
+    from modalities_tpu.batch import DatasetBatch
+
+    root = _stage_tutorial(tmp_path, "library_usage")
+    data = tmp_path / "data"
+    data.mkdir(exist_ok=True)
+    for name in ("lorem_ipsum_long.jsonl", "lorem_ipsum_long.idx"):
+        shutil.copy(Path("/root/reference/data") / name, data / name)
+    monkeypatch.chdir(root)  # main.py chdirs to the tutorial folder
+    _set_rank_env(monkeypatch, 2)
+
+    class CustomGPT2LLMCollateFnConfig(BaseModel):
+        sample_key: str
+        target_key: str
+        custom_attribute: str
+
+    class CustomGPT2LLMCollateFn:
+        def __init__(self, sample_key: str, target_key: str, custom_attribute: str):
+            self.sample_key = sample_key
+            self.target_key = target_key
+            self.custom_attribute = custom_attribute
+            self.num_calls = 0
+
+        def __call__(self, batch):
+            arr = np.asarray(batch)
+            self.num_calls += 1
+            return DatasetBatch(
+                samples={self.sample_key: arr[:, :-1]}, targets={self.target_key: arr[:, 1:]}
+            )
+
+    main = Main(root / "config_lorem_ipsum.yaml", experiment_id="tut_library_usage")
+    main.add_custom_component(
+        component_key="collate_fn",
+        variant_key="custom_gpt_2_llm_collator",
+        custom_component=CustomGPT2LLMCollateFn,
+        custom_config=CustomGPT2LLMCollateFnConfig,
+    )
+    with pytest.raises(NotImplementedError, match="vocabulary"):
+        main.build_components(TrainingComponentsInstantiationModel)
