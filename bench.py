@@ -142,6 +142,7 @@ def _probe_tpu_ladder() -> bool:
 # claim is wedged for the whole bench window. Source of truth:
 # docs/scaling_experiments/v5e_single_chip.md (judge-reproduced in round 2).
 LAST_VERIFIED_TPU = {
+    "name": "680m_64k_flash_chunked",  # candidate-ladder entry of the verified leader
     "config": "680m_64k_flash_chunked (GPT2 680M, seq 65536, mb 1, full remat, chunked head+loss)",
     "mfu": 0.6882,
     "tokens_per_s": 4043,
@@ -187,6 +188,10 @@ def peak_flops_per_chip() -> float:
 # per-step overheads and flash attention's causal-block skipping pays off).
 _TPU_CANDIDATES = [
     # (name, n_layer, n_embd, n_head, ffn, seq, mb, attn_impl, param_dtype, remat[, chunk])
+    # 80k: untested on hardware (the chip was wedged all of round 4) but the
+    # context ladder rose monotonically to 0.688 @ 64k and 96k OOMs — worth one
+    # compile attempt; the OOM step-down falls back to the verified 64k leader
+    ("680m_80k_flash_chunked", 24, 1536, 12, 6144, 81920, 1, "dao_flash", "bfloat16", "full", 2048),
     ("680m_64k_flash_chunked", 24, 1536, 12, 6144, 65536, 1, "dao_flash", "bfloat16", "full", 2048),
     ("680m_32k_flash_chunked", 24, 1536, 12, 6144, 32768, 1, "dao_flash", "bfloat16", "full", 2048),
     ("1.3b_16k_flash_chunked", 24, 2048, 16, 8192, 16384, 1, "dao_flash", "bfloat16", "full", 2048),
@@ -426,6 +431,7 @@ def main() -> None:
         candidates = [candidates[int(pin)]]
     elif pin is not None:
         print(f"bench: ignoring BENCH_CONFIG={pin} (only {len(candidates)} candidates)", file=sys.stderr)
+        pin = None  # ignored means ignored: the full ladder (and its guards) applies
     # 6 iters × 2 repeats of per-iteration timing replace the old single
     # 20-iteration aggregate; at ~16 s/step for the 64k leader that is ~3.5 min of
     # timed work, and the median-of-best-repeat is robust where the aggregate wasn't
@@ -450,6 +456,26 @@ def main() -> None:
             _reexec_on_cpu()
             return
         raise RuntimeError("all bench candidates failed:\n" + "\n".join(errors))
+
+    # exploration guard: if an untested exploratory candidate won the ladder but
+    # scored BELOW the verified leader's number, also time the known-leader config
+    # and keep the better run — first-success must never lower the scoreboard
+    if on_tpu and pin is None and result["value"] < LAST_VERIFIED_TPU["mfu"]:
+        leader_name = LAST_VERIFIED_TPU["name"]
+        leader = next((c for c in candidates if c[0] == leader_name), None)
+        leader_already_failed = any(e.startswith(f"{leader_name}:") for e in errors)
+        if leader is not None and not leader_already_failed and result["detail"].get("config") != leader[0]:
+            print(
+                f"bench: {result['detail'].get('config')} scored {result['value']:.4f} < "
+                f"verified leader {LAST_VERIFIED_TPU['mfu']}; timing the leader config too",
+                file=sys.stderr,
+            )
+            try:
+                alt = _run_candidate(leader, iters)
+                if alt["value"] > result["value"]:
+                    result = alt
+            except Exception as exc:  # noqa: BLE001 — keep the first result
+                print(f"bench: leader re-run failed ({exc}); keeping first result", file=sys.stderr)
 
     print(json.dumps(result))
 
