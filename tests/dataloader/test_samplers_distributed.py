@@ -1,0 +1,101 @@
+"""ResumableDistributedSampler contracts (reference: tests/dataloader sampler
+tests + ResumableDistributedSampler semantics, samplers.py:11). Data-order
+correctness across warmstarts rides entirely on these invariants."""
+
+import numpy as np
+import pytest
+
+from modalities_tpu.dataloader.samplers import BatchSampler, ResumableDistributedSampler
+
+
+class _Dataset:
+    def __init__(self, n):
+        self.n = n
+
+    def __len__(self):
+        return self.n
+
+
+@pytest.mark.parametrize("n,replicas", [(100, 4), (101, 4), (103, 2), (64, 8)])
+def test_ranks_partition_disjoint_and_cover(n, replicas):
+    """Without skipping, the rank shards are pairwise disjoint and (under
+    drop_last) cover exactly local_num_samples * replicas distinct indices."""
+    shards = [
+        list(ResumableDistributedSampler(_Dataset(n), rank=r, num_replicas=replicas, drop_last=True))
+        for r in range(replicas)
+    ]
+    lengths = {len(s) for s in shards}
+    assert len(lengths) == 1, "unbalanced rank shards under drop_last"
+    flat = [i for s in shards for i in s]
+    assert len(flat) == len(set(flat)), "rank shards overlap"
+    assert set(flat) <= set(range(n))
+
+
+@pytest.mark.parametrize("n,replicas", [(101, 4), (7, 4)])
+def test_no_drop_last_pads_to_even_shards(n, replicas):
+    shards = [
+        list(ResumableDistributedSampler(_Dataset(n), rank=r, num_replicas=replicas, drop_last=False))
+        for r in range(replicas)
+    ]
+    assert len({len(s) for s in shards}) == 1
+    flat = [i for s in shards for i in s]
+    # padding duplicates wrap from the stream head; every index stays in range
+    assert len(flat) >= n and set(flat) <= set(range(n))
+
+
+def test_resume_skip_equals_tail_of_uninterrupted_stream():
+    """THE warmstart invariant: skipping k global samples reproduces exactly the
+    tail of the uninterrupted stream, per rank, shuffled or not."""
+    for shuffle in (False, True):
+        for rank in (0, 1):
+            full = list(
+                ResumableDistributedSampler(
+                    _Dataset(64), rank=rank, num_replicas=2, drop_last=True, shuffle=shuffle, seed=3
+                )
+            )
+            resumed = list(
+                ResumableDistributedSampler(
+                    _Dataset(64),
+                    rank=rank,
+                    num_replicas=2,
+                    drop_last=True,
+                    shuffle=shuffle,
+                    seed=3,
+                    skip_num_global_samples=16,
+                )
+            )
+            # 16 global samples = 8 per rank
+            assert resumed == full[8:], (shuffle, rank)
+
+
+def test_shuffle_varies_by_epoch_and_seed_only():
+    ds = _Dataset(40)
+    base = list(ResumableDistributedSampler(ds, rank=0, num_replicas=2, shuffle=True, seed=1, epoch=0))
+    again = list(ResumableDistributedSampler(ds, rank=0, num_replicas=2, shuffle=True, seed=1, epoch=0))
+    other_epoch = list(ResumableDistributedSampler(ds, rank=0, num_replicas=2, shuffle=True, seed=1, epoch=1))
+    other_seed = list(ResumableDistributedSampler(ds, rank=0, num_replicas=2, shuffle=True, seed=2, epoch=0))
+    assert base == again
+    assert base != other_epoch and base != other_seed
+
+
+def test_invalid_rank_rejected():
+    with pytest.raises(ValueError, match="Invalid rank"):
+        ResumableDistributedSampler(_Dataset(10), rank=4, num_replicas=4)
+    with pytest.raises(ValueError, match="Invalid rank"):
+        ResumableDistributedSampler(_Dataset(10), rank=-1, num_replicas=2)
+
+
+def test_len_matches_iteration_length():
+    for n, replicas, drop_last, skip in [(100, 4, True, 0), (101, 4, False, 0), (64, 2, True, 10)]:
+        s = ResumableDistributedSampler(
+            _Dataset(n), rank=0, num_replicas=replicas, drop_last=drop_last, skip_num_global_samples=skip
+        )
+        assert len(list(s)) == len(s)
+
+
+def test_batch_sampler_respects_drop_last():
+    inner = ResumableDistributedSampler(_Dataset(22), rank=0, num_replicas=2, drop_last=True)
+    dropped = list(BatchSampler(inner, batch_size=4, drop_last=True))
+    kept = list(BatchSampler(inner, batch_size=4, drop_last=False))
+    assert all(len(b) == 4 for b in dropped)
+    assert len(kept) == len(dropped) + 1 and len(kept[-1]) == 11 % 4
