@@ -1,0 +1,137 @@
+"""Data-tool surfaces: shuffle/chunk/filter pipelines (reference:
+tests/end2end_tests/test_shuffle_tokenized_data.py, test_shuffle_jsonl_data.py,
+test_create_shuffled_dataset_chunk.py, test_create_filtered_tokenized_dataset.py —
+the `modalities data` CLI subcommands these back had no behavior tests here)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from modalities_tpu.api import (
+    FileExistencePolicy,
+    create_shuffled_dataset_chunk,
+    create_shuffled_jsonl_dataset_chunk,
+    filter_tokenized_dataset,
+    shuffle_jsonl_data,
+    shuffle_tokenized_data,
+)
+from modalities_tpu.dataloader.packed_data import EmbeddedStreamData, write_pbin_file
+
+
+def _docs_of(path):
+    stream = EmbeddedStreamData(path)
+    out = []
+    for offset, length in stream.index_base:
+        out.append(
+            np.frombuffer(stream.data, dtype=np.dtype(np.uint16).newbyteorder("<"),
+                          count=length // 2, offset=offset).tolist()
+        )
+    return out
+
+
+def _write(path, docs):
+    write_pbin_file(path, (np.asarray(d) for d in docs), 2)
+    return path
+
+
+def test_shuffle_tokenized_data_permutes_and_preserves_documents(tmp_path):
+    docs = [[i] * (i + 1) for i in range(20)]  # distinguishable, ragged lengths
+    src = _write(tmp_path / "in.pbin", docs)
+    dst = tmp_path / "out.pbin"
+    shuffle_tokenized_data(src, dst, batch_size=4, seed=13)
+    shuffled = _docs_of(dst)
+    assert sorted(map(tuple, shuffled)) == sorted(map(tuple, docs))  # same multiset
+    assert list(map(tuple, shuffled)) != list(map(tuple, docs))  # actually permuted
+    # same seed reproduces the same order; different seed does not
+    shuffle_tokenized_data(src, tmp_path / "again.pbin", batch_size=4, seed=13)
+    assert _docs_of(tmp_path / "again.pbin") == shuffled
+    shuffle_tokenized_data(src, tmp_path / "other.pbin", batch_size=4, seed=14)
+    assert _docs_of(tmp_path / "other.pbin") != shuffled
+
+
+def test_shuffle_tokenized_data_respects_existence_policy(tmp_path):
+    # enough docs that different seeds virtually surely produce different orders
+    src = _write(tmp_path / "in.pbin", [[i] * 2 for i in range(16)])
+    dst = tmp_path / "out.pbin"
+    shuffle_tokenized_data(src, dst, seed=1)
+    before = dst.read_bytes()
+    with pytest.raises(ValueError, match="already exists"):
+        shuffle_tokenized_data(src, dst, seed=2, file_existence_policy=FileExistencePolicy.ERROR)
+    shuffle_tokenized_data(src, dst, seed=2, file_existence_policy=FileExistencePolicy.SKIP)
+    assert dst.read_bytes() == before  # skip left the original untouched
+    shuffle_tokenized_data(src, dst, seed=2, file_existence_policy=FileExistencePolicy.OVERRIDE)
+    after = dst.read_bytes()
+    assert after != before  # override actually rewrote with the new seed's order
+    ref = tmp_path / "ref.pbin"
+    shuffle_tokenized_data(src, ref, seed=2)
+    assert after == ref.read_bytes()
+
+
+def test_shuffle_jsonl_data_permutes_lines(tmp_path):
+    src = tmp_path / "in.jsonl"
+    rows = [json.dumps({"text": f"doc {i}"}) for i in range(50)]
+    src.write_text("\n".join(rows) + "\n")
+    dst = tmp_path / "out.jsonl"
+    shuffle_jsonl_data(src, dst, seed=7)
+    out_rows = [line for line in dst.read_text().splitlines() if line]
+    assert sorted(out_rows) == sorted(rows)
+    assert out_rows != rows
+
+
+def test_shuffled_dataset_chunks_partition_the_corpus(tmp_path):
+    """Chunks over multiple pbin files must partition the full document multiset:
+    disjoint, exhaustive, and deterministic under global_seed."""
+    files = []
+    all_docs = []
+    for f in range(3):
+        docs = [[f * 100 + i] * 3 for i in range(10)]
+        all_docs += docs
+        files.append(_write(tmp_path / f"part{f}.pbin", docs))
+
+    num_chunks = 4
+    chunks = []
+    for cid in range(num_chunks):
+        out = tmp_path / f"chunk{cid}.pbin"
+        create_shuffled_dataset_chunk(files, out, cid, num_chunks, global_seed=5)
+        chunks.append(_docs_of(out))
+    flat = [tuple(d) for c in chunks for d in c]
+    assert sorted(flat) == sorted(map(tuple, all_docs))
+    assert len(flat) == len(set(flat))
+
+    redo = tmp_path / "chunk0_redo.pbin"
+    create_shuffled_dataset_chunk(files, redo, 0, num_chunks, global_seed=5,
+                                  file_existence_policy=FileExistencePolicy.OVERRIDE)
+    assert _docs_of(redo) == chunks[0]
+
+
+def test_shuffled_jsonl_chunks_partition_the_corpus(tmp_path):
+    from modalities_tpu.api import create_raw_data_index
+
+    files = []
+    all_rows = []
+    for f in range(2):
+        rows = [json.dumps({"text": f"file{f} doc{i}"}) for i in range(9)]
+        all_rows += rows
+        p = tmp_path / f"part{f}.jsonl"
+        p.write_text("\n".join(rows) + "\n")
+        create_raw_data_index(p, tmp_path / f"part{f}.idx")  # the tool reads via the line index
+        files.append(p)
+    chunks = []
+    for cid in range(3):
+        out = tmp_path / f"chunk{cid}.jsonl"
+        create_shuffled_jsonl_dataset_chunk(files, out, cid, 3, global_seed=11)
+        chunks.append([line for line in out.read_text().splitlines() if line])
+    flat = [r for c in chunks for r in c]
+    assert sorted(flat) == sorted(all_rows)
+
+
+def test_filter_tokenized_dataset_keeps_selected_documents(tmp_path):
+    docs = [[i, i, i] for i in range(12)]
+    src = _write(tmp_path / "in.pbin", docs)
+    dst = tmp_path / "out.pbin"
+    filter_tokenized_dataset(src, dst, filter_routine=lambda idx: idx % 3 == 0)
+    kept = _docs_of(dst)
+    assert [d[0] for d in kept] == [0, 3, 6, 9]
+    # byte-format round-trip: the filtered file is itself a valid pbin
+    assert EmbeddedStreamData(dst).token_size_in_bytes == 2

@@ -69,13 +69,16 @@ def test_resume_skip_equals_tail_of_uninterrupted_stream():
 
 
 def test_shuffle_varies_by_epoch_and_seed_only():
+    # non-aliased (seed, epoch) pairs: the stream is seeded by seed + epoch
+    # (samplers.py), so (1, 1) and (2, 0) would be the SAME stream — use values
+    # whose sums all differ to get three genuinely distinct comparisons
     ds = _Dataset(40)
     base = list(ResumableDistributedSampler(ds, rank=0, num_replicas=2, shuffle=True, seed=1, epoch=0))
     again = list(ResumableDistributedSampler(ds, rank=0, num_replicas=2, shuffle=True, seed=1, epoch=0))
-    other_epoch = list(ResumableDistributedSampler(ds, rank=0, num_replicas=2, shuffle=True, seed=1, epoch=1))
-    other_seed = list(ResumableDistributedSampler(ds, rank=0, num_replicas=2, shuffle=True, seed=2, epoch=0))
+    other_epoch = list(ResumableDistributedSampler(ds, rank=0, num_replicas=2, shuffle=True, seed=1, epoch=2))
+    other_seed = list(ResumableDistributedSampler(ds, rank=0, num_replicas=2, shuffle=True, seed=5, epoch=0))
     assert base == again
-    assert base != other_epoch and base != other_seed
+    assert base != other_epoch and base != other_seed and other_epoch != other_seed
 
 
 def test_invalid_rank_rejected():
