@@ -283,24 +283,40 @@ def apply_rope(x, cos, sin):
     return x * cos + _rotate_half(x) * sin
 
 
-def masked_attention(q, k, v, mask):
+def masked_attention(q, k, v, mask, dropout_rate: float = 0.0, dropout_rng=None):
     """einsum + fp32 softmax attention with an explicit [Sq, Sk] boolean mask.
-    q: [B,Sq,Hq,D], k/v: [B,Sk,Hkv,D]; GQA convention: q head h uses kv head h // group."""
+    q: [B,Sq,Hq,D], k/v: [B,Sk,Hkv,D]; GQA convention: q head h uses kv head h // group.
+
+    `dropout_rate` > 0 applies inverted dropout to the attention *probabilities*
+    (the reference semantic: manual_scaled_dot_product_attention / SDPA `dropout_p`,
+    reference gpt2_model.py:595-658) — NOT to the attention output."""
     b, sq, hq, d = q.shape
     hkv = k.shape[2]
     group = hq // hkv
     qg = q.reshape(b, sq, hkv, group, d)
     logits = jnp.einsum("bshgd,bthd->bhgst", qg, k).astype(jnp.float32) / math.sqrt(d)
     logits = jnp.where(mask[None, None, None, :, :], logits, jnp.finfo(jnp.float32).min)
-    probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
+    probs = jax.nn.softmax(logits, axis=-1)
+    if dropout_rate > 0.0:
+        if dropout_rng is None:
+            raise ValueError(
+                "masked_attention: dropout_rate > 0 requires dropout_rng — refusing "
+                "to silently skip attention-probability dropout"
+            )
+        keep = jax.random.bernoulli(dropout_rng, 1.0 - dropout_rate, probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - dropout_rate), 0.0)
+    probs = probs.astype(v.dtype)
     out = jnp.einsum("bhgst,bthd->bshgd", probs, v)
     return out.reshape(b, sq, hq, d)
 
 
-def manual_attention(q, k, v):
+def manual_attention(q, k, v, dropout_rate: float = 0.0, dropout_rng=None):
     """Oracle attention: causal mask over a square sequence (reference :595-658)."""
     s = q.shape[1]
-    return masked_attention(q, k, v, jnp.tril(jnp.ones((s, s), dtype=bool)))
+    return masked_attention(
+        q, k, v, jnp.tril(jnp.ones((s, s), dtype=bool)),
+        dropout_rate=dropout_rate, dropout_rng=dropout_rng,
+    )
 
 
 def sdpa_attention(q, k, v):
@@ -369,13 +385,40 @@ class CausalSelfAttention(nn.Module):
         k = with_logical_constraint(k, ("batch", "seq", "kv_heads", "head_dim"), spec)
 
         impl = spec.attention_impl
+        # attention-probability dropout (reference gpt2_model.py:595-658: every tier
+        # passes `dropout` into the attention itself — manual attn_dropout(att) /
+        # SDPA+flash dropout_p). The unfused path implements it exactly; the Pallas
+        # flash kernel and the ring do not sample inside the kernel, so they refuse
+        # rather than silently training a different model (docs/components.md §2.4).
+        attn_dropout_active = spec.dropout > 0.0 and not self.deterministic
         if spec.context_parallel_axis is not None:
+            if attn_dropout_active:
+                raise NotImplementedError(
+                    "attention-probability dropout (dropout > 0) is not implemented for "
+                    "ring attention (context parallelism): the ring merges per-chunk "
+                    "softmax statistics that dropout would invalidate. Set dropout: 0.0 "
+                    "or run without a cp mesh axis."
+                )
             # real context parallelism: ring attention over the cp axis (the slot the
             # reference leaves unfilled, SURVEY.md §5.7)
             from modalities_tpu.parallel.ring_attention import ring_attention
             from modalities_tpu.running_env.device_mesh import current_mesh
 
             y = ring_attention(q, k, v, current_mesh(), axis_name=spec.context_parallel_axis)
+        elif attn_dropout_active:
+            if impl == AttentionImplementation.DAO_FLASH.value:
+                raise NotImplementedError(
+                    "attention-probability dropout (dropout > 0) is not implemented in "
+                    "the dao_flash Pallas kernel. Use attention_implementation: manual "
+                    "or pytorch_flash (both apply the reference's attention-weight "
+                    "dropout semantics), or set dropout: 0.0."
+                )
+            # manual AND pytorch_flash: the reference applies dropout_p inside SDPA;
+            # the fused XLA SDPA has no dropout hook, so both tiers drop to the exact
+            # unfused path — same math, probabilities dropped out as the reference does
+            y = manual_attention(
+                q, k, v, dropout_rate=spec.dropout, dropout_rng=self.make_rng("dropout")
+            )
         elif impl == AttentionImplementation.MANUAL.value:
             y = manual_attention(q, k, v)
         elif impl == AttentionImplementation.DAO_FLASH.value:
@@ -429,8 +472,10 @@ class CausalSelfAttention(nn.Module):
         return self._project_out(x, y)
 
     def _project_out(self, x, y):
+        # no dropout on y here: the reference drops attention *probabilities* inside
+        # the attention op (handled in __call__) and residuals after c_proj — never
+        # the raw attention output (reference gpt2_model.py:676 resid_dropout(c_proj))
         spec = self.spec
-        y = nn.Dropout(rate=spec.dropout)(y, deterministic=self.deterministic or spec.dropout == 0.0)
         out = nn.DenseGeneral(
             features=spec.n_embd,
             axis=(-2, -1),

@@ -172,6 +172,21 @@ class VisionTransformer(NNModel):
         img_size = (img_size, img_size) if isinstance(img_size, int) else tuple(img_size)
         self.img_size = img_size
         self.n_img_channels = n_img_channels
+        if ffn_hidden is None and n_embd != 768:
+            # ADVICE r4: before round 4 the unset default was 4*n_embd; it is now the
+            # reference's constructor default 3072 (the reference never forwards
+            # ffn_hidden, vision_transformer_model.py:184). For n_embd != 768 those
+            # differ, so a pre-round-4 checkpoint trained with the old default will
+            # fail to restore against this architecture — warn with the fix up front
+            # rather than letting the restore shape error explain itself.
+            from modalities_tpu.utils.logging import get_logger
+
+            get_logger(__name__).warning(
+                "VisionTransformer ffn_hidden unset with n_embd=%d: the default is "
+                "3072 (reference parity; before 2026-07 it was 4*n_embd=%d). "
+                "Checkpoints from the old default need ffn_hidden: %d set explicitly.",
+                n_embd, 4 * n_embd, 4 * n_embd,
+            )
         self._spec = {
             # unset -> 3072: the reference never forwards ffn_hidden into its
             # VisionTransformer (its config has no such field), so torch's

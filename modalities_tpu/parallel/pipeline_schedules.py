@@ -391,9 +391,13 @@ def _build_dualpipev_tables(num_stages: int, num_microbatches: int) -> ScheduleT
     signature property — in the overlap zone each device pairs a FORWARD of one
     direction (chunk) with a BACKWARD of the other direction in the same unit.
 
-    These are genuinely DISTINCT tables from `zbv` (asserted by test): the greedy
-    zbv fill pairs same-chunk F+B exclusively; this builder swaps each same-chunk
-    pairing to the opposite chunk whenever a ready forward exists there.
+    These are DISTINCT tables from `zbv` whenever the schedule has an overlap zone
+    — i.e. num_microbatches > num_stages (asserted by test): the greedy zbv fill
+    pairs same-chunk F+B exclusively; this builder swaps each same-chunk pairing to
+    the opposite chunk whenever a ready forward exists there. For M <= P no
+    same-chunk F+B overlap zone exists, the swap pass never fires, and the two
+    schedules emit byte-identical tables — a zbv-vs-dualpipev benchmark at small M
+    compares the same program with itself, not two schedules.
 
     Honest TPU cost note: dual-direction pairing exists to hide cross-device
     communication under compute in an eager multi-stream runtime (each direction's

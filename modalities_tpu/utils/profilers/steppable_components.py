@@ -8,6 +8,9 @@ from abc import ABC, abstractmethod
 import numpy as np
 
 from modalities_tpu.util import hard_sync
+from modalities_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
 
 
 class SteppableComponentIF(ABC):
@@ -40,18 +43,40 @@ class RandomDatasetBatchGenerator:
         self.min_val = min_val
         self.max_val = max_val
         self._rng = np.random.default_rng(seed)
+        self._warned_caps: set = set()
 
-    def get_batch(self, num_microbatches: int = 1) -> dict:
+    def _capped(self, configured: int, vocab_cap: int | None, field: str) -> int:
+        """Clamp the token draw ceiling to the profiled model's vocab, warning once
+        per distinct clamp (not per profiled step — get_batch runs in the hot loop)."""
+        if vocab_cap is None or configured <= vocab_cap:
+            return configured
+        key = (field, configured, vocab_cap)
+        if key not in self._warned_caps:
+            self._warned_caps.add(key)
+            logger.warning(
+                "batch generator %s=%d exceeds the profiled model's vocab_size=%d; "
+                "clamping token draws to the model vocab",
+                field, configured, vocab_cap,
+            )
+        return vocab_cap
+
+    def get_batch(self, num_microbatches: int = 1, vocab_cap: int | None = None) -> dict:
+        """Token batches for the step drivers. `vocab_cap` (the profiled model's
+        vocab_size, when the caller knows it) clamps the draw range: the dims-style
+        defaults (max_val=256) have no relation to the model, and out-of-range ids
+        would silently clamp inside jnp.take — profiling a distorted embedding
+        access pattern instead of failing or correcting."""
         if self.dims is not None:
             # dims-style: derive token batches from the declared batch/seq sizes
             size = tuple(self.dims.values())
             batch, seq = size[0], size[1] if len(size) > 1 else self.sequence_length
-            tokens = self._rng.integers(
-                self.min_val, self.max_val, size=(num_microbatches, batch, seq + 1)
-            )
+            hi = self._capped(self.max_val, vocab_cap, "max_val")
+            lo = min(self.min_val, hi - 1)
+            tokens = self._rng.integers(lo, hi, size=(num_microbatches, batch, seq + 1))
         else:
+            hi = self._capped(self.vocab_size, vocab_cap, "vocab_size")
             tokens = self._rng.integers(
-                0, self.vocab_size,
+                0, hi,
                 size=(num_microbatches, self.micro_batch_size, self.sequence_length + 1),
             )
         return {
@@ -110,17 +135,23 @@ class SteppableForwardPass(SteppableComponentIF):
             self._step_functions = self._step_functions_thunk()
         return self._step_functions
 
+    def _model_vocab(self) -> int | None:
+        spec = getattr(getattr(self.step_functions.app_state_handle, "model", None), "config_spec", None)
+        return getattr(spec, "vocab_size", None)
+
     def step(self) -> None:
         handle = self.step_functions.app_state_handle
         if self.include_backward:
             # train_step scans over the leading accumulation dim
-            raw = self.batch_generator.get_batch(self.gradient_accumulation_steps)
+            raw = self.batch_generator.get_batch(
+                self.gradient_accumulation_steps, vocab_cap=self._model_vocab()
+            )
             batch = self.step_functions.put_batch(raw)
             handle.state, metrics = self.step_functions.train_step(handle.state, batch)
             hard_sync(metrics["loss"])
         else:
             # eval_step takes a flat (batch, seq) micro-batch
-            raw = self.batch_generator.get_batch(1)
+            raw = self.batch_generator.get_batch(1, vocab_cap=self._model_vocab())
             flat = {
                 "samples": {k: v[0] for k, v in raw["samples"].items()},
                 "targets": {k: v[0] for k, v in raw["targets"].items()},

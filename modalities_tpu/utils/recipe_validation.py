@@ -46,13 +46,31 @@ def _per_device_bytes(abstract_leaf, sharding) -> int:
     return int(np.prod(shape, dtype=np.int64)) * itemsize if shape else itemsize
 
 
-def _tree_per_device_bytes(abstract_tree, sharding_tree) -> int:
+def _matched_shardings(abstract_tree, sharding_tree, caveats: Optional[list] = None) -> tuple:
+    """(state leaves, sharding leaves) with matching lengths. On a leaf-count
+    mismatch (a sharding tree that collapsed Nones) every leaf is treated as
+    REPLICATED — which can inflate per-chip bytes by up to world_size x and wrongly
+    fail the budget check — so the fallback is surfaced, never silent."""
     import jax
+    import warnings
 
     leaves = jax.tree.leaves(abstract_tree)
     shardings = jax.tree.leaves(sharding_tree) if sharding_tree is not None else [None] * len(leaves)
-    if len(shardings) != len(leaves):  # sharding tree may collapse Nones
+    if len(shardings) != len(leaves):
+        msg = (
+            f"sharding tree has {len(shardings)} leaves but the state tree has "
+            f"{len(leaves)}: treating every leaf as REPLICATED, which can inflate "
+            "per-chip bytes by up to world_size x and wrongly fail the budget check"
+        )
+        if caveats is not None:
+            caveats.append(msg)
+        warnings.warn(msg, stacklevel=2)
         shardings = [None] * len(leaves)
+    return leaves, shardings
+
+
+def _tree_per_device_bytes(abstract_tree, sharding_tree, caveats: Optional[list] = None) -> int:
+    leaves, shardings = _matched_shardings(abstract_tree, sharding_tree, caveats)
     return sum(_per_device_bytes(x, s) for x, s in zip(leaves, shardings))
 
 
@@ -70,7 +88,23 @@ def _estimate_activation_bytes(model, mesh_handle, step_profile) -> dict:
     The lm head adds b*s_l*vocab/tp fp32 logits UNLESS lm_head_chunk_size caps it at
     b*chunk*vocab/tp.
     """
-    spec = model.config_spec
+    spec = getattr(model, "config_spec", None)
+    required = ("n_embd", "n_layer", "vocab_size", "activation", "ffn_hidden")
+    if spec is None or any(not hasattr(spec, a) for a in required):
+        # validating a non-GPT2 recipe (CoCa/ViT/...): state bytes are still exact,
+        # but the activation formula is GPT2LLM-specific — report that clearly
+        # instead of crashing mid-report with an AttributeError
+        return {
+            "remat_mode": None,
+            "layer_activation_bytes": 0,
+            "lm_head_bytes": 0,
+            "total": 0,
+            "unavailable": (
+                f"activation estimate unavailable for model family "
+                f"{type(model).__name__}: the formula is GPT2LLM-specific; "
+                "per-chip totals below cover params/optimizer/gradients only"
+            ),
+        }
     degrees = mesh_handle.degrees
     tp = max(1, degrees.get("tp", 1))
     cp = max(1, degrees.get("cp", 1))
@@ -189,15 +223,21 @@ def validate_recipe(
     # --- exact per-chip state bytes from the shardings
     state = fns.app_state_handle.state
     shardings = fns.app_state_handle.state_shardings
-    params_pd = _tree_per_device_bytes(state.params, shardings.params)
-    opt_pd = _tree_per_device_bytes(state.opt_state, shardings.opt_state)
-    # gradients mirror the param shardings; accumulated in reduce_dtype (fp32)
+    budget_warnings: list = []
+    params_pd = _tree_per_device_bytes(state.params, shardings.params, budget_warnings)
+    opt_pd = _tree_per_device_bytes(state.opt_state, shardings.opt_state, budget_warnings)
+    # gradients mirror the param shardings; accumulated in reduce_dtype (fp32).
+    # Same length-matched pairing as the byte counts: a collapsed sharding tree must
+    # fall back to replicated counting, not zip-truncate leaves to grads_pd=0
+    param_leaves, param_shardings = _matched_shardings(state.params, shardings.params)
     param_count_pd = sum(
         int(np.prod(s.shard_shape(tuple(x.shape)) if hasattr(s, "shard_shape") else x.shape))
-        for x, s in zip(jax.tree.leaves(state.params), jax.tree.leaves(shardings.params))
+        for x, s in zip(param_leaves, param_shardings)
     )
     grads_pd = param_count_pd * 4
     act = _estimate_activation_bytes(model, mesh_handle, step_profile)
+    if "unavailable" in act:  # surface through the same channel as budget caveats
+        budget_warnings.append(act["unavailable"])
     total_pd = params_pd + opt_pd + grads_pd + act["total"]
 
     num_params = sum(int(np.prod(x.shape)) for x in jax.tree.leaves(state.params))
@@ -218,6 +258,8 @@ def validate_recipe(
         "hbm_budget_bytes": int(hbm_budget_bytes),
         "fits_budget": bool(total_pd < hbm_budget_bytes),
     }
+    if budget_warnings:
+        report["warnings"] = budget_warnings
     return report
 
 

@@ -212,3 +212,88 @@ def test_selective_layer_remat_honored_on_unrolled_blocks():
     )
     with pytest.raises(ValueError, match="scan_layers=False"):
         scanned.init_params(jax.random.PRNGKey(0))
+
+
+# --------------------------------------------------------- attention-prob dropout
+
+
+def test_masked_attention_dropout_is_on_probabilities():
+    """Reference semantics (gpt2_model.py:595-658): dropout hits the attention
+    *probabilities* (inverted: survivors scaled by 1/(1-p)), not the output.
+    With v = identity basis the attention output IS the probability row, so we can
+    observe the dropped entries directly: each is either 0 or probs/(1-p), and the
+    empirical drop fraction matches p."""
+    from modalities_tpu.models.gpt2.gpt2_model import masked_attention
+
+    b, s, h = 2, 16, 2
+    d = s  # v one-hot basis: out[b,i,h,:] == dropped-out probs row i
+    rng = jax.random.PRNGKey(0)
+    q = jax.random.normal(rng, (b, s, h, d))
+    k = jax.random.normal(jax.random.fold_in(rng, 1), (b, s, h, d))
+    v = jnp.broadcast_to(jnp.eye(s)[None, :, None, :], (b, s, h, d))
+    mask = jnp.tril(jnp.ones((s, s), dtype=bool))
+
+    p = 0.5
+    probs = np.asarray(masked_attention(q, k, v, mask))  # no dropout: plain probs
+    dropped = np.asarray(masked_attention(q, k, v, mask, p, jax.random.PRNGKey(7)))
+
+    # every entry is 0 or the scaled probability — output-dropout can't produce this
+    causal = np.tril(np.ones((s, s), dtype=bool))[None, :, None, :]
+    scaled = probs / (1 - p)
+    is_zero = np.isclose(dropped, 0.0, atol=1e-7)
+    is_scaled = np.isclose(dropped, scaled, rtol=1e-5, atol=1e-7)
+    assert np.all(is_zero | is_scaled)
+    # drop fraction over the causal support ~ p (binomial, n = b*h*s*(s+1)/2 = 544)
+    n_support = causal.sum() * b * h
+    frac = (is_zero & causal).sum() / n_support
+    assert 0.35 < frac < 0.65, f"drop fraction {frac} far from p={p}"
+    # unbiased in expectation: mean over many masks approaches the undropped probs.
+    # Worst-case element is a prob-1.0 entry: per-draw values {0, 2}, so the mean of
+    # n_rep=300 draws has sigma = 2*sqrt(.25/300) ~ 0.058; bound the max element at
+    # ~4.3 sigma (0.25) and the average error (1024 elements) much tighter.
+    acc = np.zeros_like(probs)
+    n_rep = 300
+    for i in range(n_rep):
+        acc += np.asarray(masked_attention(q, k, v, mask, p, jax.random.PRNGKey(100 + i)))
+    err = np.abs(acc / n_rep - probs)
+    assert err.max() < 0.25, f"max bias {err.max()}"
+    assert err.mean() < 0.02, f"mean bias {err.mean()}"
+
+
+def test_manual_and_sdpa_tiers_share_attn_dropout_path():
+    """With dropout active, manual and pytorch_flash produce IDENTICAL logits under
+    the same rng (both lower to the unfused attn-prob-dropout path — the fused SDPA
+    has no dropout hook), and train-mode != eval-mode."""
+    tokens = {"input_ids": jnp.asarray(np.random.default_rng(3).integers(0, 128, (2, 16)), jnp.int32)}
+    m_manual = tiny_gpt2("manual", dropout=0.3)
+    m_sdpa = tiny_gpt2("pytorch_flash", dropout=0.3)
+    params = m_manual.init_params(jax.random.PRNGKey(0))
+
+    r = {"dropout": jax.random.PRNGKey(5)}
+    o_manual = m_manual.apply(params, tokens, train=True, rngs=r)["logits"]
+    o_sdpa = m_sdpa.apply(params, tokens, train=True, rngs=r)["logits"]
+    np.testing.assert_array_equal(np.asarray(o_manual), np.asarray(o_sdpa))
+
+    o_eval = m_manual.apply(params, tokens)["logits"]
+    assert not np.allclose(np.asarray(o_manual), np.asarray(o_eval), atol=1e-4)
+
+
+def test_dao_flash_rejects_attn_dropout():
+    """The Pallas kernel does not sample inside the kernel: training with dropout > 0
+    on dao_flash must fail loudly with a pointer to the supported tiers, not silently
+    train a different model (VERDICT r4 weak #3)."""
+    m = tiny_gpt2("dao_flash", dropout=0.1)
+    params = m.init_params(jax.random.PRNGKey(0))  # init is deterministic: fine
+    tokens = {"input_ids": jnp.zeros((1, 16), jnp.int32)}
+    with pytest.raises(NotImplementedError, match="manual"):
+        m.apply(params, tokens, train=True, rngs={"dropout": jax.random.PRNGKey(0)})
+
+
+def test_ring_attention_rejects_attn_dropout():
+    """cp + dropout > 0: actionable rejection (the ring merges softmax stats that
+    per-chunk dropout would invalidate)."""
+    m = tiny_gpt2("manual", dropout=0.1).with_spec_updates(context_parallel_axis="cp")
+    params = tiny_gpt2("manual", dropout=0.1).init_params(jax.random.PRNGKey(0))
+    tokens = {"input_ids": jnp.zeros((1, 16), jnp.int32)}
+    with pytest.raises(NotImplementedError, match="dropout: 0.0"):
+        m.apply(params, tokens, train=True, rngs={"dropout": jax.random.PRNGKey(0)})
