@@ -188,11 +188,15 @@ def peak_flops_per_chip() -> float:
 # per-step overheads and flash attention's causal-block skipping pays off).
 _TPU_CANDIDATES = [
     # (name, n_layer, n_embd, n_head, ffn, seq, mb, attn_impl, param_dtype, remat[, chunk])
-    # 80k: untested on hardware (the chip was wedged all of round 4) but the
-    # context ladder rose monotonically to 0.688 @ 64k and 96k OOMs — worth one
-    # compile attempt; the OOM step-down falls back to the verified 64k leader
-    ("680m_80k_flash_chunked", 24, 1536, 12, 6144, 81920, 1, "dao_flash", "bfloat16", "full", 2048),
+    # LEADER FIRST (VERDICT r4 weak #7): a hardware window's first minutes must
+    # re-verify the 64k leader with the current timing code — the 0.382-vs-0.6882
+    # conflict (BENCH_r02 vs the builder scoreboard) is resolved by whatever this
+    # entry measures, so it cannot sit behind an untested compile attempt.
     ("680m_64k_flash_chunked", 24, 1536, 12, 6144, 65536, 1, "dao_flash", "bfloat16", "full", 2048),
+    # 80k: untested on hardware (the chip was wedged all of rounds 3-4) but the
+    # context ladder rose monotonically to 0.688 @ 64k and 96k OOMs — worth one
+    # compile attempt AFTER the leader re-time; never-lower guard applies
+    ("680m_80k_flash_chunked", 24, 1536, 12, 6144, 81920, 1, "dao_flash", "bfloat16", "full", 2048),
     ("680m_32k_flash_chunked", 24, 1536, 12, 6144, 32768, 1, "dao_flash", "bfloat16", "full", 2048),
     ("1.3b_16k_flash_chunked", 24, 2048, 16, 8192, 16384, 1, "dao_flash", "bfloat16", "full", 2048),
     ("1.3b_flash_mb8", 24, 2048, 16, 8192, 2048, 8, "dao_flash", "bfloat16", "full"),
@@ -314,7 +318,9 @@ def _run_candidate(cand, iters: int):
     # the driver's scoreboard is whatever number we print. Repeat the measurement,
     # take the median iteration of the BEST repeat (a degraded window only ever
     # slows iterations down), and rerun when a repeat's spread looks degraded.
-    repeats = int(os.environ.get("BENCH_REPEATS", "2" if dev.platform == "tpu" else "1"))
+    # default 3 TPU repeats (VERDICT r4 #1: the leader re-time needs >=2 repeats
+    # agreeing within tolerance to count as reproduced; 3 gives one to spare)
+    repeats = int(os.environ.get("BENCH_REPEATS", "3" if dev.platform == "tpu" else "1"))
     variance_tol = float(os.environ.get("BENCH_VARIANCE_TOL", "0.10"))
     max_extra_repeats = 2
 
@@ -388,9 +394,42 @@ def _run_candidate(cand, iters: int):
             # the MFU value is a CI placeholder, not a hardware result — the
             # last_verified_tpu block carries the best known-good measurement
             "tpu_unreachable": not on_tpu,
-            **({} if on_tpu else {"last_verified_tpu": LAST_VERIFIED_TPU}),
+            **(
+                {"calibration_matmul_tflops": _calibration_matmul_tflops()}
+                if on_tpu
+                else {"last_verified_tpu": LAST_VERIFIED_TPU}
+            ),
         },
     }
+
+
+def _calibration_matmul_tflops(repeats: int = 3):
+    """Pure bf16 8192^3 matmul TFLOP/s (host-transfer sync) — the chip-health anchor
+    that makes an MFU number auditable: a healthy v5e measures ~87% of its 197
+    TFLOP/s peak on this op (verified 2026-07-29), so a low MFU alongside a healthy
+    calibration indicts the program, while both low indicts the chip/relay window.
+    Persisted into the BENCH line per VERDICT r4 weak #1 (the 0.6882 claim could not
+    be audited because no calibration was stored with it)."""
+    import jax
+    import jax.numpy as jnp
+
+    from modalities_tpu.util import hard_sync
+
+    try:
+        n = 8192
+        x = jnp.ones((n, n), jnp.bfloat16)
+        f = jax.jit(lambda a: (a @ a)[0, 0])
+        hard_sync(f(x))  # compile + warm
+        best = None
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            hard_sync(f(x))
+            dt = time.perf_counter() - t0
+            best = dt if best is None else min(best, dt)
+        return round(2 * n**3 / best / 1e12, 1)
+    except Exception as exc:  # calibration must never take the bench down
+        print(f"bench: calibration matmul failed: {exc}", file=sys.stderr)
+        return None
 
 
 def _is_oom(exc: BaseException) -> bool:
@@ -457,10 +496,45 @@ def main() -> None:
             return
         raise RuntimeError("all bench candidates failed:\n" + "\n".join(errors))
 
-    # exploration guard: if an untested exploratory candidate won the ladder but
-    # scored BELOW the verified leader's number, also time the known-leader config
-    # and keep the better run — first-success must never lower the scoreboard
-    if on_tpu and pin is None and result["value"] < LAST_VERIFIED_TPU["mfu"]:
+    # exploration step: the ladder is leader-first, so a successful leader run stops
+    # before the exploratory 80k head. Spend the remaining window on ONE exploration
+    # attempt and keep the better number — the leader result is already in hand, so
+    # a failed/slow exploration can no longer cost the round its hardware datapoint.
+    leader_timed_this_run = result["detail"].get("config") == LAST_VERIFIED_TPU["name"]
+    if on_tpu and pin is None and leader_timed_this_run:
+        explore = next((c for c in candidates if c[0] == "680m_80k_flash_chunked"), None)
+        if explore is not None:
+            print("bench: leader timed; trying exploratory 80k head", file=sys.stderr)
+            try:
+                alt = _run_candidate(explore, iters)
+                if alt["value"] > result["value"]:
+                    # the fresh leader number is the round's key evidence (it resolves
+                    # the 0.382-vs-0.6882 conflict) — carry it even when 80k wins
+                    alt["detail"]["leader_rerun"] = {
+                        "config": result["detail"].get("config"),
+                        "value": result["value"],
+                        "tokens_per_sec": result["detail"].get("tokens_per_sec"),
+                        "repeats_s": result["detail"].get("repeats_s"),
+                    }
+                    result = alt
+                else:
+                    result["detail"]["exploration"] = {
+                        "config": explore[0],
+                        "value": alt["value"],
+                        "outcome": "slower than leader; kept leader",
+                    }
+            except Exception as exc:  # noqa: BLE001 — keep the leader result
+                print(f"bench: 80k exploration failed ({exc}); keeping leader", file=sys.stderr)
+                result["detail"]["exploration"] = {
+                    "config": explore[0],
+                    "outcome": f"failed: {type(exc).__name__}: {str(exc)[:160]}",
+                }
+
+    # never-lower guard: if an exploratory candidate won the ladder because the
+    # LEADER FAILED earlier (never when the leader was already timed above — a
+    # third run would waste the window) and scored below the verified number,
+    # also time the known leader config and keep the better run
+    if on_tpu and pin is None and not leader_timed_this_run and result["value"] < LAST_VERIFIED_TPU["mfu"]:
         leader_name = LAST_VERIFIED_TPU["name"]
         leader = next((c for c in candidates if c[0] == leader_name), None)
         leader_already_failed = any(e.startswith(f"{leader_name}:") for e in errors)

@@ -34,12 +34,11 @@ def flash_attention_or_fallback(q, k, v, causal: bool = True, sm_scale: float | 
     tiles still fit VMEM comfortably (4 MB)."""
     global _warned
     if _on_tpu():
-        import os
-
         # parsed outside the fallback guard: a malformed override must raise, not
         # silently demote every attention call to the SDPA tier
-        block_q = int(os.environ.get("MODALITIES_TPU_FLASH_BLOCK_Q", "1024"))
-        block_k = int(os.environ.get("MODALITIES_TPU_FLASH_BLOCK_K", "1024"))
+        from modalities_tpu.ops.pallas.flash_attention import env_flash_blocks
+
+        block_q, block_k = env_flash_blocks(q.shape[1], k.shape[1])
         try:
             from modalities_tpu.ops.pallas.flash_attention import pallas_flash_attention
 

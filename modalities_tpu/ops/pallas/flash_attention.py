@@ -183,6 +183,19 @@ def _pick_block(seq: int, preferred: int) -> int:
     return seq
 
 
+def env_flash_blocks(seq_q: int, seq_k: int) -> tuple[int, int]:
+    """The (block_q, block_k) tuning knobs, shared by every kernel consumer
+    (ops/attention.py dispatch, the ring tier): MODALITIES_TPU_FLASH_BLOCK_Q/_K env
+    overrides (default 1024 — see ops/attention.py for the v5e tuning evidence),
+    stepped down to divide the sequence. A malformed override raises (int()) — it
+    must never silently demote the call to a fallback tier."""
+    import os
+
+    block_q = int(os.environ.get("MODALITIES_TPU_FLASH_BLOCK_Q", "1024"))
+    block_k = int(os.environ.get("MODALITIES_TPU_FLASH_BLOCK_K", "1024"))
+    return _pick_block(seq_q, block_q), _pick_block(seq_k, block_k)
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def _flash_attention_bhsd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
     out, _ = _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret)
@@ -224,22 +237,32 @@ def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
     return out, (q, k, v, out, lse)
 
 
+def flash_fwd_out_lse(q, k, v, *, causal, sm_scale, block_q, block_k, interpret):
+    """Raw kernel forward WITH the log-sum-exp exposed: [B, H, S, D] ->
+    (out [B, H, S, D], lse [B, H, Sq, 1] fp32). (out, lse) is the information-
+    equivalent of unnormalized (o, m, l) block stats — o = out * exp(lse - m) * ...
+    collapses to this pair — and it is exactly what an online-softmax merge needs:
+    ring attention (parallel/ring_attention.py) merges per-hop (out, lse) pairs
+    across k/v rotations. No custom_vjp here: the caller owns differentiation."""
+    out, (_, _, _, _, lse) = _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret)
+    return out, lse
+
+
 def _flash_fwd_vjp(q, k, v, sm_scale, causal, block_q, block_k, interpret):
     # custom_vjp fwd receives arguments in the primal order (nondiff included in place)
     out, res = _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret)
     return out, res
 
 
-def _flash_bwd_vjp(sm_scale, causal, block_q, block_k, interpret, res, do):
-    q, k, v, out, lse = res
+def flash_bwd_dq(q, k, v, do, lse, delta, *, causal, sm_scale, block_q, block_k, interpret):
+    """dq for one (q, k, v) pairing given GLOBAL (lse, delta) — reusable by the ring
+    backward, where lse/delta come from the merged multi-hop softmax. All [B,H,S,D];
+    lse/delta [B,H,Sq,1] fp32."""
     batch, num_heads, seq_q, head_dim = q.shape
-    num_kv_heads, seq_k = k.shape[1], k.shape[2]
-    group = num_heads // num_kv_heads
+    seq_k = k.shape[2]
+    group = num_heads // k.shape[1]
 
-    # [B, H, Sq, 1] — trailing singleton lane dim (see module docstring)
-    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1, keepdims=True)
-
-    dq = pl.pallas_call(
+    return pl.pallas_call(
         functools.partial(
             _bwd_dq_kernel, sm_scale=sm_scale, causal=causal, block_q=block_q, block_k=block_k
         ),
@@ -257,6 +280,14 @@ def _flash_bwd_vjp(sm_scale, causal, block_q, block_k, interpret, res, do):
         scratch_shapes=[pltpu.VMEM((block_q, head_dim), jnp.float32)],
         interpret=interpret,
     )(q, k, v, do, lse, delta)
+
+def flash_bwd_dkv(q, k, v, do, lse, delta, *, causal, sm_scale, block_q, block_k, interpret):
+    """(dk, dv) for one (q, k, v) pairing given GLOBAL (lse, delta), GQA group-summed
+    down to the kv heads ([B, Hkv, Sk, D]). Reusable by the ring backward, where the
+    accumulators ride the k/v rotation."""
+    batch, num_heads, seq_q, head_dim = q.shape
+    num_kv_heads, seq_k = k.shape[1], k.shape[2]
+    group = num_heads // num_kv_heads
 
     # dk/dv per q-head (q blocks innermost), then summed over the GQA group
     dk_h, dv_h = pl.pallas_call(
@@ -292,7 +323,17 @@ def _flash_bwd_vjp(sm_scale, causal, block_q, block_k, interpret, res, do):
         dv = dv_h.reshape(batch, num_kv_heads, group, seq_k, head_dim).sum(axis=2)
     else:
         dk, dv = dk_h, dv_h
-    return dq, dk.astype(k.dtype), dv.astype(v.dtype)
+    return dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+def _flash_bwd_vjp(sm_scale, causal, block_q, block_k, interpret, res, do):
+    q, k, v, out, lse = res
+    # [B, H, Sq, 1] — trailing singleton lane dim (see module docstring)
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1, keepdims=True)
+    kw = dict(causal=causal, sm_scale=sm_scale, block_q=block_q, block_k=block_k, interpret=interpret)
+    dq = flash_bwd_dq(q, k, v, do, lse, delta, **kw)
+    dk, dv = flash_bwd_dkv(q, k, v, do, lse, delta, **kw)
+    return dq, dk, dv
 
 
 _flash_attention_bhsd.defvjp(_flash_fwd_vjp, _flash_bwd_vjp)

@@ -7,11 +7,16 @@ commented-out CP context). This module fills that slot TPU-first:
 - sequence dim sharded over `cp`; each device holds local q/k/v chunks
 - k/v chunks rotate around the ring via `lax.ppermute` (ICI neighbor hops) while each
   device accumulates attention for its q chunk with an online-softmax merge — peak
-  memory O(S_local^2) per device instead of O(S^2), communication fully overlappable
-- causality handled with *global position* masks (device i's chunk j contributes only
-  where q_global >= k_global), so chunks from the "future" merge as exact no-ops
-- differentiable end-to-end: the ring is plain traced JAX (ppermute + einsum), so
-  autodiff produces the reverse ring for dk/dv.
+  memory O(S_local * block) per device instead of O(S^2), communication overlappable
+- two inner-loop tiers: on TPU each hop runs the in-repo Pallas flash kernel
+  (ops/pallas/flash_attention.py) and hops merge their normalized (out, lse) pairs
+  with the flash-decoding rule; off-TPU a dense/k-blocked einsum path keeps tests
+  exact. Chunk-level causality is decided OUTSIDE the kernel (full/diagonal/skip
+  branches under lax.switch), so the kernel needs no traced position offsets.
+- differentiable end-to-end: the dense tier by plain autodiff (reverse ring derived
+  by JAX); the flash tier by an explicit custom_vjp that re-runs the ring with the
+  flash backward kernels against the global (lse, delta), with dk/dv accumulators
+  riding the k/v rotation.
 
 Composable with GQA (kv-head grouping) and remat (the block remat wraps this).
 """
@@ -27,11 +32,11 @@ import jax.numpy as jnp
 NEG_INF = -1e30
 
 
-# k-block size for the fused (flash-style) local attention: above this key length
-# the per-hop logits are computed block-by-block under lax.scan with an online-softmax
-# merge, so per-device peak memory is O(S_local * BLOCK_K) instead of O(S_local^2).
-# (The Pallas flash kernel can't serve the ring hop directly: the merge needs the
-# UNNORMALIZED (o, m, l) stats, which the kernel does not expose.)
+# k-block size for the fused (flash-style) local attention in the DENSE tier: above
+# this key length the per-hop logits are computed block-by-block under lax.scan with
+# an online-softmax merge, so per-device peak memory is O(S_local * BLOCK_K) instead
+# of O(S_local^2). On TPU the ring instead runs the Pallas flash kernel per hop
+# (the `flash` tier below), merging per-hop (out, lse) pairs.
 BLOCK_K = 1024
 
 
@@ -107,8 +112,8 @@ def _chunk_attention_stats(
     return acc, m_run, l_run
 
 
-def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool, sm_scale: float):
-    """Runs on each cp shard inside shard_map. q/k/v: [B, S_local, H(, kv), D]."""
+def _ring_dense_local(q, k, v, *, axis_name: str, causal: bool, sm_scale: float):
+    """Dense/einsum ring body (CPU and fallback tier). q/k/v: [B, S_local, H(, kv), D]."""
     cp = jax.lax.psum(1, axis_name)
     my_index = jax.lax.axis_index(axis_name)
     s_local = q.shape[1]
@@ -137,6 +142,212 @@ def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool, sm_scale: fl
 
     l_safe = jnp.maximum(l_run, 1e-30)
     return (acc / l_safe[..., None]).astype(q.dtype)
+
+
+# ------------------------------------------------------- flash-kernel ring tier
+#
+# The ring hop runs the Pallas flash kernel (ops/pallas/flash_attention.py) instead
+# of dense einsums (VERDICT r4 #5). Two design moves keep the kernel unchanged:
+#
+# 1. (out, lse) replaces unnormalized (o, m, l): the kernel's normalized output plus
+#    its log-sum-exp carry the same information (o = out * exp(lse), m+log l = lse),
+#    and two hops merge exactly with the flash-decoding rule
+#        lse' = logaddexp(lse_a, lse_b);  out' = out_a e^{lse_a-lse'} + out_b e^{lse_b-lse'}
+# 2. chunk-level causality never enters the kernel: with whole-chunk hops a (q_i, k_j)
+#    pairing is either fully visible (j < i: plain non-causal kernel), diagonal
+#    (j == i: plain causal kernel, offsets cancel), or fully masked (j > i: skip —
+#    constants, no kernel launch). The traced j-vs-i decision selects between the
+#    three compiled branches with lax.switch, so no traced offsets reach Mosaic.
+#
+# Backward is the standard ring reversal: after the forward, (lse, delta) describe
+# the GLOBAL softmax, so each hop can run the flash backward kernels blockwise
+# (p = exp(s - lse)); dk/dv accumulators ride the k/v rotation and arrive home after
+# cp hops. Differentiation is a custom_vjp over the whole per-shard ring.
+
+
+def _hop_blocks(seq_q: int, seq_k: int):
+    from modalities_tpu.ops.pallas.flash_attention import env_flash_blocks
+
+    return env_flash_blocks(seq_q, seq_k)
+
+
+def _hop_fwd(q, k, v, idx, sm_scale, interpret):
+    """One ring hop, all [B, H, S, D]: lax.switch over (full | diagonal | skip).
+    Returns (out fp32 [B,Hq,S,D], lse fp32 [B,Hq,S,1])."""
+    from modalities_tpu.ops.pallas.flash_attention import flash_fwd_out_lse
+
+    bq, bk = _hop_blocks(q.shape[2], k.shape[2])
+
+    def full(k_, v_):
+        o, lse = flash_fwd_out_lse(
+            q, k_, v_, causal=False, sm_scale=sm_scale, block_q=bq, block_k=bk, interpret=interpret
+        )
+        return o.astype(jnp.float32), lse
+
+    def diag(k_, v_):
+        o, lse = flash_fwd_out_lse(
+            q, k_, v_, causal=True, sm_scale=sm_scale, block_q=bq, block_k=bk, interpret=interpret
+        )
+        return o.astype(jnp.float32), lse
+
+    def skip(k_, v_):
+        b, hq, sq, d = q.shape
+        return (
+            jnp.zeros((b, hq, sq, d), jnp.float32),
+            jnp.full((b, hq, sq, 1), NEG_INF, jnp.float32),
+        )
+
+    return jax.lax.switch(idx, (full, diag, skip), k, v)
+
+
+def _merge_out_lse(out_a, lse_a, out_b, lse_b):
+    """Flash-decoding merge of two normalized partials. NEG_INF sentinels (not real
+    -inf) keep the arithmetic NaN-free: exp(NEG_INF - finite) underflows to 0."""
+    lse_m = jnp.maximum(lse_a, lse_b)
+    lse_new = lse_m + jnp.log(jnp.exp(lse_a - lse_m) + jnp.exp(lse_b - lse_m))
+    wa = jnp.exp(lse_a - lse_new)
+    wb = jnp.exp(lse_b - lse_new)
+    return out_a * wa + out_b * wb, lse_new
+
+
+def _branch_index(causal: bool, my_index, j_index):
+    if not causal:
+        return jnp.int32(0)
+    return jnp.where(j_index == my_index, 1, jnp.where(j_index < my_index, 0, 2)).astype(jnp.int32)
+
+
+def _ring_flash_fwd_res(q, k, v, axis_name, causal, sm_scale, interpret):
+    """[B, S, H, D] inputs -> (out [B,S,Hq,D], residuals in kernel layout)."""
+    cp = jax.lax.psum(1, axis_name)
+    my_index = jax.lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % cp) for i in range(cp)]
+
+    qt = q.transpose(0, 2, 1, 3)  # [B, Hq, S, D]
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+
+    b, hq, s, d = qt.shape
+    out_run = jnp.zeros((b, hq, s, d), jnp.float32)
+    lse_run = jnp.full((b, hq, s, 1), NEG_INF, jnp.float32)
+
+    k_cur, v_cur = kt, vt
+    for r in range(cp):
+        j_index = (my_index - r) % cp
+        o_r, lse_r = _hop_fwd(q=qt, k=k_cur, v=v_cur,
+                              idx=_branch_index(causal, my_index, j_index),
+                              sm_scale=sm_scale, interpret=interpret)
+        out_run, lse_run = _merge_out_lse(out_run, lse_run, o_r, lse_r)
+        if r != cp - 1:
+            k_cur = jax.lax.ppermute(k_cur, axis_name, perm)
+            v_cur = jax.lax.ppermute(v_cur, axis_name, perm)
+
+    out_t = out_run.astype(q.dtype)  # [B, Hq, S, D]
+    return out_t.transpose(0, 2, 1, 3), (qt, kt, vt, out_t, lse_run)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _ring_flash_local(q, k, v, axis_name, causal, sm_scale, interpret):
+    return _ring_flash_fwd_res(q, k, v, axis_name, causal, sm_scale, interpret)[0]
+
+
+def _ring_flash_vjp_fwd(q, k, v, axis_name, causal, sm_scale, interpret):
+    return _ring_flash_fwd_res(q, k, v, axis_name, causal, sm_scale, interpret)
+
+
+def _ring_flash_vjp_bwd(axis_name, causal, sm_scale, interpret, res, do):
+    from modalities_tpu.ops.pallas.flash_attention import flash_bwd_dkv, flash_bwd_dq
+
+    qt, kt, vt, out_t, lse = res
+    cp = jax.lax.psum(1, axis_name)
+    my_index = jax.lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % cp) for i in range(cp)]
+
+    do_t = do.transpose(0, 2, 1, 3).astype(qt.dtype)  # [B, Hq, S, D]
+    delta = jnp.sum(do_t.astype(jnp.float32) * out_t.astype(jnp.float32), axis=-1, keepdims=True)
+    bq, bk = _hop_blocks(qt.shape[2], kt.shape[2])
+
+    def full_hop(k_, v_):
+        kw = dict(causal=False, sm_scale=sm_scale, block_q=bq, block_k=bk, interpret=interpret)
+        dq_r = flash_bwd_dq(qt, k_, v_, do_t, lse, delta, **kw)
+        dk_r, dv_r = flash_bwd_dkv(qt, k_, v_, do_t, lse, delta, **kw)
+        return dq_r.astype(jnp.float32), dk_r.astype(jnp.float32), dv_r.astype(jnp.float32)
+
+    def diag_hop(k_, v_):
+        kw = dict(causal=True, sm_scale=sm_scale, block_q=bq, block_k=bk, interpret=interpret)
+        dq_r = flash_bwd_dq(qt, k_, v_, do_t, lse, delta, **kw)
+        dk_r, dv_r = flash_bwd_dkv(qt, k_, v_, do_t, lse, delta, **kw)
+        return dq_r.astype(jnp.float32), dk_r.astype(jnp.float32), dv_r.astype(jnp.float32)
+
+    def skip_hop(k_, v_):
+        return (
+            jnp.zeros(qt.shape, jnp.float32),
+            jnp.zeros(k_.shape, jnp.float32),
+            jnp.zeros(v_.shape, jnp.float32),
+        )
+
+    dq_total = jnp.zeros(qt.shape, jnp.float32)
+    # dk/dv accumulators ride the rotation with their chunk; after cp rotations the
+    # chunk (and its fully-accumulated gradient) is back on its home device
+    k_cur, v_cur = kt, vt
+    dk_cur = jnp.zeros(kt.shape, jnp.float32)
+    dv_cur = jnp.zeros(vt.shape, jnp.float32)
+
+    for r in range(cp):
+        j_index = (my_index - r) % cp
+        idx = _branch_index(causal, my_index, j_index)
+        dq_r, dk_r, dv_r = jax.lax.switch(idx, (full_hop, diag_hop, skip_hop), k_cur, v_cur)
+        dq_total = dq_total + dq_r
+        dk_cur = dk_cur + dk_r
+        dv_cur = dv_cur + dv_r
+        if r != cp - 1:
+            k_cur, v_cur, dk_cur, dv_cur = (
+                jax.lax.ppermute(x, axis_name, perm) for x in (k_cur, v_cur, dk_cur, dv_cur)
+            )
+        else:
+            # k/v are never read again — only the gradient accumulators take the
+            # final hop home (saves 2 dead chunk transfers per layer per backward)
+            dk_cur, dv_cur = (
+                jax.lax.ppermute(x, axis_name, perm) for x in (dk_cur, dv_cur)
+            )
+
+    dq_out = dq_total.astype(qt.dtype).transpose(0, 2, 1, 3)
+    dk_out = dk_cur.astype(kt.dtype).transpose(0, 2, 1, 3)
+    dv_out = dv_cur.astype(vt.dtype).transpose(0, 2, 1, 3)
+    return dq_out, dk_out, dv_out
+
+
+_ring_flash_local.defvjp(_ring_flash_vjp_fwd, _ring_flash_vjp_bwd)
+
+
+def _ring_impl() -> str:
+    """'flash' (Pallas hops) on TPU, 'dense' elsewhere; MODALITIES_TPU_RING_IMPL
+    overrides (dense | flash | flash_interpret — the latter for CPU equivalence
+    tests of the kernel path)."""
+    import os
+
+    override = os.environ.get("MODALITIES_TPU_RING_IMPL", "").strip()
+    if override:
+        if override not in ("dense", "flash", "flash_interpret"):
+            raise ValueError(
+                f"MODALITIES_TPU_RING_IMPL={override!r}: expected dense | flash | "
+                "flash_interpret — refusing to silently fall back to a default tier"
+            )
+        return override
+    try:
+        on_tpu = jax.devices()[0].platform == "tpu"
+    except Exception:
+        on_tpu = False
+    return "flash" if on_tpu else "dense"
+
+
+def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool, sm_scale: float):
+    """Runs on each cp shard inside shard_map. q/k/v: [B, S_local, H(, kv), D]."""
+    impl = _ring_impl()
+    if impl in ("flash", "flash_interpret"):
+        return _ring_flash_local(
+            q, k, v, axis_name, causal, sm_scale, impl == "flash_interpret"
+        )
+    return _ring_dense_local(q, k, v, axis_name=axis_name, causal=causal, sm_scale=sm_scale)
 
 
 def ring_attention(

@@ -224,12 +224,14 @@ def validate_recipe(
     state = fns.app_state_handle.state
     shardings = fns.app_state_handle.state_shardings
     budget_warnings: list = []
-    params_pd = _tree_per_device_bytes(state.params, shardings.params, budget_warnings)
+    param_leaves, param_shardings = _matched_shardings(
+        state.params, shardings.params, budget_warnings
+    )
+    params_pd = sum(_per_device_bytes(x, s) for x, s in zip(param_leaves, param_shardings))
     opt_pd = _tree_per_device_bytes(state.opt_state, shardings.opt_state, budget_warnings)
     # gradients mirror the param shardings; accumulated in reduce_dtype (fp32).
     # Same length-matched pairing as the byte counts: a collapsed sharding tree must
     # fall back to replicated counting, not zip-truncate leaves to grads_pd=0
-    param_leaves, param_shardings = _matched_shardings(state.params, shardings.params)
     param_count_pd = sum(
         int(np.prod(s.shard_shape(tuple(x.shape)) if hasattr(s, "shard_shape") else x.shape))
         for x, s in zip(param_leaves, param_shardings)

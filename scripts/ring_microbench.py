@@ -1,0 +1,108 @@
+"""Single-chip microbench: flash-kernel ring tier vs dense/k-blocked ring tier.
+
+Times ONE device's worth of ring-attention inner-loop work (the per-hop local
+attention both tiers run inside shard_map) at a 32k-token local sequence — the
+shape the 7B@32k cp=4 acceptance recipe puts on each chip (VERDICT r4 weak #4:
+that recipe's MFU lives on this loop). No mesh is needed: the hop math is
+identical on 1 device with cp hops simulated back-to-back; the ppermute cost is
+not measured here (it is overlapped ICI traffic in the real ring).
+
+Prints one JSON line per tier: {"tier", "local_seq", "hops", "ms_per_hop_chain",
+"speedup_vs_dense"}. Queued BEHIND bench.py's ladder in a hardware window
+(leader re-time first — VERDICT r4 #1).
+
+Usage (TPU): python scripts/ring_microbench.py [--seq 32768] [--hops 4]
+CPU smoke:   JAX_PLATFORMS=cpu python scripts/ring_microbench.py --seq 512 --interpret
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--seq", type=int, default=32768, help="local (per-device) sequence length")
+    p.add_argument("--hops", type=int, default=4, help="ring size cp to simulate")
+    p.add_argument("--heads", type=int, default=32)
+    p.add_argument("--kv_heads", type=int, default=8)
+    p.add_argument("--head_dim", type=int, default=128)
+    p.add_argument("--batch", type=int, default=1)
+    p.add_argument("--iters", type=int, default=3)
+    p.add_argument("--interpret", action="store_true", help="Pallas interpret mode (CPU smoke)")
+    args = p.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    from modalities_tpu.parallel.ring_attention import (
+        _chunk_attention_stats,
+        _hop_fwd,
+        _merge_out_lse,
+        _merge_stats,
+        NEG_INF,
+    )
+    from modalities_tpu.util import hard_sync
+
+    b, s, hq, hkv, d = args.batch, args.seq, args.heads, args.kv_heads, args.head_dim
+    sm_scale = 1.0 / float(np.sqrt(d))
+    rng = jax.random.PRNGKey(0)
+    dt = jnp.bfloat16 if jax.devices()[0].platform == "tpu" else jnp.float32
+    q = jax.random.normal(jax.random.fold_in(rng, 0), (b, s, hq, d), dt)
+    k = jax.random.normal(jax.random.fold_in(rng, 1), (b, s, hkv, d), dt)
+    v = jax.random.normal(jax.random.fold_in(rng, 2), (b, s, hkv, d), dt)
+
+    def dense_chain(q, k, v):
+        # hop 0 = diagonal (causal), hops 1..cp-1 = full past chunks — device cp-1's
+        # work, the busiest (worst-case) device in a causal ring
+        acc = jnp.zeros((b, s, hq, d), jnp.float32)
+        m = jnp.full((b, s, hq), NEG_INF, jnp.float32)
+        l = jnp.zeros((b, s, hq), jnp.float32)
+        for r in range(args.hops):
+            o_r, m_r, l_r = _chunk_attention_stats(
+                q, k, v, q_offset=(args.hops - 1) * s, k_offset=(args.hops - 1 - r) * s,
+                causal=True, sm_scale=sm_scale,
+            )
+            acc, m, l = _merge_stats(acc, m, l, o_r, m_r, l_r)
+        return (acc / jnp.maximum(l, 1e-30)[..., None])[0, 0, 0, 0]
+
+    def flash_chain(q, k, v):
+        qt, kt, vt = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
+        out = jnp.zeros((b, hq, s, d), jnp.float32)
+        lse = jnp.full((b, hq, s, 1), NEG_INF, jnp.float32)
+        for r in range(args.hops):
+            idx = jnp.int32(1 if r == 0 else 0)  # diagonal first, then full chunks
+            o_r, lse_r = _hop_fwd(qt, kt, vt, idx, sm_scale, args.interpret)
+            out, lse = _merge_out_lse(out, lse, o_r, lse_r)
+        return out[0, 0, 0, 0]
+
+    results = {}
+    for tier, fn in (("dense", dense_chain), ("flash", flash_chain)):
+        f = jax.jit(fn)
+        hard_sync(f(q, k, v))  # compile + warm
+        best = None
+        for _ in range(args.iters):
+            t0 = time.perf_counter()
+            hard_sync(f(q, k, v))
+            elapsed = time.perf_counter() - t0
+            best = elapsed if best is None else min(best, elapsed)
+        results[tier] = best
+        print(json.dumps({
+            "tier": tier,
+            "local_seq": s,
+            "hops": args.hops,
+            "ms_per_hop_chain": round(best * 1e3, 2),
+            "speedup_vs_dense": round(results["dense"] / best, 3),
+        }))
+
+
+if __name__ == "__main__":
+    main()

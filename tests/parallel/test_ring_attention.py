@@ -111,3 +111,86 @@ def test_blocked_chunk_stats_gradients_match_dense():
     )(q, k, v)
     for a, b_ in zip(g_dense, g_blocked):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b_), rtol=2e-4, atol=2e-4)
+
+
+# ------------------------------------------------------------- flash-kernel tier
+
+
+@pytest.fixture
+def flash_ring(monkeypatch):
+    """Route the ring through the Pallas-kernel hops in interpret mode (the CPU
+    equivalence harness for the TPU tier, VERDICT r4 #5)."""
+    monkeypatch.setenv("MODALITIES_TPU_RING_IMPL", "flash_interpret")
+
+
+@pytest.mark.parametrize("hq,hkv", [(4, 4), (4, 2)])
+def test_flash_ring_matches_oracle(flash_ring, hq, hkv):
+    """Flash-hop ring (interpret mode) vs single-device oracle, causal + GQA."""
+    mesh = _mesh(cp=4, dp=2)
+    q, k, v = _rand(0, 2, 32, hq, hkv, 16)
+    expected = manual_attention(q, k, v)
+    sharding = NamedSharding(mesh, P("dp_shard", "cp", None, None))
+    qs, ks, vs = (jax.device_put(x, sharding) for x in (q, k, v))
+    got = jax.jit(lambda q, k, v: ring_attention(q, k, v, mesh, causal=True))(qs, ks, vs)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected), rtol=2e-5, atol=2e-5)
+
+
+def test_flash_ring_non_causal(flash_ring):
+    mesh = _mesh(cp=4, dp=2)
+    q, k, v = _rand(1, 1, 16, 2, 2, 16)
+    expected = jax.nn.dot_product_attention(q, k, v, is_causal=False)
+    sharding = NamedSharding(mesh, P(None, "cp", None, None))
+    qs, ks, vs = (jax.device_put(x, sharding) for x in (q, k, v))
+    got = jax.jit(lambda q, k, v: ring_attention(q, k, v, mesh, causal=False))(qs, ks, vs)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected), rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("hq,hkv", [(2, 1), (2, 2)])
+def test_flash_ring_gradients_match_oracle(flash_ring, hq, hkv):
+    """The custom_vjp ring backward (flash bwd kernels + rotating dk/dv accumulators)
+    vs plain autodiff through the single-device oracle."""
+    mesh = _mesh(cp=4, dp=2)
+    q, k, v = _rand(2, 1, 16, hq, hkv, 8)
+    sharding = NamedSharding(mesh, P(None, "cp", None, None))
+    qs, ks, vs = (jax.device_put(x, sharding) for x in (q, k, v))
+
+    def weighted(o):
+        # position-dependent weights make dk/dv asymmetric across chunks, so a
+        # misrouted accumulator rotation cannot cancel out
+        w = jnp.arange(o.shape[1], dtype=o.dtype)[None, :, None, None] + 1.0
+        return (o * w).sum()
+
+    g_ring = jax.jit(
+        jax.grad(lambda q, k, v: weighted(ring_attention(q, k, v, mesh, causal=True)), argnums=(0, 1, 2))
+    )(qs, ks, vs)
+    g_oracle = jax.grad(lambda q, k, v: weighted(manual_attention(q, k, v)), argnums=(0, 1, 2))(q, k, v)
+    for gr, go, name in zip(g_ring, g_oracle, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(gr), np.asarray(go), rtol=5e-4, atol=5e-4, err_msg=f"d{name} mismatch"
+        )
+
+
+def test_flash_ring_matches_dense_ring(flash_ring):
+    """Flash tier vs the dense ring tier on identical shards — the two inner-loop
+    implementations must agree, not just both approximate the oracle."""
+    from modalities_tpu.parallel.ring_attention import _ring_dense_local, _ring_flash_local
+    from functools import partial
+
+    mesh = _mesh(cp=4, dp=1)
+    q, k, v = _rand(4, 1, 32, 4, 2, 8)
+    sharding = NamedSharding(mesh, P(None, "cp", None, None))
+    qs, ks, vs = (jax.device_put(x, sharding) for x in (q, k, v))
+    sm = 1.0 / np.sqrt(q.shape[-1])
+
+    def run(body):
+        fn = jax.shard_map(
+            body, mesh=mesh,
+            in_specs=(P(None, "cp", None, None),) * 3,
+            out_specs=P(None, "cp", None, None),
+            axis_names=frozenset({"cp"}), check_vma=False,
+        )
+        return jax.jit(fn)(qs, ks, vs)
+
+    dense = run(partial(_ring_dense_local, axis_name="cp", causal=True, sm_scale=sm))
+    flash = run(lambda a, b, c: _ring_flash_local(a, b, c, "cp", True, sm, True))
+    np.testing.assert_allclose(np.asarray(flash), np.asarray(dense), rtol=2e-5, atol=2e-5)
