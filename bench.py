@@ -418,12 +418,17 @@ def _calibration_matmul_tflops(repeats: int = 3):
     try:
         n = 8192
         x = jnp.ones((n, n), jnp.bfloat16)
-        f = jax.jit(lambda a: (a @ a)[0, 0])
-        hard_sync(f(x))  # compile + warm
+        # the jit returns the FULL product: a sliced/reduced output would let the
+        # algebraic simplifier shrink the dot (slice-of-dot -> dot-of-slices) and
+        # time a row-product instead of the 2n^3 matmul. The sync indexes the
+        # committed output OUTSIDE the jit, so only a scalar crosses the relay
+        # while completion of the whole buffer is what is fenced.
+        f = jax.jit(lambda a: a @ a)
+        hard_sync(f(x)[0, 0])  # compile + warm
         best = None
         for _ in range(repeats):
             t0 = time.perf_counter()
-            hard_sync(f(x))
+            hard_sync(f(x)[0, 0])
             dt = time.perf_counter() - t0
             best = dt if best is None else min(best, dt)
         return round(2 * n**3 / best / 1e12, 1)

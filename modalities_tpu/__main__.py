@@ -298,9 +298,19 @@ def entry_point_list_remaining_runs(sweep_dir: Path, skip_oom_configs: bool) -> 
     default=None,
     help="Real checkpoint folder for warmstart recipes (default: a synthetic name).",
 )
+@click.option(
+    "--compile_memory_check",
+    is_flag=True,
+    default=False,
+    help="Also COMPILE the lowered step on the virtual mesh and report XLA's own "
+    "per-device memory accounting next to the formula estimate (slower).",
+)
 @_exception_handling
 def entry_point_validate_recipe(
-    config_file_path: Path, hbm_budget_gib: float, warmstart_checkpoint_folder: Optional[str]
+    config_file_path: Path,
+    hbm_budget_gib: float,
+    warmstart_checkpoint_folder: Optional[str],
+    compile_memory_check: bool,
 ) -> None:
     """Compile-only v5p readiness check: lower the recipe's full sharded train step
     over a virtual mesh of its world_size and report the per-chip HBM budget
@@ -311,6 +321,7 @@ def entry_point_validate_recipe(
         config_file_path,
         hbm_budget_bytes=int(hbm_budget_gib * 1024**3),
         warmstart_checkpoint_folder=warmstart_checkpoint_folder,
+        compile_memory_check=compile_memory_check,
     )
     click.echo(json.dumps(report, indent=2))
     if report["lowering"] != "ok" or not report["fits_budget"]:

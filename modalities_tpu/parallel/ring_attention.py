@@ -178,17 +178,15 @@ def _hop_fwd(q, k, v, idx, sm_scale, interpret):
 
     bq, bk = _hop_blocks(q.shape[2], k.shape[2])
 
-    def full(k_, v_):
-        o, lse = flash_fwd_out_lse(
-            q, k_, v_, causal=False, sm_scale=sm_scale, block_q=bq, block_k=bk, interpret=interpret
-        )
-        return o.astype(jnp.float32), lse
+    def make_hop(causal):  # one body, two causal flavors — keep the branches twins
+        def hop(k_, v_):
+            o, lse = flash_fwd_out_lse(
+                q, k_, v_, causal=causal, sm_scale=sm_scale,
+                block_q=bq, block_k=bk, interpret=interpret,
+            )
+            return o.astype(jnp.float32), lse
 
-    def diag(k_, v_):
-        o, lse = flash_fwd_out_lse(
-            q, k_, v_, causal=True, sm_scale=sm_scale, block_q=bq, block_k=bk, interpret=interpret
-        )
-        return o.astype(jnp.float32), lse
+        return hop
 
     def skip(k_, v_):
         b, hq, sq, d = q.shape
@@ -197,7 +195,7 @@ def _hop_fwd(q, k, v, idx, sm_scale, interpret):
             jnp.full((b, hq, sq, 1), NEG_INF, jnp.float32),
         )
 
-    return jax.lax.switch(idx, (full, diag, skip), k, v)
+    return jax.lax.switch(idx, (make_hop(causal=False), make_hop(causal=True), skip), k, v)
 
 
 def _merge_out_lse(out_a, lse_a, out_b, lse_b):
@@ -266,17 +264,14 @@ def _ring_flash_vjp_bwd(axis_name, causal, sm_scale, interpret, res, do):
     delta = jnp.sum(do_t.astype(jnp.float32) * out_t.astype(jnp.float32), axis=-1, keepdims=True)
     bq, bk = _hop_blocks(qt.shape[2], kt.shape[2])
 
-    def full_hop(k_, v_):
-        kw = dict(causal=False, sm_scale=sm_scale, block_q=bq, block_k=bk, interpret=interpret)
-        dq_r = flash_bwd_dq(qt, k_, v_, do_t, lse, delta, **kw)
-        dk_r, dv_r = flash_bwd_dkv(qt, k_, v_, do_t, lse, delta, **kw)
-        return dq_r.astype(jnp.float32), dk_r.astype(jnp.float32), dv_r.astype(jnp.float32)
+    def make_bwd_hop(causal):  # one body, two causal flavors — keep the branches twins
+        def hop(k_, v_):
+            kw = dict(causal=causal, sm_scale=sm_scale, block_q=bq, block_k=bk, interpret=interpret)
+            dq_r = flash_bwd_dq(qt, k_, v_, do_t, lse, delta, **kw)
+            dk_r, dv_r = flash_bwd_dkv(qt, k_, v_, do_t, lse, delta, **kw)
+            return dq_r.astype(jnp.float32), dk_r.astype(jnp.float32), dv_r.astype(jnp.float32)
 
-    def diag_hop(k_, v_):
-        kw = dict(causal=True, sm_scale=sm_scale, block_q=bq, block_k=bk, interpret=interpret)
-        dq_r = flash_bwd_dq(qt, k_, v_, do_t, lse, delta, **kw)
-        dk_r, dv_r = flash_bwd_dkv(qt, k_, v_, do_t, lse, delta, **kw)
-        return dq_r.astype(jnp.float32), dk_r.astype(jnp.float32), dv_r.astype(jnp.float32)
+        return hop
 
     def skip_hop(k_, v_):
         return (
@@ -295,7 +290,9 @@ def _ring_flash_vjp_bwd(axis_name, causal, sm_scale, interpret, res, do):
     for r in range(cp):
         j_index = (my_index - r) % cp
         idx = _branch_index(causal, my_index, j_index)
-        dq_r, dk_r, dv_r = jax.lax.switch(idx, (full_hop, diag_hop, skip_hop), k_cur, v_cur)
+        dq_r, dk_r, dv_r = jax.lax.switch(
+            idx, (make_bwd_hop(causal=False), make_bwd_hop(causal=True), skip_hop), k_cur, v_cur
+        )
         dq_total = dq_total + dq_r
         dk_cur = dk_cur + dk_r
         dv_cur = dv_cur + dv_r

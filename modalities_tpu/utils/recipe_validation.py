@@ -147,6 +147,7 @@ def validate_recipe(
     config_file_path: Path,
     hbm_budget_bytes: int = V5P_HBM_BUDGET_BYTES,
     warmstart_checkpoint_folder: Optional[str] = None,
+    compile_memory_check: bool = False,
 ) -> dict:
     """Build the recipe's train step over its real mesh, lower it, and report the
     per-chip memory budget. Requires jax.device_count() >= the config's world_size
@@ -214,11 +215,33 @@ def validate_recipe(
         "targets": {components.loss_fn.target_key: tok},
     }
 
+    xla_memory = None
+    lowered = None
     try:
-        fns.lower_train_step(batch_abstract)
+        lowered = fns.lower_train_step(batch_abstract)
         lowering = "ok"
     except Exception as e:  # report the partitioning/tracing failure, don't crash
         lowering = f"failed: {type(e).__name__}: {str(e)[:500]}"
+    if compile_memory_check and lowered is not None:
+        # VERDICT r4 #7: back the activation FORMULA with the compiler's own
+        # per-device accounting. The virtual-mesh CPU compile runs the same
+        # GSPMD partitioning, so temp_size (all per-device intermediates:
+        # activations kept for backward + workspace + gradient buffers) is an
+        # independent order-of-magnitude check on the estimate. It is NOT a
+        # TPU HBM measurement (CPU scheduling/fusion differ) — disagreement is a
+        # flag to investigate, not a verdict. A compile failure is recorded HERE,
+        # never conflated with the lowering verdict: this diagnostic must not
+        # flip a lowering-green recipe to CLI exit 1 with a misleading cause.
+        try:
+            stats = lowered.compile().memory_analysis()
+            xla_memory = {
+                "temp_bytes": int(stats.temp_size_in_bytes),
+                "argument_bytes": int(stats.argument_size_in_bytes),
+                "output_bytes": int(stats.output_size_in_bytes),
+                "backend": "cpu_virtual_mesh",
+            }
+        except Exception as e:
+            xla_memory = {"error": f"{type(e).__name__}: {str(e)[:500]}"}
 
     # --- exact per-chip state bytes from the shardings
     state = fns.app_state_handle.state
@@ -242,6 +265,45 @@ def validate_recipe(
         budget_warnings.append(act["unavailable"])
     total_pd = params_pd + opt_pd + grads_pd + act["total"]
 
+    if xla_memory is not None and "temp_bytes" in xla_memory and act["total"] > 0:
+        # what the compiler calls "temp" is every per-device intermediate held
+        # across the step — the formula's analogue is activations + fp32 grads
+        formula_bytes = act["total"] + grads_pd
+        ratio = xla_memory["temp_bytes"] / max(1, formula_bytes)
+        xla_memory["formula_activations_plus_grads_bytes"] = int(formula_bytes)
+        xla_memory["temp_over_formula"] = round(ratio, 3)
+        # Known graph delta on the virtual-mesh compile: the dao_flash tier exists
+        # only on TPU, so the CPU compile runs the SDPA fallback whose backward
+        # saves O(S^2) attention probabilities — bytes the TPU flash kernel (custom
+        # vjp, blockwise recompute) NEVER materializes. Quantify it so the raw
+        # ratio is interpretable instead of alarming.
+        spec = getattr(model, "config_spec", None)
+        if spec is not None and getattr(spec, "attention_impl", None) == "dao_flash":
+            degrees = mesh_handle.degrees
+            s_l = step_profile.sequence_length // max(1, degrees.get("cp", 1))
+            h_l = max(1, spec.n_head_q // max(1, degrees.get("tp", 1)))  # heads/chip
+            b = step_profile.local_train_micro_batch_size
+            # fwd-saved probs [B, Hq_local, S_l, S_l] fp32, one copy per layer that
+            # KEEPS residuals: all local layers without remat, ~one block's
+            # recompute working set under full remat
+            mode = str(getattr(spec, "remat_variant", None) or "none")
+            layers_keeping = (
+                1 if "full" in mode else -(-spec.n_layer // max(1, degrees.get("pp", 1)))
+            )
+            s2 = layers_keeping * b * h_l * s_l * s_l * 4
+            xla_memory["cpu_sdpa_fallback_s2_residuals_bytes"] = int(s2)
+            adj = (xla_memory["temp_bytes"] - s2) / max(1, formula_bytes)
+            xla_memory["temp_minus_s2_over_formula"] = round(adj, 3)
+        xla_memory["disagrees_gt_15pct"] = not (0.85 <= ratio <= 1.15)
+        if xla_memory["disagrees_gt_15pct"]:
+            budget_warnings.append(
+                f"XLA compiled temp ({xla_memory['temp_bytes'] / 1024**3:.2f} GiB/chip) "
+                f"disagrees with the activation+grad formula ({formula_bytes / 1024**3:.2f} "
+                f"GiB/chip) by more than 15% (ratio {ratio:.2f}); inspect "
+                "xla_compiled_memory for the known CPU-graph deltas (SDPA s^2 "
+                "residuals, unfused CPU scheduling) before re-deriving the estimate"
+            )
+
     num_params = sum(int(np.prod(x.shape)) for x in jax.tree.leaves(state.params))
     report = {
         "config": str(config_file_path),
@@ -254,6 +316,7 @@ def validate_recipe(
             "optimizer_bytes": opt_pd,
             "gradient_bytes": grads_pd,
             "activation_estimate": act,
+            **({"xla_compiled_memory": xla_memory} if xla_memory is not None else {}),
             "total_bytes": total_pd,
             "total_gib": round(total_pd / 1024**3, 3),
         },
@@ -269,6 +332,7 @@ def run_validation_subprocess(
     config_file_path: Path,
     hbm_budget_bytes: int = V5P_HBM_BUDGET_BYTES,
     warmstart_checkpoint_folder: Optional[str] = None,
+    compile_memory_check: bool = False,
 ) -> dict:
     """Spawn `python -m modalities_tpu.utils.recipe_validation` in a child process
     with the CPU backend forced and world_size virtual devices, so validation works
@@ -308,6 +372,8 @@ def run_validation_subprocess(
     ]
     if warmstart_checkpoint_folder:
         cmd += ["--warmstart_checkpoint_folder", warmstart_checkpoint_folder]
+    if compile_memory_check:
+        cmd += ["--compile_memory_check"]
     proc = subprocess.run(cmd, env=env, capture_output=True, text=True)
     if proc.returncode != 0:
         raise RuntimeError(
@@ -325,11 +391,13 @@ def _main() -> None:
     parser.add_argument("config_file_path", type=Path)
     parser.add_argument("--hbm_budget_bytes", type=int, default=V5P_HBM_BUDGET_BYTES)
     parser.add_argument("--warmstart_checkpoint_folder", default=None)
+    parser.add_argument("--compile_memory_check", action="store_true")
     args = parser.parse_args()
     report = validate_recipe(
         args.config_file_path,
         hbm_budget_bytes=args.hbm_budget_bytes,
         warmstart_checkpoint_folder=args.warmstart_checkpoint_folder,
+        compile_memory_check=args.compile_memory_check,
     )
     print(json.dumps(report))
 

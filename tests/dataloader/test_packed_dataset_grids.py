@@ -1,0 +1,256 @@
+"""Packed-dataset edge grids ported from the reference's behavior tables
+(reference tests/dataloader/test_packed_dataset.py and
+test_end_to_end_indexation_and_tokenization.py — VERDICT r4 #4, dataloader tier).
+
+The dummy corpus mirrors the reference conftest (conftest.py:33-47): 20 tokens
+0..19 split into documents of 6, 10, 3 and 1 tokens, so every expected value in
+the grids is comparable line by line with the reference's tables.
+"""
+
+import numpy as np
+import pytest
+
+from modalities_tpu.dataloader.dataset import (
+    PackedMemMapDatasetBase,
+    PackedMemMapDatasetContinuous,
+    PackedMemMapDatasetMegatron,
+)
+from modalities_tpu.dataloader.packed_data import (
+    token_size_in_bytes_for_vocab,
+    write_pbin_file,
+)
+from modalities_tpu.models.gpt2.collator import GPT2LLMCollateFn
+
+DOC_LENGTHS = (6, 10, 3, 1)  # the reference's index: lengths 6, 10, 3, 1
+
+
+@pytest.fixture
+def dummy_packed_data_path(tmp_path):
+    docs, start = [], 0
+    for n in DOC_LENGTHS:
+        docs.append(np.arange(start, start + n))
+        start += n
+    path = tmp_path / "dummy.pbin"
+    write_pbin_file(path, iter(docs), token_size_in_bytes=4)
+    return path
+
+
+# ------------------------------------------------------------------ megatron grid
+
+
+@pytest.mark.parametrize(
+    "block_size, expected_length",
+    [(1, 4), (2, 3), (3, 3), (10, 2), (6, 2), (20, 1), (25, 0)],
+)
+def test_packed_megatron_dataset_loading(dummy_packed_data_path, block_size, expected_length):
+    """Reference grid test_packed_dataset.py:16-21: whole-document packing lengths
+    for every block size against the 6/10/3/1 corpus."""
+    ds = PackedMemMapDatasetMegatron(
+        raw_data_path=dummy_packed_data_path, block_size=block_size, sample_key="input_ids"
+    )
+    assert len(ds) == expected_length
+
+
+# ---------------------------------------------------------------- continuous grid
+
+
+@pytest.mark.parametrize(
+    "block_size, expected_length, expected_output, reuse_last_target",
+    [
+        (2, 19, [[i, i + 1] for i in range(19)], True),
+        (3, 9, [[2 * i, 2 * i + 1, 2 * i + 2] for i in range(9)], True),
+        (10, 2, [list(range(10)), list(range(9, 19))], True),
+        (6, 3, [[0, 1, 2, 3, 4, 5], [5, 6, 7, 8, 9, 10], [10, 11, 12, 13, 14, 15]], True),
+        (20, 1, [list(range(20))], True),
+        (21, 0, ValueError, True),
+        (1, 0, ValueError, True),
+        (2, 10, [[2 * i, 2 * i + 1] for i in range(10)], False),
+        (6, 3, [[0, 1, 2, 3, 4, 5], [6, 7, 8, 9, 10, 11], [12, 13, 14, 15, 16, 17]], False),
+    ],
+)
+def test_packed_continuous_dataset_loading(
+    dummy_packed_data_path, block_size, expected_length, expected_output, reuse_last_target
+):
+    """Reference grid test_packed_dataset.py:24-97: exact window contents for both
+    overlap modes, plus the too-large-block and block_size<2 rejections."""
+    try:
+        ds = PackedMemMapDatasetContinuous(
+            raw_data_path=dummy_packed_data_path,
+            block_size=block_size,
+            sample_key="input_ids",
+            reuse_last_target=reuse_last_target,
+        )
+    except ValueError:
+        assert expected_output is ValueError
+        return
+    assert expected_output is not ValueError
+    assert len(ds) == expected_length
+    assert [list(s["input_ids"]) for s in ds] == expected_output
+
+
+def test_packed_continuous_dataset_missing_file(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        PackedMemMapDatasetContinuous(
+            tmp_path / "does_not_exist.pbin",
+            block_size=10,
+            sample_key="input_ids",
+            reuse_last_target=True,
+        )
+
+
+# -------------------------------------------------------- token width conversion
+
+
+@pytest.mark.parametrize("token_size_in_bytes", [1, 2, 4])
+def test_tokens_decodable_at_every_width_and_collatable(tmp_path, token_size_in_bytes):
+    """Reference test_conversion_tokens_represented_as_unsigned_ints: every on-disk
+    width decodes as unsigned and flows through the GPT2 collator."""
+    path = tmp_path / "w.pbin"
+    hi = min(200, 2 ** (8 * token_size_in_bytes) - 2)
+    docs = [np.arange(0, hi) % hi, np.arange(0, 30) % hi]
+    write_pbin_file(path, iter(docs), token_size_in_bytes=token_size_in_bytes)
+    ds = PackedMemMapDatasetContinuous(
+        raw_data_path=path, block_size=10, sample_key="input_ids", reuse_last_target=True
+    )
+    samples = list(ds)
+    assert samples
+    assert all((s["input_ids"] >= 0).all() for s in samples)  # unsigned decode
+
+    collator = GPT2LLMCollateFn(sample_key="input_ids", target_key="target_ids")
+    for pair in zip(samples, samples):
+        batch = collator(list(pair))
+        assert batch.samples["input_ids"].shape == (2, 9)
+        np.testing.assert_array_equal(
+            batch.targets["target_ids"], np.stack([p["input_ids"][1:] for p in pair])
+        )
+
+
+# ----------------------------------------------------------------- slicing grid
+
+
+@pytest.mark.parametrize(
+    "sl",
+    [
+        (0, 2), (0, 4), (0, 5), (1, 3), (1, -1), (-3, -1), (3, 1), (3, None),
+        (None, None), (4, 5), (2, 2),
+    ],
+)
+def test_base_dataset_slicing_matches_document_list(dummy_packed_data_path, sl):
+    """Reference slicing grid (test_packed_dataset.py:289-307): every slice of the
+    base dataset equals the same slice of the document list, including empty,
+    negative, reversed and past-the-end slices."""
+    ds = PackedMemMapDatasetBase(dummy_packed_data_path, sample_key="input_ids")
+    docs, start = [], 0
+    for n in DOC_LENGTHS:
+        docs.append(list(range(start, start + n)))
+        start += n
+    got = [list(s) for s in ds[sl[0] : sl[1]]["input_ids"]]
+    assert got == docs[sl[0] : sl[1]]
+
+
+def test_base_dataset_strided_slice_rejected(dummy_packed_data_path):
+    ds = PackedMemMapDatasetBase(dummy_packed_data_path, sample_key="input_ids")
+    with pytest.raises(ValueError, match="[Ss]trided"):
+        ds[0:4:2]
+
+
+# ----------------------------------------------------- packed index arithmetic
+
+
+@pytest.mark.parametrize(
+    "token_size_in_bytes, block_size, total_tokens",
+    [(1, 32, 32), (2, 32, 512), (4, 32, 1000), (4, 32, 1234)],
+)
+def test_continuously_packed_index_vectorized_matches_slow(
+    token_size_in_bytes, block_size, total_tokens
+):
+    """Reference test_continuously_packed_index: the vectorized (offset, length)
+    index equals the per-sample arithmetic spelled out longhand."""
+    num_samples = (total_tokens - block_size) // (block_size - 1) + 1
+    slow = [
+        [(i * block_size - i) * token_size_in_bytes, block_size * token_size_in_bytes]
+        for i in range(num_samples)
+    ]
+    fast = PackedMemMapDatasetContinuous._create_packed_index(
+        total_tokens=total_tokens,
+        block_size=block_size,
+        token_size_in_bytes=token_size_in_bytes,
+        reuse_last_target=True,
+    )
+    assert np.all(np.asarray(slow) == fast)
+
+
+@pytest.mark.parametrize(
+    "vocab_size, expected_num_bytes",
+    [
+        (254, 1), (255, 1), (256, 1), (257, 2), (65534, 2), (65535, 2), (65536, 2),
+        (65537, 4), (65538, 4), (10000000, 4),
+    ],
+)
+def test_required_bytes_to_represent_vocab(vocab_size, expected_num_bytes):
+    """Reference test__get_required_num_of_bytes_to_repr, including the boundary
+    convention: vocab_size counts ids 0..vocab_size-1 PLUS room for the EOD
+    sentinel, so 256 still fits one byte and 65536 two."""
+    assert token_size_in_bytes_for_vocab(vocab_size) == expected_num_bytes
+
+
+# ----------------------------------------- e2e indexation + tokenization edges
+
+
+class _Tok:
+    """Deterministic stand-in tokenizer (unicode-safe, fork-safe)."""
+
+    vocab_size = 300
+
+    def tokenize(self, text):
+        return [ord(c) % 250 for c in text]
+
+    def get_token_id(self, token):
+        return 255
+
+    def decode(self, ids):
+        return "".join(chr(i) for i in ids)
+
+
+def _verify(src):
+    from modalities_tpu.utils.verify_tokenization_consistency import (
+        verify_tokenization_consistency,
+    )
+
+    verify_tokenization_consistency(src, eod_token="<eod>", tokenizer=_Tok())
+
+
+def test_tokenization_consistency_without_trailing_newline(tmp_path):
+    """Reference lorem_ipsum_without_last_newline cases: the final line must not be
+    dropped or duplicated when the file lacks a trailing newline."""
+    src = tmp_path / "d.jsonl"
+    src.write_text('{"text": "first doc"}\n{"text": "last doc no newline"}')
+    _verify(src)
+
+
+def test_tokenization_consistency_unicode_documents(tmp_path):
+    """Reference danish_test_dataset case: multi-byte UTF-8 content survives the
+    index (byte offsets) -> pack -> decode round trip."""
+    src = tmp_path / "d.jsonl"
+    docs = ["sådan går det", "æøå ÆØÅ", "ascii too"]
+    src.write_text("\n".join('{"text": "%s"}' % d for d in docs) + "\n", encoding="utf-8")
+    _verify(src)
+
+
+def test_tokenization_consistency_eod_mid_document(tmp_path):
+    """A document whose own text tokenizes to the EOD id must not split: the pbin
+    document boundary comes from the index, never from token values."""
+    src = tmp_path / "d.jsonl"
+    # chr(255 + 250) % 250... pick a char whose ord % 250 == 255 is impossible
+    # (ids < 250), so instead embed the eod id via a custom tokenizer
+    src.write_text('{"text": "ab"}\n{"text": "c"}\n')
+
+    class EodTok(_Tok):
+        def tokenize(self, text):
+            return [255 if c == "b" else ord(c) % 250 for c in text]
+
+    from modalities_tpu.utils.verify_tokenization_consistency import (
+        verify_tokenization_consistency,
+    )
+
+    verify_tokenization_consistency(src, eod_token="<eod>", tokenizer=EodTok())

@@ -90,9 +90,111 @@ def build_and_step(local_rows_slice, mode="dp"):
     return float(metrics["loss"])
 
 
+def ckpt_run(phase: str) -> list[float]:
+    """Multi-process Orbax checkpointing contract (VERDICT r4 #3). Phases over the
+    same deterministic 5-step curriculum (per-step seeded batches, dp over ALL
+    global devices):
+      - oracle: 5 uninterrupted steps (single process, global mesh)
+      - save:   steps 0-2, then save through the REAL CheckpointSaving stack
+                (strategy + OrbaxCheckpointSaving) — per-process shard writes,
+                primary-host resume pointer
+      - resume: restore via OrbaxCheckpointLoading into the CURRENT process
+                topology (2-process or single-process), run steps 3-4
+    The parent asserts resume losses continue the oracle EXACTLY under both
+    process counts. Checkpoint dir comes from MP_CKPT_DIR."""
+    import json
+    from pathlib import Path
+
+    from modalities_tpu.loss_functions import CLMCrossEntropyLoss
+    from modalities_tpu.optimizers.optimizer_factory import OptimizerFactory
+    from modalities_tpu.running_env.device_mesh import get_data_loading_info, get_device_mesh
+    from modalities_tpu.training.train_step import TrainStepBuilder
+    from tests.models.test_gpt2_model import tiny_gpt2
+
+    ckpt_dir = Path(os.environ["MP_CKPT_DIR"])
+    world = len(jax.devices())
+    mesh = get_device_mesh(device_type="cpu", data_parallel_shard_degree=world, world_size=world)
+    num_ranks, rank = get_data_loading_info(mesh)
+
+    model = tiny_gpt2("pytorch_flash", n_layer=4)
+    opt = OptimizerFactory.get_adam_w(
+        lr=1e-3, betas=(0.9, 0.95), eps=1e-8, weight_decay=0.1,
+        weight_decay_groups_excluded=["norm", "embedding"], wrapped_model=model,
+    )
+    fns = TrainStepBuilder(
+        model=model,
+        loss_fn=CLMCrossEntropyLoss(target_key="target_ids", prediction_key="logits"),
+        optimizer_spec=opt,
+        mesh_handle=mesh,
+        gradient_acc_steps=1,
+        grad_clip_norm=1.0,
+    ).build(seed=0)
+    handle = fns.app_state_handle
+
+    def batch_for(step: int):
+        rng = np.random.default_rng(100 + step)
+        tokens = rng.integers(0, 128, size=(1, 8, 17))
+        rows = 8 // num_ranks
+        local = tokens[:, rank * rows : (rank + 1) * rows]
+        return fns.put_batch(
+            {
+                "samples": {"input_ids": local[:, :, :-1].astype(np.int32)},
+                "targets": {"target_ids": local[:, :, 1:].astype(np.int32)},
+            }
+        )
+
+    tokens_per_step = 8 * 16
+    if phase == "ckpt_resume":
+        from modalities_tpu.checkpointing.orbax.orbax_checkpoint_loading import (
+            OrbaxCheckpointLoading,
+        )
+
+        info = json.loads((ckpt_dir / "last_checkpoint_info.json").read_text())
+        assert "seen_steps_3-" in info["checkpoint_folder_path"]
+        OrbaxCheckpointLoading().load_app_state(handle, Path(info["checkpoint_folder_path"]))
+        steps = range(3, 5)
+    else:
+        steps = range(5) if phase == "ckpt_oracle" else range(3)
+
+    losses = []
+    for s in steps:
+        handle.state, metrics = fns.train_step(handle.state, batch_for(s))
+        losses.append(float(metrics["loss"]))
+
+    if phase == "ckpt_save":
+        from modalities_tpu.checkpointing.checkpoint_saving import CheckpointSaving
+        from modalities_tpu.checkpointing.checkpoint_saving_strategies import (
+            SaveKMostRecentCheckpointsStrategy,
+        )
+        from modalities_tpu.checkpointing.orbax.orbax_checkpoint_saving import (
+            OrbaxCheckpointSaving,
+        )
+        from modalities_tpu.training.training_progress import TrainingProgress
+
+        saving = CheckpointSaving(
+            SaveKMostRecentCheckpointsStrategy(k=2),
+            OrbaxCheckpointSaving(ckpt_dir, experiment_id="mp_ckpt"),
+        )
+        saving.save_checkpoint(
+            TrainingProgress(
+                num_seen_steps_current_run=3,
+                num_seen_tokens_current_run=3 * tokens_per_step,
+                num_target_steps=5,
+                num_target_tokens=5 * tokens_per_step,
+            ),
+            handle,
+        )
+        saving.wait_until_finished()
+    return losses
+
+
 def main() -> None:
     if sys.argv[1] == "single":
         mode = sys.argv[2] if len(sys.argv) > 2 else "dp"
+        if mode.startswith("ckpt"):
+            for loss in ckpt_run(mode):
+                print(f"LOSS {loss:.6f}", flush=True)
+            return
         print(f"LOSS {build_and_step(local_rows_slice=False, mode=mode):.6f}", flush=True)
         return
     port, pid, nprocs = int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3])
@@ -109,6 +211,10 @@ def main() -> None:
     run_communication_test()
     print("COMM OK", flush=True)
 
+    if mode.startswith("ckpt"):
+        for loss in ckpt_run(mode):
+            print(f"LOSS {loss:.6f}", flush=True)
+        return
     loss = build_and_step(local_rows_slice=True, mode=mode)
     print(f"LOSS {loss:.6f}", flush=True)
 
