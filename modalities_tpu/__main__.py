@@ -260,6 +260,38 @@ def entry_point_prepare_instruction_tuning_data(config_file_path: Path) -> None:
     create_instruction_tuning_data(config_file_path)
 
 
+@data.command(name="analyze_debug_logs")
+@click.option("--log_file_path", type=click.Path(exists=True, path_type=Path), required=True,
+              help="A debug_stats_rank_N.jsonl written by DebugStatsLogger.")
+@click.option("--step", type=int, default=None, help="Filter to one training step.")
+@click.option("--tree", type=str, default=None, help="Filter to one tree (params/grads/...).")
+@click.option("--sort_by", type=str, default="max", show_default=True)
+@click.option("--ascending", is_flag=True, default=False)
+@click.option("--top", type=int, default=20, show_default=True)
+@click.option("--nonfinite_only", is_flag=True, default=False,
+              help="Only tensors with nan/inf counts > 0.")
+@click.option("--as_json", is_flag=True, default=False, help="Emit jsonl rows instead of a table.")
+@_exception_handling
+def entry_point_analyze_debug_logs(
+    log_file_path: Path, step: Optional[int], tree: Optional[str], sort_by: str,
+    ascending: bool, top: int, nonfinite_only: bool, as_json: bool,
+) -> None:
+    """Per-tensor stats triage over a DebugStatsLogger jsonl stream — the CLI
+    equivalent of the reference's debug-log analysis notebook
+    (notebooks/debug_logs_analysis/model_step_analyser.ipynb)."""
+    from modalities_tpu.utils.debug_components import analyze_debug_log, format_debug_log_rows
+
+    rows = analyze_debug_log(
+        log_file_path, step=step, tree=tree, sort_by=sort_by, ascending=ascending,
+        top=top, nonfinite_only=nonfinite_only,
+    )
+    if as_json:
+        for r in rows:
+            click.echo(json.dumps(r))
+    else:
+        click.echo(format_debug_log_rows(rows))
+
+
 # ---------------------------------------------------------------------- benchmark
 
 

@@ -11,6 +11,7 @@ from typing import Callable, Optional
 import numpy as np
 
 from modalities_tpu.utils.logging import get_logger
+from modalities_tpu.utils.seeding import calculate_hashed_seed
 
 logger = get_logger(__name__)
 
@@ -131,6 +132,17 @@ def shuffle_jsonl_data(
     )
 
 
+def _chunk_rng(global_seed, chunk_id: int) -> np.random.Generator:
+    """Chunk-shuffle rng: hashed, not global_seed + chunk_id — arithmetic seeds
+    collide across NEIGHBORING (seed, id) pairs like (5, 1)/(4, 2). The digest-sum
+    hash removes that class (it is still commutative — (1, 2) and (2, 1) coincide —
+    exactly as the reference's construction is, api.py:266; bit-compatibility with
+    the reference wins over fixing that residual symmetry)."""
+    if global_seed is None:
+        return np.random.default_rng(None)
+    return np.random.default_rng(calculate_hashed_seed(input_data=[str(global_seed), str(chunk_id)]))
+
+
 def create_shuffled_dataset_chunk(
     file_path_list: list[Path],
     output_chunk_file_path: Path,
@@ -156,7 +168,7 @@ def create_shuffled_dataset_chunk(
         all_docs.extend(Chunking.get_tokenized_file_chunk(esd, num_chunks, chunk_id))
     if not all_docs:
         raise ValueError(f"Chunk {chunk_id} contains no samples.")
-    rng = np.random.default_rng(None if global_seed is None else global_seed + chunk_id)
+    rng = _chunk_rng(global_seed, chunk_id)
     permutation = rng.permutation(len(all_docs))
     write_pbin_file(Path(output_chunk_file_path), (all_docs[i] for i in permutation), token_size)
 
@@ -180,7 +192,7 @@ def create_shuffled_jsonl_dataset_chunk(
         lines.extend(Chunking.get_jsonl_file_chunk(reader, num_chunks, chunk_id))
     if not lines:
         raise ValueError(f"Chunk {chunk_id} contains no samples.")
-    rng = np.random.default_rng(None if global_seed is None else global_seed + chunk_id)
+    rng = _chunk_rng(global_seed, chunk_id)
     shuffled = [lines[i] for i in rng.permutation(len(lines))]
     Path(output_chunk_file_path).write_text("\n".join(shuffled) + "\n")
 

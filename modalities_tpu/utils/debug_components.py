@@ -122,6 +122,71 @@ class DebugStatsLogger:
             self._file.close()
 
 
+def analyze_debug_log(
+    log_file_path: Path,
+    step: Optional[int] = None,
+    tree: Optional[str] = None,
+    sort_by: str = "max",
+    ascending: bool = False,
+    top: Optional[int] = 20,
+    nonfinite_only: bool = False,
+) -> list[dict]:
+    """Flatten a DebugStatsLogger jsonl stream into sorted per-tensor rows — the CLI
+    equivalent of the reference's debug-log analysis notebook
+    (notebooks/debug_logs_analysis/model_step_analyser.ipynb: DataFrame filter by
+    step/hook, sort by min/max, spot non-finite tensors).
+
+    Each row: {step, tree, tensor, mean, std, min, max, nan_count, inf_count,
+    global_shape, sharded}. Filters: `step` (exact), `tree` (params/grads/...),
+    `nonfinite_only` (rows with any nan/inf). Sorting: any numeric column;
+    `top=None` returns everything."""
+    log_file_path = Path(log_file_path)
+    rows: list[dict] = []
+    with log_file_path.open() as f:
+        for line_no, line in enumerate(f):
+            try:
+                record = json.loads(line)
+            except json.JSONDecodeError:
+                logger.warning("%s:%d: skipping undecodable line", log_file_path, line_no + 1)
+                continue
+            rec_step = record.get("step")
+            if step is not None and rec_step != step:
+                continue
+            for tree_name, stats in record.items():
+                if tree_name == "step" or not isinstance(stats, dict):
+                    continue
+                if tree is not None and tree_name != tree:
+                    continue
+                for tensor, s in stats.items():
+                    if nonfinite_only and not (s.get("nan_count") or s.get("inf_count")):
+                        continue
+                    rows.append({"step": rec_step, "tree": tree_name, "tensor": tensor, **s})
+    if sort_by is not None:
+        if rows and sort_by not in rows[0]:
+            raise ValueError(
+                f"sort_by={sort_by!r} is not a stats column; have {sorted(rows[0])}"
+            )
+        rows.sort(key=lambda r: (r[sort_by] is None, r[sort_by]), reverse=not ascending)
+    return rows[:top] if top is not None else rows
+
+
+def format_debug_log_rows(rows: list[dict]) -> str:
+    """Fixed-width text table of analyze_debug_log rows (what the CLI prints)."""
+    if not rows:
+        return "(no rows matched)"
+    cols = ["step", "tree", "tensor", "mean", "std", "min", "max", "nan_count", "inf_count"]
+    table = [cols]
+    for r in rows:
+        table.append(
+            [
+                f"{r[c]:.4g}" if isinstance(r.get(c), float) else str(r.get(c, ""))
+                for c in cols
+            ]
+        )
+    widths = [max(len(row[i]) for row in table) for i in range(len(cols))]
+    return "\n".join("  ".join(cell.ljust(w) for cell, w in zip(row, widths)) for row in table)
+
+
 @_functools.cache
 def _nonfinite_check_fn():
     import jax

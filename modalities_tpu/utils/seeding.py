@@ -1,0 +1,19 @@
+"""Hashed seed derivation (reference: src/modalities/utils/seeding.py).
+
+`global_seed + chunk_id`-style arithmetic seeds COLLIDE across neighboring
+(seed, id) pairs — (5, 1) and (4, 2) shuffle two chunk streams identically.
+Hashing each component and summing the digests decorrelates every pair while
+staying deterministic and order-insensitive in the same way the reference is.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+
+def calculate_hashed_seed(input_data: list[str], max_seed: int = 2**32 - 1) -> int:
+    """A deterministic seed in [0, max_seed) from a list of strings: sum of the
+    per-string sha256 digests, reduced mod max_seed (reference seeding.py:4-21 —
+    the digest SUM, so the function matches the reference bit-for-bit)."""
+    hash_sum = sum(int(hashlib.sha256(x.encode("utf-8")).hexdigest(), 16) for x in input_data)
+    return hash_sum % max_seed

@@ -2,6 +2,7 @@
 (reference: conversion/gpt2 check_converted_model logit-diff test, :70)."""
 
 import jax
+from pathlib import Path
 import numpy as np
 import pytest
 
@@ -155,3 +156,87 @@ def test_full_export_loads_in_vanilla_transformers_with_tokenizer(tmp_path):
     with torch.no_grad():
         torch_logits = reloaded(torch.from_numpy(tokens)).logits.float().numpy()
     assert np.abs(jax_logits - torch_logits).max() < 1e-4
+
+
+def test_convert_checkpoint_to_hf_cli_end_to_end(tmp_path):
+    """The real `convert_checkpoint_to_hf` CLI over a real training checkpoint:
+    train the lorem config briefly (Main.run), point a conversion config at the
+    saved Orbax folder, run the CLI as a subprocess, and load the export with
+    stock transformers (reference checkpoint-conversion e2e,
+    tests/checkpointing/test_checkpoint_conversion.py)."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    import numpy as np
+    import yaml
+
+    from modalities_tpu.dataloader.packed_data import write_pbin_file
+    from modalities_tpu.main import Main
+
+    repo = Path(__file__).parent.parent.parent
+    run_config = repo / "configs" / "config_lorem_ipsum_tpu.yaml"
+
+    rng = np.random.default_rng(0)
+    (tmp_path / "data").mkdir()
+    write_pbin_file(
+        tmp_path / "data" / "lorem_ipsum.pbin",
+        iter([rng.integers(0, 256, size=34000)]),
+        token_size_in_bytes=2,
+    )
+    cwd = os.getcwd()
+    os.chdir(tmp_path)
+    try:
+        main = Main(run_config, experiments_root_path=tmp_path / "data" / "experiments",
+                    experiment_id="conv_e2e")
+        main.run(main.build_components())
+    finally:
+        os.chdir(cwd)
+    info = json.loads((tmp_path / "data" / "checkpoints" / "last_checkpoint_info.json").read_text())
+
+    # conversion config: the trained model architecture + the checkpoint pointer
+    train_cfg = yaml.safe_load(run_config.read_text())
+    model_cfg = train_cfg["model_raw"]["config"]
+    model_cfg["sample_key"] = "input_ids"
+    model_cfg["prediction_key"] = "logits"
+    model_cfg["sequence_length"] = train_cfg["settings"]["step_profile"]["sequence_length"]
+
+    # the training config's nested blocks reference ${model_raw.config.*}; the
+    # conversion config has no model_raw key, so materialize them to literals
+    def materialize(node):
+        if isinstance(node, dict):
+            return {k: materialize(v) for k, v in node.items()}
+        if isinstance(node, list):
+            return [materialize(v) for v in node]
+        if isinstance(node, str) and node.startswith("${model_raw.config.") and node.endswith("}"):
+            return model_cfg[node[len("${model_raw.config.") : -1]]
+        return node
+
+    model_cfg = materialize(model_cfg)
+    conv = {
+        "settings": {"checkpoint_folder_path": info["checkpoint_folder_path"]},
+        "model": {"component_key": "model", "variant_key": "gpt2", "config": model_cfg},
+    }
+    conv_path = tmp_path / "convert.yaml"
+    conv_path.write_text(yaml.safe_dump(conv, default_flow_style=False, sort_keys=False))
+
+    out_dir = tmp_path / "hf_export"
+    env = dict(os.environ)
+    env.update(PALLAS_AXON_POOL_IPS="", JAX_PLATFORMS="cpu", PYTHONPATH=str(repo))
+    proc = subprocess.run(
+        [sys.executable, "-m", "modalities_tpu", "convert_checkpoint_to_hf",
+         "--config_file_path", str(conv_path), "--output_hf_checkpoint_dir", str(out_dir)],
+        capture_output=True, text=True, timeout=900, env=env, cwd=tmp_path,
+    )
+    assert proc.returncode == 0, f"{proc.stdout[-1500:]}\n{proc.stderr[-3000:]}"
+
+    # the export loads in stock transformers and produces sane logits
+    import torch
+    from transformers import AutoModelForCausalLM
+
+    hf_model = AutoModelForCausalLM.from_pretrained(out_dir)
+    with torch.no_grad():
+        logits = hf_model(torch.arange(16, dtype=torch.long)[None] % 256).logits
+    assert logits.shape == (1, 16, model_cfg["vocab_size"])
+    assert torch.isfinite(logits).all()

@@ -254,3 +254,90 @@ def test_tokenization_consistency_eod_mid_document(tmp_path):
     )
 
     verify_tokenization_consistency(src, eod_token="<eod>", tokenizer=EodTok())
+
+
+# -------------------------------------------- index generation + reader edges
+
+
+def test_index_creation_validates_json_and_unicode_offsets(tmp_path):
+    """Reference test_index_creation: a non-JSONL file is rejected at INDEX time
+    with the faulty line numbers (drop_faulty_entries=True thins instead), and
+    multi-byte UTF-8 content indexes by BYTE offsets that round-trip exactly."""
+    import json as _json
+    import pickle
+
+    from modalities_tpu.dataloader.create_index import IndexGenerator
+
+    plain = tmp_path / "plain.txt"
+    plain.write_bytes(
+        b"This is \na dummy text\nwith newline chars\nand other rand\xc3\xb8m\nchars.\n"
+        b"It also includes malformatted json chars, like\n{{\n"
+    )
+    with pytest.raises(ValueError, match="not valid JSON"):
+        IndexGenerator(plain).create_index(tmp_path / "plain.idx")
+    IndexGenerator(plain, drop_faulty_entries=True).create_index(tmp_path / "plain.idx")
+    assert pickle.loads((tmp_path / "plain.idx").read_bytes()) == []  # nothing parseable
+
+    texts = plain.read_bytes().decode("utf-8").split("\n")
+    jsonl = tmp_path / "good.jsonl"
+    jsonl.write_text(
+        "\n".join(_json.dumps({"text": t}, ensure_ascii=False) for t in texts), encoding="utf-8"
+    )
+    IndexGenerator(jsonl).create_index(tmp_path / "good.idx")
+    raw = jsonl.read_bytes()
+    index = pickle.loads((tmp_path / "good.idx").read_bytes())
+    # byte-exact spans: decoding each (offset, length) reproduces every document,
+    # including the ones containing 2-byte UTF-8 characters
+    assert [_json.loads(raw[o : o + l])["text"] for o, l in index] == texts
+
+
+def test_index_creation_native_and_python_paths_agree(tmp_path):
+    import pickle
+
+    from modalities_tpu.dataloader.create_index import IndexGenerator
+
+    src = tmp_path / "d.jsonl"
+    src.write_text("\n".join('{"text": "doc %d æø"}' % i for i in range(20)) + "\n")
+    IndexGenerator(src, use_native=True).create_index(tmp_path / "n.idx")
+    IndexGenerator(src, use_native=False).create_index(tmp_path / "p.idx")
+    assert pickle.loads((tmp_path / "n.idx").read_bytes()) == pickle.loads(
+        (tmp_path / "p.idx").read_bytes()
+    )
+
+
+def test_lines_reader_slice_iter_and_missing_file(tmp_path):
+    """Reference test_large_file_lines_reader_*: text round-trip, slicing, iteration,
+    and the missing-source / missing-index rejections."""
+    from modalities_tpu.dataloader.create_index import IndexGenerator
+    from modalities_tpu.dataloader.large_file_lines_reader import LargeFileLinesReader
+
+    src = tmp_path / "d.jsonl"
+    docs = ['{"text": "l%d"}' % i for i in range(6)]
+    src.write_text("\n".join(docs) + "\n")
+    IndexGenerator(src).create_index(tmp_path / "d.idx")
+
+    reader = LargeFileLinesReader(src)
+    assert len(reader) == 6
+    assert list(reader) == docs
+    assert reader[2:5] == docs[2:5]
+    assert reader[-1] == docs[-1]
+    with pytest.raises(IndexError):
+        reader[100]
+    reader.close()
+
+    with pytest.raises(FileNotFoundError, match="Raw data"):
+        LargeFileLinesReader(tmp_path / "nope.jsonl")
+    (tmp_path / "noidx.jsonl").write_text('{"a": 1}\n')
+    with pytest.raises(FileNotFoundError, match="Index"):
+        LargeFileLinesReader(tmp_path / "noidx.jsonl")
+
+
+def test_index_validation_reports_true_line_numbers_past_blank_lines(tmp_path):
+    """Blank lines are skipped by the offset scan, so index ordinals drift from
+    file line numbers — the error must still name the TRUE faulty line."""
+    from modalities_tpu.dataloader.create_index import IndexGenerator
+
+    src = tmp_path / "d.jsonl"
+    src.write_text('{"a": 1}\n\n\n{{ not json\n{"b": 2}\n')
+    with pytest.raises(ValueError, match=r"lines 4\b"):
+        IndexGenerator(src).create_index(tmp_path / "d.idx")

@@ -211,6 +211,13 @@ def main() -> None:
     run_communication_test()
     print("COMM OK", flush=True)
 
+    # experiment-id sync contract (reference tests/utils/test_experiment_id_generation.py):
+    # process 0 generates, every process adopts — the parent asserts both EID lines
+    # match even though each process' own clock/hash input could differ
+    from modalities_tpu.util import get_synced_experiment_id_of_run
+
+    print(f"EID {get_synced_experiment_id_of_run('configs/config_lorem_ipsum_tpu.yaml')}", flush=True)
+
     if mode.startswith("ckpt"):
         for loss in ckpt_run(mode):
             print(f"LOSS {loss:.6f}", flush=True)

@@ -85,12 +85,15 @@ def _run_two_procs(mode: str, env: dict) -> list[list[float]]:
         )
         for pid in range(2)
     ]
-    outs = []
+    outs, eids = [], []
     for p in procs:
         out, err = p.communicate(timeout=600)
         assert p.returncode == 0, err[-3000:]
         assert "COMM OK" in out
+        eids += [line.split(None, 1)[1] for line in out.splitlines() if line.startswith("EID ")]
         outs.append(_parse_losses(out))
+    # experiment-id sync: process 0 generated it, every process adopted it
+    assert len(eids) == 2 and eids[0] == eids[1], eids
     return outs
 
 

@@ -87,3 +87,139 @@ def test_verify_tokenization_detects_mismatch(tmp_path):
 
     with pytest.raises(ValueError, match="mismatch"):
         verify_tokenization_consistency(src, eod_token="<eod>", tokenizer=FlakyTok())
+
+
+def test_analyze_debug_log_roundtrip(tmp_path):
+    """The analysis CLI consumes what DebugStatsLogger writes (reference ships this
+    loop as the model_step_analyser notebook): filter by step/tree, sort by any
+    stats column, isolate non-finite tensors."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from modalities_tpu.utils.debug_components import (
+        DebugStatsLogger,
+        analyze_debug_log,
+        format_debug_log_rows,
+    )
+
+    dbg = DebugStatsLogger(tmp_path, log_interval_steps=1)
+    good = {"w": jnp.ones((4, 4)), "b": jnp.full((2,), 3.0)}
+    bad = {"w": jnp.asarray([np.nan, 1.0]), "b": jnp.asarray([np.inf, 2.0, 4.0])}
+    dbg.log(0, params=good)
+    dbg.log(1, params=good, grads=bad)
+    dbg.close()
+
+    path = tmp_path / "debug_stats_rank_0.jsonl"
+    rows = analyze_debug_log(path, sort_by="max", top=None)
+    assert {(r["step"], r["tree"]) for r in rows} == {(0, "params"), (1, "params"), (1, "grads")}
+    assert rows[0]["max"] >= rows[-1]["max"]  # descending by default
+
+    only_bad = analyze_debug_log(path, nonfinite_only=True, top=None)
+    assert {(r["tree"], r["tensor"]) for r in only_bad} == {
+        ("grads", "grads/w"), ("grads", "grads/b"),
+    }
+    assert any(r["nan_count"] == 1 for r in only_bad)
+    assert any(r["inf_count"] == 1 for r in only_bad)
+
+    step1 = analyze_debug_log(path, step=1, tree="params", sort_by="mean", ascending=True, top=1)
+    assert len(step1) == 1 and step1[0]["step"] == 1 and step1[0]["tree"] == "params"
+
+    with pytest.raises(ValueError, match="sort_by"):
+        analyze_debug_log(path, sort_by="not_a_column")
+
+    table = format_debug_log_rows(rows)
+    assert "tensor" in table.splitlines()[0] and "params/w" in table
+
+
+def test_analyze_debug_logs_cli(tmp_path):
+    """The real `data analyze_debug_logs` entry point over a written stream."""
+    import subprocess
+    import sys
+
+    import jax.numpy as jnp
+
+    from modalities_tpu.utils.debug_components import DebugStatsLogger
+
+    dbg = DebugStatsLogger(tmp_path, log_interval_steps=1)
+    dbg.log(0, params={"w": jnp.ones((2, 2))})
+    dbg.close()
+    out = subprocess.run(
+        [sys.executable, "-m", "modalities_tpu", "data", "analyze_debug_logs",
+         "--log_file_path", str(tmp_path / "debug_stats_rank_0.jsonl"), "--as_json"],
+        capture_output=True, text=True, timeout=300,
+        env={**__import__("os").environ, "PALLAS_AXON_POOL_IPS": "", "JAX_PLATFORMS": "cpu"},
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    import json as _json
+
+    rows = [_json.loads(line) for line in out.stdout.splitlines() if line.strip().startswith("{")]
+    assert rows and rows[0]["tensor"] == "params/w" and rows[0]["max"] == 1.0
+
+
+# ------------------------------------------------------------------ hashed seeds
+
+
+@pytest.mark.parametrize(
+    "input_data, max_seed",
+    [
+        (["a", "b", "c"], 2**32 - 1),
+        (["d", "e", "f"], 2**32 - 1),
+        (["g", "hij", "klmnop"], 2**32 - 1),
+        (["5d3b0e03a13dff183d4d77bc258bec18"] * 3, 2**32 - 1),
+        (["123", "456", "789"], 97),
+    ],
+)
+def test_calculate_hashed_seed_in_range(input_data, max_seed):
+    """Reference tests/utils/test_seeding.py grid: always in [0, max_seed)."""
+    from modalities_tpu.utils.seeding import calculate_hashed_seed
+
+    seed = calculate_hashed_seed(input_data=input_data, max_seed=max_seed)
+    assert 0 <= seed < max_seed
+
+
+def test_calculate_hashed_seed_matches_reference_construction():
+    """Pin the exact digest-sum construction (sha256 per string, summed, mod) so the
+    derived chunk seeds stay byte-compatible with the reference's."""
+    import hashlib
+
+    from modalities_tpu.utils.seeding import calculate_hashed_seed
+
+    data = ["42", "7"]
+    expected = sum(int(hashlib.sha256(x.encode()).hexdigest(), 16) for x in data) % (2**32 - 1)
+    assert calculate_hashed_seed(data) == expected
+
+
+def test_hashed_seed_decorrelates_neighboring_pairs():
+    """The reason hashing replaced global_seed + chunk_id in api.py: (5, 1) and
+    (4, 2) must derive DIFFERENT seeds (arithmetic addition collides them)."""
+    from modalities_tpu.utils.seeding import calculate_hashed_seed
+
+    a = calculate_hashed_seed(["5", "1"])
+    b = calculate_hashed_seed(["4", "2"])
+    assert a != b
+    assert calculate_hashed_seed(["5", "1"]) == a  # deterministic
+
+
+def test_shuffled_chunks_differ_across_chunk_ids(tmp_path):
+    """Two chunks of the same corpus under one global_seed must not share a
+    permutation pattern (the api-level consequence of hashed seeds)."""
+    import numpy as np
+
+    from modalities_tpu.api import create_shuffled_jsonl_dataset_chunk
+
+    src = tmp_path / "d.jsonl"
+    lines = ['{"text": "doc %03d"}' % i for i in range(40)]
+    src.write_text("\n".join(lines) + "\n")
+    from modalities_tpu.dataloader.create_index import IndexGenerator
+
+    IndexGenerator(src).create_index(tmp_path / "d.idx")
+    outs = []
+    for cid in (0, 1):
+        out = tmp_path / f"chunk{cid}.jsonl"
+        create_shuffled_jsonl_dataset_chunk([src], out, cid, 2, global_seed=5)
+        outs.append(out.read_text().splitlines())
+    assert len(outs[0]) == len(outs[1]) == 20
+    # same seed, different chunk id -> different relative order of their halves
+    order0 = [int(line[-5:-2]) for line in outs[0]]
+    order1 = [int(line[-5:-2]) - 20 for line in outs[1]]
+    assert order0 != order1
