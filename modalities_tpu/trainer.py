@@ -80,6 +80,7 @@ class Trainer:
         micro_stack_samples: list[dict] = []
         micro_stack_targets: list[dict] = []
         pending_metrics: list[dict] = []
+        deferred_publish = None  # a completed interval awaiting its overlap-publish
         interval_start = time.perf_counter()
         step_id = self.num_seen_train_steps
         target_steps = training_progress.num_target_steps
@@ -115,6 +116,17 @@ class Trainer:
                 step_fn = step_functions.train_step_debug if debug_tick else train_step
                 state, metrics = step_fn(state, device_batch)
                 debug_grads = metrics.pop("grads", None)  # exposed only when debugging
+                # publish the PREVIOUS interval now, with this step already in
+                # flight: the publish's metrics fetch blocks until that interval's
+                # last step completed, but the device is not idle while it does —
+                # the same dispatch-ahead/fetch-behind structure bench.py times
+                # with, so in-app throughput stops paying a per-interval stall
+                # (VERDICT r4 #8). The fetch-return instant IS the completion time
+                # of the interval's last step, so it also starts the next clock.
+                if deferred_publish is not None:
+                    interval_start = self._publish_interval(*deferred_publish)
+                    deferred_publish = None
+
                 pending_metrics.append(metrics)
                 step_id += 1
                 training_progress.num_seen_steps_current_run += 1
@@ -126,11 +138,20 @@ class Trainer:
                 )
 
                 if step_id % self.training_log_interval_in_steps == 0:
-                    self._publish_interval(
-                        pending_metrics, step_id, train_loader.dataloader_tag, interval_start, training_progress
+                    # with the non-finite guard ARMED, check the interval's flags
+                    # EAGERLY — before the boundary callbacks below can save a
+                    # NaN-poisoned checkpoint as the latest resume target. The
+                    # host sync this costs is exactly what error_if_nonfinite
+                    # opts into: per-interval safety over overlap.
+                    if "nonfinite_grads" in pending_metrics[0]:
+                        self._raise_on_nonfinite(pending_metrics, step_id)
+                    # snapshot the token count AT the boundary: by publish time the
+                    # in-flight step has already been counted into training_progress
+                    deferred_publish = (
+                        pending_metrics, step_id, train_loader.dataloader_tag,
+                        interval_start, training_progress.num_seen_tokens_total,
                     )
                     pending_metrics = []
-                    interval_start = time.perf_counter()
 
                 if self.debug_stats_logger is not None:
                     trees = {"params": state.params}
@@ -150,17 +171,35 @@ class Trainer:
 
                 if step_id >= target_steps:
                     break
+        except BaseException:
+            # a COMPLETED interval held for the overlap-publish must not vanish
+            # because a later step (callbacks, loader, put_batch) crashed — before
+            # the deferral it had already been published at the boundary
+            if deferred_publish is not None:
+                try:
+                    self._publish_interval(*deferred_publish)
+                    deferred_publish = None
+                except Exception:
+                    logger.warning(
+                        "failed to flush the completed metrics interval while "
+                        "propagating a training error", exc_info=True,
+                    )
+            raise
         finally:
             if profiler_cm is not None:
                 profiler_cm.__exit__(None, None, None)
             if self.gc_frequency > 0:
                 gc.enable()
 
-        # flush tail metrics when the loop exits mid-interval (target steps reached or
-        # loader exhausted) so token/loss accounting stays honest
+        # flush the deferred interval and any tail metrics when the loop exits
+        # (target steps reached or loader exhausted) so token/loss accounting stays
+        # honest and ordered
+        if deferred_publish is not None:
+            interval_start = self._publish_interval(*deferred_publish)
         if pending_metrics:
             self._publish_interval(
-                pending_metrics, step_id, train_loader.dataloader_tag, interval_start, training_progress
+                pending_metrics, step_id, train_loader.dataloader_tag, interval_start,
+                training_progress.num_seen_tokens_total,
             )
         if micro_stack_samples:
             logger.warning(
@@ -172,27 +211,36 @@ class Trainer:
 
         step_functions.app_state_handle.state = state
 
+    @staticmethod
+    def _raise_on_nonfinite(pending_metrics: list[dict], step_id: int) -> None:
+        """Host-syncs the interval's non-finite flags and names the first bad step."""
+        flags = np.asarray([int(m["nonfinite_grads"]) for m in pending_metrics])
+        if flags.any():
+            first_bad = step_id - len(pending_metrics) + 1 + int(flags.argmax())
+            raise RuntimeError(
+                f"non-finite gradient norm at train step {first_bad} "
+                "(gradient_clipper.error_if_nonfinite=True)"
+            )
+
     def _publish_interval(
         self,
         pending_metrics: list[dict],
         step_id: int,
         dataloader_tag: str,
         interval_start: float,
-        training_progress: TrainingProgress,
-    ) -> None:
+        tokens_total: int,
+    ) -> float:
+        """Fetch + publish one interval's metrics. Returns the post-fetch timestamp —
+        the completion instant of the interval's last step, which is the honest
+        start-of-clock for the NEXT interval under the deferred-publish overlap."""
         # single host sync point per interval: fetch the accumulated device metrics
         if "nonfinite_grads" in pending_metrics[0]:
-            flags = np.asarray([int(m["nonfinite_grads"]) for m in pending_metrics])
-            if flags.any():
-                first_bad = step_id - len(pending_metrics) + 1 + int(flags.argmax())
-                raise RuntimeError(
-                    f"non-finite gradient norm at train step {first_bad} "
-                    "(gradient_clipper.error_if_nonfinite=True)"
-                )
+            self._raise_on_nonfinite(pending_metrics, step_id)
         losses = np.asarray([m["loss"] for m in pending_metrics], dtype=np.float64)
         grad_norms = np.asarray([m["grad_norm"] for m in pending_metrics], dtype=np.float64)
         lrs = np.asarray([m["lr"] for m in pending_metrics], dtype=np.float64)
-        elapsed = max(time.perf_counter() - interval_start, 1e-9)
+        fetch_done = time.perf_counter()
+        elapsed = max(fetch_done - interval_start, 1e-9)
         num_steps = len(pending_metrics)
         tokens_per_second = num_steps * self.global_num_tokens_per_train_step / elapsed
 
@@ -222,8 +270,9 @@ class Trainer:
                 "grad norm avg": ResultItem(grad_norms.mean(), 5),
                 "grad norm last": ResultItem(grad_norms[-1], 5),
                 "lr mean": ResultItem(lrs.mean(), 8),
-                "consumed tokens": ResultItem(training_progress.num_seen_tokens_total, 0),
+                "consumed tokens": ResultItem(tokens_total, 0),
             },
             throughput_metrics=throughput,
         )
         self.evaluation_result_publisher.publish_message(result, MessageTypes.EVALUATION_RESULT)
+        return fetch_done

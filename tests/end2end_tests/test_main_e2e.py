@@ -51,6 +51,11 @@ def test_main_end_to_end(workdir):
     assert train_lines[-1]["num_train_steps_done"] == 8
     assert "MFU" in train_lines[-1]["throughput_metrics"]
     assert train_lines[-1]["metrics"]["consumed tokens"] == 8 * 4096
+    # EVERY interval line's token count matches its own boundary — the deferred
+    # (overlap) publish must report the snapshot taken at the boundary, not the
+    # count after the next in-flight step was already added
+    for rec in train_lines:
+        assert rec["metrics"]["consumed tokens"] == rec["num_train_steps_done"] * 4096
 
     ckpts = sorted((workdir / "data" / "checkpoints").glob("eid_e2e_test-*"))
     assert len(ckpts) == 2  # k=2 most recent of steps 4, 8
