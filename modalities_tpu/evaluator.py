@@ -44,8 +44,11 @@ class Evaluator:
                     ProgressUpdate(batch_id + 1, ExperimentStatus.EVALUATION, data_loader.dataloader_tag),
                     MessageTypes.BATCH_PROGRESS_UPDATE,
                 )
-            elapsed = max(time.perf_counter() - start, 1e-9)
+            # fetch BEFORE reading the clock: dispatch returns early, so an elapsed
+            # taken pre-sync times the host loop, not the device work — the same
+            # honest-clock rule the trainer and bench.py follow (hard_sync lesson)
             losses_np = np.asarray([np.asarray(loss) for loss in losses], dtype=np.float64)
+            elapsed = max(time.perf_counter() - start, 1e-9)
             result = EvaluationResultBatch(
                 dataloader_tag=data_loader.dataloader_tag,
                 num_train_steps_done=num_train_steps_done,
