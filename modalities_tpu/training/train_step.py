@@ -520,19 +520,59 @@ class TrainStepBuilder:
             batch_axes = spec[0]
             seq_axis = spec[1] if len(spec) > 1 else None
 
+            _seq_slice_cache: dict[int, slice] = {}
+
+            def local_seq_slice(seq_len: int) -> slice:
+                """This process's slice of a cp-sharded sequence dim. The loader
+                always yields FULL sequences, but make_array_from_process_local_data
+                treats local data as the per-process portion along dims whose
+                sharding spans processes and INFERS the global extent from it —
+                feeding the full sequence there silently builds a double-length
+                global sequence of duplicated tokens (caught by the 2-process cp
+                ring test). So when cp spans processes, slice first. Cached per
+                seq_len: the result depends only on (mesh, seq_axis, seq_len) and
+                the devices_indices_map walk is O(global devices) — too hot to
+                redo per leaf per step on a pod."""
+                if seq_len in _seq_slice_cache:
+                    return _seq_slice_cache[seq_len]
+                seq_sh = js.NamedSharding(data_sharding.mesh, js.PartitionSpec(seq_axis))
+                spans = sorted(
+                    {
+                        idx[0].indices(seq_len)[:2]
+                        for dev, idx in seq_sh.devices_indices_map((seq_len,)).items()
+                        if dev.process_index == jax.process_index()
+                    }
+                )
+                lo, hi = spans[0][0], spans[-1][1]
+                covered = 0
+                for s, e in spans:
+                    covered += e - s
+                if covered != hi - lo:
+                    raise NotImplementedError(
+                        f"this process's cp shards of the sequence are non-contiguous "
+                        f"({spans}): the per-host feeding path needs one contiguous "
+                        "block per process — reorder the mesh so cp is innermost "
+                        "within each host"
+                    )
+                _seq_slice_cache[seq_len] = slice(lo, hi)
+                return _seq_slice_cache[seq_len]
+
             def put_leaf(path, x):
                 x = np.asarray(x)
                 leaf_key = getattr(path[-1], "key", None) if path else None
                 lead = (None,) if has_acc_dim else ()
                 data_dims = x.ndim - len(lead) - 1  # dims after the batch dim
                 tail = [None] * data_dims
-                if leaf_key in seq_sharded_keys and data_dims == 1:
+                seq_sharded = leaf_key in seq_sharded_keys and data_dims == 1
+                if seq_sharded:
                     tail[0] = seq_axis  # tokens [.., batch, seq]: seq shards over cp
                 full = js.NamedSharding(
                     data_sharding.mesh, js.PartitionSpec(*lead, batch_axes, *tail)
                 )
                 if jax.process_count() == 1:
                     return jax.device_put(x, full)
+                if seq_sharded and seq_axis is not None:
+                    x = x[..., local_seq_slice(x.shape[-1])]
                 return jax.make_array_from_process_local_data(full, x)
 
             return jax.tree_util.tree_map_with_path(put_leaf, batch_dict)

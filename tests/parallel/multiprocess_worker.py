@@ -50,6 +50,17 @@ def build_and_step(local_rows_slice, mode="dp"):
             data_parallel_shard_degree=world // 2,
             world_size=world,
         )
+    elif mode == "cp":
+        # cp spanning the WHOLE world: with 2 processes the ring attention k/v
+        # rotation (lax.ppermute over cp) crosses the process boundary — the DCN
+        # tier of SURVEY §5.7 context parallelism, which no single-process test
+        # can exercise
+        mesh = get_device_mesh(
+            device_type="cpu",
+            data_parallel_shard_degree=1,
+            context_parallel_degree=world,
+            world_size=world,
+        )
     else:
         mesh = get_device_mesh(
             device_type="cpu", data_parallel_shard_degree=world, world_size=world

@@ -136,6 +136,14 @@ def test_multiprocess_orbax_checkpoint_save_and_crosstopology_resume(tmp_path):
     assert np.allclose(_parse_losses(single2.stdout), oracle[3:], atol=1e-5)
 
 
+def test_two_process_ring_attention_crosses_process_boundary():
+    """cp spanning ALL 8 devices of 2 jax.distributed processes: the ring's k/v
+    ppermute hops cross the process boundary (the DCN tier of SURVEY §5.7 context
+    parallelism — unreachable from any single-process mesh), and the global loss
+    must match the single-process cp8 oracle exactly."""
+    _run_two_process_vs_single("cp")
+
+
 def test_two_process_pipeline_mesh_crosses_process_boundary():
     """pp2 x dp2 spanning two jax.distributed processes: the scheduled executor's
     activation/cotangent ppermutes and the head psum-broadcast cross the process
