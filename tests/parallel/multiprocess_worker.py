@@ -61,6 +61,18 @@ def build_and_step(local_rows_slice, mode="dp"):
             context_parallel_degree=world,
             world_size=world,
         )
+    elif mode == "hsdp":
+        # HSDP with the replicate axis OUTERMOST: with 2 processes each process is
+        # one replica group (the reference's HYBRID_SHARD multi-node story —
+        # param all-reduce over dp_replicate rides the DCN tier), and the batch
+        # still shards over (dp_replicate, dp_shard), so each process loads its
+        # replica group's distinct rows
+        mesh = get_device_mesh(
+            device_type="cpu",
+            data_parallel_replicate_degree=2,
+            data_parallel_shard_degree=world // 2,
+            world_size=world,
+        )
     else:
         mesh = get_device_mesh(
             device_type="cpu", data_parallel_shard_degree=world, world_size=world

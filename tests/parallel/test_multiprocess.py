@@ -136,6 +136,14 @@ def test_multiprocess_orbax_checkpoint_save_and_crosstopology_resume(tmp_path):
     assert np.allclose(_parse_losses(single2.stdout), oracle[3:], atol=1e-5)
 
 
+def test_two_process_hsdp_replicate_axis_crosses_process_boundary():
+    """HSDP (dp_replicate=2 x dp_shard=4) over 2 processes: each process IS one
+    replica group, so the gradient all-reduce over dp_replicate crosses the
+    process boundary and each process feeds only its replica group's rows. Global
+    loss must equal the single-process HSDP oracle exactly."""
+    _run_two_process_vs_single("hsdp")
+
+
 def test_two_process_ring_attention_crosses_process_boundary():
     """cp spanning ALL 8 devices of 2 jax.distributed processes: the ring's k/v
     ppermute hops cross the process boundary (the DCN tier of SURVEY §5.7 context
