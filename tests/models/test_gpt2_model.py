@@ -297,3 +297,54 @@ def test_ring_attention_rejects_attn_dropout():
     tokens = {"input_ids": jnp.zeros((1, 16), jnp.int32)}
     with pytest.raises(NotImplementedError, match="dropout: 0.0"):
         m.apply(params, tokens, train=True, rngs={"dropout": jax.random.PRNGKey(0)})
+
+
+# ------------------------------------------------------------------ weight tying
+
+
+def test_weight_tying_parameter_count_and_absence_of_head():
+    """Reference test_weight_tying_parameter_count/_named_parameters: tying removes
+    the separate lm_head kernel — exactly vocab*n_embd fewer parameters, and no
+    lm_head leaf exists in the tied tree (the tie is structural, not a copy)."""
+    tied = tiny_gpt2(use_weight_tying=True)
+    untied = tiny_gpt2(use_weight_tying=False)
+    p_tied = tied.init_params(jax.random.PRNGKey(0))
+    p_untied = untied.init_params(jax.random.PRNGKey(0))
+
+    def count(tree):
+        return sum(int(np.prod(x.shape)) for x in jax.tree.leaves(tree))
+
+    assert count(p_untied) - count(p_tied) == 128 * 128  # vocab * n_embd
+    flat = jax.tree_util.tree_flatten_with_path(p_tied)[0]
+    names = ["/".join(str(getattr(p, "key", p)) for p in flat_path) for flat_path, _ in flat]
+    assert not any("lm_head" in n and "norm" not in n for n in names)
+
+
+def test_weight_tying_gradient_flows_through_both_uses():
+    """Reference test_weight_tying_behavior, functional form. The discriminating
+    signal is an UNSEEN vocab row: a lookup-only (untied) embedding gets exactly
+    zero gradient there, while the tied table receives the output-projection
+    cotangent on every row. Assert both sides of that contrast."""
+    tokens = {"input_ids": jnp.asarray([[1, 2, 3, 1, 2, 3, 1, 2]], jnp.int32)}
+
+    def wte_grad(model):
+        params = model.init_params(jax.random.PRNGKey(0))
+
+        def loss(p):
+            logits = model.apply(p, tokens)["logits"]
+            return jax.nn.log_softmax(logits)[..., 0].mean()
+
+        flat = jax.tree_util.tree_flatten_with_path(jax.grad(loss)(params))[0]
+        return next(
+            np.asarray(g) for path, g in flat
+            if "wte" in "/".join(str(getattr(p, "key", p)) for p in path)
+        )
+
+    g_tied = wte_grad(tiny_gpt2(use_weight_tying=True))
+    g_untied = wte_grad(tiny_gpt2(use_weight_tying=False))
+    # unseen row 100: projection-path gradient exists ONLY under tying
+    assert np.abs(g_tied[100]).sum() > 0
+    assert np.abs(g_untied[100]).sum() == 0
+    # seen row: both get the lookup gradient
+    assert np.abs(g_tied[1]).sum() > 0
+    assert np.abs(g_untied[1]).sum() > 0

@@ -730,3 +730,76 @@ def test_head_chunk_without_sum_and_count_raises():
             model=model, loss_fn=NoAccLoss(), optimizer_spec=opt,
             mesh_handle=mesh, gradient_acc_steps=1, grad_clip_norm=1.0,
         ).build(seed=0)
+
+
+# --------------------------------------------- per-strategy placement contracts
+
+
+def _param_specs(fns):
+    """{param_path: PartitionSpec} of the built state's shardings."""
+    flat = jax.tree_util.tree_flatten_with_path(fns.app_state_handle.state_shardings.params)[0]
+    return {
+        "/".join(str(getattr(p, "key", p)) for p in path): s.spec
+        for path, s in flat
+        if hasattr(s, "spec")
+    }
+
+
+def test_fsdp_placement_shards_embed_dim_over_dp_shard():
+    """Reference fsdp2_parallelization/test_full_and_hybrid_sharding.py FULL_SHARD
+    arm: under pure dp every 2D+ weight shards its embed dim over dp_shard."""
+    mesh = get_device_mesh(device_type="cpu", data_parallel_shard_degree=8, world_size=8)
+    fns = _builder(tiny_gpt2("pytorch_flash"), mesh).build(seed=0)
+    specs = _param_specs(fns)
+    attn = {k: v for k, v in specs.items() if "q_attn/kernel" in k or "c_proj/kernel" in k}
+    assert attn, sorted(specs)
+    assert all(any(ax == "dp_shard" for ax in s if ax) for s in attn.values()), attn
+
+
+def test_hsdp_placement_shards_over_dp_shard_replicates_over_dp_replicate():
+    """HYBRID_SHARD arm: params shard over dp_shard ONLY — the dp_replicate axis
+    never appears in a param spec (pure replication), yet it DOES carry the batch."""
+    mesh = get_device_mesh(
+        device_type="cpu", data_parallel_replicate_degree=2, data_parallel_shard_degree=4,
+        world_size=8,
+    )
+    fns = _builder(tiny_gpt2("pytorch_flash"), mesh).build(seed=0)
+    for name, spec in _param_specs(fns).items():
+        flat_axes = [a for ax in spec if ax for a in (ax if isinstance(ax, tuple) else (ax,))]
+        assert "dp_replicate" not in flat_axes, (name, spec)
+    from modalities_tpu.parallel.sharding import batch_sharding
+
+    assert "dp_replicate" in str(batch_sharding(mesh).spec)
+
+
+def test_tp_placement_colwise_rowwise_and_vocab():
+    """Reference fsdp2_parallelization/test_tensor_parallelism.py plan: q/k/v and
+    ffn-up shard their OUTPUT dim over tp (colwise), c_proj/ffn-down their INPUT
+    dim (rowwise), and the embedding its vocab dim."""
+    mesh = get_device_mesh(
+        device_type="cpu", data_parallel_shard_degree=4, tensor_parallel_degree=2,
+        world_size=8,
+    )
+    fns = _builder(tiny_gpt2("pytorch_flash"), mesh).build(seed=0)
+    specs = _param_specs(fns)
+
+    def axes_of(substr):
+        matches = {k: v for k, v in specs.items() if substr in k}
+        assert matches, (substr, sorted(specs))
+        return matches
+
+    for name, spec in axes_of("q_attn/kernel").items():
+        # [.., embed, heads, head_dim]: heads (output) dim on tp => colwise
+        # (negative index: the scanned model prepends a layers dim)
+        assert spec[-2] == "tp", (name, spec)
+    for name, spec in axes_of("c_proj/kernel").items():
+        # attn c_proj [.., heads, head_dim, embed]: heads (input) on tp => rowwise;
+        # mlp c_proj/W_2 [.., mlp, embed]: mlp (input) on tp => rowwise
+        assert ("tp" in (spec[-3], spec[-2])) and spec[-1] != "tp", (name, spec)
+    for name, spec in axes_of("mlp/W/kernel").items():
+        # ffn up (SwiGLU gate) [.., embed, mlp]: mlp (output) dim on tp => colwise
+        assert spec[-1] == "tp", (name, spec)
+    for name, spec in axes_of("wte").items():
+        assert "tp" in [a for ax in spec if ax for a in (ax if isinstance(ax, tuple) else (ax,))], (
+            name, spec,
+        )
