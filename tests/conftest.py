@@ -25,3 +25,20 @@ def tmp_experiment_dir(tmp_path):
     d = tmp_path / "experiments"
     d.mkdir()
     return d
+
+
+def make_word_level_tokenizer(vocab: dict, dst, unk_token: str, **special_tokens):
+    """Tiny offline WordLevel HF tokenizer saved to `dst` — the shared builder for
+    every test that needs a tokenizer without hub access (sft/generate/conversion/
+    instruction-tuning e2e). `special_tokens` forwards to PreTrainedTokenizerFast
+    (eos_token=..., pad_token=..., bos_token=...)."""
+    tokenizers = pytest.importorskip("tokenizers")
+    from tokenizers.models import WordLevel
+    from tokenizers.pre_tokenizers import Whitespace
+    from transformers import PreTrainedTokenizerFast
+
+    tok = tokenizers.Tokenizer(WordLevel(vocab, unk_token=unk_token))
+    tok.pre_tokenizer = Whitespace()
+    fast = PreTrainedTokenizerFast(tokenizer_object=tok, **special_tokens)
+    fast.save_pretrained(dst)
+    return fast

@@ -94,19 +94,13 @@ def test_roundtrip_save_load(tmp_path):
 
 def _tiny_hf_tokenizer_dir(tmp_path):
     """Build a tiny WordLevel HF tokenizer fully offline (no hub access)."""
-    from tokenizers import Tokenizer
-    from tokenizers.models import WordLevel
-    from tokenizers.pre_tokenizers import Whitespace
-    from transformers import PreTrainedTokenizerFast
+    from tests.conftest import make_word_level_tokenizer
 
     vocab = {"<pad>": 0, "<bos>": 1, "<eos>": 2, "hello": 3, "world": 4, "the": 5}
-    tok = Tokenizer(WordLevel(vocab, unk_token="<pad>"))
-    tok.pre_tokenizer = Whitespace()
-    fast = PreTrainedTokenizerFast(
-        tokenizer_object=tok, bos_token="<bos>", eos_token="<eos>", pad_token="<pad>"
-    )
     src = tmp_path / "src_tok"
-    fast.save_pretrained(src)
+    make_word_level_tokenizer(
+        vocab, src, unk_token="<pad>", bos_token="<bos>", eos_token="<eos>", pad_token="<pad>"
+    )
     return src
 
 

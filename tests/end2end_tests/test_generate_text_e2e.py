@@ -20,10 +20,7 @@ GEN_CONFIG = Path(__file__).parent.parent.parent / "configs" / "config_generate_
 
 def _build_byte_tokenizer_dir(dst: Path) -> None:
     """256-entry WordLevel tokenizer so every model token id decodes (offline)."""
-    tokenizers = pytest.importorskip("tokenizers")
-    from tokenizers.models import WordLevel
-    from tokenizers.pre_tokenizers import Whitespace
-    from transformers import PreTrainedTokenizerFast
+    from tests.conftest import make_word_level_tokenizer
 
     vocab = {f"t{i}": i for i in range(256)}
     # give <eod> a REAL id: PreTrainedHFTokenizer.get_token_id maps unknown tokens
@@ -31,9 +28,7 @@ def _build_byte_tokenizer_dir(dst: Path) -> None:
     # whose first greedy token is 0
     vocab["<eod>"] = 255
     del vocab["t255"]
-    tok = tokenizers.Tokenizer(WordLevel(vocab, unk_token="t0"))
-    tok.pre_tokenizer = Whitespace()
-    PreTrainedTokenizerFast(tokenizer_object=tok, pad_token="t0", eos_token="<eod>").save_pretrained(dst)
+    make_word_level_tokenizer(vocab, dst, unk_token="t0", pad_token="t0", eos_token="<eod>")
 
 
 def test_generate_text_from_training_checkpoint(workdir, monkeypatch, capsys):  # noqa: F811

@@ -21,18 +21,13 @@ B_ID, E_ID = 250, 251
 def _build_tokenizer_dir(dst: Path) -> None:
     """Tiny WordLevel HF tokenizer, fully offline, whose vocab carries the span
     markers at the ids the packed stream uses."""
-    tokenizers = pytest.importorskip("tokenizers")
-    from tokenizers.models import WordLevel
-    from tokenizers.pre_tokenizers import Whitespace
-    from transformers import PreTrainedTokenizerFast
+    from tests.conftest import make_word_level_tokenizer
 
     vocab = {f"tok{i}": i for i in range(250)}
     vocab["<b_inc>"] = B_ID
     vocab["<e_inc>"] = E_ID
     vocab["<pad>"] = 252
-    tok = tokenizers.Tokenizer(WordLevel(vocab, unk_token="<pad>"))
-    tok.pre_tokenizer = Whitespace()
-    PreTrainedTokenizerFast(tokenizer_object=tok, pad_token="<pad>").save_pretrained(dst)
+    make_word_level_tokenizer(vocab, dst, unk_token="<pad>", pad_token="<pad>")
 
 
 @pytest.fixture

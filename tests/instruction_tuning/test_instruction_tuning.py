@@ -66,3 +66,74 @@ def test_create_instruction_tuning_data_builds_indexes(it_config):
     out_dir = next((tmp_path / "out").glob("conversations_*"))
     idx_files = list(out_dir.glob("*.idx"))
     assert idx_files, "no index files created"
+
+
+def test_full_instruction_tuning_prep_chain_to_pbin(it_config):
+    """The reference's e2e prep contract (test_e2e_instruction_tuning:
+    data_preperation + check_correct_packing): chat template -> partitioned jsonl
+    -> .idx -> .pbin per partition, with the packed token streams decoding back to
+    the chat-formatted text. Fully offline via a tiny WordLevel HF tokenizer."""
+    import numpy as np
+
+    from tests.conftest import make_word_level_tokenizer
+    from modalities_tpu.dataloader.dataset import PackedMemMapDatasetBase
+    from transformers import PreTrainedTokenizerFast
+
+    config_path, config, tmp_path = it_config
+
+    # offline tokenizer whose vocab covers the chat-template output words
+    # (the Whitespace pre-tokenizer splits "User:" into "User" + ":")
+    words = {"User", "Assistant", ":", "<eod>", "hi", "hello"}
+    words |= {str(i) for i in range(50)}
+    vocab = {w: i for i, w in enumerate(sorted(words))}
+    vocab["<unk>"] = len(vocab)
+    tok_dir = tmp_path / "tok"
+    make_word_level_tokenizer(vocab, tok_dir, unk_token="<unk>", eos_token="<eod>", pad_token="<unk>")
+
+    pbin_cfg = {
+        "settings": {
+            "src_path": "PLACEHOLDER",
+            "dst_path": "PLACEHOLDER",
+            "index_path": "PLACEHOLDER",
+            "jq_pattern": ".chat",
+            "num_cpus": 1,
+            "eod_token": "<eod>",
+            "processing_batch_size": 8,
+            "raw_samples_queue_size": 8,
+            "processed_samples_queue_size": 8,
+        },
+        "tokenizer": {
+            "component_key": "tokenizer",
+            "variant_key": "pretrained_hf_tokenizer",
+            "config": {"pretrained_model_name_or_path": str(tok_dir)},
+        },
+    }
+    pbin_cfg_path = tmp_path / "pbin_config.yaml"
+    pbin_cfg_path.write_text(yaml.safe_dump(pbin_cfg))
+    config["settings"]["pbin_creation_config_file_path"] = str(pbin_cfg_path)
+    config_path.write_text(yaml.safe_dump(config))
+
+    create_instruction_tuning_data(config_path)
+
+    out_dir = next((tmp_path / "out").glob("conversations_*"))
+    for suffix in (".jsonl", ".idx", ".pbin"):
+        found = list(out_dir.glob(f"*{suffix}"))
+        assert len(found) == 3, (suffix, found)  # train/val/test partitions
+
+    # the packed stream decodes back to the chat-formatted text of its partition
+    hf_tok = PreTrainedTokenizerFast.from_pretrained(tok_dir)
+    for pbin in out_dir.glob("*.pbin"):
+        ds = PackedMemMapDatasetBase(pbin, sample_key="text")
+        jsonl = pbin.with_suffix(".jsonl")
+        lines = [json.loads(line)["chat"] for line in jsonl.read_text().splitlines()]
+        assert len(ds) == len(lines) > 0
+        first = np.asarray(ds[0]["text"])
+        decoded = hf_tok.decode(first)
+        assert "User" in decoded and "Assistant" in decoded
+        # the eod CONTRACT, not just presence (the template already emits <eod>
+        # after each message): the document ends with exactly one eod id and
+        # carries one per message — a broken packer eod-append or a double-append
+        # both change this count
+        eod_id = hf_tok.convert_tokens_to_ids("<eod>")
+        assert first[-1] == eod_id
+        assert int((first == eod_id).sum()) == 2  # one per message, no extra append
