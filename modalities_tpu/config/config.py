@@ -414,6 +414,15 @@ class RepeatingDataLoaderConfig(BaseModel):
     reshuffle_after_epoch: Optional[bool] = False
 
 
+class DeviceFeederConfig(BaseModel):
+    """Async host→device input pipeline (device_feeder.default).
+
+    prefetch_to_device is the queue depth of device-resident batches staged
+    ahead of the step loop; 0 restores the synchronous inline path."""
+
+    prefetch_to_device: Annotated[int, Field(strict=True, ge=0)] = 2
+
+
 # ---------------------------------------------------------------------- tokenizers
 
 

@@ -20,6 +20,7 @@ from modalities_tpu.config.pydantic_if_types import (
     PydanticAppStateType,
     PydanticCheckpointSavingIFType,
     PydanticDatasetIFType,
+    PydanticDeviceFeederIFType,
     PydanticDeviceMeshIFType,
     PydanticGradientClipperIFType,
     PydanticLLMDataLoaderIFType,
@@ -33,6 +34,23 @@ from modalities_tpu.config.pydantic_if_types import (
 from modalities_tpu.utils.logging import warn_rank_0
 
 logger = logging.getLogger(__name__)
+
+
+def _reject_unsupported_dropout(app_state, device_mesh) -> None:
+    """Config-time guard: attention-probability dropout is unimplemented on the
+    cp ring-attention path (the ring kernel fuses softmax statistics), so a
+    `dropout > 0` model on a mesh with a cp axis would only fail with a
+    NotImplementedError at the first forward, deep inside a run. Reject it when
+    the component graph is assembled instead (covers `validate_recipe` too)."""
+    model = getattr(app_state, "model", None)
+    spec = getattr(model, "config_spec", None)
+    dropout = getattr(spec, "dropout", 0.0)
+    if device_mesh is not None and dropout > 0.0 and device_mesh.degrees.get("cp", 1) > 1:
+        raise ValueError(
+            "dropout > 0 is not supported with a cp (context-parallel) mesh axis: "
+            "the ring-attention path has no attention-probability dropout hook. "
+            "Set dropout: 0.0 or drop the cp axis."
+        )
 
 
 class DistEnvSettings(BaseModel):
@@ -177,7 +195,13 @@ class TrainingComponentsInstantiationModel(BaseModel):
     mfu_calculator: Optional[PydanticMFUCalculatorIFType] = None
     scheduled_pipeline: Optional[PydanticPipelineIFType] = None
     device_mesh: Optional[PydanticDeviceMeshIFType] = None
+    device_feeder: Optional[PydanticDeviceFeederIFType] = None
     model_raw: Optional[Any] = None
+
+    @model_validator(mode="after")
+    def _check_dropout_supported(self) -> "TrainingComponentsInstantiationModel":
+        _reject_unsupported_dropout(self.app_state, self.device_mesh)
+        return self
 
     @model_validator(mode="after")
     def _check_token_amount_in_dataset(self) -> "TrainingComponentsInstantiationModel":
@@ -206,6 +230,11 @@ class RecipeValidationInstantiationModel(BaseModel):
     loss_fn: PydanticLossIFType
     gradient_clipper: PydanticGradientClipperIFType
     device_mesh: PydanticDeviceMeshIFType
+
+    @model_validator(mode="after")
+    def _check_dropout_supported(self) -> "RecipeValidationInstantiationModel":
+        _reject_unsupported_dropout(self.app_state, self.device_mesh)
+        return self
 
 
 class PackedDatasetComponentsInstantiationModel(BaseModel):

@@ -64,6 +64,7 @@ PydanticSamplerIFType = _lazy("modalities_tpu.dataloader.samplers", "SamplerIF")
 PydanticBatchSamplerIFType = _lazy("modalities_tpu.dataloader.samplers", "BatchSamplerIF")
 PydanticCollateFnIFType = _lazy("modalities_tpu.dataloader.collate_fns.collate_if", "CollateFnIF")
 PydanticLLMDataLoaderIFType = _lazy("modalities_tpu.dataloader.dataloader", "LLMDataLoader")
+PydanticDeviceFeederIFType = _lazy("modalities_tpu.dataloader.device_feeder", "DeviceFeeder")
 PydanticTokenizerIFType = _lazy("modalities_tpu.tokenization.tokenizer_wrapper", "TokenizerWrapper")
 PydanticAppStateType = _lazy("modalities_tpu.checkpointing.stateful.app_state_factory", "AppStateSpec")
 PydanticCheckpointSavingIFType = _lazy("modalities_tpu.checkpointing.checkpoint_saving", "CheckpointSaving")

@@ -1,0 +1,201 @@
+"""DeviceFeeder — async host→device input pipeline.
+
+The device-side train step is one fused jit program, but every optimizer step
+used to pay a synchronous host tax inline in the Trainer loop: stacking
+`gradient_acc_steps` microbatches along the leading acc dim and running
+`put_batch` (the cp-aware per-process sequence slice plus the sharded
+`device_put` / `make_array_from_process_local_data` transfer). The feeder moves
+that whole path into ONE background thread that stays `prefetch_to_device`
+batches ahead of the step loop, so the transfer for step N+1 overlaps the device
+executing step N — the GSPMD per-host feeding model (arXiv:2105.04663) where
+input transfer is never on the critical path.
+
+Multi-host safety: each process runs exactly one producer thread over its own
+deterministic loader stream and enqueues transfers strictly in loader order, so
+every process issues its `make_array_from_process_local_data` calls for the same
+global batches in the same order — the same ordering contract the old inline
+path provided, just one thread away from the step loop. The transfers themselves
+are collective-free (purely local H2D placement), so overlapping them with the
+main thread's step dispatch cannot deadlock collectives.
+
+`prefetch_to_device: 0` disables the thread entirely: batches are assembled and
+transferred inline in `__next__` (the old synchronous behavior, bit-identical by
+the feeder-equivalence tests) — both a kill switch and the baseline the async
+path is measured against.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Callable, Iterator
+
+import numpy as np
+
+from modalities_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+_SENTINEL = object()
+
+
+class DeviceBatchIterator:
+    """Iterates device-ready batches; accounts the time the consumer spent blocked.
+
+    `take_stall_s()` returns-and-resets the accumulated host-stall seconds: in
+    async mode the time `__next__` blocked on the queue, in sync mode the full
+    inline assemble+transfer time. Either way it is exactly the step-loop time
+    NOT overlapped with device execution — the number the Trainer subtracts from
+    the wall clock to publish the device-time throughput split.
+
+    Exceptions raised in the producer (a poisoned dataset, a failed transfer)
+    propagate promptly out of `__next__`; `close()` stops and joins the producer
+    when the consumer bails early (target steps reached, an error mid-loop).
+    """
+
+    def __init__(self, host_batches: Iterator, put_fn: Callable, prefetch: int):
+        self._host_batches = host_batches
+        self._put_fn = put_fn
+        self._stall_s = 0.0
+        self._done = False
+        self._thread: threading.Thread | None = None
+        if prefetch > 0:
+            self._queue: queue.Queue = queue.Queue(maxsize=prefetch)
+            self._error: list[BaseException] = []
+            self._stop = threading.Event()
+            self._thread = threading.Thread(
+                target=self._produce, daemon=True, name="device-feeder"
+            )
+            self._thread.start()
+
+    def _produce(self) -> None:
+        try:
+            for host_batch in self._host_batches:
+                item = self._put_fn(host_batch)
+                while not self._stop.is_set():
+                    try:
+                        self._queue.put(item, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+                if self._stop.is_set():
+                    return
+        except BaseException as e:  # propagate into the consumer
+            self._error.append(e)
+        finally:
+            # the end-of-stream sentinel must land even when the queue is full of
+            # unconsumed batches; a set stop flag means the consumer is closing
+            # and no longer reads the queue at all
+            while not self._stop.is_set():
+                try:
+                    self._queue.put(_SENTINEL, timeout=0.1)
+                    break
+                except queue.Full:
+                    continue
+
+    def __iter__(self) -> "DeviceBatchIterator":
+        return self
+
+    def __next__(self):
+        t0 = time.perf_counter()
+        try:
+            if self._thread is None:
+                host_batch = next(self._host_batches)  # StopIteration ends the loop
+                return self._put_fn(host_batch)
+            item = self._queue.get()
+            if item is _SENTINEL:
+                self._done = True
+                if self._error:
+                    raise self._error[0]
+                raise StopIteration
+            return item
+        finally:
+            self._stall_s += time.perf_counter() - t0
+
+    def take_stall_s(self) -> float:
+        """Accumulated consumer-blocked seconds since the last call (then reset)."""
+        stall, self._stall_s = self._stall_s, 0.0
+        return stall
+
+    def close(self) -> None:
+        """Stop the producer and join it — a consumer bailing early must not leak
+        a thread blocked on a full queue (or keep transferring a whole epoch)."""
+        if self._thread is None or self._done:
+            self._done = True
+            return
+        self._done = True
+        self._stop.set()
+        while self._thread.is_alive():
+            try:  # free a slot so a producer blocked in put() can see the stop flag
+                self._queue.get_nowait()
+            except queue.Empty:
+                pass
+            self._thread.join(timeout=0.1)
+        while True:  # drop batches flushed while the producer was exiting
+            try:
+                self._queue.get_nowait()
+            except queue.Empty:
+                break
+
+
+class DeviceFeeder:
+    """Registry component ("device_feeder", "default").
+
+    `prefetch_to_device` is the queue depth of device-resident batches staged
+    ahead of the step loop (default 2: one in flight, one ready); `0` restores
+    the synchronous inline path.
+    """
+
+    def __init__(self, prefetch_to_device: int = 2):
+        if prefetch_to_device < 0:
+            raise ValueError(f"prefetch_to_device must be >= 0, got {prefetch_to_device}")
+        self.prefetch_to_device = prefetch_to_device
+
+    def feed_train(
+        self, train_loader, put_batch: Callable, gradient_acc_steps: int
+    ) -> DeviceBatchIterator:
+        """Device-ready TRAIN batches: accumulate `gradient_acc_steps` microbatches,
+        stack them along the leading acc dim, transfer via `put_batch`. Trailing
+        microbatches that never form a full step are counted in the returned
+        iterator's `counters["dropped_microbatches"]` (valid once exhausted)."""
+        counters = {"dropped_microbatches": 0}
+
+        def host_batches():
+            micro_samples: list[dict] = []
+            micro_targets: list[dict] = []
+            for batch in train_loader:
+                micro_samples.append(batch.samples)
+                micro_targets.append(batch.targets)
+                if len(micro_samples) < gradient_acc_steps:
+                    continue
+                yield {
+                    "samples": {
+                        k: np.stack([m[k] for m in micro_samples]) for k in micro_samples[0]
+                    },
+                    "targets": {
+                        k: np.stack([m[k] for m in micro_targets]) for k in micro_targets[0]
+                    },
+                }
+                micro_samples, micro_targets = [], []
+            counters["dropped_microbatches"] = len(micro_samples)
+
+        it = DeviceBatchIterator(
+            host_batches(), lambda host: put_batch(host, has_acc_dim=True), self.prefetch_to_device
+        )
+        it.counters = counters
+        return it
+
+    def feed_eval(self, data_loader, put_batch: Callable) -> DeviceBatchIterator:
+        """Device-ready EVAL batches as (device_batch, local_num_samples) pairs —
+        no acc dim, no stacking; sample counts ride along for throughput."""
+
+        def host_batches():
+            for batch in data_loader:
+                yield {"samples": batch.samples, "targets": batch.targets}, len(batch)
+
+        def put(item):
+            host, num_samples = item
+            return put_batch(host, has_acc_dim=False), num_samples
+
+        return DeviceBatchIterator(host_batches(), put, self.prefetch_to_device)

@@ -6,7 +6,10 @@ import time
 
 import numpy as np
 
+from typing import Optional
+
 from modalities_tpu.batch import EvaluationResultBatch, ResultItem
+from modalities_tpu.dataloader.device_feeder import DeviceFeeder
 from modalities_tpu.logging_broker.messages import ExperimentStatus, MessageTypes, ProgressUpdate
 from modalities_tpu.logging_broker.publisher import MessagePublisher
 from modalities_tpu.training.train_step import StepFunctions
@@ -17,9 +20,11 @@ class Evaluator:
         self,
         progress_publisher: MessagePublisher,
         evaluation_result_publisher: MessagePublisher,
+        device_feeder: Optional[DeviceFeeder] = None,
     ) -> None:
         self.progress_publisher = progress_publisher
         self.evaluation_result_publisher = evaluation_result_publisher
+        self.device_feeder = device_feeder if device_feeder is not None else DeviceFeeder()
 
     def evaluate(
         self,
@@ -33,17 +38,21 @@ class Evaluator:
             start = time.perf_counter()
             losses = []
             num_samples = 0
-            for batch_id, batch in enumerate(data_loader):
-                device_batch = step_functions.put_batch(
-                    {"samples": batch.samples, "targets": batch.targets}, has_acc_dim=False
-                )
-                metrics = step_functions.eval_step(state, device_batch)
-                losses.append(metrics["loss"])
-                num_samples += len(batch)
-                self.progress_publisher.publish_message(
-                    ProgressUpdate(batch_id + 1, ExperimentStatus.EVALUATION, data_loader.dataloader_tag),
-                    MessageTypes.BATCH_PROGRESS_UPDATE,
-                )
+            # device-ready batches from the feeder pipeline: the transfer for
+            # batch N+1 overlaps the device evaluating batch N (same path as the
+            # Trainer, minus the acc-dim stacking)
+            feed = self.device_feeder.feed_eval(data_loader, step_functions.put_batch)
+            try:
+                for batch_id, (device_batch, batch_samples) in enumerate(feed):
+                    metrics = step_functions.eval_step(state, device_batch)
+                    losses.append(metrics["loss"])
+                    num_samples += batch_samples
+                    self.progress_publisher.publish_message(
+                        ProgressUpdate(batch_id + 1, ExperimentStatus.EVALUATION, data_loader.dataloader_tag),
+                        MessageTypes.BATCH_PROGRESS_UPDATE,
+                    )
+            finally:
+                feed.close()
             # fetch BEFORE reading the clock: dispatch returns early, so an elapsed
             # taken pre-sync times the host loop, not the device work — the same
             # honest-clock rule the trainer and bench.py follow (hard_sync lesson)

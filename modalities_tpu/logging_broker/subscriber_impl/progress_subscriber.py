@@ -71,3 +71,11 @@ class RichProgressSubscriber(MessageSubscriberIF[ProgressUpdate]):
             self._task_ids[tag] = self._progress.add_task(f"[cyan]{tag}", total=None)
         self._progress.update(self._task_ids[tag], completed=update.num_steps_done)
         self._progress.refresh()
+
+    def stop(self) -> None:
+        """Release the rich live display. rich allows only ONE live display per
+        console, so a run that ends (or dies) without stopping poisons every later
+        display in the process — Main.run calls this in a finally."""
+        if self._started:
+            self._progress.stop()
+            self._started = False

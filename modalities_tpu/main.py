@@ -162,20 +162,30 @@ class Main:
             mfu_calculator=components.mfu_calculator,
             profiler=components.profiler,
             debug_stats_logger=debug_stats_logger,
+            device_feeder=components.device_feeder,
         )
         evaluator = Evaluator(
-            progress_publisher=progress_publisher, evaluation_result_publisher=results_publisher
+            progress_publisher=progress_publisher,
+            evaluation_result_publisher=results_publisher,
+            device_feeder=components.device_feeder,
         )
         gym = Gym(trainer=trainer, evaluator=evaluator, loss_fun=components.loss_fn)
-        gym.run(
-            step_functions=step_functions,
-            train_data_loader=components.train_dataloader,
-            evaluation_data_loaders=components.eval_dataloaders,
-            checkpoint_saving=components.checkpoint_saving,
-            training_progress=training_progress,
-            evaluation_interval_in_steps=settings.intervals.evaluation_interval_in_steps,
-            checkpointing_interval_in_steps=settings.intervals.checkpointing_interval_in_steps,
-        )
+        try:
+            gym.run(
+                step_functions=step_functions,
+                train_data_loader=components.train_dataloader,
+                evaluation_data_loaders=components.eval_dataloaders,
+                checkpoint_saving=components.checkpoint_saving,
+                training_progress=training_progress,
+                evaluation_interval_in_steps=settings.intervals.evaluation_interval_in_steps,
+                checkpointing_interval_in_steps=settings.intervals.checkpointing_interval_in_steps,
+            )
+        finally:
+            # the rich live display is process-global; leaving it running after a
+            # crashed (or finished) run blocks every later live display in-process
+            stop = getattr(components.progress_subscriber, "stop", None)
+            if callable(stop):
+                stop()
 
 
 def _to_plain(obj):

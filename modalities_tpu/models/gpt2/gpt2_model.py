@@ -137,6 +137,19 @@ class GPT2LLMConfig(BaseModel):
         return self
 
     @model_validator(mode="after")
+    def check_dropout_supported(self) -> "GPT2LLMConfig":
+        # fail at config parse time, not NotImplementedError at the first forward
+        # deep inside a run: the Pallas dao_flash kernel fuses softmax statistics
+        # that attention-probability dropout would invalidate (see GPT2Attention)
+        if self.dropout > 0.0 and self.attention_implementation == AttentionImplementation.DAO_FLASH:
+            raise ValueError(
+                "dropout > 0 is not supported with attention_implementation: dao_flash "
+                "(the fused Pallas kernel has no dropout hook). Use manual or "
+                "pytorch_flash for exact reference dropout semantics, or set dropout: 0.0."
+            )
+        return self
+
+    @model_validator(mode="after")
     def validate_sizes(self) -> "GPT2LLMConfig":
         for param, name in zip(
             [self.ffn_hidden, self.vocab_size, self.n_embd], ["ffn_hidden", "vocab_size", "n_embd"]
@@ -257,8 +270,9 @@ def _manual_axis_active(axis_name: Optional[str]) -> bool:
     """True when tracing inside a shard_map region that binds `axis_name` manually."""
     if axis_name is None:
         return False
-    ambient = jax.sharding.get_abstract_mesh()
-    return ambient is not None and axis_name in getattr(ambient, "manual_axes", ())
+    from modalities_tpu.parallel.jax_compat import manual_axes
+
+    return axis_name in manual_axes()
 
 
 def cp_shard_offset(axis_name: Optional[str], local_seq_len: int):

@@ -157,8 +157,10 @@ def pipeline_blocks(
         manual_axes.add(seq_shard_axis)
         x_spec = P(None, None, seq_shard_axis)  # [M, B, S, ...]: seq sharded over cp
 
+    from modalities_tpu.parallel.jax_compat import shard_map
+
     param_specs = jax.tree.map(lambda _: P(axis_name), stacked_params)
-    fn = jax.shard_map(
+    fn = shard_map(
         functools.partial(
             _gpipe_local,
             axis_name=axis_name,

@@ -252,7 +252,9 @@ def scheduled_pipeline_loss_and_grads(
         seq_axis=seq_axis,
         rng=rng,
     )
-    fn = jax.shard_map(
+    from modalities_tpu.parallel.jax_compat import shard_map
+
+    fn = shard_map(
         local,
         mesh=mesh,
         in_specs=(param_specs, shared_specs, token_spec, token_spec),

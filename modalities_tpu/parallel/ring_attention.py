@@ -316,6 +316,24 @@ def _ring_flash_vjp_bwd(axis_name, causal, sm_scale, interpret, res, do):
 _ring_flash_local.defvjp(_ring_flash_vjp_fwd, _ring_flash_vjp_bwd)
 
 
+# Platform probe cached once per process: jax.devices() can trigger backend
+# initialization, which must never happen inside a shard_map body mid-trace.
+# Only the PROBE is cached — the MODALITIES_TPU_RING_IMPL override is re-read on
+# every ring_attention() call because the graft entrypoint mutates it at runtime
+# (e.g. forcing flash_interpret for CPU equivalence tests).
+_platform_is_tpu: bool | None = None
+
+
+def _probe_tpu_platform() -> bool:
+    global _platform_is_tpu
+    if _platform_is_tpu is None:
+        try:
+            _platform_is_tpu = jax.devices()[0].platform == "tpu"
+        except Exception:
+            _platform_is_tpu = False
+    return _platform_is_tpu
+
+
 def _ring_impl() -> str:
     """'flash' (Pallas hops) on TPU, 'dense' elsewhere; MODALITIES_TPU_RING_IMPL
     overrides (dense | flash | flash_interpret — the latter for CPU equivalence
@@ -330,16 +348,14 @@ def _ring_impl() -> str:
                 "flash_interpret — refusing to silently fall back to a default tier"
             )
         return override
-    try:
-        on_tpu = jax.devices()[0].platform == "tpu"
-    except Exception:
-        on_tpu = False
-    return "flash" if on_tpu else "dense"
+    return "flash" if _probe_tpu_platform() else "dense"
 
 
-def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool, sm_scale: float):
-    """Runs on each cp shard inside shard_map. q/k/v: [B, S_local, H(, kv), D]."""
-    impl = _ring_impl()
+def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool, sm_scale: float, impl: str):
+    """Runs on each cp shard inside shard_map. q/k/v: [B, S_local, H(, kv), D].
+    `impl` is resolved by the caller BEFORE entering the shard_map body — the
+    tier is baked into the traced program, so changing MODALITIES_TPU_RING_IMPL
+    after a step has compiled has no effect until a retrace."""
     if impl in ("flash", "flash_interpret"):
         return _ring_flash_local(
             q, k, v, axis_name, causal, sm_scale, impl == "flash_interpret"
@@ -351,7 +367,11 @@ def ring_attention(
     q, k, v, mesh, *, axis_name: str = "cp", causal: bool = True, sm_scale: float | None = None
 ):
     """Context-parallel attention. q: [B, S, Hq, D], k/v: [B, S, Hkv, D], with S
-    sharded over `axis_name`; all other axes left to GSPMD (shard_map auto mode)."""
+    sharded over `axis_name`; all other axes left to GSPMD (shard_map auto mode).
+
+    The kernel tier (dense | flash | flash_interpret) is resolved HERE, at trace
+    time, outside the shard_map body — it is baked into the compiled program.
+    """
     from jax.sharding import PartitionSpec as P
 
     if sm_scale is None:
@@ -361,17 +381,24 @@ def ring_attention(
     if mesh is None or axis_name not in mesh.axis_names or mesh.shape[axis_name] == 1:
         return jax.nn.dot_product_attention(q, k, v, is_causal=causal, scale=sm_scale)
 
+    impl = _ring_impl()
+
     # Already inside a manual region over cp (e.g. the pp pipeline's shard_map binds
     # {pp, cp})? Then q/k/v are per-shard local and collectives over cp are legal
     # directly — run the ring body without nesting another shard_map.
-    ambient = jax.sharding.get_abstract_mesh()
-    if ambient is not None and axis_name in getattr(ambient, "manual_axes", ()):
-        return _ring_attention_local(q, k, v, axis_name=axis_name, causal=causal, sm_scale=sm_scale)
+    from modalities_tpu.parallel.jax_compat import manual_axes, shard_map
+
+    if axis_name in manual_axes():
+        return _ring_attention_local(
+            q, k, v, axis_name=axis_name, causal=causal, sm_scale=sm_scale, impl=impl
+        )
 
     spec = P(None, axis_name, None, None)
     # only `cp` is manual; dp/tp stay auto so GSPMD keeps partitioning batch/heads
-    fn = jax.shard_map(
-        functools.partial(_ring_attention_local, axis_name=axis_name, causal=causal, sm_scale=sm_scale),
+    fn = shard_map(
+        functools.partial(
+            _ring_attention_local, axis_name=axis_name, causal=causal, sm_scale=sm_scale, impl=impl
+        ),
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,

@@ -150,8 +150,9 @@ def constrain_activation(x, logical_axes, explicit: bool = False):
     if not state:
         return x
     rules, mesh = state
-    ambient = jax.sharding.get_abstract_mesh()
-    if ambient is not None and getattr(ambient, "manual_axes", ()):
+    from modalities_tpu.parallel.jax_compat import manual_axes
+
+    if manual_axes():
         return x
     spec = logical_to_mesh_spec(tuple(logical_axes), rules)
     if not explicit and all(s is None for s in spec):

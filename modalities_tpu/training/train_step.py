@@ -510,65 +510,82 @@ class TrainStepBuilder:
             if k is not None
         }
 
-        def put(batch_dict: dict, has_acc_dim: bool = True) -> dict:
-            if data_sharding is None:
+        if data_sharding is None:
+
+            def put_plain(batch_dict: dict, has_acc_dim: bool = True) -> dict:
                 return jax.tree.map(jnp.asarray, batch_dict)
 
-            import jax.sharding as js
+            return put_plain
 
-            spec = tuple(data_sharding.spec)
-            batch_axes = spec[0]
-            seq_axis = spec[1] if len(spec) > 1 else None
+        import jax.sharding as js
 
-            _seq_slice_cache: dict[int, slice] = {}
+        spec = tuple(data_sharding.spec)
+        batch_axes = spec[0]
+        seq_axis = spec[1] if len(spec) > 1 else None
 
-            def local_seq_slice(seq_len: int) -> slice:
-                """This process's slice of a cp-sharded sequence dim. The loader
-                always yields FULL sequences, but make_array_from_process_local_data
-                treats local data as the per-process portion along dims whose
-                sharding spans processes and INFERS the global extent from it —
-                feeding the full sequence there silently builds a double-length
-                global sequence of duplicated tokens (caught by the 2-process cp
-                ring test). So when cp spans processes, slice first. Cached per
-                seq_len: the result depends only on (mesh, seq_axis, seq_len) and
-                the devices_indices_map walk is O(global devices) — too hot to
-                redo per leaf per step on a pod."""
-                if seq_len in _seq_slice_cache:
-                    return _seq_slice_cache[seq_len]
-                seq_sh = js.NamedSharding(data_sharding.mesh, js.PartitionSpec(seq_axis))
-                spans = sorted(
-                    {
-                        idx[0].indices(seq_len)[:2]
-                        for dev, idx in seq_sh.devices_indices_map((seq_len,)).items()
-                        if dev.process_index == jax.process_index()
-                    }
-                )
-                lo, hi = spans[0][0], spans[-1][1]
-                covered = 0
-                for s, e in spans:
-                    covered += e - s
-                if covered != hi - lo:
-                    raise NotImplementedError(
-                        f"this process's cp shards of the sequence are non-contiguous "
-                        f"({spans}): the per-host feeding path needs one contiguous "
-                        "block per process — reorder the mesh so cp is innermost "
-                        "within each host"
-                    )
-                _seq_slice_cache[seq_len] = slice(lo, hi)
+        # Both caches live OUTSIDE the per-call path and persist for the life of
+        # the returned closure: steady-state training sees the same (leaf key,
+        # shape, dtype, acc-dim) signatures every step, so the per-leaf
+        # NamedSharding construction and the O(global devices)
+        # devices_indices_map walk happen once per signature, not once per step.
+        _seq_slice_cache: dict[int, slice] = {}
+        _leaf_sharding_cache: dict[tuple, tuple] = {}
+
+        def local_seq_slice(seq_len: int) -> slice:
+            """This process's slice of a cp-sharded sequence dim. The loader
+            always yields FULL sequences, but make_array_from_process_local_data
+            treats local data as the per-process portion along dims whose
+            sharding spans processes and INFERS the global extent from it —
+            feeding the full sequence there silently builds a double-length
+            global sequence of duplicated tokens (caught by the 2-process cp
+            ring test). So when cp spans processes, slice first."""
+            if seq_len in _seq_slice_cache:
                 return _seq_slice_cache[seq_len]
+            seq_sh = js.NamedSharding(data_sharding.mesh, js.PartitionSpec(seq_axis))
+            spans = sorted(
+                {
+                    idx[0].indices(seq_len)[:2]
+                    for dev, idx in seq_sh.devices_indices_map((seq_len,)).items()
+                    if dev.process_index == jax.process_index()
+                }
+            )
+            lo, hi = spans[0][0], spans[-1][1]
+            covered = 0
+            for s, e in spans:
+                covered += e - s
+            if covered != hi - lo:
+                raise NotImplementedError(
+                    f"this process's cp shards of the sequence are non-contiguous "
+                    f"({spans}): the per-host feeding path needs one contiguous "
+                    "block per process — reorder the mesh so cp is innermost "
+                    "within each host"
+                )
+            _seq_slice_cache[seq_len] = slice(lo, hi)
+            return _seq_slice_cache[seq_len]
 
+        def leaf_sharding(leaf_key, shape: tuple, dtype, has_acc_dim: bool) -> tuple:
+            """(NamedSharding, seq_sharded) for one leaf signature, cached."""
+            sig = (leaf_key, shape, dtype, has_acc_dim)
+            cached = _leaf_sharding_cache.get(sig)
+            if cached is not None:
+                return cached
+            lead = (None,) if has_acc_dim else ()
+            data_dims = len(shape) - len(lead) - 1  # dims after the batch dim
+            tail = [None] * data_dims
+            seq_sharded = leaf_key in seq_sharded_keys and data_dims == 1
+            if seq_sharded:
+                tail[0] = seq_axis  # tokens [.., batch, seq]: seq shards over cp
+            full = js.NamedSharding(
+                data_sharding.mesh, js.PartitionSpec(*lead, batch_axes, *tail)
+            )
+            _leaf_sharding_cache[sig] = (full, seq_sharded)
+            return full, seq_sharded
+
+        def put(batch_dict: dict, has_acc_dim: bool = True) -> dict:
             def put_leaf(path, x):
                 x = np.asarray(x)
                 leaf_key = getattr(path[-1], "key", None) if path else None
-                lead = (None,) if has_acc_dim else ()
-                data_dims = x.ndim - len(lead) - 1  # dims after the batch dim
-                tail = [None] * data_dims
-                seq_sharded = leaf_key in seq_sharded_keys and data_dims == 1
-                if seq_sharded:
-                    tail[0] = seq_axis  # tokens [.., batch, seq]: seq shards over cp
-                full = js.NamedSharding(
-                    data_sharding.mesh, js.PartitionSpec(*lead, batch_axes, *tail)
-                )
+                full, seq_sharded = leaf_sharding(leaf_key, x.shape, x.dtype.str, has_acc_dim)
                 if jax.process_count() == 1:
                     return jax.device_put(x, full)
                 if seq_sharded and seq_axis is not None:

@@ -10,6 +10,10 @@ Usage:
 
 Experiment axes: microbatch, flash block sizes (via MODALITIES_TPU_FLASH_BLOCK_Q/K),
 remat policy (full vs selective-op save lists). BENCH_ITERS trims timing iterations.
+
+Each line carries bench.py's full throughput split: `value`/`step_time_s` are
+device-time (bench-comparable), `wall_step_time_s`/`tokens_per_sec_wall`/`mfu_wall`
+time the whole dispatch+fetch loop, and `host_stall_s` is their difference.
 """
 
 from __future__ import annotations

@@ -113,6 +113,69 @@ def build_and_step(local_rows_slice, mode="dp"):
     return float(metrics["loss"])
 
 
+def feeder_run() -> list[float]:
+    """DeviceFeeder equivalence over the cp ring (tentpole guard): 3 train steps on
+    a cp-over-the-whole-world mesh, microbatches staged through DeviceFeeder with
+    MP_FEEDER_PREFETCH (2 = async background transfers, 0 = sync inline). The
+    parent compares a single-process sync oracle against the 2-process async run —
+    guarding BOTH the feeder's multi-host enqueue-order contract and put_batch's
+    `local_seq_slice` (each process must transfer only its contiguous cp block of
+    the sequence, from a background thread)."""
+    from modalities_tpu.batch import DatasetBatch
+    from modalities_tpu.dataloader.device_feeder import DeviceFeeder
+    from modalities_tpu.loss_functions import CLMCrossEntropyLoss
+    from modalities_tpu.optimizers.optimizer_factory import OptimizerFactory
+    from modalities_tpu.running_env.device_mesh import get_device_mesh
+    from modalities_tpu.training.train_step import TrainStepBuilder
+    from tests.models.test_gpt2_model import tiny_gpt2
+
+    world = len(jax.devices())
+    mesh = get_device_mesh(
+        device_type="cpu",
+        data_parallel_shard_degree=1,
+        context_parallel_degree=world,
+        world_size=world,
+    )
+    model = tiny_gpt2("pytorch_flash", n_layer=2)
+    opt = OptimizerFactory.get_adam_w(
+        lr=1e-3, betas=(0.9, 0.95), eps=1e-8, weight_decay=0.1,
+        weight_decay_groups_excluded=["norm", "embedding"], wrapped_model=model,
+    )
+    fns = TrainStepBuilder(
+        model=model,
+        loss_fn=CLMCrossEntropyLoss(target_key="target_ids", prediction_key="logits"),
+        optimizer_spec=opt,
+        mesh_handle=mesh,
+        gradient_acc_steps=1,
+        grad_clip_norm=1.0,
+    ).build(seed=0)
+
+    def microbatches():
+        # dp=1: the batch dim is unsharded, so every process loads the SAME full
+        # rows; put_batch slices the cp-sharded sequence dim per process itself
+        for s in range(3):
+            rng = np.random.default_rng(200 + s)
+            tokens = rng.integers(0, 128, size=(8, 17))
+            yield DatasetBatch(
+                samples={"input_ids": tokens[:, :-1].astype(np.int32)},
+                targets={"target_ids": tokens[:, 1:].astype(np.int32)},
+            )
+
+    prefetch = int(os.environ.get("MP_FEEDER_PREFETCH", "2"))
+    feed = DeviceFeeder(prefetch_to_device=prefetch).feed_train(
+        microbatches(), fns.put_batch, gradient_acc_steps=1
+    )
+    losses = []
+    state = fns.app_state_handle.state
+    try:
+        for device_batch in feed:
+            state, metrics = fns.train_step(state, device_batch)
+            losses.append(float(metrics["loss"]))
+    finally:
+        feed.close()
+    return losses
+
+
 def ckpt_run(phase: str) -> list[float]:
     """Multi-process Orbax checkpointing contract (VERDICT r4 #3). Phases over the
     same deterministic 5-step curriculum (per-step seeded batches, dp over ALL
@@ -218,6 +281,10 @@ def main() -> None:
             for loss in ckpt_run(mode):
                 print(f"LOSS {loss:.6f}", flush=True)
             return
+        if mode == "feeder_cp":
+            for loss in feeder_run():
+                print(f"LOSS {loss:.6f}", flush=True)
+            return
         print(f"LOSS {build_and_step(local_rows_slice=False, mode=mode):.6f}", flush=True)
         return
     port, pid, nprocs = int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3])
@@ -243,6 +310,10 @@ def main() -> None:
 
     if mode.startswith("ckpt"):
         for loss in ckpt_run(mode):
+            print(f"LOSS {loss:.6f}", flush=True)
+        return
+    if mode == "feeder_cp":
+        for loss in feeder_run():
             print(f"LOSS {loss:.6f}", flush=True)
         return
     loss = build_and_step(local_rows_slice=True, mode=mode)

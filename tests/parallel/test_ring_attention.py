@@ -9,6 +9,14 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from modalities_tpu.models.gpt2.gpt2_model import manual_attention
 from modalities_tpu.parallel.ring_attention import ring_attention
+from modalities_tpu.parallel.jax_compat import PARTIAL_AUTO_SUPPORTED
+
+# the dp_shard=2 meshes leave dp auto while cp is manual — a partial-auto program
+# legacy jax runtimes cannot compile (jax_compat refuses at trace time)
+requires_partial_auto = pytest.mark.skipif(
+    not PARTIAL_AUTO_SUPPORTED,
+    reason="partial-auto shard_map unsupported on this jax runtime (see jax_compat)",
+)
 
 
 def _mesh(cp=4, dp=2):
@@ -25,6 +33,7 @@ def _rand(seed, b, s, hq, hkv, d):
 
 
 @pytest.mark.parametrize("hq,hkv", [(4, 4), (4, 2)])
+@requires_partial_auto
 def test_ring_attention_matches_oracle(hq, hkv):
     mesh = _mesh(cp=4, dp=2)
     q, k, v = _rand(0, 2, 32, hq, hkv, 16)
@@ -37,6 +46,7 @@ def test_ring_attention_matches_oracle(hq, hkv):
     np.testing.assert_allclose(np.asarray(got), np.asarray(expected), rtol=2e-5, atol=2e-5)
 
 
+@requires_partial_auto
 def test_ring_attention_non_causal():
     mesh = _mesh(cp=4, dp=2)
     q, k, v = _rand(1, 1, 16, 2, 2, 16)
@@ -47,6 +57,7 @@ def test_ring_attention_non_causal():
     np.testing.assert_allclose(np.asarray(got), np.asarray(expected), rtol=2e-5, atol=2e-5)
 
 
+@requires_partial_auto
 def test_ring_attention_gradients_match():
     mesh = _mesh(cp=4, dp=2)
     q, k, v = _rand(2, 1, 16, 2, 1, 8)
@@ -124,6 +135,7 @@ def flash_ring(monkeypatch):
 
 
 @pytest.mark.parametrize("hq,hkv", [(4, 4), (4, 2)])
+@requires_partial_auto
 def test_flash_ring_matches_oracle(flash_ring, hq, hkv):
     """Flash-hop ring (interpret mode) vs single-device oracle, causal + GQA."""
     mesh = _mesh(cp=4, dp=2)
@@ -135,6 +147,7 @@ def test_flash_ring_matches_oracle(flash_ring, hq, hkv):
     np.testing.assert_allclose(np.asarray(got), np.asarray(expected), rtol=2e-5, atol=2e-5)
 
 
+@requires_partial_auto
 def test_flash_ring_non_causal(flash_ring):
     mesh = _mesh(cp=4, dp=2)
     q, k, v = _rand(1, 1, 16, 2, 2, 16)
@@ -146,6 +159,7 @@ def test_flash_ring_non_causal(flash_ring):
 
 
 @pytest.mark.parametrize("hq,hkv", [(2, 1), (2, 2)])
+@requires_partial_auto
 def test_flash_ring_gradients_match_oracle(flash_ring, hq, hkv):
     """The custom_vjp ring backward (flash bwd kernels + rotating dk/dv accumulators)
     vs plain autodiff through the single-device oracle."""
@@ -183,7 +197,9 @@ def test_flash_ring_matches_dense_ring(flash_ring):
     sm = 1.0 / np.sqrt(q.shape[-1])
 
     def run(body):
-        fn = jax.shard_map(
+        from modalities_tpu.parallel.jax_compat import shard_map
+
+        fn = shard_map(
             body, mesh=mesh,
             in_specs=(P(None, "cp", None, None),) * 3,
             out_specs=P(None, "cp", None, None),

@@ -89,6 +89,32 @@ def test_probe_error_short_circuits_without_retry(bench):
     assert len(calls) == 1
 
 
+def test_probe_budget_caps_the_ladder(bench, monkeypatch):
+    """A wedged chip must cost at most BENCH_PROBE_BUDGET_S: rungs whose sleep
+    leaves no room for a useful probe are skipped outright (no sleeping against a
+    dead budget), so the r5 failure mode — the ladder alone outliving the driver
+    window and emitting NO JSON — cannot recur."""
+    monkeypatch.setenv("BENCH_PROBE_LADDER", "0,600,1200")
+    monkeypatch.setenv("BENCH_PROBE_BUDGET_S", "200")
+    calls = []
+    bench._probe_tpu = lambda timeout_s=180: (calls.append(timeout_s), "wedged")[1]
+    assert bench._probe_tpu_ladder() is False
+    # rung 1 probes (sleep 0); rung 2's 600 s sleep exceeds the remaining budget
+    # and is skipped BEFORE sleeping — exactly one probe, near-instant return
+    assert len(calls) == 1
+
+
+def test_probe_budget_shrinks_probe_timeout(bench, monkeypatch):
+    """The probe child's own timeout is clamped to the remaining budget, so even
+    the FIRST probe cannot run past BENCH_PROBE_BUDGET_S."""
+    monkeypatch.setenv("BENCH_PROBE_LADDER", "0")
+    monkeypatch.setenv("BENCH_PROBE_BUDGET_S", "100")
+    timeouts = []
+    bench._probe_tpu = lambda timeout_s=180: (timeouts.append(timeout_s), "wedged")[1]
+    assert bench._probe_tpu_ladder() is False
+    assert len(timeouts) == 1 and timeouts[0] <= 100.0
+
+
 # ------------------------------------------------- leader-first window flow
 
 
@@ -175,3 +201,32 @@ def test_never_lower_guard_only_when_leader_was_not_timed(bench, monkeypatch, ca
     # leader tried once by the ladder; guard does not retry it again (it already
     # failed this run), and exploration never runs without a leader result
     assert runs.count("680m_64k_flash_chunked") == 1
+
+
+# ------------------------------------------------- end-to-end CPU smoke
+
+
+def test_bench_cpu_smoke_emits_one_json_line():
+    """The whole bench, minimally configured, as the driver runs it: forced CPU,
+    probe off, one iteration — must exit 0 and print EXACTLY one parseable JSON
+    line carrying the wall/device split keys."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", "BENCH_TPU_PROBE": "0",
+           "BENCH_ITERS": "1", "BENCH_REPEATS": "1", "PALLAS_AXON_POOL_IPS": ""}
+    proc = subprocess.run(
+        [sys.executable, str(Path(__file__).parents[1] / "bench.py")],
+        capture_output=True, text=True, timeout=420, env=env,
+    )
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    json_lines = [ln for ln in proc.stdout.splitlines() if ln.startswith("{")]
+    assert len(json_lines) == 1, proc.stdout
+    out = json.loads(json_lines[0])
+    assert out["metric"] and isinstance(out["value"], float)
+    detail = out["detail"]
+    for key in ("wall_step_time_s", "tokens_per_sec_wall", "mfu_wall",
+                "host_stall_s", "boundary_stall_s"):
+        assert key in detail, (key, sorted(detail))
