@@ -244,6 +244,7 @@ _CPU_CANDIDATE = ("cpu_tiny", 2, 256, 4, 1024, 256, 4, "pytorch_flash", "float32
 
 def _run_candidate(cand, iters: int):
     """Build the train step for one candidate and time it. Returns the result dict."""
+    t_candidate_start = time.perf_counter()
     import jax
 
     from modalities_tpu.loss_functions import CLMCrossEntropyLoss
@@ -341,9 +342,12 @@ def _run_candidate(cand, iters: int):
     # step "took" 0.5 ms), so only fetching a value gives an honest clock.
     from modalities_tpu.util import hard_sync
 
+    t_build_done = time.perf_counter()
+
     # warmup/compile
     state, metrics = fns.train_step(state, batch)
     hard_sync(metrics["loss"])
+    t_warmup_done = time.perf_counter()
 
     # Per-iteration timing with a host sync each step: an aggregate over N steps
     # cannot distinguish a uniformly slow run from one degraded-relay window, and
@@ -413,6 +417,19 @@ def _run_candidate(cand, iters: int):
     mfu = tokens_per_sec * flops_per_token / peak_flops_per_chip()
     mfu_wall = tokens_per_sec_wall * flops_per_token / peak_flops_per_chip()
 
+    # The same goodput accounting the Trainer publishes per interval, over this
+    # candidate's whole run: build -> init, warmup -> compile_first_step, every
+    # timed iteration -> train_step; the remainder (numpy batch gen, inter-repeat
+    # bookkeeping) folds into `other` inside summary(). bench.py and the training
+    # loop therefore report the SAME bucket schema from the same ledger code.
+    from modalities_tpu.telemetry.goodput import GoodputLedger
+
+    ledger = GoodputLedger()
+    ledger.add_seconds("init", t_build_done - t_candidate_start)
+    ledger.add_seconds("compile_first_step", t_warmup_done - t_build_done)
+    ledger.add_seconds("train_step", float(np.sum([np.sum(ts) for ts in all_repeats])))
+    goodput = ledger.summary(wall_s=time.perf_counter() - t_candidate_start)
+
     baseline_mfu = 0.6867  # reference best (6.7B, 8xA100, README.md:339)
     return {
         "metric": "gpt_train_mfu_single_chip",
@@ -432,6 +449,7 @@ def _run_candidate(cand, iters: int):
             "mfu_wall": round(mfu_wall, 4),
             "host_stall_s": round(host_stall_s, 4),
             "boundary_stall_s": 0.0,
+            "goodput": goodput,
             # per-iteration evidence: each inner list is one repeat's host-synced
             # iteration times; value above = median of the best (fastest-median) repeat
             "repeats_s": [[round(t, 4) for t in ts] for ts in all_repeats],

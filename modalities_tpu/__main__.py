@@ -292,6 +292,24 @@ def entry_point_analyze_debug_logs(
         click.echo(format_debug_log_rows(rows))
 
 
+@data.command(name="analyze_telemetry")
+@click.option("--sink_path", type=click.Path(exists=True, path_type=Path), required=True,
+              help="A telemetry_rank_N.jsonl file, or the telemetry folder holding them.")
+@click.option("--as_json", is_flag=True, default=False, help="Emit the summary dict as JSON.")
+@_exception_handling
+def entry_point_analyze_telemetry(sink_path: Path, as_json: bool) -> None:
+    """Summarize a run's telemetry JSONL sink into a per-rank goodput table:
+    every wall-clock second attributed to a bucket (init, compile, train_step,
+    data_stall, eval, checkpoint, publish, other) plus goodput %."""
+    from modalities_tpu.telemetry.goodput import format_goodput_table, summarize_sink
+
+    summary = summarize_sink(sink_path)
+    if as_json:
+        click.echo(json.dumps(summary))
+    else:
+        click.echo(format_goodput_table(summary))
+
+
 # ---------------------------------------------------------------------- benchmark
 
 

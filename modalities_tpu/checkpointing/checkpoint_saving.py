@@ -6,6 +6,7 @@ from __future__ import annotations
 from modalities_tpu.checkpointing.checkpoint_saving_execution import CheckpointSavingExecutionABC
 from modalities_tpu.checkpointing.checkpoint_saving_strategies import CheckpointSavingStrategyIF
 from modalities_tpu.checkpointing.stateful.app_state import AppStateHandle
+from modalities_tpu.telemetry import span
 from modalities_tpu.training.training_progress import TrainingProgress
 
 
@@ -19,14 +20,15 @@ class CheckpointSaving:
         self.checkpoint_saving_execution = checkpoint_saving_execution
 
     def save_checkpoint(self, training_progress: TrainingProgress, app_state_handle: AppStateHandle) -> None:
-        instruction = self.checkpoint_saving_strategy.get_checkpoint_instruction(
-            training_progress=training_progress
-        )
-        self.checkpoint_saving_execution.run_checkpoint_instruction(
-            checkpointing_instruction=instruction,
-            training_progress=training_progress,
-            app_state_handle=app_state_handle,
-        )
+        with span("checkpoint_save"):
+            instruction = self.checkpoint_saving_strategy.get_checkpoint_instruction(
+                training_progress=training_progress
+            )
+            self.checkpoint_saving_execution.run_checkpoint_instruction(
+                checkpointing_instruction=instruction,
+                training_progress=training_progress,
+                app_state_handle=app_state_handle,
+            )
 
     def wait_until_finished(self) -> None:
         """Drain pending (async) saves; flushes the deferred resume pointer."""

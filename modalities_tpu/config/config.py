@@ -423,6 +423,24 @@ class DeviceFeederConfig(BaseModel):
     prefetch_to_device: Annotated[int, Field(strict=True, ge=0)] = 2
 
 
+class TelemetryConfig(BaseModel):
+    """Telemetry subsystem (telemetry.default): span tracing + goodput ledger +
+    hang watchdog + per-rank JSONL sink.
+
+    enabled=False swaps every call for an allocation-free no-op.
+    output_folder_path defaults to <experiment folder>/telemetry (set by Main).
+    watchdog_deadline_s: no completed step within this budget dumps a crash
+    artifact (all-thread stacks, device memory, feeder queue); 0 disables.
+    watchdog_first_step_factor stretches the first deadline (trace + compile).
+    """
+
+    enabled: bool = True
+    output_folder_path: Optional[Path] = None
+    watchdog_deadline_s: Annotated[float, Field(ge=0)] = 1800.0
+    watchdog_first_step_factor: Annotated[float, Field(ge=1)] = 4.0
+    use_jax_annotations: bool = True
+
+
 # ---------------------------------------------------------------------- tokenizers
 
 

@@ -29,6 +29,7 @@ from modalities_tpu.config.pydantic_if_types import (
     PydanticMFUCalculatorIFType,
     PydanticPipelineIFType,
     PydanticProfilerIFType,
+    PydanticTelemetryIFType,
     PydanticTokenizerIFType,
 )
 from modalities_tpu.utils.logging import warn_rank_0
@@ -196,6 +197,7 @@ class TrainingComponentsInstantiationModel(BaseModel):
     scheduled_pipeline: Optional[PydanticPipelineIFType] = None
     device_mesh: Optional[PydanticDeviceMeshIFType] = None
     device_feeder: Optional[PydanticDeviceFeederIFType] = None
+    telemetry: Optional[PydanticTelemetryIFType] = None
     model_raw: Optional[Any] = None
 
     @model_validator(mode="after")

@@ -65,6 +65,7 @@ PydanticBatchSamplerIFType = _lazy("modalities_tpu.dataloader.samplers", "BatchS
 PydanticCollateFnIFType = _lazy("modalities_tpu.dataloader.collate_fns.collate_if", "CollateFnIF")
 PydanticLLMDataLoaderIFType = _lazy("modalities_tpu.dataloader.dataloader", "LLMDataLoader")
 PydanticDeviceFeederIFType = _lazy("modalities_tpu.dataloader.device_feeder", "DeviceFeeder")
+PydanticTelemetryIFType = _lazy("modalities_tpu.telemetry", "Telemetry")
 PydanticTokenizerIFType = _lazy("modalities_tpu.tokenization.tokenizer_wrapper", "TokenizerWrapper")
 PydanticAppStateType = _lazy("modalities_tpu.checkpointing.stateful.app_state_factory", "AppStateSpec")
 PydanticCheckpointSavingIFType = _lazy("modalities_tpu.checkpointing.checkpoint_saving", "CheckpointSaving")

@@ -118,6 +118,18 @@ class DeviceBatchIterator:
         stall, self._stall_s = self._stall_s, 0.0
         return stall
 
+    def queue_state(self) -> dict:
+        """Diagnostic snapshot for the telemetry watchdog's crash artifact: is the
+        producer alive and how full is the staging queue when a step wedges?"""
+        return {
+            "mode": "sync" if self._thread is None else "async",
+            "queue_size": self._queue.qsize() if self._thread is not None else 0,
+            "producer_alive": self._thread.is_alive() if self._thread is not None else False,
+            "done": self._done,
+            "pending_error": repr(self._error[0]) if self._thread is not None and self._error else None,
+            "stall_s_accumulated": round(self._stall_s, 6),
+        }
+
     def close(self) -> None:
         """Stop the producer and join it — a consumer bailing early must not leak
         a thread blocked on a full queue (or keep transferring a whole epoch)."""

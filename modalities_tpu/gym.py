@@ -5,6 +5,7 @@ from __future__ import annotations
 from typing import Optional
 
 from modalities_tpu.evaluator import Evaluator
+from modalities_tpu.telemetry import span
 from modalities_tpu.trainer import Trainer
 from modalities_tpu.training.train_step import StepFunctions
 from modalities_tpu.training.training_progress import TrainingProgress
@@ -72,7 +73,8 @@ class Gym:
             # (exit 0 with a lost final checkpoint would silently break warmstart).
             if checkpoint_saving is not None and hasattr(checkpoint_saving, "wait_until_finished"):
                 try:
-                    checkpoint_saving.wait_until_finished()
+                    with span("checkpoint_drain"):
+                        checkpoint_saving.wait_until_finished()
                 except Exception:  # noqa: BLE001
                     logger.exception("draining async checkpoint saves failed during shutdown")
                     if training_succeeded:

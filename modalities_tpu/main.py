@@ -26,6 +26,7 @@ from modalities_tpu.logging_broker.messages import MessageTypes
 from modalities_tpu.logging_broker.publisher import MessagePublisher
 from modalities_tpu.registry.components import COMPONENTS
 from modalities_tpu.registry.registry import ComponentEntity, Registry
+from modalities_tpu.telemetry import Telemetry, set_active_telemetry
 from modalities_tpu.trainer import Trainer
 from modalities_tpu.training.train_step import TrainStepBuilder
 from modalities_tpu.training.training_progress import TrainingProgress
@@ -67,6 +68,39 @@ class Main:
         return self.component_factory.build_components(self.config_dict, components_model_type)
 
     def run(self, components: TrainingComponentsInstantiationModel) -> None:
+        # telemetry is on by default: use the configured component when present,
+        # otherwise a default instance. The sink/artifact folder rides with the
+        # experiment folder so every run leaves its goodput record next to its
+        # results. Activated process-globally so deep call sites (checkpointing,
+        # evaluator) reach it via the free `span()` — restored in `finally`.
+        telemetry = getattr(components, "telemetry", None) or Telemetry()
+        # the sink lands next to evaluation_results.jsonl: prefer the explicit
+        # constructor root, else the config's settings.paths.experiments_root_path
+        # (the CLI `run` path, where Main gets no experiments_root_path argument)
+        experiments_root = self.experiments_root_path
+        if experiments_root is None:
+            configured = (self.config_dict.get("settings", {}).get("paths", {}) or {}).get(
+                "experiments_root_path"
+            )
+            experiments_root = Path(configured) if configured else None
+        if experiments_root is not None:
+            telemetry.set_output_folder(experiments_root / self.experiment_id / "telemetry")
+        previous_telemetry = set_active_telemetry(telemetry)
+        try:
+            self._run_training(components, telemetry)
+        finally:
+            # seal the telemetry record on BOTH the success and the crash path —
+            # a killed run with no goodput summary is the failure mode this PR
+            # exists to prevent — and restore the previous active telemetry so
+            # in-process back-to-back runs (tests) don't leak a closed sink.
+            # This finally covers build/init failures too, not just gym.run.
+            try:
+                telemetry.close()
+            except Exception:
+                logger.exception("closing telemetry failed during shutdown")
+            set_active_telemetry(previous_telemetry)
+
+    def _run_training(self, components: TrainingComponentsInstantiationModel, telemetry: Telemetry) -> None:
         settings = components.settings
 
         # persist resolved config into the experiment folder (reference main.py:134-143)
@@ -104,26 +138,32 @@ class Main:
                     "and no experiments_root_path to derive one — debug stats are DISABLED"
                 )
 
-        builder = TrainStepBuilder(
-            model=app_state_spec.model,
-            loss_fn=components.loss_fn,
-            optimizer_spec=app_state_spec.optimizer,
-            scheduler_spec=app_state_spec.lr_scheduler,
-            mesh_handle=components.device_mesh,
-            gradient_acc_steps=step_profile.gradient_accumulation_steps,
-            grad_clip_norm=getattr(clipper, "max_norm", None),
-            grad_clipper=clipper if hasattr(clipper, "build_transform") else None,
-            expose_grads=debug_stats_logger is not None,
-        )
-        step_functions = builder.build()
+        with telemetry.span("init"):
+            builder = TrainStepBuilder(
+                model=app_state_spec.model,
+                loss_fn=components.loss_fn,
+                optimizer_spec=app_state_spec.optimizer,
+                scheduler_spec=app_state_spec.lr_scheduler,
+                mesh_handle=components.device_mesh,
+                gradient_acc_steps=step_profile.gradient_accumulation_steps,
+                grad_clip_norm=getattr(clipper, "max_norm", None),
+                grad_clipper=clipper if hasattr(clipper, "build_transform") else None,
+                expose_grads=debug_stats_logger is not None,
+            )
+            step_functions = builder.build()
 
-        if app_state_spec.checkpoint_dir_path is not None:
-            loader = app_state_spec.checkpoint_loading
-            if loader is None:
-                from modalities_tpu.checkpointing.orbax.orbax_checkpoint_loading import OrbaxCheckpointLoading
+            if app_state_spec.checkpoint_dir_path is not None:
+                with telemetry.span("checkpoint_restore"):
+                    loader = app_state_spec.checkpoint_loading
+                    if loader is None:
+                        from modalities_tpu.checkpointing.orbax.orbax_checkpoint_loading import (
+                            OrbaxCheckpointLoading,
+                        )
 
-                loader = OrbaxCheckpointLoading()
-            loader.load_app_state(step_functions.app_state_handle, app_state_spec.checkpoint_dir_path)
+                        loader = OrbaxCheckpointLoading()
+                    loader.load_app_state(
+                        step_functions.app_state_handle, app_state_spec.checkpoint_dir_path
+                    )
 
         num_params = get_total_number_of_trainable_parameters(step_functions.app_state_handle.state)
         print_rank_0(f"experiment {self.experiment_id}: {num_params:,} trainable parameters")
@@ -163,6 +203,7 @@ class Main:
             profiler=components.profiler,
             debug_stats_logger=debug_stats_logger,
             device_feeder=components.device_feeder,
+            telemetry=telemetry,
         )
         evaluator = Evaluator(
             progress_publisher=progress_publisher,

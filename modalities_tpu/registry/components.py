@@ -21,6 +21,7 @@ from modalities_tpu.dataloader.collate_fns.collator_fn_wrapper_for_loss_masking 
 )
 from modalities_tpu.dataloader.dataloader_factory import DataloaderFactory
 from modalities_tpu.dataloader.device_feeder import DeviceFeeder
+from modalities_tpu.telemetry import Telemetry
 from modalities_tpu.dataloader.dataset import DummyDataset, DummyDatasetConfig
 from modalities_tpu.dataloader.dataset_factory import DatasetFactory
 from modalities_tpu.dataloader.sampler_factory import BatchSamplerFactory, SamplerFactory
@@ -304,6 +305,8 @@ COMPONENTS: list[ComponentEntity] = [
     ComponentEntity("data_loader", "default", DataloaderFactory.get_dataloader, cfg.LLMDataLoaderConfig),
     ComponentEntity("data_loader", "repeating_data_loader", _repeating_dataloader, cfg.RepeatingDataLoaderConfig),
     ComponentEntity("device_feeder", "default", DeviceFeeder, cfg.DeviceFeederConfig),
+    # telemetry (spans + goodput + watchdog + sink; on by default via Main)
+    ComponentEntity("telemetry", "default", Telemetry, cfg.TelemetryConfig),
     # checkpointing
     ComponentEntity(
         "checkpoint_saving_strategy",

@@ -1,0 +1,213 @@
+"""Telemetry subsystem: step-aligned tracing, goodput ledger, hang watchdog, sink.
+
+One `Telemetry` object per process composes the four parts:
+
+- `spans.SpanRecorder` — host phases as spans doubling as profiler annotations
+- `goodput.GoodputLedger` — every wall second classified into a bucket
+- `watchdog.Watchdog` — per-step heartbeat; wedged step -> crash artifact
+- `sink.TelemetrySink` — per-rank always-flushed JSONL event stream
+
+Deep call sites (checkpointing, evaluator) use the module-level `span("name")`
+free function, which routes to the process-global active telemetry — no DI
+plumbing through every layer. `Main` constructs/activates the instance (it is a
+registry component, on by default); everything degrades to an allocation-free
+no-op when disabled, so library code never guards its telemetry calls.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+from pathlib import Path
+from typing import Callable, Optional, Union
+
+from modalities_tpu.telemetry.goodput import BUCKETS, GoodputLedger
+from modalities_tpu.telemetry.sink import TelemetrySink
+from modalities_tpu.telemetry.spans import NULL_CONTEXT, SpanRecorder, step_trace_annotation
+from modalities_tpu.telemetry.watchdog import Watchdog
+from modalities_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+def _default_rank() -> int:
+    try:
+        return int(os.environ["RANK"])
+    except (KeyError, ValueError):
+        pass
+    try:
+        import jax
+
+        return jax.process_index()
+    except Exception:
+        return 0
+
+
+class Telemetry:
+    """Facade over recorder + ledger + watchdog + sink.
+
+    `enabled=False` is the fast path: `span()`/`step_annotation()` return a shared
+    no-op context manager and every other method returns immediately — safe to
+    call unconditionally from hot loops.
+    """
+
+    def __init__(
+        self,
+        enabled: bool = True,
+        output_folder_path: Optional[Union[str, Path]] = None,
+        watchdog_deadline_s: float = 1800.0,
+        watchdog_first_step_factor: float = 4.0,
+        use_jax_annotations: bool = True,
+        global_rank: Optional[int] = None,
+    ):
+        self.enabled = enabled
+        self.watchdog_deadline_s = float(watchdog_deadline_s)
+        self.watchdog_first_step_factor = float(watchdog_first_step_factor)
+        self._sink: Optional[TelemetrySink] = None
+        self._watchdog: Optional[Watchdog] = None
+        self._pending_state_providers: list[Callable[[], dict]] = []
+        self._folder: Optional[Path] = None
+        if not enabled:
+            self.global_rank = 0
+            self._recorder = None
+            self.ledger = GoodputLedger()  # inert but present: summary() stays callable
+            return
+        self.global_rank = _default_rank() if global_rank is None else global_rank
+        self.ledger = GoodputLedger()
+        self._recorder = SpanRecorder(on_record=self._on_record, use_jax_annotations=use_jax_annotations)
+        if output_folder_path is not None:
+            self.set_output_folder(output_folder_path)
+
+    # ------------------------------------------------------------------ spans
+
+    def span(self, name: str):
+        if not self.enabled:
+            return NULL_CONTEXT
+        return self._recorder.span(name)
+
+    def step_annotation(self, step_id: int):
+        if not self.enabled:
+            return NULL_CONTEXT
+        return step_trace_annotation(step_id)
+
+    def set_timeline_thread(self) -> None:
+        """Mark the CALLING thread as the step-loop timeline (ledger source)."""
+        if self.enabled:
+            self._recorder.set_timeline_thread()
+
+    def _on_record(self, record) -> None:
+        self.ledger.add_record(record)
+        if self._sink is not None:
+            self._sink.emit_span(record)
+
+    # ------------------------------------------------------------------- sink
+
+    def set_output_folder(self, output_folder_path: Union[str, Path]) -> None:
+        """Open the JSONL sink (idempotent; Main calls this once the experiment
+        folder is known). Watchdog artifacts land in the same folder."""
+        if not self.enabled or self._sink is not None:
+            return
+        self._folder = Path(output_folder_path)
+        self._sink = TelemetrySink(self._folder, global_rank=self.global_rank)
+        if self._watchdog is not None:
+            self._watchdog.artifact_dir = self._folder
+
+    @property
+    def sink_path(self) -> Optional[Path]:
+        return self._sink.path if self._sink is not None else None
+
+    # --------------------------------------------------------------- watchdog
+
+    def _ensure_watchdog(self) -> Optional[Watchdog]:
+        if not self.enabled or self.watchdog_deadline_s <= 0:
+            return None
+        if self._watchdog is None:
+            artifact_dir = self._folder or Path(tempfile.gettempdir()) / "modalities_tpu_telemetry"
+            self._watchdog = Watchdog(
+                deadline_s=self.watchdog_deadline_s,
+                artifact_dir=artifact_dir,
+                global_rank=self.global_rank,
+            )
+            for provider in self._pending_state_providers:
+                self._watchdog.register_state_provider(provider)
+            self._pending_state_providers.clear()
+            self._watchdog.start()
+        return self._watchdog
+
+    def arm_watchdog(self, step_id: int, first_step: bool = False) -> None:
+        watchdog = self._ensure_watchdog()
+        if watchdog is None:
+            return
+        deadline_s = self.watchdog_deadline_s * (self.watchdog_first_step_factor if first_step else 1.0)
+        watchdog.arm(step_id, deadline_s=deadline_s)
+
+    def beat_watchdog(self, step_id: int) -> None:
+        if self._watchdog is not None:
+            self._watchdog.beat(step_id)
+
+    def disarm_watchdog(self) -> None:
+        if self._watchdog is not None:
+            self._watchdog.disarm()
+
+    def register_watchdog_state_provider(self, provider: Callable[[], dict]) -> None:
+        if not self.enabled:
+            return
+        if self._watchdog is not None:
+            self._watchdog.register_state_provider(provider)
+        else:
+            self._pending_state_providers.append(provider)
+
+    @property
+    def watchdog_artifacts(self) -> list[Path]:
+        return list(self._watchdog.fired_artifacts) if self._watchdog is not None else []
+
+    # ---------------------------------------------------------------- goodput
+
+    def goodput_summary(self) -> dict:
+        return self.ledger.summary()
+
+    def throughput_metrics(self) -> dict[str, float]:
+        """Cumulative goodput metrics for the interval publish: goodput % plus
+        per-bucket seconds. Empty when disabled (publishers skip cleanly)."""
+        if not self.enabled:
+            return {}
+        summary = self.ledger.summary()
+        metrics = {"goodput [%]": summary["goodput_pct"]}
+        for bucket in BUCKETS:
+            metrics[f"goodput/{bucket} [s]"] = summary["buckets"][bucket]
+        return metrics
+
+    # -------------------------------------------------------------- lifecycle
+
+    def close(self) -> None:
+        """Stop the watchdog and seal the sink with a run summary. Idempotent;
+        safe on the exception path."""
+        if self._watchdog is not None:
+            self._watchdog.stop()
+        if self._sink is not None:
+            self._sink.close(run_summary=self.goodput_summary())
+
+
+# -------------------------------------------------------- process-global routing
+
+NOOP_TELEMETRY = Telemetry(enabled=False)
+_active: Telemetry = NOOP_TELEMETRY
+
+
+def get_active_telemetry() -> Telemetry:
+    return _active
+
+
+def set_active_telemetry(telemetry: Optional[Telemetry]) -> Telemetry:
+    """Install the process-global telemetry (None -> no-op). Returns the previous
+    one so callers can restore it in a finally block."""
+    global _active
+    previous = _active
+    _active = telemetry if telemetry is not None else NOOP_TELEMETRY
+    return previous
+
+
+def span(name: str):
+    """`with span("checkpoint_save"): ...` against the active telemetry — the
+    zero-plumbing entry point for deep call sites."""
+    return _active.span(name)

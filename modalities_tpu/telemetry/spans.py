@@ -1,0 +1,142 @@
+"""Host-side span recording — the event source for the goodput ledger and the sink.
+
+A span is `with recorder.span("checkpoint_save"): ...` around a host phase. Each
+span records wall timestamps plus its EXCLUSIVE time (duration minus enclosed child
+spans, tracked per thread), so a span stream can be bucketed into wall-time
+accounting without interval arithmetic: every second of a thread's timeline lands
+in exactly one span's exclusive time.
+
+Every span doubles as a `jax.profiler.TraceAnnotation`, so host phases appear by
+name on the host rows of an XPlane/Perfetto trace next to the device streams; and
+`step_trace_annotation(step_id)` wraps a train-step dispatch in
+`jax.profiler.StepTraceAnnotation` so device work is step-aligned in the trace
+viewer. Both degrade to no-ops when jax (or its profiler) is unavailable.
+
+Threading: spans may be opened from any thread (the DeviceFeeder producer records
+its transfers here too). Only spans from the designated *timeline thread* (the
+step loop) are forwarded with `timeline=True`; the goodput ledger ignores the
+rest, because background-thread work overlaps the main timeline and would
+double-count wall seconds.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+
+@dataclass
+class SpanRecord:
+    name: str
+    ts: float  # epoch seconds at span start
+    dur_s: float  # wall duration of the span
+    self_s: float  # duration minus enclosed child spans (exclusive time)
+    thread: str
+    timeline: bool  # True when recorded on the designated step-loop thread
+
+
+def _resolve_trace_annotation():
+    try:
+        from jax.profiler import TraceAnnotation
+
+        return TraceAnnotation
+    except Exception:
+        return None
+
+
+class _NullContext:
+    """Shared allocation-free no-op context manager (the disabled fast path)."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        return False
+
+
+NULL_CONTEXT = _NullContext()
+
+
+class _Span:
+    __slots__ = ("_recorder", "name", "_ts", "_t0", "_children_s", "_annotation")
+
+    def __init__(self, recorder: "SpanRecorder", name: str):
+        self._recorder = recorder
+        self.name = name
+        self._annotation = None
+
+    def __enter__(self) -> "_Span":
+        recorder = self._recorder
+        stack = getattr(recorder._tls, "stack", None)
+        if stack is None:
+            stack = recorder._tls.stack = []
+        stack.append(self)
+        self._children_s = 0.0
+        if recorder._trace_annotation is not None:
+            try:
+                self._annotation = recorder._trace_annotation(self.name)
+                self._annotation.__enter__()
+            except Exception:  # a broken profiler must never take the span down
+                self._annotation = None
+        self._ts = time.time()
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc_val, exc_tb) -> bool:
+        dur_s = time.perf_counter() - self._t0
+        if self._annotation is not None:
+            self._annotation.__exit__(exc_type, exc_val, exc_tb)
+        recorder = self._recorder
+        stack = recorder._tls.stack
+        stack.pop()
+        if stack:
+            stack[-1]._children_s += dur_s
+        if recorder._on_record is not None:
+            recorder._on_record(
+                SpanRecord(
+                    name=self.name,
+                    ts=self._ts,
+                    dur_s=dur_s,
+                    self_s=max(0.0, dur_s - self._children_s),
+                    thread=threading.current_thread().name,
+                    timeline=threading.get_ident() == recorder._timeline_ident,
+                )
+            )
+        return False
+
+
+class SpanRecorder:
+    """Thread-safe span source. `on_record(SpanRecord)` fires at every span exit
+    (on the exiting span's own thread — consumers must be thread-safe)."""
+
+    def __init__(
+        self,
+        on_record: Optional[Callable[[SpanRecord], None]] = None,
+        use_jax_annotations: bool = True,
+    ):
+        self._on_record = on_record
+        self._tls = threading.local()
+        self._timeline_ident = threading.get_ident()
+        self._trace_annotation = _resolve_trace_annotation() if use_jax_annotations else None
+
+    def set_timeline_thread(self, ident: Optional[int] = None) -> None:
+        """Designate the thread whose spans carry `timeline=True` (default: the
+        thread that constructed the recorder)."""
+        self._timeline_ident = threading.get_ident() if ident is None else ident
+
+    def span(self, name: str) -> _Span:
+        return _Span(self, name)
+
+
+def step_trace_annotation(step_id: int, name: str = "train_step"):
+    """`jax.profiler.StepTraceAnnotation` for one train-step dispatch: device
+    traces group by step id in TensorBoard/Perfetto. No-op without jax."""
+    try:
+        from jax.profiler import StepTraceAnnotation
+    except Exception:
+        return NULL_CONTEXT
+    return StepTraceAnnotation(name, step_num=step_id)

@@ -43,6 +43,7 @@ from modalities_tpu.batch import EvaluationResultBatch, ResultItem
 from modalities_tpu.dataloader.device_feeder import DeviceBatchIterator, DeviceFeeder
 from modalities_tpu.logging_broker.messages import ExperimentStatus, MessageTypes, ProgressUpdate
 from modalities_tpu.logging_broker.publisher import MessagePublisher
+from modalities_tpu.telemetry import Telemetry, get_active_telemetry
 from modalities_tpu.training.train_step import StepFunctions
 from modalities_tpu.training.training_progress import TrainingProgress
 from modalities_tpu.utils.logging import get_logger
@@ -65,6 +66,7 @@ class Trainer:
         gc_frequency: int = 10,
         debug_stats_logger=None,
         device_feeder: Optional[DeviceFeeder] = None,
+        telemetry: Optional[Telemetry] = None,
     ) -> None:
         self.progress_publisher = progress_publisher
         self.evaluation_result_publisher = evaluation_result_publisher
@@ -80,7 +82,13 @@ class Trainer:
         self.debug_stats_logger = debug_stats_logger
         # async prefetch is the default path; prefetch_to_device=0 restores sync
         self.device_feeder = device_feeder if device_feeder is not None else DeviceFeeder()
+        # None -> resolve the process-global telemetry at train() time (no-op unless
+        # Main activated one), so direct Trainer construction needs no plumbing
+        self.telemetry = telemetry
         self._boundary_stall_s = 0.0
+
+    def _telemetry(self) -> Telemetry:
+        return self.telemetry if self.telemetry is not None else get_active_telemetry()
 
     def train(
         self,
@@ -92,6 +100,9 @@ class Trainer:
     ) -> None:
         state = step_functions.app_state_handle.state
         train_step = step_functions.train_step
+        telemetry = self._telemetry()
+        # THIS thread's spans are the run's wall-clock timeline (goodput source)
+        telemetry.set_timeline_thread()
 
         # initial callbacks at "step -1" semantics (reference trainer.py:250-259)
         evaluation_callback(self.num_seen_train_steps)
@@ -111,11 +122,23 @@ class Trainer:
         feed = self.device_feeder.feed_train(
             train_loader, step_functions.put_batch, self.gradient_acc_steps
         )
+        queue_state = getattr(feed, "queue_state", None)
+        if queue_state is not None:
+            telemetry.register_watchdog_state_provider(lambda: {"device_feeder": queue_state()})
+        first_step_id = step_id
+        # first deadline is stretched: the first step legitimately traces + compiles
+        telemetry.arm_watchdog(step_id + 1, first_step=True)
         profiler_cm = self.profiler
         if profiler_cm is not None:
             profiler_cm.__enter__()
         try:
-            for device_batch in feed:
+            while True:
+                with telemetry.span("data_wait"):
+                    try:
+                        device_batch = next(feed)
+                    except StopIteration:
+                        exhausted = True
+                        break
                 # the debug step variant (grads in metrics) runs ONLY on logging ticks
                 # so the extra grad tree isn't materialized on every step
                 debug_tick = (
@@ -124,7 +147,9 @@ class Trainer:
                     and (step_id + 1) % self.debug_stats_logger.log_interval_steps == 0
                 )
                 step_fn = step_functions.train_step_debug if debug_tick else train_step
-                state, metrics = step_fn(state, device_batch)
+                with telemetry.step_annotation(step_id + 1):
+                    with telemetry.span("first_step" if step_id == first_step_id else "train_step"):
+                        state, metrics = step_fn(state, device_batch)
                 debug_grads = metrics.pop("grads", None)  # exposed only when debugging
                 # publish the PREVIOUS interval now, with this step already in
                 # flight: the publish's metrics fetch blocks until that interval's
@@ -182,10 +207,12 @@ class Trainer:
                 if profiler_cm is not None:
                     profiler_cm.step()
 
+                # step completed end-to-end (callbacks included): re-arm the hang
+                # deadline for the next one
+                telemetry.beat_watchdog(step_id)
+
                 if step_id >= target_steps:
                     break
-            else:
-                exhausted = True
         except BaseException:
             # a COMPLETED interval held for the overlap-publish must not vanish
             # because a later step (callbacks, loader, transfer) crashed — before
@@ -201,6 +228,8 @@ class Trainer:
                     )
             raise
         finally:
+            # post-loop drain work (publish flush, checkpoint drain) is not a hang
+            telemetry.disarm_watchdog()
             feed.close()
             if profiler_cm is not None:
                 profiler_cm.__exit__(None, None, None)
@@ -252,12 +281,16 @@ class Trainer:
         the honest start-of-clock for the NEXT interval under the deferred-publish
         overlap. Drains the host/boundary stall accumulators, so each stalled second
         is attributed to exactly one interval window."""
-        # single host sync point per interval: fetch the accumulated device metrics
-        if "nonfinite_grads" in pending_metrics[0]:
-            self._raise_on_nonfinite(pending_metrics, step_id)
-        losses = np.asarray([m["loss"] for m in pending_metrics], dtype=np.float64)
-        grad_norms = np.asarray([m["grad_norm"] for m in pending_metrics], dtype=np.float64)
-        lrs = np.asarray([m["lr"] for m in pending_metrics], dtype=np.float64)
+        telemetry = self._telemetry()
+        # single host sync point per interval: fetch the accumulated device metrics.
+        # The fetch blocks until the interval's device work finished, so its span
+        # counts toward the train_step goodput bucket, not overhead.
+        with telemetry.span("metrics_fetch"):
+            if "nonfinite_grads" in pending_metrics[0]:
+                self._raise_on_nonfinite(pending_metrics, step_id)
+            losses = np.asarray([m["loss"] for m in pending_metrics], dtype=np.float64)
+            grad_norms = np.asarray([m["grad_norm"] for m in pending_metrics], dtype=np.float64)
+            lrs = np.asarray([m["lr"] for m in pending_metrics], dtype=np.float64)
         fetch_done = time.perf_counter()
         wall_elapsed = max(fetch_done - interval_start, 1e-9)
         host_stall_s = feed.take_stall_s() if feed is not None else 0.0
@@ -282,14 +315,15 @@ class Trainer:
             throughput["MFU (device)"] = ResultItem(
                 self.mfu_calculator.compute(tokens_per_second_device), 4
             )
-        try:
-            import jax
-
-            mem_stats = jax.local_devices()[0].memory_stats() or {}
-            if "peak_bytes_in_use" in mem_stats:
-                throughput["peak memory [MB]"] = ResultItem(mem_stats["peak_bytes_in_use"] / 2**20, 1)
-        except Exception:
-            pass
+        peak_mb = self._peak_memory_mb()
+        if peak_mb is not None:
+            throughput["peak memory [MB]"] = ResultItem(peak_mb, 1)
+        goodput_metrics = telemetry.throughput_metrics()
+        if goodput_metrics:
+            # cumulative since run start: goodput % plus per-bucket wall seconds
+            throughput["goodput [%]"] = ResultItem(goodput_metrics.pop("goodput [%]"), 2)
+            for key, seconds in goodput_metrics.items():
+                throughput[key] = ResultItem(seconds, 3)
 
         result = EvaluationResultBatch(
             dataloader_tag=dataloader_tag,
@@ -306,5 +340,28 @@ class Trainer:
             },
             throughput_metrics=throughput,
         )
-        self.evaluation_result_publisher.publish_message(result, MessageTypes.EVALUATION_RESULT)
+        with telemetry.span("publish"):
+            self.evaluation_result_publisher.publish_message(result, MessageTypes.EVALUATION_RESULT)
         return fetch_done
+
+    _local_devices = None  # cached once per process: device topology is fixed
+
+    @classmethod
+    def _peak_memory_mb(cls) -> Optional[float]:
+        """Max peak_bytes_in_use across ALL local devices, in MB. The device list
+        is looked up once, not per interval (it cannot change mid-run)."""
+        if cls._local_devices is None:
+            try:
+                import jax
+
+                cls._local_devices = jax.local_devices()
+            except Exception:
+                cls._local_devices = []
+        peak_bytes = 0
+        for device in cls._local_devices:
+            try:
+                stats = device.memory_stats() or {}
+            except Exception:
+                continue
+            peak_bytes = max(peak_bytes, stats.get("peak_bytes_in_use", 0))
+        return peak_bytes / 2**20 if peak_bytes else None

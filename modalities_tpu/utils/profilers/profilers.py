@@ -116,25 +116,29 @@ class SteppableKernelProfiler(SteppableProfilerIF):
 
 class SteppableMemoryProfiler(SteppableProfilerIF):
     """Per-step device memory stats -> jsonl + final memory-profile dump
-    (reference SteppableMemoryProfiler, :86-128)."""
+    (reference SteppableMemoryProfiler, :86-128).
+
+    Records are appended (and flushed) to memory_stats.jsonl at every step, not
+    buffered until `__exit__` — a run that crashes or is killed mid-profile keeps
+    every sample taken up to that point."""
 
     def __init__(self, output_folder_path: Path, max_steps: int = 0):
         self.output_folder_path = Path(output_folder_path)
         self.max_steps = max_steps
         self._step = 0
-        self._records: list[dict] = []
+        self._file = None
 
     def __len__(self) -> int:
         return self.max_steps
 
     def __enter__(self):
+        self.output_folder_path.mkdir(parents=True, exist_ok=True)
+        self._file = open(self.output_folder_path / "memory_stats.jsonl", "w")
         return self
 
     def __exit__(self, exc_type, exc_val, exc_tb):
-        self.output_folder_path.mkdir(parents=True, exist_ok=True)
-        with open(self.output_folder_path / "memory_stats.jsonl", "w") as f:
-            for rec in self._records:
-                f.write(json.dumps(rec) + "\n")
+        if self._file is not None and not self._file.closed:
+            self._file.close()
         try:
             import jax
 
@@ -152,8 +156,14 @@ class SteppableMemoryProfiler(SteppableProfilerIF):
             stats = jax.local_devices()[0].memory_stats() or {}
         except Exception:
             stats = {}
-        self._records.append({"step": self._step, **{k: int(v) for k, v in stats.items()}})
+        record = {"step": self._step, **{k: int(v) for k, v in stats.items()}}
         self._step += 1
+        if self._file is None:  # step() without __enter__ (harness misuse): open lazily
+            self.output_folder_path.mkdir(parents=True, exist_ok=True)
+            self._file = open(self.output_folder_path / "memory_stats.jsonl", "w")
+        if not self._file.closed:
+            self._file.write(json.dumps(record) + "\n")
+            self._file.flush()
 
 
 class SteppableCombinedProfiler(SteppableProfilerIF):

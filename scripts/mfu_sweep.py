@@ -14,6 +14,9 @@ remat policy (full vs selective-op save lists). BENCH_ITERS trims timing iterati
 Each line carries bench.py's full throughput split: `value`/`step_time_s` are
 device-time (bench-comparable), `wall_step_time_s`/`tokens_per_sec_wall`/`mfu_wall`
 time the whole dispatch+fetch loop, and `host_stall_s` is their difference.
+`detail.goodput` breaks the whole candidate run into the telemetry subsystem's
+goodput buckets (init / compile_first_step / train_step / other + goodput_pct) —
+the same schema the Trainer publishes per interval, from the same ledger code.
 """
 
 from __future__ import annotations

@@ -172,6 +172,8 @@ def test_trainer_publishes_wall_device_split_and_stalls():
         def compute(self, tokens_per_second):
             return tokens_per_second / 1e6
 
+    from modalities_tpu.telemetry import Telemetry
+
     trainer = Trainer(
         progress_publisher=pub,
         evaluation_result_publisher=pub,
@@ -180,6 +182,7 @@ def test_trainer_publishes_wall_device_split_and_stalls():
         training_log_interval_in_steps=2,
         mfu_calculator=_MFU(),
         gc_frequency=0,
+        telemetry=Telemetry(watchdog_deadline_s=0),  # enabled, sinkless, no watchdog
     )
     progress = TrainingProgress(
         num_seen_steps_current_run=0, num_seen_tokens_current_run=0,
@@ -195,8 +198,10 @@ def test_trainer_publishes_wall_device_split_and_stalls():
     for msg in results.messages:
         tp = msg.payload.throughput_metrics
         for key in ("tokens/s", "tokens/s (device)", "host stall [s]",
-                    "boundary stall [s]", "MFU", "MFU (device)"):
+                    "boundary stall [s]", "MFU", "MFU (device)",
+                    "goodput [%]", "goodput/train_step [s]", "goodput/data_stall [s]"):
             assert key in tp, (key, sorted(tp))
+        assert 0.0 <= tp["goodput [%]"].value <= 100.0
         # device-time rate excludes the measured stalls, so it can only be faster
         assert tp["tokens/s (device)"].value >= tp["tokens/s"].value
         assert tp["boundary stall [s]"].value > 0.0  # the sleeping eval callback

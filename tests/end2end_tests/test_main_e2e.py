@@ -67,3 +67,20 @@ def test_main_end_to_end(workdir):
     resolved_cfg = yaml.safe_load(resolved.read_text())
     assert resolved_cfg["settings"]["experiment_id"] == "e2e_test"
     assert resolved_cfg["model_raw"]["config"]["sequence_length"] == 64
+
+    # telemetry rode along by default: the sink sealed with a run summary whose
+    # bucket seconds tile the run's wall time, and the publishes carried goodput
+    telemetry_dir = workdir / "data" / "experiments" / "e2e_test" / "telemetry"
+    sink = telemetry_dir / "telemetry_rank_0.jsonl"
+    events = [json.loads(line) for line in sink.read_text().splitlines()]
+    assert events[-1]["event"] == "run_summary"
+    summary = events[-1]  # the ledger summary rides flat on the sealing event
+    assert sum(summary["buckets"].values()) == pytest.approx(summary["wall_s"], rel=0.05)
+    assert summary["buckets"]["train_step"] > 0.0
+    assert summary["buckets"]["compile_first_step"] > 0.0
+    assert summary["buckets"]["eval"] > 0.0
+    assert summary["buckets"]["checkpoint"] > 0.0
+    assert 0.0 < summary["goodput_pct"] <= 100.0
+    assert json.loads((telemetry_dir / "goodput_summary.json").read_text())["wall_s"] > 0.0
+    assert "goodput [%]" in train_lines[-1]["throughput_metrics"]
+    assert not list(telemetry_dir.glob("watchdog_dump_*.json"))  # healthy run

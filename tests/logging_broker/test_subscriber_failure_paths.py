@@ -103,6 +103,32 @@ def test_to_disc_survives_external_file_deletion(tmp_path):
     assert [r["num_train_steps_done"] for r in rows] == [2]
 
 
+def test_to_disc_serializes_telemetry_goodput_keys(tmp_path):
+    """The interval publish now carries goodput keys (telemetry subsystem); the
+    jsonl row must round-trip them as plain floats, bracket-units and all."""
+    from modalities_tpu.batch import ResultItem
+
+    sub = EvaluationResultToDiscSubscriber(output_folder_path=tmp_path)
+    result = EvaluationResultBatch(
+        dataloader_tag="train",
+        num_train_steps_done=4,
+        losses={"CLMCrossEntropyLoss": 2.0},
+        metrics={},
+        throughput_metrics={
+            "tokens/s": ResultItem(1000.0, 2),
+            "goodput [%]": ResultItem(87.654, 2),
+            "goodput/train_step [s]": ResultItem(1.2345, 3),
+            "goodput/data_stall [s]": ResultItem(0.1, 3),
+        },
+    )
+    sub.consume_message(_msg(result))
+    row = json.loads((tmp_path / "evaluation_results.jsonl").read_text())
+    tp = row["throughput_metrics"]
+    assert tp["goodput [%]"] == pytest.approx(87.65, abs=0.01)
+    assert tp["goodput/train_step [s]"] == pytest.approx(1.2345, abs=0.001)
+    assert tp["goodput/data_stall [s]"] == pytest.approx(0.1)
+
+
 # ------------------------------------------------------------ rich / rank gating
 
 
@@ -163,6 +189,36 @@ def test_wandb_factory_pins_env_dirs(tmp_path, monkeypatch):
     assert os.environ["WANDB_DIR"] == str(Path(tmp_path).absolute())
     assert (Path(tmp_path) / "wandb").is_dir()
     sub.consume_message(_msg(_result()))  # must not raise regardless of wandb availability
+
+
+def test_wandb_subscriber_warns_once_and_noops_when_wandb_missing(monkeypatch):
+    """wandb absent (this image never ships it): construction must emit the rank-0
+    warning EXACTLY once and every consume must be a silent no-op — a multi-week
+    run configured with wandb must not die on the first eval tick."""
+    import builtins
+    import sys
+
+    import modalities_tpu.utils.logging as tpu_logging
+    from modalities_tpu.logging_broker.subscriber_impl.results_subscriber import (
+        WandBEvaluationResultSubscriber,
+    )
+
+    monkeypatch.delitem(sys.modules, "wandb", raising=False)
+    real_import = builtins.__import__
+
+    def no_wandb(name, *args, **kwargs):
+        if name == "wandb":
+            raise ImportError("No module named 'wandb'")
+        return real_import(name, *args, **kwargs)
+
+    monkeypatch.setattr(builtins, "__import__", no_wandb)
+    warnings = []
+    monkeypatch.setattr(tpu_logging, "warn_rank_0", warnings.append)
+    sub = WandBEvaluationResultSubscriber(project="p", experiment_id="e")
+    assert warnings == ["wandb is not installed; WandB subscriber is a no-op."]
+    assert sub._run is None and sub._wandb is None
+    sub.consume_message(_msg(_result()))  # no-op, must not raise
+    sub.consume_message(_msg(_result(step=2)))
 
 
 # -------------------------------------------------------------- broker contracts

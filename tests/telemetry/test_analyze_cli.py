@@ -1,0 +1,52 @@
+"""`data analyze_telemetry` CLI: a run's JSONL sink (file or folder, multi-rank)
+renders into a per-rank goodput table / JSON summary."""
+
+import json
+
+from click.testing import CliRunner
+
+from modalities_tpu.__main__ import main as cli_main
+
+
+def _write_sink(folder, rank, spans):
+    path = folder / f"telemetry_rank_{rank}.jsonl"
+    with open(path, "w") as f:
+        for name, ts, dur in spans:
+            f.write(json.dumps({
+                "rank": rank, "event": "span", "name": name, "ts": ts,
+                "dur_s": dur, "self_s": dur, "thread": "MainThread", "timeline": True,
+            }) + "\n")
+    return path
+
+
+def test_analyze_telemetry_table_over_folder(tmp_path):
+    _write_sink(tmp_path, 0, [("init", 0.0, 1.0), ("train_step", 1.0, 8.0), ("checkpoint_save", 9.0, 1.0)])
+    _write_sink(tmp_path, 1, [("init", 0.0, 2.0), ("train_step", 2.0, 8.0)])
+    result = CliRunner().invoke(cli_main, ["data", "analyze_telemetry", "--sink_path", str(tmp_path)])
+    assert result.exit_code == 0, result.output
+    assert "train_step" in result.output and "goodput" in result.output
+    assert "rank  0" in result.output and "rank  1" in result.output
+    assert "80.00 %" in result.output  # both ranks: 8s of 10s wall
+
+
+def test_analyze_telemetry_json_over_single_file(tmp_path):
+    sink = _write_sink(tmp_path, 0, [("train_step", 0.0, 3.0), ("data_wait", 3.0, 1.0)])
+    result = CliRunner().invoke(
+        cli_main, ["data", "analyze_telemetry", "--sink_path", str(sink), "--as_json"]
+    )
+    assert result.exit_code == 0, result.output
+    summary = json.loads(result.output)
+    rank0 = summary["ranks"]["0"] if "0" in summary["ranks"] else summary["ranks"][0]
+    assert rank0["buckets"]["train_step"] == 3.0
+    assert rank0["buckets"]["data_stall"] == 1.0
+    assert summary["combined"]["goodput_pct"] == 75.0
+
+
+def test_analyze_telemetry_tolerates_torn_tail_line(tmp_path):
+    """A sink from a killed run may end mid-line — analysis must not crash."""
+    sink = _write_sink(tmp_path, 0, [("train_step", 0.0, 2.0)])
+    with open(sink, "a") as f:
+        f.write('{"rank": 0, "event": "span", "name": "tr')  # torn write
+    result = CliRunner().invoke(cli_main, ["data", "analyze_telemetry", "--sink_path", str(sink)])
+    assert result.exit_code == 0, result.output
+    assert "train_step" in result.output

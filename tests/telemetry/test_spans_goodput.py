@@ -1,0 +1,149 @@
+"""Span recorder + goodput ledger + sink: the span stream must partition the
+timeline thread's wall time (exclusive-time accounting), classify into buckets
+summing to wall time, and leave a parseable always-flushed JSONL record."""
+
+import json
+import threading
+import time
+
+import pytest
+
+from modalities_tpu.telemetry import NOOP_TELEMETRY, Telemetry, set_active_telemetry, span
+from modalities_tpu.telemetry.goodput import BUCKETS, GoodputLedger, bucket_of, summarize_sink
+from modalities_tpu.telemetry.spans import NULL_CONTEXT, SpanRecorder
+
+
+def test_nested_spans_report_exclusive_time():
+    records = []
+    recorder = SpanRecorder(on_record=records.append, use_jax_annotations=False)
+    with recorder.span("outer"):
+        time.sleep(0.02)
+        with recorder.span("inner"):
+            time.sleep(0.03)
+    by_name = {r.name: r for r in records}
+    assert by_name["inner"].self_s == pytest.approx(by_name["inner"].dur_s)
+    # outer's exclusive time excludes inner entirely
+    assert by_name["outer"].self_s == pytest.approx(by_name["outer"].dur_s - by_name["inner"].dur_s)
+    assert by_name["outer"].self_s >= 0.015
+    assert by_name["outer"].timeline and by_name["inner"].timeline
+
+
+def test_background_thread_spans_are_not_timeline():
+    records = []
+    recorder = SpanRecorder(on_record=records.append, use_jax_annotations=False)
+
+    def work():
+        with recorder.span("bg"):
+            pass
+
+    t = threading.Thread(target=work, name="bg-thread")
+    t.start()
+    t.join()
+    (record,) = records
+    assert record.thread == "bg-thread" and not record.timeline
+    # and the ledger ignores it: overlapped background work must not double-count
+    ledger = GoodputLedger()
+    ledger.add_record(record)
+    assert sum(ledger.bucket_seconds().values()) == 0.0
+
+
+def test_span_survives_exception_and_still_records():
+    records = []
+    recorder = SpanRecorder(on_record=records.append, use_jax_annotations=False)
+    with pytest.raises(RuntimeError):
+        with recorder.span("doomed"):
+            raise RuntimeError("boom")
+    assert records and records[0].name == "doomed"
+    # the per-thread stack unwound: a following span nests at top level again
+    with recorder.span("after"):
+        pass
+    assert records[-1].name == "after" and records[-1].self_s == pytest.approx(records[-1].dur_s)
+
+
+def test_bucket_mapping_covers_all_wired_span_names():
+    assert bucket_of("first_step") == "compile_first_step"
+    assert bucket_of("train_step") == "train_step"
+    assert bucket_of("metrics_fetch") == "train_step"  # device wait = goodput
+    assert bucket_of("data_wait") == "data_stall"
+    assert bucket_of("eval/val") == "eval"  # namespaced: first segment decides
+    assert bucket_of("checkpoint_save") == "checkpoint"
+    assert bucket_of("checkpoint_drain") == "checkpoint"
+    assert bucket_of("checkpoint_restore") == "init"
+    assert bucket_of("publish") == "publish"
+    assert bucket_of("init") == "init"
+    assert bucket_of("no_such_span") == "other"
+
+
+def test_ledger_summary_folds_untracked_into_other_and_sums_to_wall():
+    ledger = GoodputLedger()
+    ledger.add_seconds("train_step", 6.0)
+    ledger.add_seconds("data_stall", 1.0)
+    summary = ledger.summary(wall_s=10.0)
+    assert summary["buckets"]["other"] == pytest.approx(3.0)
+    assert sum(summary["buckets"].values()) == pytest.approx(10.0)
+    assert summary["goodput_pct"] == pytest.approx(60.0)
+    assert set(summary["buckets"]) == set(BUCKETS)
+
+
+def test_telemetry_sink_jsonl_schema_and_rank0_summary(tmp_path):
+    telemetry = Telemetry(output_folder_path=tmp_path, watchdog_deadline_s=0)
+    with telemetry.span("train_step"):
+        time.sleep(0.01)
+    telemetry.close()
+    lines = [json.loads(ln) for ln in telemetry.sink_path.read_text().splitlines()]
+    span_events = [e for e in lines if e["event"] == "span"]
+    assert span_events and span_events[0]["name"] == "train_step"
+    for key in ("rank", "ts", "dur_s", "self_s", "thread", "timeline"):
+        assert key in span_events[0]
+    assert lines[-1]["event"] == "run_summary" and "goodput_pct" in lines[-1]
+    assert (tmp_path / "goodput_summary.json").is_file()
+    # offline aggregation replays the sink into the same bucket schema
+    summary = summarize_sink(tmp_path)
+    assert summary["ranks"][0]["buckets"]["train_step"] >= 0.009
+
+
+def test_disabled_telemetry_is_noop_and_allocation_free(tmp_path):
+    telemetry = Telemetry(enabled=False, output_folder_path=tmp_path)
+    assert telemetry.span("x") is NULL_CONTEXT  # shared instance: no per-call alloc
+    assert telemetry.step_annotation(3) is NULL_CONTEXT
+    assert telemetry.throughput_metrics() == {}
+    telemetry.arm_watchdog(1)
+    telemetry.beat_watchdog(1)
+    telemetry.close()
+    assert list(tmp_path.iterdir()) == []  # no sink, no artifacts
+
+
+def test_active_telemetry_routing_and_restore(tmp_path):
+    telemetry = Telemetry(output_folder_path=tmp_path, watchdog_deadline_s=0)
+    previous = set_active_telemetry(telemetry)
+    try:
+        assert previous is NOOP_TELEMETRY
+        with span("checkpoint_save"):
+            pass
+    finally:
+        restored = set_active_telemetry(previous)
+    assert restored is telemetry
+    assert span("x") is NULL_CONTEXT  # back to the no-op
+    telemetry.close()
+    events = [json.loads(ln) for ln in telemetry.sink_path.read_text().splitlines()]
+    assert any(e.get("name") == "checkpoint_save" for e in events)
+
+
+def test_span_overhead_is_small():
+    """The disabled path must be negligible and the enabled path cheap enough for
+    a per-step call (<50us/span enabled is orders below any real step time)."""
+    telemetry_off = Telemetry(enabled=False)
+    n = 2000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        with telemetry_off.span("s"):
+            pass
+    off_per_span = (time.perf_counter() - t0) / n
+    assert off_per_span < 5e-6
+    telemetry_on = Telemetry(watchdog_deadline_s=0, use_jax_annotations=False)
+    t0 = time.perf_counter()
+    for _ in range(n):
+        with telemetry_on.span("s"):
+            pass
+    on_per_span = (time.perf_counter() - t0) / n
+    assert on_per_span < 5e-5

@@ -1,0 +1,188 @@
+"""Trainer x telemetry integration (fake step functions, no device work): goodput
+keys ride the interval publish, the sink records the loop's spans, bucket seconds
+tile wall time, and a wedged step leaves a watchdog artifact containing the
+feeder thread."""
+
+import json
+import time
+from types import SimpleNamespace
+
+import pytest
+
+from modalities_tpu.logging_broker.message_broker import MessageBroker
+from modalities_tpu.logging_broker.messages import Message, MessageTypes
+from modalities_tpu.logging_broker.publisher import MessagePublisher
+from modalities_tpu.telemetry import Telemetry
+from modalities_tpu.telemetry.goodput import BUCKETS
+from modalities_tpu.trainer import Trainer
+from modalities_tpu.training.training_progress import TrainingProgress
+from tests.dataloader.test_device_feeder import _FakeTrainLoader, _microbatches, _Recorder
+
+
+def _fake_fns(step_sleep_s=0.0):
+    def fake_train_step(state, batch):
+        if step_sleep_s:
+            time.sleep(step_sleep_s)
+        return state + 1, {"loss": 1.0, "grad_norm": 0.5, "lr": 1e-3}
+
+    return SimpleNamespace(
+        app_state_handle=SimpleNamespace(state=0),
+        train_step=fake_train_step,
+        put_batch=lambda batch, has_acc_dim=True: batch,
+        train_step_debug=None,
+    )
+
+
+def _run_trainer(telemetry, n_steps=4, interval=2, step_sleep_s=0.0, eval_sleep_s=0.01):
+    broker = MessageBroker()
+    results = _Recorder()
+    broker.add_subscriber(MessageTypes.EVALUATION_RESULT, results)
+    pub = MessagePublisher(broker)
+    trainer = Trainer(
+        progress_publisher=pub,
+        evaluation_result_publisher=pub,
+        gradient_acc_steps=1,
+        global_num_tokens_per_train_step=128,
+        training_log_interval_in_steps=interval,
+        gc_frequency=0,
+        telemetry=telemetry,
+    )
+    progress = TrainingProgress(
+        num_seen_steps_current_run=0, num_seen_tokens_current_run=0,
+        num_target_steps=n_steps, num_target_tokens=128 * n_steps,
+    )
+    fns = _fake_fns(step_sleep_s)
+    trainer.train(
+        fns, _FakeTrainLoader(list(_microbatches(n_steps))), progress,
+        evaluation_callback=lambda step: time.sleep(eval_sleep_s),
+        checkpointing_callback=lambda p: None,
+    )
+    return results.messages
+
+
+def test_interval_publish_carries_goodput_keys(tmp_path):
+    telemetry = Telemetry(output_folder_path=tmp_path, watchdog_deadline_s=0)
+    t0 = time.perf_counter()
+    messages = _run_trainer(telemetry, step_sleep_s=0.02)
+    wall = time.perf_counter() - t0
+    assert len(messages) == 2
+    for msg in messages:
+        tp = msg.payload.throughput_metrics
+        assert "goodput [%]" in tp, sorted(tp)
+        for bucket in BUCKETS:
+            assert f"goodput/{bucket} [s]" in tp, (bucket, sorted(tp))
+        assert 0.0 <= tp["goodput [%]"].value <= 100.0
+    # cumulative: the later interval's train_step seconds can only grow
+    first, last = messages[0].payload.throughput_metrics, messages[-1].payload.throughput_metrics
+    assert last["goodput/train_step [s]"].value >= first["goodput/train_step [s]"].value
+    # the 3 non-first steps x 20ms must land in train_step (step 1 is compile)
+    assert last["goodput/train_step [s]"].value >= 0.95 * 3 * 0.02
+    assert last["goodput/train_step [s]"].value <= wall
+    telemetry.close()
+
+
+def test_sink_buckets_tile_wall_time_within_5pct(tmp_path):
+    """The acceptance-criteria invariant, at unit scale: replaying the sink's
+    bucket seconds against the ledger's own wall clock must agree to 5%."""
+    telemetry = Telemetry(output_folder_path=tmp_path, watchdog_deadline_s=0)
+    telemetry.ledger.start()
+    _run_trainer(telemetry, n_steps=6, step_sleep_s=0.03, eval_sleep_s=0.02)
+    summary = telemetry.goodput_summary()
+    telemetry.close()
+    assert sum(summary["buckets"].values()) == pytest.approx(summary["wall_s"], rel=0.05)
+    # and the tracked (non-other) share is the vast majority of the loop's time
+    tracked = summary["wall_s"] - summary["buckets"]["other"]
+    assert tracked >= 0.5 * summary["wall_s"], summary
+    events = [json.loads(ln) for ln in telemetry.sink_path.read_text().splitlines()]
+    names = {e["name"] for e in events if e["event"] == "span"}
+    assert {"first_step", "train_step", "data_wait", "metrics_fetch", "publish"} <= names, names
+
+
+def test_first_step_classified_as_compile_bucket(tmp_path):
+    telemetry = Telemetry(output_folder_path=tmp_path, watchdog_deadline_s=0)
+    _run_trainer(telemetry, n_steps=4, step_sleep_s=0.02)
+    summary = telemetry.goodput_summary()
+    telemetry.close()
+    assert summary["buckets"]["compile_first_step"] >= 0.018
+    assert summary["buckets"]["train_step"] >= 0.05  # the 3 later steps + fetches
+
+
+def test_wedged_step_leaves_watchdog_artifact_with_feeder_thread(tmp_path):
+    """A step that outlives the deadline while the feeder producer is parked on
+    its queue: the artifact must exist before the loop even finishes and name the
+    device-feeder thread in the stacks."""
+    telemetry = Telemetry(
+        output_folder_path=tmp_path, watchdog_deadline_s=0.15, watchdog_first_step_factor=1.0
+    )
+    from modalities_tpu.dataloader.device_feeder import DeviceFeeder
+
+    broker = MessageBroker()
+    pub = MessagePublisher(broker)
+    trainer = Trainer(
+        progress_publisher=pub, evaluation_result_publisher=pub, gradient_acc_steps=1,
+        global_num_tokens_per_train_step=128, training_log_interval_in_steps=2,
+        gc_frequency=0, telemetry=telemetry,
+        device_feeder=DeviceFeeder(prefetch_to_device=2),  # async: real feeder thread
+    )
+    progress = TrainingProgress(
+        num_seen_steps_current_run=0, num_seen_tokens_current_run=0,
+        num_target_steps=2, num_target_tokens=256,
+    )
+    # more batches than target steps: the producer thread stays parked on its
+    # full prefetch queue for the whole wedged step, so the dump can catch it
+    trainer.train(
+        _fake_fns(step_sleep_s=0.5), _FakeTrainLoader(list(_microbatches(8))), progress,
+        evaluation_callback=lambda step: None, checkpointing_callback=lambda p: None,
+    )
+    telemetry.close()
+    artifacts = telemetry.watchdog_artifacts
+    assert artifacts, "wedged 0.5s step never tripped the 0.15s deadline"
+    artifact = json.loads(artifacts[0].read_text())
+    assert any(key.startswith("device-feeder") for key in artifact["thread_stacks"]), (
+        sorted(artifact["thread_stacks"])
+    )
+    assert artifact["state"]["device_feeder"]["mode"] == "async"
+
+
+def test_normal_run_with_watchdog_leaves_no_artifact(tmp_path):
+    telemetry = Telemetry(output_folder_path=tmp_path, watchdog_deadline_s=5.0)
+    _run_trainer(telemetry, step_sleep_s=0.005)
+    telemetry.close()
+    assert telemetry.watchdog_artifacts == []
+    assert not list(tmp_path.glob("watchdog_dump_*.json"))
+    assert telemetry._watchdog is not None and not telemetry._watchdog.is_alive
+
+
+def test_watchdog_joins_on_training_exception(tmp_path):
+    telemetry = Telemetry(output_folder_path=tmp_path, watchdog_deadline_s=5.0)
+    broker = MessageBroker()
+    pub = MessagePublisher(broker)
+    trainer = Trainer(
+        progress_publisher=pub, evaluation_result_publisher=pub, gradient_acc_steps=1,
+        global_num_tokens_per_train_step=128, training_log_interval_in_steps=2,
+        gc_frequency=0, telemetry=telemetry,
+    )
+    progress = TrainingProgress(
+        num_seen_steps_current_run=0, num_seen_tokens_current_run=0,
+        num_target_steps=4, num_target_tokens=512,
+    )
+
+    def exploding_step(state, batch):
+        raise RuntimeError("kaboom mid-step")
+
+    fns = SimpleNamespace(
+        app_state_handle=SimpleNamespace(state=0), train_step=exploding_step,
+        put_batch=lambda batch, has_acc_dim=True: batch, train_step_debug=None,
+    )
+    with pytest.raises(RuntimeError, match="kaboom"):
+        try:
+            trainer.train(
+                fns, _FakeTrainLoader(list(_microbatches(4))), progress,
+                evaluation_callback=lambda step: None, checkpointing_callback=lambda p: None,
+            )
+        finally:
+            telemetry.close()
+    assert not telemetry._watchdog.is_alive
+    # the sink survived the crash path with its record sealed
+    events = [json.loads(ln) for ln in telemetry.sink_path.read_text().splitlines()]
+    assert events[-1]["event"] == "run_summary"

@@ -228,5 +228,12 @@ def test_bench_cpu_smoke_emits_one_json_line():
     assert out["metric"] and isinstance(out["value"], float)
     detail = out["detail"]
     for key in ("wall_step_time_s", "tokens_per_sec_wall", "mfu_wall",
-                "host_stall_s", "boundary_stall_s"):
+                "host_stall_s", "boundary_stall_s", "goodput"):
         assert key in detail, (key, sorted(detail))
+    # same schema as the telemetry subsystem's ledger: % + bucket seconds that
+    # sum to the candidate's wall time (the untracked remainder is in `other`)
+    goodput = detail["goodput"]
+    assert 0.0 < goodput["goodput_pct"] <= 100.0
+    assert goodput["buckets"]["train_step"] > 0.0
+    assert goodput["buckets"]["compile_first_step"] > 0.0
+    assert sum(goodput["buckets"].values()) == pytest.approx(goodput["wall_s"], rel=0.05)

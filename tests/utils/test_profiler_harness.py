@@ -1,9 +1,12 @@
 """Profiler harness: config -> steppable component + profiler -> stepped run
 (reference: modalities_profiler.py:36-158)."""
 
+import json
+
 import yaml
 
 from modalities_tpu.utils.profilers.modalities_profiler import ModalitiesProfilerStarter
+from modalities_tpu.utils.profilers.profilers import SteppableMemoryProfiler
 
 
 def test_profiler_harness_end_to_end(tmp_path):
@@ -79,3 +82,23 @@ def test_profiler_harness_end_to_end(tmp_path):
     cfg_path.write_text(yaml.safe_dump(config))
     ModalitiesProfilerStarter.run_single_process(cfg_path)
     assert (tmp_path / "prof" / "memory_stats.jsonl").exists()
+
+
+def test_memory_profiler_appends_incrementally_not_only_on_exit(tmp_path):
+    """A crash mid-profile must keep every sample taken so far: records are
+    appended+flushed per step, not buffered until __exit__."""
+    profiler = SteppableMemoryProfiler(output_folder_path=tmp_path, max_steps=10)
+    profiler.__enter__()
+    profiler.step()
+    profiler.step()
+    # NO __exit__ — simulating a killed run; the file must already hold both rows
+    stats_path = tmp_path / "memory_stats.jsonl"
+    rows = [json.loads(ln) for ln in stats_path.read_text().splitlines()]
+    assert [r["step"] for r in rows] == [0, 1]
+    profiler.step()
+    rows = [json.loads(ln) for ln in stats_path.read_text().splitlines()]
+    assert [r["step"] for r in rows] == [0, 1, 2]
+    profiler.__exit__(None, None, None)
+    # exit closes without rewriting or truncating what was already on disk
+    rows = [json.loads(ln) for ln in stats_path.read_text().splitlines()]
+    assert [r["step"] for r in rows] == [0, 1, 2]
