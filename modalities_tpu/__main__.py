@@ -310,6 +310,55 @@ def entry_point_analyze_telemetry(sink_path: Path, as_json: bool) -> None:
         click.echo(format_goodput_table(summary))
 
 
+@data.command(name="tune_kernels")
+@click.option("--out_dir", type=click.Path(path_type=Path), default=None,
+              help="Where to write {device_kind}.json (default: $MODALITIES_TPU_TUNE_DIR, "
+                   "else ./tuning_tables). Point MODALITIES_TPU_TUNE_DIR here so training "
+                   "consults the result.")
+@click.option("--rows", type=int, default=4096, show_default=True,
+              help="Flattened token rows (batch*seq) for the fused-CE/RMSNorm shapes.")
+@click.option("--n_embd", type=int, default=1024, show_default=True)
+@click.option("--vocab_size", type=int, default=16384, show_default=True)
+@click.option("--seq_len", type=int, default=2048, show_default=True,
+              help="Sequence length for the flash-attention sweep.")
+@click.option("--dtype", type=str, default="bfloat16", show_default=True)
+@click.option("--iters", type=int, default=3, show_default=True, help="Best-of-N timing repeats.")
+@click.option("--smoke", is_flag=True, default=False,
+              help="Tiny shapes (CI / CPU interpret): exercises the round-trip, not the timings.")
+@click.option("--as_json", is_flag=True, default=False, help="Emit the full summary dict as JSON.")
+@_exception_handling
+def entry_point_tune_kernels(
+    out_dir: Optional[Path], rows: int, n_embd: int, vocab_size: int, seq_len: int,
+    dtype: str, iters: int, smoke: bool, as_json: bool,
+) -> None:
+    """Timed block-size sweep for the Pallas kernels (flash attention, fused CE,
+    fused RMSNorm); persists the winners to a per-device-kind JSON tuning table
+    that the dispatch wrappers consult at trace time (env var > tune dir >
+    shipped defaults — see docs/components.md). Off-TPU the sweep runs under the
+    interpret emulator: the table round-trips but the timings are smoke only."""
+    from modalities_tpu.ops.pallas.autotune import tune_kernels
+
+    resolved_out = out_dir or Path(os.environ.get("MODALITIES_TPU_TUNE_DIR") or "tuning_tables")
+    summary = tune_kernels(
+        out_dir=resolved_out, rows=rows, n_embd=n_embd, vocab_size=vocab_size,
+        seq_len=seq_len, dtype=dtype, iters=iters, smoke=smoke,
+    )
+    if as_json:
+        click.echo(json.dumps(summary))
+        return
+    click.echo(f"device_kind: {summary['device_kind']} (platform {summary['platform']}, "
+               f"interpret={summary['interpret']})")
+    for kernel, timings in summary["timings"].items():
+        for label, secs in sorted(timings.items(), key=lambda kv: kv[1]):
+            click.echo(f"  {kernel:18s} {label:32s} {secs * 1e3:9.3f} ms")
+    for key, blocks in summary["entries"].items():
+        click.echo(f"best {key}: {blocks}")
+    if "path" in summary:
+        click.echo(f"table written: {summary['path']}")
+        if not os.environ.get("MODALITIES_TPU_TUNE_DIR"):
+            click.echo(f"export MODALITIES_TPU_TUNE_DIR={resolved_out} to use it in training")
+
+
 # ---------------------------------------------------------------------- benchmark
 
 

@@ -49,6 +49,17 @@ class CLMCrossEntropyLoss(Loss):
         )
         return (token_losses * mask).sum(), mask.sum()
 
+    def fused_sum_and_count(self, hidden, head_weight, labels, interpret: bool = False):
+        """`sum_and_count` without ever materializing logits: the Pallas
+        vocab-streaming fused-CE kernel consumes the pre-head hidden states
+        `[..., E]` and the head weight `[V, E]` directly (ops/cross_entropy.py
+        dispatch; the chunked scan in train_step stays the fallback tier)."""
+        from modalities_tpu.ops.cross_entropy import fused_ce_sum_and_count
+
+        return fused_ce_sum_and_count(
+            hidden, head_weight, labels, ignore_index=self.ignore_index, interpret=interpret
+        )
+
     def __call__(self, predictions: dict, targets: dict):
         total, count = self.sum_and_count(
             predictions[self.prediction_key], targets[self.target_key]

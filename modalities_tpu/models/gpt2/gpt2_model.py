@@ -24,7 +24,7 @@ from __future__ import annotations
 import math
 from dataclasses import dataclass
 from enum import Enum
-from typing import Annotated, Optional
+from typing import Annotated, Literal, Optional
 
 import flax.linen as nn
 import jax
@@ -117,23 +117,19 @@ class GPT2LLMConfig(BaseModel):
     seed: Optional[int] = None
     enforce_swiglu_hidden_dim_multiple_of: int = 256
     # fuse lm-head + loss per sequence chunk (long-context memory: [B,S,V] fp32
-    # logits never materialize); None = whole-sequence logits
+    # logits never materialize); None = whole-sequence logits. A non-divisor
+    # chunk is fine: the scan covers the divisible prefix and the remainder runs
+    # as one short chunk (odd eval lengths need no config change).
     lm_head_chunk_size: Optional[Annotated[int, Field(strict=True, ge=1)]] = None
+    # Pallas vocab-streaming fused CE tier (ops/cross_entropy.py): "auto" = on
+    # TPU only, "on" = always (interpret off-TPU), "off" = chunked-scan fallback.
+    # MODALITIES_TPU_FUSED_CE overrides at trace time.
+    lm_head_fused_ce: Literal["auto", "on", "off"] = "auto"
 
     @model_validator(mode="after")
     def check_divisibility(self) -> "GPT2LLMConfig":
         if self.n_head_q % self.n_head_kv != 0:
             raise ValueError("n_head_q must be divisible by n_head_kv")
-        if (
-            self.lm_head_chunk_size is not None
-            and self.sequence_length % self.lm_head_chunk_size != 0
-        ):
-            # a non-divisor would silently fall back to whole-sequence logits —
-            # the exact memory blowup the chunking exists to prevent
-            raise ValueError(
-                f"sequence_length ({self.sequence_length}) must be divisible by "
-                f"lm_head_chunk_size ({self.lm_head_chunk_size})"
-            )
         return self
 
     @model_validator(mode="after")
@@ -199,6 +195,9 @@ class GPT2ModelSpec:
     # [B,S,V] fp32 logits never materialize — at 32k ctx x 50k vocab that tensor
     # alone is 6.6 GB, more than a v5e can give it. None = whole-sequence logits.
     lm_head_chunk_size: Optional[int] = None
+    # Pallas vocab-streaming fused-CE tier: "auto" | "on" | "off" (the chunked
+    # scan above stays the fallback tier; MODALITIES_TPU_FUSED_CE overrides)
+    lm_head_fused_ce: str = "auto"
     context_parallel_axis: Optional[str] = None  # set when the mesh has cp > 1
     pipeline_axis: Optional[str] = None  # set when the mesh has pp > 1
     pp_num_microbatches: Optional[int] = None  # GPipe microbatches (default: pp degree)
@@ -242,6 +241,7 @@ class GPT2ModelSpec:
                 self.remat_freq,
                 self.remat_save_list,
                 self.lm_head_chunk_size,
+                self.lm_head_fused_ce,
                 self.context_parallel_axis,
                 self.pipeline_axis,
                 self.pp_num_microbatches,
@@ -775,6 +775,7 @@ class GPT2LLM(NNModel):
         seed: Optional[int] = None,
         enforce_swiglu_hidden_dim_multiple_of: int = 256,
         lm_head_chunk_size: Optional[int] = None,
+        lm_head_fused_ce: str = "auto",
     ):
         super().__init__(
             sample_key=sample_key,
@@ -836,6 +837,7 @@ class GPT2LLM(NNModel):
                 else None
             ),
             lm_head_chunk_size=lm_head_chunk_size,
+            lm_head_fused_ce=lm_head_fused_ce,
         )
         self.sequence_length = sequence_length
         self.vocab_size = vocab_size
@@ -878,6 +880,16 @@ class GPT2LLM(NNModel):
         """fp32 logits for a [B, C, E] hidden chunk (weight-tied or lm_head),
         vocab-constrained like the in-module head (loss parallel works)."""
         return head_project(self.config_spec, params["params"], hidden_chunk)
+
+    def head_weight(self, params):
+        """The `[V, E]` head projection matrix (tied wte, or lm_head kernel
+        transposed) — consumed by the Pallas fused-CE tier, which contracts it
+        against hidden states tile-by-tile instead of materializing logits.
+        Gradients flow back through the transpose/tie via autodiff."""
+        inner = params["params"]
+        if self.config_spec.use_weight_tying:
+            return inner["wte"]
+        return inner["lm_head"]["kernel"].T
 
     # ----------------------------------------------------------- KV-cache decoding
     def init_decode_cache(self, params, batch_size: int):
@@ -970,15 +982,11 @@ class GPT2LLM(NNModel):
             head+loss run per sequence chunk, accumulating (sum, count)."""
             p = shared["params"]
             seq = x.shape[1]
-            if head_chunk is not None and seq > head_chunk and seq % head_chunk != 0:
-                # falling back would materialize the [B,S,V] logits the chunking
-                # exists to avoid — fail fast instead (mirrors train_step)
-                raise ValueError(
-                    f"sequence length {seq} is not divisible by "
-                    f"lm_head_chunk_size {head_chunk}"
-                )
             if head_chunk is not None and seq > head_chunk:
-                num_chunks = seq // head_chunk
+                # ragged tail: scan the divisible prefix, then one short chunk for
+                # the remainder — odd eval lengths need no config change and the
+                # [B,S,V] logits still never materialize (mirrors train_step)
+                num_chunks, tail = divmod(seq, head_chunk)
 
                 def body(acc, i):
                     xc = jax.lax.dynamic_slice_in_dim(x, i * head_chunk, head_chunk, 1)
@@ -991,6 +999,13 @@ class GPT2LLM(NNModel):
                     (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32)),
                     jnp.arange(num_chunks),
                 )
+                if tail:
+                    s, c = chunk_sum_count(
+                        p,
+                        jax.lax.slice_in_dim(x, num_chunks * head_chunk, seq, axis=1),
+                        jax.lax.slice_in_dim(targets, num_chunks * head_chunk, seq, axis=1),
+                    )
+                    total, count = total + s, count + c
             elif has_sum_count:
                 total, count = _norm_head_sum(p, x, targets)
             else:

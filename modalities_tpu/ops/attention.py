@@ -38,7 +38,7 @@ def flash_attention_or_fallback(q, k, v, causal: bool = True, sm_scale: float | 
         # silently demote every attention call to the SDPA tier
         from modalities_tpu.ops.pallas.flash_attention import env_flash_blocks
 
-        block_q, block_k = env_flash_blocks(q.shape[1], k.shape[1])
+        block_q, block_k = env_flash_blocks(q.shape[1], k.shape[1], dtype=q.dtype)
         try:
             from modalities_tpu.ops.pallas.flash_attention import pallas_flash_attention
 

@@ -1,0 +1,99 @@
+"""Fused cross-entropy dispatch — same tier pattern as ops/attention.py.
+
+Tier resolution (`MODALITIES_TPU_FUSED_CE`, falling back to the model spec's
+`lm_head_fused_ce` knob): "auto" runs the Pallas vocab-streaming kernel on TPU
+only; "on" forces it everywhere (interpret mode off-TPU, which is how CPU tests
+and the no-[B,S,V]-HLO assertion exercise the real kernel); "off" keeps the
+chunked-scan fallback tier. Malformed values raise — never silently demote.
+
+Block sizes: env override > autotune table (ops/pallas/autotune.py, consulted
+at trace time) > module default.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+
+from modalities_tpu.ops.tiers import KernelTier, on_tpu, resolve_tier
+from modalities_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+_warned = False
+
+DEFAULT_BLOCK_ROWS = 256
+DEFAULT_BLOCK_VOCAB = 512
+
+
+def fused_ce_tier(spec_setting: Optional[str] = None) -> KernelTier:
+    return resolve_tier("MODALITIES_TPU_FUSED_CE", spec_setting)
+
+
+def resolve_ce_blocks(rows: int, vocab: int, n_embd: int, dtype) -> Tuple[int, int]:
+    """env var > autotune table > default — parsed outside any fallback guard so
+    a malformed override raises instead of demoting the kernel tier."""
+    env_rows = os.environ.get("MODALITIES_TPU_CE_BLOCK_ROWS")
+    env_vocab = os.environ.get("MODALITIES_TPU_CE_BLOCK_VOCAB")
+    block_rows = int(env_rows) if env_rows is not None else None
+    block_vocab = int(env_vocab) if env_vocab is not None else None
+    if block_rows is None or block_vocab is None:
+        from modalities_tpu.ops.pallas import autotune
+
+        hit = autotune.lookup(
+            "fused_ce",
+            f"n{autotune.shape_bucket(rows)}_v{autotune.shape_bucket(vocab)}_e{autotune.shape_bucket(n_embd)}",
+            jnp.dtype(dtype).name,
+        )
+        if hit:
+            block_rows = block_rows if block_rows is not None else int(hit.get("block_rows", DEFAULT_BLOCK_ROWS))
+            block_vocab = block_vocab if block_vocab is not None else int(hit.get("block_vocab", DEFAULT_BLOCK_VOCAB))
+    return (
+        block_rows if block_rows is not None else DEFAULT_BLOCK_ROWS,
+        block_vocab if block_vocab is not None else DEFAULT_BLOCK_VOCAB,
+    )
+
+
+def fused_ce_sum_and_count(hidden, head_weight, labels, *, ignore_index: int = -100, interpret: bool = False):
+    """(total_loss, token_count) over hidden @ head_weight.T without the logits
+    buffer. Drop-in for `loss_fn.sum_and_count(head_logits(...), labels)`.
+
+    On TPU, a trace-time Pallas failure falls back (with a one-time warning) to
+    the dense reference — correctness over memory, mirroring attention's SDPA
+    fallback. In interpret mode (tests) nothing is caught: a kernel bug must
+    fail the test, not silently pass via the fallback."""
+    global _warned
+    import numpy as np
+
+    rows = int(np.prod(hidden.shape[:-1])) if hidden.ndim > 1 else hidden.shape[0]
+    block_rows, block_vocab = resolve_ce_blocks(rows, head_weight.shape[0], hidden.shape[-1], hidden.dtype)
+
+    from modalities_tpu.ops.pallas.fused_ce import fused_ce_sum_and_count as pallas_fused_ce
+
+    if interpret or not on_tpu():
+        return pallas_fused_ce(
+            hidden, head_weight, labels,
+            ignore_index=ignore_index, block_rows=block_rows, block_vocab=block_vocab, interpret=True,
+        )
+    try:
+        return pallas_fused_ce(
+            hidden, head_weight, labels,
+            ignore_index=ignore_index, block_rows=block_rows, block_vocab=block_vocab, interpret=False,
+        )
+    except Exception as e:  # pragma: no cover - TPU only
+        if not _warned:
+            logger.warning("Pallas fused CE unavailable (%s); using dense logits fallback.", e)
+            _warned = True
+        return _dense_sum_and_count(hidden, head_weight, labels, ignore_index)
+
+
+def _dense_sum_and_count(hidden, head_weight, labels, ignore_index):
+    import optax
+
+    logits = jnp.einsum("...e,ve->...v", hidden.astype(jnp.float32), head_weight.astype(jnp.float32))
+    mask = (labels != ignore_index).astype(jnp.float32)
+    safe = jnp.where(labels != ignore_index, labels, 0)
+    token_losses = optax.softmax_cross_entropy_with_integer_labels(logits, safe)
+    return (token_losses * mask).sum(), mask.sum()

@@ -183,16 +183,35 @@ def _pick_block(seq: int, preferred: int) -> int:
     return seq
 
 
-def env_flash_blocks(seq_q: int, seq_k: int) -> tuple[int, int]:
+def env_flash_blocks(seq_q: int, seq_k: int, dtype="bfloat16") -> tuple[int, int]:
     """The (block_q, block_k) tuning knobs, shared by every kernel consumer
-    (ops/attention.py dispatch, the ring tier): MODALITIES_TPU_FLASH_BLOCK_Q/_K env
-    overrides (default 1024 — see ops/attention.py for the v5e tuning evidence),
-    stepped down to divide the sequence. A malformed override raises (int()) — it
-    must never silently demote the call to a fallback tier."""
+    (ops/attention.py dispatch, the ring tier). Precedence per knob:
+    MODALITIES_TPU_FLASH_BLOCK_Q/_K env override > the per-device autotune table
+    (ops/pallas/autotune.py, consulted at trace time) > 1024 (see ops/attention.py
+    for the v5e tuning evidence) — then stepped down to divide the sequence. A
+    malformed override raises (int()) — it must never silently demote the call to
+    a fallback tier."""
     import os
 
-    block_q = int(os.environ.get("MODALITIES_TPU_FLASH_BLOCK_Q", "1024"))
-    block_k = int(os.environ.get("MODALITIES_TPU_FLASH_BLOCK_K", "1024"))
+    env_q = os.environ.get("MODALITIES_TPU_FLASH_BLOCK_Q")
+    env_k = os.environ.get("MODALITIES_TPU_FLASH_BLOCK_K")
+    block_q = int(env_q) if env_q is not None else None
+    block_k = int(env_k) if env_k is not None else None
+    if block_q is None or block_k is None:
+        from modalities_tpu.ops.pallas import autotune
+
+        hit = autotune.lookup(
+            "flash_attention",
+            f"sq{autotune.shape_bucket(seq_q)}_sk{autotune.shape_bucket(seq_k)}",
+            jnp.dtype(dtype).name,
+        )
+        if hit:
+            block_q = block_q if block_q is not None else int(hit.get("block_q", 1024))
+            block_k = block_k if block_k is not None else int(hit.get("block_k", 1024))
+    if block_q is None:
+        block_q = 1024
+    if block_k is None:
+        block_k = 1024
     return _pick_block(seq_q, block_q), _pick_block(seq_k, block_k)
 
 

@@ -1,0 +1,74 @@
+"""Fused RMSNorm dispatch — same tier pattern as ops/attention.py.
+
+Tier resolution via `MODALITIES_TPU_FUSED_RMSNORM`: "auto" (default) uses the
+Pallas kernel on TPU and the exact reference everywhere else, so CPU tier-1
+numerics are byte-identical to the seed; "on" forces the kernel (interpret mode
+off-TPU); "off" pins the reference. Malformed values raise.
+
+Block size: `MODALITIES_TPU_RMSNORM_BLOCK_ROWS` > autotune table > 256.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+import jax.numpy as jnp
+
+from modalities_tpu.ops.tiers import KernelTier, on_tpu, resolve_tier
+from modalities_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+_warned = False
+
+DEFAULT_BLOCK_ROWS = 256
+
+
+def fused_rmsnorm_tier() -> KernelTier:
+    return resolve_tier("MODALITIES_TPU_FUSED_RMSNORM")
+
+
+def resolve_rmsnorm_block_rows(n_embd: int, dtype) -> int:
+    env = os.environ.get("MODALITIES_TPU_RMSNORM_BLOCK_ROWS")
+    if env is not None:
+        return int(env)  # malformed must raise, never demote
+    from modalities_tpu.ops.pallas import autotune
+
+    hit = autotune.lookup("fused_rmsnorm", f"e{autotune.shape_bucket(n_embd)}", jnp.dtype(dtype).name)
+    if hit:
+        return int(hit.get("block_rows", DEFAULT_BLOCK_ROWS))
+    return DEFAULT_BLOCK_ROWS
+
+
+def rms_norm_or_fallback(x, scale=None, bias=None, *, eps: float = 1e-6, interpret: bool = False):
+    """Single-HBM-round-trip RMSNorm with the reference as the fallback tier.
+
+    In interpret mode (tests) exceptions propagate — a kernel bug must fail the
+    parity test, not vanish into the fallback."""
+    global _warned
+    block_rows = resolve_rmsnorm_block_rows(x.shape[-1], x.dtype)
+
+    from modalities_tpu.ops.pallas.fused_rmsnorm import fused_rms_norm
+
+    if interpret or not on_tpu():
+        return fused_rms_norm(x, scale, bias, eps=eps, block_rows=block_rows, interpret=True)
+    try:
+        return fused_rms_norm(x, scale, bias, eps=eps, block_rows=block_rows, interpret=False)
+    except Exception as e:  # pragma: no cover - TPU only
+        if not _warned:
+            logger.warning("Pallas fused RMSNorm unavailable (%s); using reference ops.", e)
+            _warned = True
+        return reference_rms_norm(x, scale, bias, eps=eps)
+
+
+def reference_rms_norm(x, scale=None, bias=None, *, eps: float = 1e-6):
+    """Same math as layer_norms.RMSNormWithBias, kept here as the fallback tier
+    and the parity-test oracle."""
+    x32 = x.astype(jnp.float32)
+    y = x32 * jax.lax.rsqrt((x32 * x32).mean(axis=-1, keepdims=True) + eps)
+    if scale is not None:
+        y = y * scale
+    if bias is not None:
+        y = y + bias
+    return y.astype(x.dtype)

@@ -267,22 +267,40 @@ class TrainStepBuilder:
             # pipeline executor's, so ignore_index semantics are exact.
             target_key = loss_fn.target_key
 
+            # Pallas fused-CE tier (ops/cross_entropy.py): when the loss and model
+            # expose the fused path and the tier resolves enabled, the vocab
+            # dimension streams through VMEM and not even the [B,chunk,V] buffer
+            # exists; the chunked scan below stays the fallback tier. Resolved
+            # ONCE at build so the tier is baked at trace time, and resolution
+            # errors (malformed env) surface here, not mid-run.
+            fused_ce_tier_resolved = None
+            if hasattr(loss_fn, "fused_sum_and_count") and hasattr(model, "head_weight"):
+                from modalities_tpu.ops.cross_entropy import fused_ce_tier
+
+                tier = fused_ce_tier(getattr(model_spec, "lm_head_fused_ce", None))
+                if tier.enabled:
+                    fused_ce_tier_resolved = tier
+
             chunk_sum_count = jax.checkpoint(
                 lambda params, hc, lc: loss_fn.sum_and_count(model.head_logits(params, hc), lc),
                 prevent_cse=False,
             )
 
             def _chunked_ce(params, hidden, labels):
-                seq = hidden.shape[1]
-                if seq > head_chunk and seq % head_chunk != 0:
-                    # falling back would materialize the [B,S,V] logits this
-                    # feature exists to avoid — fail fast instead
-                    raise ValueError(
-                        f"sequence length {seq} is not divisible by "
-                        f"lm_head_chunk_size {head_chunk}"
+                if fused_ce_tier_resolved is not None:
+                    total, count = loss_fn.fused_sum_and_count(
+                        hidden,
+                        model.head_weight(params),
+                        labels,
+                        interpret=fused_ce_tier_resolved.interpret,
                     )
-                if seq % head_chunk == 0 and seq > head_chunk:
-                    num_chunks = seq // head_chunk
+                    return total / jnp.maximum(count, 1.0)
+                seq = hidden.shape[1]
+                if seq > head_chunk:
+                    # ragged tail: scan the divisible prefix, then one short chunk
+                    # for the remainder — odd eval sequence lengths need no config
+                    # change and the [B,S,V] logits still never materialize
+                    num_chunks, tail = divmod(seq, head_chunk)
 
                     def body(acc, i):
                         hc = jax.lax.dynamic_slice_in_dim(hidden, i * head_chunk, head_chunk, 1)
@@ -294,7 +312,14 @@ class TrainStepBuilder:
                         body, (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32)),
                         jnp.arange(num_chunks),
                     )
-                else:  # short or ragged sequences: one chunk, same code path
+                    if tail:
+                        s, c = chunk_sum_count(
+                            params,
+                            jax.lax.slice_in_dim(hidden, num_chunks * head_chunk, seq, axis=1),
+                            jax.lax.slice_in_dim(labels, num_chunks * head_chunk, seq, axis=1),
+                        )
+                        total, count = total + s, count + c
+                else:  # short sequences: one chunk, same code path
                     total, count = loss_fn.sum_and_count(model.head_logits(params, hidden), labels)
                 return total / jnp.maximum(count, 1.0)
 

@@ -197,11 +197,15 @@ def test_trainer_publishes_wall_device_split_and_stalls():
     assert len(results.messages) == 2  # 4 steps / interval 2
     for msg in results.messages:
         tp = msg.payload.throughput_metrics
-        for key in ("tokens/s", "tokens/s (device)", "host stall [s]",
-                    "boundary stall [s]", "MFU", "MFU (device)",
+        for key in ("tokens/s", "tokens/s (wall)", "tokens/s (device)", "host stall [s]",
+                    "boundary stall [s]", "MFU", "MFU (wall)", "MFU (device)",
                     "goodput [%]", "goodput/train_step [s]", "goodput/data_stall [s]"):
             assert key in tp, (key, sorted(tp))
         assert 0.0 <= tp["goodput [%]"].value <= 100.0
+        # the explicit wall aliases are the same measurements as the bare keys
+        # (kept for dashboards), never a third timing source
+        assert tp["tokens/s (wall)"].value == tp["tokens/s"].value
+        assert tp["MFU (wall)"].value == tp["MFU"].value
         # device-time rate excludes the measured stalls, so it can only be faster
         assert tp["tokens/s (device)"].value >= tp["tokens/s"].value
         assert tp["boundary stall [s]"].value > 0.0  # the sleeping eval callback

@@ -129,6 +129,32 @@ def test_to_disc_serializes_telemetry_goodput_keys(tmp_path):
     assert tp["goodput/data_stall [s]"] == pytest.approx(0.1)
 
 
+def test_to_disc_carries_wall_and_device_throughput_split(tmp_path):
+    """Scoreboard auditability: the on-disk row must carry the explicit wall
+    tokens/s alongside the device-time rate, exactly as published."""
+    from modalities_tpu.batch import ResultItem
+
+    sub = EvaluationResultToDiscSubscriber(output_folder_path=tmp_path)
+    result = EvaluationResultBatch(
+        dataloader_tag="train",
+        num_train_steps_done=4,
+        losses={"CLMCrossEntropyLoss": 2.0},
+        metrics={},
+        throughput_metrics={
+            "tokens/s": ResultItem(900.0, 1),
+            "tokens/s (wall)": ResultItem(900.0, 1),
+            "tokens/s (device)": ResultItem(1000.0, 1),
+            "MFU (wall)": ResultItem(0.61, 4),
+            "MFU (device)": ResultItem(0.68, 4),
+        },
+    )
+    sub.consume_message(_msg(result))
+    tp = json.loads((tmp_path / "evaluation_results.jsonl").read_text())["throughput_metrics"]
+    assert tp["tokens/s (wall)"] == pytest.approx(900.0)
+    assert tp["tokens/s (device)"] == pytest.approx(1000.0)
+    assert tp["MFU (wall)"] == pytest.approx(0.61)
+
+
 # ------------------------------------------------------------ rich / rank gating
 
 

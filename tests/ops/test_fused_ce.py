@@ -1,0 +1,134 @@
+"""Vocab-streaming fused cross-entropy vs the dense optax oracle (fwd + grads),
+in Pallas interpret mode on CPU — same pattern as test_flash_attention.py."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from modalities_tpu.ops.pallas.fused_ce import fused_ce_sum_and_count
+
+
+def _oracle_sum_and_count(hidden, head_weight, labels, ignore_index=-100):
+    logits = jnp.einsum(
+        "...e,ve->...v", hidden.astype(jnp.float32), head_weight.astype(jnp.float32)
+    )
+    mask = (labels != ignore_index).astype(jnp.float32)
+    safe = jnp.where(labels != ignore_index, labels, 0)
+    per_token = optax.softmax_cross_entropy_with_integer_labels(logits, safe)
+    return (per_token * mask).sum(), mask.sum()
+
+
+def _inputs(seed, rows, vocab, embd, dtype=jnp.float32, w_dtype=None):
+    rng = jax.random.PRNGKey(seed)
+    h = jax.random.normal(jax.random.fold_in(rng, 0), (rows, embd), dtype)
+    w = jax.random.normal(jax.random.fold_in(rng, 1), (vocab, embd), w_dtype or dtype)
+    y = jax.random.randint(jax.random.fold_in(rng, 2), (rows,), 0, vocab)
+    return h, w, y
+
+
+@pytest.mark.parametrize(
+    "rows,vocab,block_rows,block_vocab",
+    [
+        (32, 256, 16, 128),  # divisible everywhere
+        (21, 256, 16, 128),  # ragged rows (padded with ignore_index)
+        (32, 200, 16, 128),  # non-divisible vocab tail (padded cols masked to -inf)
+        (21, 200, 16, 128),  # both ragged
+    ],
+)
+def test_forward_matches_oracle(rows, vocab, block_rows, block_vocab):
+    h, w, y = _inputs(0, rows, vocab, 64)
+    exp_total, exp_count = _oracle_sum_and_count(h, w, y)
+    got_total, got_count = fused_ce_sum_and_count(
+        h, w, y, block_rows=block_rows, block_vocab=block_vocab, interpret=True
+    )
+    np.testing.assert_allclose(float(got_total), float(exp_total), rtol=1e-5)
+    assert float(got_count) == float(exp_count)
+
+
+def test_ignore_index_rows_masked():
+    h, w, y = _inputs(1, 24, 128, 32)
+    y = y.at[:7].set(-100)
+    exp_total, exp_count = _oracle_sum_and_count(h, w, y)
+    got_total, got_count = fused_ce_sum_and_count(
+        h, w, y, block_rows=8, block_vocab=128, interpret=True
+    )
+    np.testing.assert_allclose(float(got_total), float(exp_total), rtol=1e-5)
+    assert float(got_count) == float(exp_count) == 17.0
+
+
+def test_all_rows_ignored_zero_count():
+    h, w, _ = _inputs(2, 16, 128, 32)
+    y = jnp.full((16,), -100, dtype=jnp.int32)
+    got_total, got_count = fused_ce_sum_and_count(
+        h, w, y, block_rows=8, block_vocab=128, interpret=True
+    )
+    assert float(got_total) == 0.0
+    assert float(got_count) == 0.0
+
+
+def test_gradients_match_oracle():
+    h, w, y = _inputs(3, 21, 200, 48)
+    y = y.at[2].set(-100)  # an ignored row must contribute zero grad
+
+    def loss_fused(h, w):
+        total, count = fused_ce_sum_and_count(
+            h, w, y, block_rows=8, block_vocab=128, interpret=True
+        )
+        return total / jnp.maximum(count, 1.0)
+
+    def loss_oracle(h, w):
+        total, count = _oracle_sum_and_count(h, w, y)
+        return total / jnp.maximum(count, 1.0)
+
+    gh_f, gw_f = jax.grad(loss_fused, argnums=(0, 1))(h, w)
+    gh_o, gw_o = jax.grad(loss_oracle, argnums=(0, 1))(h, w)
+    np.testing.assert_allclose(np.asarray(gh_f), np.asarray(gh_o), rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(gw_f), np.asarray(gw_o), rtol=1e-4, atol=1e-5)
+    # padded-row / padded-vocab pollution check: grads carry the primal shapes
+    assert gh_f.shape == h.shape and gw_f.shape == w.shape
+
+
+def test_bf16_hidden_fp32_accumulation():
+    """bf16 activations, fp32 stats: totals must match the oracle computed on the
+    same bf16 inputs upcast to fp32 (accumulation is what the kernel controls)."""
+    h, w, y = _inputs(4, 32, 256, 64, dtype=jnp.bfloat16, w_dtype=jnp.float32)
+    exp_total, exp_count = _oracle_sum_and_count(h, w, y)
+    got_total, got_count = fused_ce_sum_and_count(
+        h, w, y, block_rows=16, block_vocab=128, interpret=True
+    )
+    assert got_total.dtype == jnp.float32
+    np.testing.assert_allclose(float(got_total), float(exp_total), rtol=1e-3)
+    assert float(got_count) == float(exp_count)
+
+    def loss_fused(h):
+        total, count = fused_ce_sum_and_count(
+            h, w, y, block_rows=16, block_vocab=128, interpret=True
+        )
+        return total / count
+
+    gh = jax.grad(loss_fused)(h)
+    assert gh.dtype == h.dtype  # cotangent lands back in the activation dtype
+
+
+def test_multidim_hidden_flattened():
+    """[B, S, E] hidden / [B, S] labels round-trip through the row flattening."""
+    rng = jax.random.PRNGKey(5)
+    h = jax.random.normal(jax.random.fold_in(rng, 0), (2, 9, 32))
+    w = jax.random.normal(jax.random.fold_in(rng, 1), (100, 32))
+    y = jax.random.randint(jax.random.fold_in(rng, 2), (2, 9), 0, 100)
+    exp_total, exp_count = _oracle_sum_and_count(h, w, y)
+    got_total, got_count = fused_ce_sum_and_count(
+        h, w, y, block_rows=8, block_vocab=128, interpret=True
+    )
+    np.testing.assert_allclose(float(got_total), float(exp_total), rtol=1e-5)
+    assert float(got_count) == float(exp_count)
+
+    def loss(h):
+        total, count = fused_ce_sum_and_count(
+            h, w, y, block_rows=8, block_vocab=128, interpret=True
+        )
+        return total / count
+
+    assert jax.grad(loss)(h).shape == h.shape

@@ -1,0 +1,74 @@
+"""Fused RMSNorm kernel vs the exact reference (fwd + grads), in Pallas
+interpret mode on CPU."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from modalities_tpu.ops.pallas.fused_rmsnorm import fused_rms_norm
+from modalities_tpu.ops.rmsnorm import reference_rms_norm
+
+
+def _inputs(seed, rows, embd, dtype=jnp.float32, with_bias=True):
+    rng = jax.random.PRNGKey(seed)
+    x = jax.random.normal(jax.random.fold_in(rng, 0), (rows, embd), dtype)
+    scale = jax.random.normal(jax.random.fold_in(rng, 1), (embd,)) * 0.1 + 1.0
+    bias = jax.random.normal(jax.random.fold_in(rng, 2), (embd,)) * 0.1 if with_bias else None
+    return x, scale, bias
+
+
+@pytest.mark.parametrize("rows", [32, 21])  # divisible and ragged (padded) rows
+@pytest.mark.parametrize("with_bias", [True, False])
+def test_forward_matches_reference(rows, with_bias):
+    x, scale, bias = _inputs(0, rows, 64, with_bias=with_bias)
+    exp = reference_rms_norm(x, scale, bias)
+    got = fused_rms_norm(x, scale, bias, block_rows=8, interpret=True)
+    assert got.shape == x.shape
+    np.testing.assert_allclose(np.asarray(got), np.asarray(exp), rtol=2e-6, atol=2e-6)
+
+
+def test_forward_no_scale_no_bias():
+    x, _, _ = _inputs(1, 16, 32, with_bias=False)
+    exp = reference_rms_norm(x)
+    got = fused_rms_norm(x, block_rows=8, interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(exp), rtol=2e-6, atol=2e-6)
+
+
+def test_gradients_match_reference():
+    x, scale, bias = _inputs(2, 21, 48)
+    cot = jax.random.normal(jax.random.PRNGKey(9), (21, 48))
+
+    def loss_fused(x, s, b):
+        return (fused_rms_norm(x, s, b, block_rows=8, interpret=True) * cot).sum()
+
+    def loss_ref(x, s, b):
+        return (reference_rms_norm(x, s, b) * cot).sum()
+
+    g_fused = jax.grad(loss_fused, argnums=(0, 1, 2))(x, scale, bias)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(x, scale, bias)
+    for gf, gr, name in zip(g_fused, g_ref, ("dx", "dscale", "dbias")):
+        assert gf.shape == gr.shape, name
+        np.testing.assert_allclose(
+            np.asarray(gf), np.asarray(gr), rtol=5e-5, atol=5e-5, err_msg=f"{name} mismatch"
+        )
+
+
+def test_bf16_input_fp32_stats():
+    x, scale, bias = _inputs(3, 32, 64, dtype=jnp.bfloat16)
+    exp = reference_rms_norm(x, scale, bias)  # reference also upcasts to fp32
+    got = fused_rms_norm(x, scale, bias, block_rows=16, interpret=True)
+    assert got.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(got, dtype=np.float32), np.asarray(exp, dtype=np.float32), rtol=1e-2, atol=1e-2
+    )
+
+
+def test_multidim_input():
+    rng = jax.random.PRNGKey(4)
+    x = jax.random.normal(rng, (2, 9, 32))
+    scale = jnp.ones((32,))
+    exp = reference_rms_norm(x, scale)
+    got = fused_rms_norm(x, scale, block_rows=8, interpret=True)
+    assert got.shape == x.shape
+    np.testing.assert_allclose(np.asarray(got), np.asarray(exp), rtol=2e-6, atol=2e-6)

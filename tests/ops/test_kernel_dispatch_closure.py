@@ -1,0 +1,73 @@
+"""Static closure check: every Pallas kernel reachable through a dispatch
+wrapper must be drivable in interpret mode, so CPU parity tests can always
+exercise the real kernel code path (never just the fallback tier).
+
+Pure AST/inspect — no tracing, runs in milliseconds."""
+
+import ast
+import inspect
+from pathlib import Path
+
+import modalities_tpu.ops.pallas as pallas_pkg
+
+PALLAS_DIR = Path(pallas_pkg.__file__).parent
+
+
+def _pallas_call_sites(tree):
+    """Yield (lineno, keywords) for every `pl.pallas_call(...)` / `pallas_call(...)`."""
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        name = fn.attr if isinstance(fn, ast.Attribute) else getattr(fn, "id", None)
+        if name == "pallas_call":
+            yield node.lineno, {kw.arg for kw in node.keywords}
+
+
+def test_every_pallas_call_wires_interpret():
+    offenders = []
+    found_any = False
+    for path in sorted(PALLAS_DIR.glob("*.py")):
+        tree = ast.parse(path.read_text())
+        for lineno, kwargs in _pallas_call_sites(tree):
+            found_any = True
+            if "interpret" not in kwargs:
+                offenders.append(f"{path.name}:{lineno}")
+    assert found_any, "no pallas_call sites found — did the kernels move?"
+    assert not offenders, (
+        "pallas_call sites without an interpret= kwarg (CPU parity tests could "
+        f"only reach the fallback tier): {offenders}"
+    )
+
+
+def test_dispatch_entry_points_expose_interpret():
+    """The manifest of kernel entry points reachable from dispatch wrappers.
+    A new kernel added to a wrapper without an interpret path must fail here."""
+    from modalities_tpu.ops.cross_entropy import fused_ce_sum_and_count as ce_dispatch
+    from modalities_tpu.ops.pallas.flash_attention import pallas_flash_attention
+    from modalities_tpu.ops.pallas.fused_ce import fused_ce_sum_and_count
+    from modalities_tpu.ops.pallas.fused_rmsnorm import fused_rms_norm
+    from modalities_tpu.ops.rmsnorm import rms_norm_or_fallback
+
+    for fn in (pallas_flash_attention, fused_ce_sum_and_count, fused_rms_norm, ce_dispatch, rms_norm_or_fallback):
+        params = inspect.signature(fn).parameters
+        assert "interpret" in params, f"{fn.__module__}.{fn.__name__} lacks an interpret path"
+        assert params["interpret"].default is False, fn.__name__
+
+
+def test_dispatch_wrappers_cover_every_kernel_module():
+    """Every kernel module in ops/pallas/ must be imported by some dispatch-tier
+    module under ops/ — a kernel nobody dispatches to is dead weight or, worse,
+    wired in somewhere that skips the tier pattern."""
+    kernel_modules = {
+        p.stem for p in PALLAS_DIR.glob("*.py") if p.stem not in ("__init__", "autotune")
+    }
+    ops_dir = PALLAS_DIR.parent
+    imported = set()
+    for path in ops_dir.glob("*.py"):
+        text = path.read_text()
+        for mod in kernel_modules:
+            if f"pallas.{mod}" in text:
+                imported.add(mod)
+    missing = kernel_modules - imported
+    assert not missing, f"kernel modules with no dispatch-tier consumer under ops/: {missing}"

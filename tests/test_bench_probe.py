@@ -237,3 +237,46 @@ def test_bench_cpu_smoke_emits_one_json_line():
     assert goodput["buckets"]["train_step"] > 0.0
     assert goodput["buckets"]["compile_first_step"] > 0.0
     assert sum(goodput["buckets"].values()) == pytest.approx(goodput["wall_s"], rel=0.05)
+
+
+def test_wedged_ladder_emits_probe_wedged_json_and_exits_clean(bench, monkeypatch, capsys):
+    """Probe ladder exhausts fully wedged -> main() must emit EXACTLY one valid
+    JSON line with probe_wedged=true (value 0.0, verified-TPU provenance riding
+    in detail) and return without ever starting a CPU fallback run — the
+    BENCH_r05 failure mode (rc=124, parsed null) must stay dead."""
+    import json
+
+    bench._probe_tpu = lambda timeout_s=180: "wedged"
+    monkeypatch.setattr(
+        bench, "_run_candidate",
+        lambda *a, **k: pytest.fail("wedged exit must not run any candidate"),
+    )
+    bench.main()
+    json_lines = [ln for ln in capsys.readouterr().out.splitlines() if ln.startswith("{")]
+    assert len(json_lines) == 1
+    out = json.loads(json_lines[0])
+    assert out["probe_wedged"] is True
+    assert out["value"] == 0.0 and out["vs_baseline"] == 0.0
+    assert out["detail"]["last_verified_tpu"]["mfu"] == pytest.approx(0.6882)
+
+
+def test_transient_wedge_that_clears_does_not_mark_wedged(bench):
+    """A wedge that clears on a later rung is a healthy TPU: the wedged flag must
+    NOT stick from the early rungs."""
+    calls = []
+
+    def probe(timeout_s=180):
+        calls.append(1)
+        return "tpu" if len(calls) >= 2 else "wedged"
+
+    bench._probe_tpu = probe
+    assert bench._probe_tpu_ladder() is True
+    assert bench._PROBE_WEDGED is False
+
+
+def test_clean_no_tpu_exhaustion_is_not_wedged(bench):
+    """'No TPU on this host' exhaustion must fall through to the CPU run (the
+    laptop/CI path), not the wedged short-circuit."""
+    bench._probe_tpu = lambda timeout_s=180: "no_tpu"
+    assert bench._probe_tpu_ladder() is False
+    assert bench._PROBE_WEDGED is False
