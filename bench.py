@@ -258,6 +258,12 @@ _CPU_CANDIDATE = ("cpu_tiny", 2, 256, 4, 1024, 256, 4, "pytorch_flash", "float32
 def _run_candidate(cand, iters: int):
     """Build the train step for one candidate and time it. Returns the result dict."""
     t_candidate_start = time.perf_counter()
+    # resilience events (anomaly skips, checkpoint-IO retries, preemption) firing
+    # inside the measurement window mean the timings are NOT a clean-chip number:
+    # snapshot the counters here and flag the JSON line if anything fired
+    from modalities_tpu.resilience.events import counts_since, snapshot_counts
+
+    resilience_snapshot = snapshot_counts()
     import jax
 
     from modalities_tpu.loss_functions import CLMCrossEntropyLoss
@@ -442,6 +448,7 @@ def _run_candidate(cand, iters: int):
     ledger.add_seconds("compile_first_step", t_warmup_done - t_build_done)
     ledger.add_seconds("train_step", float(np.sum([np.sum(ts) for ts in all_repeats])))
     goodput = ledger.summary(wall_s=time.perf_counter() - t_candidate_start)
+    resilience_events = counts_since(resilience_snapshot)
 
     baseline_mfu = 0.6867  # reference best (6.7B, 8xA100, README.md:339)
     return {
@@ -469,6 +476,11 @@ def _run_candidate(cand, iters: int):
             "best_repeat": best_idx,
             "repeat_medians_s": [round(m, 4) for m in repeat_medians],
             "variance_reruns": extra_used,
+            # any anomaly/retry/preemption event during the window taints the
+            # measurement — `degraded: true` tells the scoreboard reader to
+            # distrust this line without having to diff telemetry sinks
+            "degraded": bool(resilience_events),
+            "resilience_events": resilience_events,
             "params": n_params,
             "device": dev.device_kind,
             "seq": seq,

@@ -18,13 +18,16 @@ import functools
 import click
 
 from modalities_tpu.api import FileExistencePolicy
+from modalities_tpu.resilience.errors import RESUMABLE_EXIT_CODE, ResumableError
 from modalities_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
 
 
 def _exception_handling(func):
-    """Write a per-rank structured JSON error log next to stderr (reference :736)."""
+    """Write a per-rank structured JSON error log next to stderr (reference :736).
+    A `ResumableError` (preemption, anomaly rollback) maps to the distinguished
+    `RESUMABLE_EXIT_CODE` so a supervisor can tell "warmstart me" from a crash."""
 
     @functools.wraps(func)
     def wrapper(*args, **kwargs):
@@ -37,6 +40,7 @@ def _exception_handling(func):
                 "hostname": socket.gethostname(),
                 "timestamp": datetime.now().isoformat(),
                 "error": repr(e),
+                "resumable": isinstance(e, ResumableError),
                 "stacktrace": traceback.format_exc(),
             }
             error_dir = Path(os.environ.get("MODALITIES_TPU_ERROR_LOG_DIR", "."))
@@ -44,6 +48,12 @@ def _exception_handling(func):
             error_file = error_dir / f"error_rank_{rank}.json"
             with open(error_file, "w") as f:
                 json.dump(error_record, f, indent=2)
+            if isinstance(e, ResumableError):
+                logger.warning(
+                    "Run stopped resumably (%s); exiting %d for the supervisor. "
+                    "Error log: %s", e, RESUMABLE_EXIT_CODE, error_file,
+                )
+                raise SystemExit(RESUMABLE_EXIT_CODE) from e
             logger.error("Run failed; error log written to %s", error_file)
             raise
 
@@ -59,9 +69,47 @@ def main() -> None:
 @click.option("--config_file_path", type=click.Path(exists=True, path_type=Path), required=True)
 @click.option("--experiments_root_path", type=click.Path(path_type=Path), default=None)
 @click.option("--test_comm", is_flag=True, default=False, help="Run a pre-flight collective check.")
+@click.option("--resilient", is_flag=True, default=False,
+              help="Supervise the run: auto-warmstart on resumable exits (preemption, rollback).")
+@click.option("--last_checkpoint_info_file_path", type=click.Path(path_type=Path), default=None,
+              help="Where the resume pointer lives/will appear (required with --resilient).")
+@click.option("--max_restarts", type=int, default=3, show_default=True,
+              help="Crash-loop cap for --resilient.")
+@click.option("--backoff_base_s", type=float, default=1.0, show_default=True,
+              help="Exponential-backoff base between --resilient restarts.")
+@click.option("--warmstart_config_file_path", type=click.Path(exists=True, path_type=Path),
+              default=None,
+              help="Config the --resilient supervisor uses for resume children; a cold "
+              "config pins progress at zero, so most runs need a distinct warmstart YAML.")
 @_exception_handling
-def entry_point_run(config_file_path: Path, experiments_root_path: Optional[Path], test_comm: bool) -> None:
+def entry_point_run(
+    config_file_path: Path,
+    experiments_root_path: Optional[Path],
+    test_comm: bool,
+    resilient: bool,
+    last_checkpoint_info_file_path: Optional[Path],
+    max_restarts: int,
+    backoff_base_s: float,
+    warmstart_config_file_path: Optional[Path],
+) -> None:
     """Train from a YAML config."""
+    if resilient:
+        if last_checkpoint_info_file_path is None:
+            raise click.UsageError("--resilient requires --last_checkpoint_info_file_path")
+        from modalities_tpu.resilience.supervisor import run_resilient
+
+        code = run_resilient(
+            config_file_path=config_file_path,
+            last_checkpoint_info_file_path=last_checkpoint_info_file_path,
+            experiments_root_path=experiments_root_path,
+            warmstart_config_file_path=warmstart_config_file_path,
+            max_restarts=max_restarts,
+            backoff_base_s=backoff_base_s,
+        )
+        if code != 0:
+            raise SystemExit(code)
+        return
+
     from modalities_tpu.main import Main
     from modalities_tpu.running_env.env import TpuEnv
     from modalities_tpu.utils.communication_test import run_communication_test
@@ -85,16 +133,21 @@ def entry_point_warmstart(
     config_file_path: Path, last_checkpoint_info_file_path: Path, experiments_root_path: Optional[Path]
 ) -> None:
     """Resume from the last checkpoint (reference __main__.py:112-163: injects the
-    ${warmstart_env:checkpoint_paths} resolver from last_checkpoint_info.json)."""
+    ${warmstart_env:checkpoint_paths} resolver from last_checkpoint_info.json).
+
+    The resume folder is resolved and VERIFIED here, before config build, because
+    the folder name is the metadata store (steps/tokens/sampler position are
+    parsed from it): if the pointer's target fails its manifest, the ring is
+    walked back to the newest verifiable folder."""
     from modalities_tpu.main import Main
+    from modalities_tpu.resilience.manifest import resolve_resume_folder
     from modalities_tpu.running_env.env import TpuEnv
 
-    with open(last_checkpoint_info_file_path) as f:
-        info = json.load(f)
+    resume_folder = str(resolve_resume_folder(last_checkpoint_info_file_path))
 
     def warmstart_env(key: str):
         if key in ("checkpoint_paths", "checkpoint_folder_path"):
-            return info["checkpoint_folder_path"]
+            return resume_folder
         raise ValueError(f"Unknown warmstart_env variable {key!r}")
 
     with TpuEnv():

@@ -19,11 +19,21 @@ class CheckpointSaving:
         self.checkpoint_saving_strategy = checkpoint_saving_strategy
         self.checkpoint_saving_execution = checkpoint_saving_execution
 
-    def save_checkpoint(self, training_progress: TrainingProgress, app_state_handle: AppStateHandle) -> None:
+    def save_checkpoint(
+        self,
+        training_progress: TrainingProgress,
+        app_state_handle: AppStateHandle,
+        force: bool = False,
+    ) -> None:
+        """`force=True` (preemption shutdown) overrides the strategy's schedule:
+        the instruction is made savable regardless of the step, while its ring
+        deletions still apply."""
         with span("checkpoint_save"):
             instruction = self.checkpoint_saving_strategy.get_checkpoint_instruction(
                 training_progress=training_progress
             )
+            if force:
+                instruction.savable = True
             self.checkpoint_saving_execution.run_checkpoint_instruction(
                 checkpointing_instruction=instruction,
                 training_progress=training_progress,

@@ -16,6 +16,9 @@ from pathlib import Path
 import jax
 
 from modalities_tpu.checkpointing.stateful.app_state import AppState, AppStateHandle
+from modalities_tpu.exceptions import CheckpointingError
+from modalities_tpu.resilience.manifest import verify_manifest
+from modalities_tpu.resilience.retry import retry_io
 from modalities_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
@@ -36,6 +39,16 @@ class OrbaxCheckpointLoading(CheckpointLoadingIF):
         checkpoint_dir_path = Path(checkpoint_dir_path)
         if not checkpoint_dir_path.exists():
             raise FileNotFoundError(f"Checkpoint directory {checkpoint_dir_path} does not exist.")
+        # integrity gate: refuse to restore a folder that fails its manifest (a
+        # folder WITHOUT a manifest is accepted — legacy checkpoints). Fallback to
+        # an older verifiable folder is NOT done here: the folder name is the
+        # metadata store, so the warmstart CLI/supervisor must resolve the fallback
+        # BEFORE config build (resilience.manifest.resolve_resume_folder).
+        verification = verify_manifest(checkpoint_dir_path)
+        if not verification.ok:
+            raise CheckpointingError(
+                f"refusing to restore {checkpoint_dir_path}: {verification.reason}"
+            )
 
         state = app_state_handle.state
         shardings = app_state_handle.state_shardings
@@ -49,8 +62,9 @@ class OrbaxCheckpointLoading(CheckpointLoadingIF):
             abstract = jax.tree.map(lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), state)
 
         logger.info("Restoring sharded checkpoint from %s ...", checkpoint_dir_path)
-        restored: AppState = ocp.StandardCheckpointer().restore(
-            checkpoint_dir_path.absolute(), abstract
+        restored: AppState = retry_io(
+            lambda: ocp.StandardCheckpointer().restore(checkpoint_dir_path.absolute(), abstract),
+            what="orbax_restore",
         )
         app_state_handle.mark_loaded()  # only after a successful restore
         app_state_handle.state = restored

@@ -15,13 +15,14 @@ while the previous state streams to disk).
 
 from __future__ import annotations
 
-import json
 import shutil
 from pathlib import Path
 
 from modalities_tpu.checkpointing.checkpoint_saving_execution import CheckpointSavingExecutionABC
 from modalities_tpu.checkpointing.stateful.app_state import AppStateHandle
-from modalities_tpu.exceptions import CheckpointingError
+from modalities_tpu.resilience.faults import fire_io_error_if_armed
+from modalities_tpu.resilience.manifest import atomic_write_json, write_manifest
+from modalities_tpu.resilience.retry import retry_io
 from modalities_tpu.training.training_progress import TrainingProgress
 from modalities_tpu.utils.logging import get_logger
 
@@ -85,9 +86,14 @@ class OrbaxCheckpointSaving(CheckpointSavingExecutionABC):
         folder.parent.mkdir(parents=True, exist_ok=True)
         logger.info("Saving sharded checkpoint to %s ...", folder)
         checkpointer = self._get_checkpointer()
-        # (an async checkpointer waits for the PREVIOUS save's commit here before
-        # starting the new one, so the pending pointer below is safe to flush)
-        checkpointer.save(folder.absolute(), app_state_handle.state)
+
+        def _save():
+            fire_io_error_if_armed()
+            # (an async checkpointer waits for the PREVIOUS save's commit here before
+            # starting the new one, so the pending pointer below is safe to flush)
+            checkpointer.save(folder.absolute(), app_state_handle.state, force=True)
+
+        retry_io(_save, what="orbax_save")
         self._flush_pending_info()
         if self.use_async:
             self._pending_info_folder = folder
@@ -95,8 +101,16 @@ class OrbaxCheckpointSaving(CheckpointSavingExecutionABC):
             # block until the atomic commit (tmp-dir rename) completes — the fence the
             # reference implements with dist.barrier() (fsdp_checkpoint_saving.py:259-263)
             checkpointer.wait_until_finished()
-            self._write_info(folder)
+            self._seal_committed(folder)
         logger.info("Checkpoint saved.")
+
+    def _seal_committed(self, folder: Path) -> None:
+        """Post-commit sealing: manifest first (its presence certifies a complete
+        folder), then the resume pointer (which names the folder the manifest
+        just certified)."""
+        if _process_index() == 0:
+            write_manifest(folder)
+        self._write_info(folder)
 
     def _write_info(self, folder: Path) -> None:
         self._last_info_folder = folder  # every process tracks this (see __init__)
@@ -104,13 +118,14 @@ class OrbaxCheckpointSaving(CheckpointSavingExecutionABC):
             return
         info = {"checkpoint_folder_path": str(folder.absolute())}
         info_path = folder.parent / LAST_CHECKPOINT_INFO_FILE_NAME
-        with open(info_path, "w", encoding="utf-8") as f:
-            json.dump(info, f)
+        # atomic: a crash mid-write must never leave a torn resume pointer — the
+        # warmstart side trusts this file blindly before any manifest check
+        retry_io(lambda: atomic_write_json(info_path, info), what="info_write")
         logger.info("Checkpoint info saved to %s.", info_path)
 
     def _flush_pending_info(self) -> None:
         if self._pending_info_folder is not None:
-            self._write_info(self._pending_info_folder)
+            self._seal_committed(self._pending_info_folder)
             self._pending_info_folder = None
 
     def _delete_checkpoint(self, training_progress: TrainingProgress) -> None:
@@ -126,9 +141,13 @@ class OrbaxCheckpointSaving(CheckpointSavingExecutionABC):
         if _process_index() != 0:
             return
         if not folder.exists():
-            raise CheckpointingError(
-                f"Checkpoint folder {folder} could not be removed. It does not exist!"
+            # an already-gone ring folder (cleaned up externally, or a previous
+            # incarnation's delete that committed before a crash) is not worth
+            # killing a healthy run over
+            logger.warning(
+                "Checkpoint folder %s already gone — skipping ring deletion.", folder
             )
+            return
         shutil.rmtree(folder)
 
     def wait_until_finished(self) -> None:

@@ -441,6 +441,32 @@ class TelemetryConfig(BaseModel):
     use_jax_annotations: bool = True
 
 
+class ResilienceConfig(BaseModel):
+    """Resilience subsystem (resilience.default): anomaly policy, preemption-aware
+    shutdown, and supervisor knobs (see modalities_tpu/resilience/).
+
+    anomaly_policy: "raise" (default, bit-identical to the raise-only guard),
+    "skip_step" (jnp.where no-ops anomalous optimizer updates, bounded by
+    skip_budget per trailing anomaly_window_steps), or "rollback" (budget
+    exhaustion exits resumable for a supervisor warmstart from the newest
+    verified checkpoint).
+    loss_spike_zscore: arm the running z-score loss-spike detector (None: off);
+    spikes feed the same policy/budget.
+    install_signal_handlers: SIGTERM/SIGINT -> graceful out-of-schedule
+    checkpoint + resumable exit.
+    max_restarts/backoff_base_s: crash-loop cap and backoff for `run --resilient`.
+    """
+
+    anomaly_policy: Literal["raise", "skip_step", "rollback"] = "raise"
+    skip_budget: Annotated[int, Field(strict=True, ge=0)] = 2
+    anomaly_window_steps: Annotated[int, Field(strict=True, gt=0)] = 100
+    loss_spike_zscore: Optional[Annotated[float, Field(gt=0)]] = None
+    loss_spike_min_history: Annotated[int, Field(strict=True, gt=0)] = 8
+    install_signal_handlers: bool = True
+    max_restarts: Annotated[int, Field(strict=True, ge=0)] = 3
+    backoff_base_s: Annotated[float, Field(ge=0)] = 1.0
+
+
 # ---------------------------------------------------------------------- tokenizers
 
 

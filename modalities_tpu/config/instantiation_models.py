@@ -29,6 +29,7 @@ from modalities_tpu.config.pydantic_if_types import (
     PydanticMFUCalculatorIFType,
     PydanticPipelineIFType,
     PydanticProfilerIFType,
+    PydanticResilienceIFType,
     PydanticTelemetryIFType,
     PydanticTokenizerIFType,
 )
@@ -198,6 +199,7 @@ class TrainingComponentsInstantiationModel(BaseModel):
     device_mesh: Optional[PydanticDeviceMeshIFType] = None
     device_feeder: Optional[PydanticDeviceFeederIFType] = None
     telemetry: Optional[PydanticTelemetryIFType] = None
+    resilience: Optional[PydanticResilienceIFType] = None
     model_raw: Optional[Any] = None
 
     @model_validator(mode="after")

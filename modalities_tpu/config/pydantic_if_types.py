@@ -66,6 +66,7 @@ PydanticCollateFnIFType = _lazy("modalities_tpu.dataloader.collate_fns.collate_i
 PydanticLLMDataLoaderIFType = _lazy("modalities_tpu.dataloader.dataloader", "LLMDataLoader")
 PydanticDeviceFeederIFType = _lazy("modalities_tpu.dataloader.device_feeder", "DeviceFeeder")
 PydanticTelemetryIFType = _lazy("modalities_tpu.telemetry", "Telemetry")
+PydanticResilienceIFType = _lazy("modalities_tpu.resilience", "Resilience")
 PydanticTokenizerIFType = _lazy("modalities_tpu.tokenization.tokenizer_wrapper", "TokenizerWrapper")
 PydanticAppStateType = _lazy("modalities_tpu.checkpointing.stateful.app_state_factory", "AppStateSpec")
 PydanticCheckpointSavingIFType = _lazy("modalities_tpu.checkpointing.checkpoint_saving", "CheckpointSaving")

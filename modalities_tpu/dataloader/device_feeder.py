@@ -174,13 +174,21 @@ class DeviceFeeder:
         counters = {"dropped_microbatches": 0}
 
         def host_batches():
+            from modalities_tpu.resilience.faults import wedge_if_armed
+
             micro_samples: list[dict] = []
             micro_targets: list[dict] = []
+            step_index = 0
             for batch in train_loader:
                 micro_samples.append(batch.samples)
                 micro_targets.append(batch.targets)
                 if len(micro_samples) < gradient_acc_steps:
                     continue
+                # chaos hook (feeder_wedge[@step][:seconds]): stalls the producer
+                # thread here — the consumer's stall accounting and the watchdog
+                # see exactly what a wedged input pipeline looks like
+                wedge_if_armed(step_index)
+                step_index += 1
                 yield {
                     "samples": {
                         k: np.stack([m[k] for m in micro_samples]) for k in micro_samples[0]

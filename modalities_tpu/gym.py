@@ -45,16 +45,31 @@ class Gym:
                     num_train_steps_done=num_train_steps_done,
                 )
 
-        def checkpointing_callback(progress: TrainingProgress) -> None:
-            if (
-                checkpoint_saving is not None
-                and checkpointing_interval_in_steps > 0
+        last_saved_step = -1
+
+        def checkpointing_callback(progress: TrainingProgress, force: bool = False) -> None:
+            nonlocal last_saved_step
+            if checkpoint_saving is None:
+                return
+            scheduled = (
+                checkpointing_interval_in_steps > 0
                 and progress.num_seen_steps_total % checkpointing_interval_in_steps == 0
-            ):
-                checkpoint_saving.save_checkpoint(
-                    training_progress=progress,
-                    app_state_handle=step_functions.app_state_handle,
-                )
+            )
+            if not (scheduled or force):
+                return
+            # a preemption landing ON an interval boundary would otherwise save the
+            # same step twice (scheduled save, then the forced out-of-schedule one)
+            if progress.num_seen_steps_total == last_saved_step:
+                return
+            last_saved_step = progress.num_seen_steps_total
+            # `force` forwarded only when set: scheduled saves keep the legacy
+            # call shape, so duck-typed savers without the kwarg keep working
+            forced_kwargs = {"force": True} if force else {}
+            checkpoint_saving.save_checkpoint(
+                training_progress=progress,
+                app_state_handle=step_functions.app_state_handle,
+                **forced_kwargs,
+            )
 
         training_succeeded = False
         try:

@@ -73,6 +73,11 @@ class Main:
         # experiment folder so every run leaves its goodput record next to its
         # results. Activated process-globally so deep call sites (checkpointing,
         # evaluator) reach it via the free `span()` — restored in `finally`.
+        # chaos faults arm once per process from $MODALITIES_TPU_FAULTS so
+        # subprocess chaos tests (and real drills) need no config change
+        from modalities_tpu.resilience.faults import load_faults_from_env
+
+        load_faults_from_env()
         telemetry = getattr(components, "telemetry", None) or Telemetry()
         # the sink lands next to evaluation_results.jsonl: prefer the explicit
         # constructor root, else the config's settings.paths.experiments_root_path
@@ -116,6 +121,7 @@ class Main:
         app_state_spec = components.app_state
         clipper = components.gradient_clipper
         step_profile = settings.step_profile
+        resilience = getattr(components, "resilience", None)
 
         # debugging_enriched model variant -> per-rank stats logger + grads exposure
         debug_cfg = getattr(app_state_spec.model, "debugging_config", None)
@@ -149,6 +155,7 @@ class Main:
                 grad_clip_norm=getattr(clipper, "max_norm", None),
                 grad_clipper=clipper if hasattr(clipper, "build_transform") else None,
                 expose_grads=debug_stats_logger is not None,
+                anomaly_policy=resilience.anomaly_policy if resilience is not None else None,
             )
             step_functions = builder.build()
 
@@ -204,6 +211,8 @@ class Main:
             debug_stats_logger=debug_stats_logger,
             device_feeder=components.device_feeder,
             telemetry=telemetry,
+            anomaly_tracker=resilience.anomaly if resilience is not None else None,
+            preemption=resilience.preemption if resilience is not None else None,
         )
         evaluator = Evaluator(
             progress_publisher=progress_publisher,
@@ -211,6 +220,11 @@ class Main:
             device_feeder=components.device_feeder,
         )
         gym = Gym(trainer=trainer, evaluator=evaluator, loss_fun=components.loss_fn)
+        if resilience is not None and resilience.preemption is not None:
+            # installed for the training window only; `finally` restores the
+            # previous handlers so in-process back-to-back runs (tests) and the
+            # surrounding CLI keep their own SIGTERM/SIGINT semantics
+            resilience.preemption.install()
         try:
             gym.run(
                 step_functions=step_functions,
@@ -222,6 +236,8 @@ class Main:
                 checkpointing_interval_in_steps=settings.intervals.checkpointing_interval_in_steps,
             )
         finally:
+            if resilience is not None and resilience.preemption is not None:
+                resilience.preemption.uninstall()
             # the rich live display is process-global; leaving it running after a
             # crashed (or finished) run blocks every later live display in-process
             stop = getattr(components.progress_subscriber, "stop", None)

@@ -22,6 +22,7 @@ from modalities_tpu.dataloader.collate_fns.collator_fn_wrapper_for_loss_masking 
 from modalities_tpu.dataloader.dataloader_factory import DataloaderFactory
 from modalities_tpu.dataloader.device_feeder import DeviceFeeder
 from modalities_tpu.telemetry import Telemetry
+from modalities_tpu.resilience import Resilience
 from modalities_tpu.dataloader.dataset import DummyDataset, DummyDatasetConfig
 from modalities_tpu.dataloader.dataset_factory import DatasetFactory
 from modalities_tpu.dataloader.sampler_factory import BatchSamplerFactory, SamplerFactory
@@ -307,6 +308,8 @@ COMPONENTS: list[ComponentEntity] = [
     ComponentEntity("device_feeder", "default", DeviceFeeder, cfg.DeviceFeederConfig),
     # telemetry (spans + goodput + watchdog + sink; on by default via Main)
     ComponentEntity("telemetry", "default", Telemetry, cfg.TelemetryConfig),
+    # resilience (anomaly policy + preemption shutdown + supervisor knobs)
+    ComponentEntity("resilience", "default", Resilience, cfg.ResilienceConfig),
     # checkpointing
     ComponentEntity(
         "checkpoint_saving_strategy",

@@ -1,0 +1,84 @@
+"""Resilience subsystem: preemption-aware shutdown, anomaly policy, checkpoint
+integrity, and a fault-injection harness (the robustness counterpart of the
+telemetry subsystem's observability layer).
+
+Four pillars, each testable on CPU via the fault harness (`faults.py`):
+
+- **Preemption** (`preemption.py`): SIGTERM/SIGINT set a flag; the Trainer lets
+  the in-flight step finish, forces an out-of-schedule checkpoint, drains async
+  commits (Gym's existing finally) and raises `PreemptionShutdown`, which the CLI
+  maps to the distinguished `RESUMABLE_EXIT_CODE` so a supervisor knows the run
+  can be warmstarted.
+- **Anomaly policy** (`anomaly.py`): the raise-only non-finite guard becomes a
+  configurable policy — `raise` (default, bit-identical to the legacy path),
+  `skip_step` (the jitted step no-ops the optimizer update via `jnp.where`, with
+  a bounded skip budget per window), `rollback` (budget exhaustion exits
+  resumable so the supervisor restarts from the newest *verified* checkpoint;
+  the existing `skip_num_global_samples` warmstart machinery fast-skips the
+  sampler past the poisoned region).
+- **Checkpoint integrity** (`manifest.py`, `retry.py`): every save commits a
+  `manifest.json` (sizes + digests); load verifies it; `resolve_resume_folder`
+  walks back to the newest verifiable folder in the ring when the pointer's
+  target is corrupt. All checkpoint IO runs through `retry_io` (exponential
+  backoff + jitter, each retry a `ckpt_retry/*` telemetry span).
+- **Fault injection** (`faults.py`): named fault points armed via env/config,
+  exercised by the CPU chaos tests under tests/resilience/.
+
+`Resilience` is the registry component ("resilience", "default") wired through
+Main into the Trainer and TrainStepBuilder.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from modalities_tpu.resilience.anomaly import AnomalyTracker
+from modalities_tpu.resilience.errors import (
+    RESUMABLE_EXIT_CODE,
+    AnomalyRollback,
+    PreemptionShutdown,
+    ResumableError,
+)
+from modalities_tpu.resilience.preemption import PreemptionHandler
+
+
+class Resilience:
+    """Registry component ("resilience", "default"): holds the anomaly tracker,
+    the preemption handler, and the supervisor knobs. `anomaly_policy="raise"`
+    with spike detection off is bit-identical to running without the component.
+    """
+
+    def __init__(
+        self,
+        anomaly_policy: str = "raise",
+        skip_budget: int = 2,
+        anomaly_window_steps: int = 100,
+        loss_spike_zscore: Optional[float] = None,
+        loss_spike_min_history: int = 8,
+        install_signal_handlers: bool = True,
+        max_restarts: int = 3,
+        backoff_base_s: float = 1.0,
+    ):
+        self.anomaly_policy = anomaly_policy
+        self.install_signal_handlers = install_signal_handlers
+        self.max_restarts = max_restarts
+        self.backoff_base_s = backoff_base_s
+        self.anomaly = AnomalyTracker(
+            policy=anomaly_policy,
+            skip_budget=skip_budget,
+            window_steps=anomaly_window_steps,
+            loss_spike_zscore=loss_spike_zscore,
+            loss_spike_min_history=loss_spike_min_history,
+        )
+        self.preemption = PreemptionHandler() if install_signal_handlers else None
+
+
+__all__ = [
+    "RESUMABLE_EXIT_CODE",
+    "AnomalyRollback",
+    "AnomalyTracker",
+    "PreemptionHandler",
+    "PreemptionShutdown",
+    "Resilience",
+    "ResumableError",
+]

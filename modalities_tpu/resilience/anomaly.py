@@ -1,0 +1,172 @@
+"""Anomaly policy: configurable response to non-finite gradients and loss spikes.
+
+Replaces the Trainer's raise-only non-finite guard with three policies:
+
+- ``raise`` (default): identical to the legacy behavior — the first non-finite
+  interval kills the run with the same error message.
+- ``skip_step``: the jitted train step already no-ops the optimizer update via
+  `jnp.where` on an all-finite flag (training/train_step.py), so the program
+  stays branch-free; this tracker host-syncs the per-interval ``skipped_step``
+  flags, enforces a bounded skip budget per trailing window, and escalates when
+  the budget is exhausted.
+- ``rollback``: like ``skip_step``, but budget exhaustion raises
+  `AnomalyRollback` — a resumable exit, so the supervisor warmstarts from the
+  newest *verified* checkpoint and the existing ``skip_num_global_samples``
+  machinery fast-skips the sampler past the poisoned batches on replay (with
+  the skip policy still armed, so a deterministic poison batch cannot re-kill
+  the run).
+
+Loss-spike detection (running z-score over recent finite losses) feeds the same
+policy: a spike counts against the same budget, and under ``raise`` it raises.
+It is off unless `loss_spike_zscore` is set, keeping the default bit-identical.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Optional
+
+import numpy as np
+
+from modalities_tpu.resilience.errors import AnomalyRollback
+from modalities_tpu.resilience.events import record_event
+from modalities_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+POLICIES = ("raise", "skip_step", "rollback")
+
+
+class AnomalyTracker:
+    def __init__(
+        self,
+        policy: str = "raise",
+        skip_budget: int = 2,
+        window_steps: int = 100,
+        loss_spike_zscore: Optional[float] = None,
+        loss_spike_min_history: int = 8,
+        loss_history_size: int = 64,
+    ):
+        if policy not in POLICIES:
+            raise ValueError(f"anomaly policy must be one of {POLICIES}, got {policy!r}")
+        self.policy = policy
+        self.skip_budget = skip_budget
+        self.window_steps = window_steps
+        self.loss_spike_zscore = loss_spike_zscore
+        self.loss_spike_min_history = loss_spike_min_history
+        self._anomalous_steps: deque[int] = deque()
+        self._loss_history: deque[float] = deque(maxlen=loss_history_size)
+
+    # ----------------------------------------------------------------- queries
+
+    @property
+    def watches_loss(self) -> bool:
+        return self.loss_spike_zscore is not None
+
+    def should_observe(self, metric_keys) -> bool:
+        """Whether `observe_interval` has anything to do for these metrics —
+        gates the per-interval host sync so an unarmed tracker costs nothing."""
+        return (
+            self.watches_loss
+            or "nonfinite_grads" in metric_keys
+            or "skipped_step" in metric_keys
+        )
+
+    def anomalies_in_window(self, step_id: int) -> int:
+        while self._anomalous_steps and self._anomalous_steps[0] <= step_id - self.window_steps:
+            self._anomalous_steps.popleft()
+        return len(self._anomalous_steps)
+
+    # ----------------------------------------------------------------- observe
+
+    def observe_interval(self, pending_metrics: list[dict], step_id: int) -> None:
+        """Host-sync the interval's anomaly flags and apply the policy. Called at
+        the interval boundary BEFORE the checkpoint callback, so an anomalous
+        interval can never be committed as the latest resume target under the
+        raise policy. Raises per policy; returns normally otherwise."""
+        first_step = step_id - len(pending_metrics) + 1
+
+        anomalous_steps: list[tuple[int, str]] = []
+
+        flag_key = "skipped_step" if "skipped_step" in pending_metrics[0] else (
+            "nonfinite_grads" if "nonfinite_grads" in pending_metrics[0] else None
+        )
+        if flag_key is not None:
+            flags = np.asarray([int(m[flag_key]) for m in pending_metrics])
+            for offset in np.flatnonzero(flags):
+                anomalous_steps.append((first_step + int(offset), "nonfinite"))
+
+        if self.watches_loss:
+            losses = np.asarray([float(m["loss"]) for m in pending_metrics], dtype=np.float64)
+            for offset, loss in enumerate(losses):
+                step = first_step + offset
+                if not np.isfinite(loss):
+                    # a non-finite loss on a step not already flagged (no grad
+                    # guard armed) is itself an anomaly
+                    if not any(s == step for s, _ in anomalous_steps):
+                        anomalous_steps.append((step, "nonfinite"))
+                    continue
+                history = np.asarray(self._loss_history)
+                if history.size >= self.loss_spike_min_history:
+                    std = history.std()
+                    zscore = abs(loss - history.mean()) / max(std, 1e-12)
+                    if zscore > self.loss_spike_zscore:
+                        anomalous_steps.append((step, f"loss_spike(z={zscore:.1f})"))
+                        # a spike is excluded from the history so a genuine
+                        # level shift still needs `min_history` steps to be
+                        # accepted as the new normal
+                        continue
+                self._loss_history.append(loss)
+
+        if not anomalous_steps:
+            return
+
+        anomalous_steps.sort()
+        first_bad_step, first_kind = anomalous_steps[0]
+
+        if self.policy == "raise":
+            if first_kind == "nonfinite":
+                # legacy message, bit-identical to the pre-policy guard
+                raise RuntimeError(
+                    f"non-finite gradient norm at train step {first_bad_step} "
+                    "(gradient_clipper.error_if_nonfinite=True)"
+                )
+            raise RuntimeError(
+                f"loss anomaly at train step {first_bad_step}: {first_kind} "
+                "(resilience.anomaly_policy=raise)"
+            )
+
+        for step, kind in anomalous_steps:
+            self._anomalous_steps.append(step)
+            record_event(
+                "anomaly/skipped" if kind == "nonfinite" else "anomaly/loss_spike",
+                step=step,
+                kind=kind,
+                policy=self.policy,
+                in_window=self.anomalies_in_window(step_id),
+                budget=self.skip_budget,
+            )
+            logger.warning(
+                "anomaly at step %d (%s): optimizer update skipped "
+                "[%d/%d budget used in trailing %d steps]",
+                step, kind, self.anomalies_in_window(step_id), self.skip_budget,
+                self.window_steps,
+            )
+
+        used = self.anomalies_in_window(step_id)
+        if used > self.skip_budget:
+            record_event(
+                "anomaly/budget_exhausted",
+                step=step_id, used=used, budget=self.skip_budget, policy=self.policy,
+            )
+            detail = (
+                f"anomaly skip budget exhausted: {used} anomalous steps in the "
+                f"trailing {self.window_steps} steps (budget {self.skip_budget}), "
+                f"first at step {first_bad_step}"
+            )
+            if self.policy == "rollback":
+                raise AnomalyRollback(
+                    detail + " — exiting resumable for a rollback warmstart from "
+                    "the newest verified checkpoint"
+                )
+            raise RuntimeError(detail + " (resilience.anomaly_policy=skip_step)")

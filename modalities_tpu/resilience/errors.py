@@ -1,0 +1,21 @@
+"""Resumable-failure contract shared by trainer, anomaly policy, CLI, supervisor."""
+
+from __future__ import annotations
+
+# Exit code signalling "this run died in a resumable way" (preemption, rollback):
+# a supervisor seeing it should warmstart from the newest verified checkpoint.
+# 75 is EX_TEMPFAIL in sysexits.h — "temporary failure, retry later".
+RESUMABLE_EXIT_CODE = 75
+
+
+class ResumableError(Exception):
+    """Base for failures that a supervisor should treat as resume-and-retry."""
+
+
+class PreemptionShutdown(ResumableError):
+    """Raised after the forced preemption checkpoint committed; exit resumable."""
+
+
+class AnomalyRollback(ResumableError):
+    """Anomaly skip budget exhausted under the rollback policy; exit resumable so
+    the supervisor warmstarts from the newest verified checkpoint."""

@@ -1,0 +1,159 @@
+"""Fault-injection harness: named fault points armed via env or API.
+
+The chaos tests (tests/resilience/) arm these to prove end-to-end recovery on
+CPU — the only way pillars 1–3 are testable in tier-1 rather than only on real
+preemptible pods. Spec grammar (env ``MODALITIES_TPU_FAULTS`` or `arm_faults`):
+
+    name[@step][:arg][,name[@step][:arg]...]
+
+- ``checkpoint_io_error[:count]`` — the next `count` (default 1) checkpoint IO
+  attempts raise OSError inside the retry helper.
+- ``nan_grads@step`` — the jitted train step poisons the gradients with NaN at
+  optimizer step `step` (baked via `jnp.where` at trace time; 0-based
+  `state.step` at dispatch).
+- ``loss_spike@step[:magnitude]`` — the reported loss metric jumps by
+  `magnitude` (default 1e3) at `step`; gradients are untouched, so only the
+  metric-driven spike detector sees it.
+- ``feeder_wedge@index[:seconds]`` — the device feeder's producer sleeps
+  `seconds` (default 5) before yielding batch `index` (watchdog/data-stall
+  chaos).
+- ``sigterm_at_step@step`` — the Trainer sends SIGTERM to its own process after
+  completing `step` (preemption chaos without an external killer).
+
+Unknown names are rejected at parse time; the static closure test
+(tests/resilience/test_fault_point_closure.py) keeps FAULT_POINTS and the chaos
+tests from drifting apart.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import time
+from dataclasses import dataclass
+from typing import Optional
+
+from modalities_tpu.resilience.events import record_event
+from modalities_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+ENV_VAR = "MODALITIES_TPU_FAULTS"
+
+FAULT_POINTS = (
+    "checkpoint_io_error",
+    "nan_grads",
+    "loss_spike",
+    "feeder_wedge",
+    "sigterm_at_step",
+)
+
+
+@dataclass
+class FaultSpec:
+    name: str
+    step: Optional[int] = None  # step/index the fault targets (None: untargeted)
+    arg: Optional[float] = None  # count / magnitude / seconds, per fault point
+    remaining: int = 1  # shots left (one-shot by default)
+
+
+_armed: dict[str, FaultSpec] = {}
+_env_loaded = False
+
+
+def parse_faults(spec: str) -> dict[str, FaultSpec]:
+    """Parse the comma-separated spec grammar; unknown names fail loudly."""
+    parsed: dict[str, FaultSpec] = {}
+    for entry in spec.split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        name, _, arg_part = entry.partition(":")
+        name, _, step_part = name.partition("@")
+        if name not in FAULT_POINTS:
+            raise ValueError(
+                f"unknown fault point {name!r}; registered fault points: {FAULT_POINTS}"
+            )
+        step = int(step_part) if step_part else None
+        arg = float(arg_part) if arg_part else None
+        remaining = 1
+        if name == "checkpoint_io_error":
+            remaining = int(arg) if arg is not None else 1
+        parsed[name] = FaultSpec(name=name, step=step, arg=arg, remaining=remaining)
+    return parsed
+
+
+def arm_faults(spec: str) -> None:
+    """Arm from a spec string (additive over already-armed points)."""
+    parsed = parse_faults(spec)
+    for name, fault in parsed.items():
+        logger.warning("FAULT ARMED: %s (step=%s arg=%s)", name, fault.step, fault.arg)
+        _armed[name] = fault
+
+
+def load_faults_from_env() -> None:
+    """Arm from $MODALITIES_TPU_FAULTS once per process (Main.run calls this, so
+    subprocess chaos tests arm via the environment)."""
+    global _env_loaded
+    if _env_loaded:
+        return
+    _env_loaded = True
+    spec = os.environ.get(ENV_VAR)
+    if spec:
+        arm_faults(spec)
+
+
+def clear_faults() -> None:
+    """Disarm everything (test isolation; does not block later env re-loads)."""
+    global _env_loaded
+    _armed.clear()
+    _env_loaded = False
+
+
+def get_fault(name: str) -> Optional[FaultSpec]:
+    """Build-time query (used by TrainStepBuilder to bake nan_grads/loss_spike
+    into the jitted program). Does not consume a shot."""
+    if name not in FAULT_POINTS:
+        raise ValueError(f"unknown fault point {name!r}")
+    return _armed.get(name)
+
+
+def _consume(name: str, step: Optional[int] = None) -> Optional[FaultSpec]:
+    fault = _armed.get(name)
+    if fault is None or fault.remaining <= 0:
+        return None
+    if fault.step is not None and step != fault.step:
+        return None
+    fault.remaining -= 1
+    return fault
+
+
+def fire_io_error_if_armed(name: str = "checkpoint_io_error") -> None:
+    """Raise an injected OSError when armed — placed inside retried IO blocks so
+    the retry helper both sees the failure and eventually succeeds."""
+    fault = _consume(name)
+    if fault is not None:
+        record_event(f"fault/{name}", remaining=fault.remaining)
+        raise OSError(f"injected fault: {name} ({fault.remaining} shots left)")
+
+
+def fire_sigterm_if_armed(step: int) -> bool:
+    """SIGTERM this process when `sigterm_at_step` is armed for `step`."""
+    fault = _consume("sigterm_at_step", step=step)
+    if fault is None:
+        return False
+    record_event("fault/sigterm_at_step", step=step)
+    logger.warning("FAULT FIRING: sigterm_at_step at step %d", step)
+    os.kill(os.getpid(), signal.SIGTERM)
+    return True
+
+
+def wedge_if_armed(index: int) -> None:
+    """Sleep inside the feeder's producer when `feeder_wedge` is armed for batch
+    `index` — simulates a wedged input pipeline for watchdog/stall chaos."""
+    fault = _consume("feeder_wedge", step=index)
+    if fault is not None:
+        seconds = fault.arg if fault.arg is not None else 5.0
+        record_event("fault/feeder_wedge", index=index, seconds=seconds)
+        logger.warning("FAULT FIRING: feeder_wedge for %.1fs at batch %d", seconds, index)
+        time.sleep(seconds)

@@ -1,0 +1,126 @@
+"""Supervisor: restart-on-resumable-exit loop with crash-loop detection.
+
+`modalities-tpu run --resilient` runs the training as a child process. A child
+exiting with `RESUMABLE_EXIT_CODE` (preemption, anomaly rollback) is restarted
+as a *warmstart* from the resume pointer — with `resolve_resume_folder` picking
+the newest VERIFIED checkpoint, so a corrupt newest folder rolls back to its
+predecessor instead of crash-looping. Restarts are bounded (`max_restarts`) and
+exponentially backed off, so a deterministic crash cannot spin the pod.
+
+The child-process design (rather than an in-process loop) is deliberate: a
+warmstart derives progress/sampler state from the checkpoint folder name at
+CONFIG BUILD time, and a fresh process guarantees no poisoned device state,
+wedged threads, or stale jit caches survive into the resumed incarnation.
+
+`runner` is injectable for unit tests (fake exit-code sequences, no processes).
+"""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+import time
+from pathlib import Path
+from typing import Callable, Optional
+
+from modalities_tpu.resilience.errors import RESUMABLE_EXIT_CODE
+from modalities_tpu.resilience.manifest import resolve_resume_folder
+from modalities_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+def _default_runner(cmd: list[str]) -> int:
+    return subprocess.call(cmd)
+
+
+def build_child_command(
+    config_file_path: Path,
+    last_checkpoint_info_file_path: Path,
+    experiments_root_path: Optional[Path] = None,
+    resume: bool = False,
+    warmstart_config_file_path: Optional[Path] = None,
+) -> list[str]:
+    """The `run` (cold) or `warmstart` (resume) child invocation — never
+    `--resilient`, so the child cannot recurse into a supervisor.
+
+    Resumes use `warmstart_config_file_path` when given: a cold-start config
+    pins `training_progress` at zero, while a warmstart config derives it from
+    the checkpoint folder name — most runs need a distinct YAML for each."""
+    cmd = [sys.executable, "-m", "modalities_tpu"]
+    if resume:
+        cmd += [
+            "warmstart",
+            "--config_file_path", str(warmstart_config_file_path or config_file_path),
+            "--last_checkpoint_info_file_path", str(last_checkpoint_info_file_path),
+        ]
+    else:
+        cmd += ["run", "--config_file_path", str(config_file_path)]
+    if experiments_root_path is not None:
+        cmd += ["--experiments_root_path", str(experiments_root_path)]
+    return cmd
+
+
+def run_resilient(
+    config_file_path: Path,
+    last_checkpoint_info_file_path: Path,
+    experiments_root_path: Optional[Path] = None,
+    warmstart_config_file_path: Optional[Path] = None,
+    max_restarts: int = 3,
+    backoff_base_s: float = 1.0,
+    restart_on_crash: bool = False,
+    runner: Callable[[list[str]], int] = _default_runner,
+    sleep_fn: Callable[[float], None] = time.sleep,
+) -> int:
+    """Supervise the run; returns the final exit code (0 on success).
+
+    `last_checkpoint_info_file_path` is where the resume pointer WILL appear
+    (it need not exist yet — a cold start that never checkpoints never resumes).
+    `restart_on_crash=True` also restarts non-resumable failures, still bounded
+    by `max_restarts`."""
+    config_file_path = Path(config_file_path)
+    info_path = Path(last_checkpoint_info_file_path)
+    restarts = 0
+    while True:
+        resume = info_path.is_file()
+        if resume:
+            # fail fast (and loudly) here if every checkpoint is unverifiable,
+            # rather than letting the child crash-loop through the budget
+            try:
+                folder = resolve_resume_folder(info_path)
+                logger.info("supervisor: resuming from verified checkpoint %s", folder)
+            except (FileNotFoundError, ValueError) as e:
+                logger.error("supervisor: no verifiable checkpoint to resume from: %s", e)
+                return 1
+        cmd = build_child_command(
+            config_file_path,
+            info_path,
+            experiments_root_path,
+            resume=resume,
+            warmstart_config_file_path=warmstart_config_file_path,
+        )
+        logger.info(
+            "supervisor: starting %s attempt (restart %d/%d)",
+            "warmstart" if resume else "cold", restarts, max_restarts,
+        )
+        code = runner(cmd)
+        if code == 0:
+            logger.info("supervisor: run completed successfully")
+            return 0
+        resumable = code == RESUMABLE_EXIT_CODE
+        if not (resumable or restart_on_crash):
+            logger.error("supervisor: child failed non-resumably (exit %d) — giving up", code)
+            return code
+        restarts += 1
+        if restarts > max_restarts:
+            logger.error(
+                "supervisor: crash loop — %d restarts exhausted (last exit %d)",
+                max_restarts, code,
+            )
+            return code
+        delay = backoff_base_s * (2 ** (restarts - 1))
+        logger.warning(
+            "supervisor: child exited %s (code %d) — restart %d/%d in %.1fs",
+            "resumable" if resumable else "crashed", code, restarts, max_restarts, delay,
+        )
+        sleep_fn(delay)

@@ -116,6 +116,13 @@ class Telemetry:
     def sink_path(self) -> Optional[Path]:
         return self._sink.path if self._sink is not None else None
 
+    def emit_event(self, name: str, payload: Optional[dict] = None) -> None:
+        """Emit a named point event (anomaly/*, preempt/*, ckpt_retry/*, ...) to
+        the JSONL sink. No-op when disabled or before the sink is open."""
+        if not self.enabled or self._sink is None:
+            return
+        self._sink.emit({"event": "resilience", "name": name, **(payload or {})})
+
     # --------------------------------------------------------------- watchdog
 
     def _ensure_watchdog(self) -> Optional[Watchdog]:

@@ -10,6 +10,8 @@ Buckets (cf. the MPMD-pipeline paper's bubble/stall attribution in PAPERS.md):
 - ``eval``               evaluation passes
 - ``checkpoint``         checkpoint save + end-of-run drain
 - ``publish``            assembling/publishing interval results to the broker
+- ``recovery``           resilience work: checkpoint-IO retries, forced
+                         preemption checkpoints, rollback/fallback resolution
 - ``other``              explicit unknown spans + all wall time not covered by
                          any timeline span (loop scaffolding, callbacks, ...)
 
@@ -41,6 +43,7 @@ BUCKETS = (
     "eval",
     "checkpoint",
     "publish",
+    "recovery",
     "other",
 )
 
@@ -59,6 +62,11 @@ _NAME_TO_BUCKET = {
     "checkpoint_save": "checkpoint",
     "checkpoint_drain": "checkpoint",
     "publish": "publish",
+    "preempt": "recovery",
+    "ckpt_retry": "recovery",
+    "anomaly": "recovery",
+    "rollback": "recovery",
+    "recovery": "recovery",
 }
 
 

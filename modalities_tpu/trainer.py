@@ -43,6 +43,9 @@ from modalities_tpu.batch import EvaluationResultBatch, ResultItem
 from modalities_tpu.dataloader.device_feeder import DeviceBatchIterator, DeviceFeeder
 from modalities_tpu.logging_broker.messages import ExperimentStatus, MessageTypes, ProgressUpdate
 from modalities_tpu.logging_broker.publisher import MessagePublisher
+from modalities_tpu.resilience.errors import PreemptionShutdown
+from modalities_tpu.resilience.events import record_event
+from modalities_tpu.resilience.faults import fire_sigterm_if_armed
 from modalities_tpu.telemetry import Telemetry, get_active_telemetry
 from modalities_tpu.training.train_step import StepFunctions
 from modalities_tpu.training.training_progress import TrainingProgress
@@ -67,6 +70,8 @@ class Trainer:
         debug_stats_logger=None,
         device_feeder: Optional[DeviceFeeder] = None,
         telemetry: Optional[Telemetry] = None,
+        anomaly_tracker=None,
+        preemption=None,
     ) -> None:
         self.progress_publisher = progress_publisher
         self.evaluation_result_publisher = evaluation_result_publisher
@@ -85,6 +90,11 @@ class Trainer:
         # None -> resolve the process-global telemetry at train() time (no-op unless
         # Main activated one), so direct Trainer construction needs no plumbing
         self.telemetry = telemetry
+        # resilience (both optional): the anomaly tracker replaces the raise-only
+        # non-finite guard at interval boundaries; the preemption handler turns
+        # SIGTERM into a forced checkpoint + PreemptionShutdown
+        self.anomaly_tracker = anomaly_tracker
+        self.preemption = preemption
         self._boundary_stall_s = 0.0
 
     def _telemetry(self) -> Telemetry:
@@ -178,8 +188,14 @@ class Trainer:
                     # EAGERLY — before the boundary callbacks below can save a
                     # NaN-poisoned checkpoint as the latest resume target. The
                     # host sync this costs is exactly what error_if_nonfinite
-                    # opts into: per-interval safety over overlap.
-                    if "nonfinite_grads" in pending_metrics[0]:
+                    # opts into: per-interval safety over overlap. An anomaly
+                    # tracker (resilience component) replaces the raise-only
+                    # guard with the configured policy at the same point.
+                    if self.anomaly_tracker is not None and self.anomaly_tracker.should_observe(
+                        pending_metrics[0]
+                    ):
+                        self.anomaly_tracker.observe_interval(pending_metrics, step_id)
+                    elif "nonfinite_grads" in pending_metrics[0]:
                         self._raise_on_nonfinite(pending_metrics, step_id)
                     # snapshot the token count AT the boundary: by publish time the
                     # in-flight step has already been counted into training_progress
@@ -210,6 +226,35 @@ class Trainer:
                 # step completed end-to-end (callbacks included): re-arm the hang
                 # deadline for the next one
                 telemetry.beat_watchdog(step_id)
+
+                if self.preemption is not None:
+                    if fire_sigterm_if_armed(step_id):  # chaos tests: sigterm_at_step@N
+                        # the real SIGTERM is in flight, but Python runs signal
+                        # handlers at a later bytecode boundary — request the stop
+                        # directly so the chaos test is deterministic about WHICH
+                        # step the shutdown lands on
+                        self.preemption.request_stop()
+                    if self.preemption.should_stop() and step_id < target_steps:
+                        # the in-flight step has completed (we are past the
+                        # callbacks); force an out-of-schedule checkpoint at this
+                        # exact step so the supervisor can warmstart from it, then
+                        # exit resumable. Async commits drain in Gym's finally.
+                        signal_name = self.preemption.received_signal or "request_stop"
+                        record_event(
+                            "preempt/shutdown_requested", step=step_id, signal=signal_name
+                        )
+                        logger.warning(
+                            "preemption signal (%s) received — saving out-of-schedule "
+                            "checkpoint at step %d and exiting resumable",
+                            signal_name, step_id,
+                        )
+                        with telemetry.span("preempt/forced_checkpoint"):
+                            checkpointing_callback(training_progress, force=True)
+                        record_event("preempt/checkpoint_saved", step=step_id)
+                        raise PreemptionShutdown(
+                            f"preempted by {signal_name} at step {step_id}; "
+                            "checkpoint saved — warmstart to resume"
+                        )
 
                 if step_id >= target_steps:
                     break
@@ -286,7 +331,10 @@ class Trainer:
         # The fetch blocks until the interval's device work finished, so its span
         # counts toward the train_step goodput bucket, not overhead.
         with telemetry.span("metrics_fetch"):
-            if "nonfinite_grads" in pending_metrics[0]:
+            # when an anomaly tracker owns the policy, the interval boundary
+            # already observed these metrics — re-raising here would bypass the
+            # configured skip/rollback policy
+            if self.anomaly_tracker is None and "nonfinite_grads" in pending_metrics[0]:
                 self._raise_on_nonfinite(pending_metrics, step_id)
             losses = np.asarray([m["loss"] for m in pending_metrics], dtype=np.float64)
             grad_norms = np.asarray([m["grad_norm"] for m in pending_metrics], dtype=np.float64)

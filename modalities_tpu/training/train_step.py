@@ -104,6 +104,7 @@ class TrainStepBuilder:
         grad_clipper=None,
         sequence_parallel: bool = True,
         expose_grads: bool = False,
+        anomaly_policy: Optional[str] = None,
     ):
         self.model = model
         self.loss_fn = loss_fn
@@ -114,6 +115,9 @@ class TrainStepBuilder:
         self.grad_clip_norm = grad_clip_norm
         self.grad_clipper = grad_clipper  # full descriptor (norm_type, error_if_nonfinite)
         self.expose_grads = expose_grads  # debugging_enriched: return grads in metrics
+        # "skip_step"/"rollback" compile the branch-free optimizer-update skip into
+        # the step; None/"raise" leaves the program bit-identical to before
+        self.anomaly_policy = anomaly_policy
         self.rules = (
             default_logical_axis_rules(mesh_handle, sequence_parallel) if mesh_handle is not None else ()
         )
@@ -241,6 +245,15 @@ class TrainStepBuilder:
         sample_key = model.sample_key
         acc_steps = self.gradient_acc_steps
         expose_grads = self.expose_grads
+        skip_on_anomaly = self.anomaly_policy in ("skip_step", "rollback")
+
+        # fault baking (chaos tests): armed faults are resolved ONCE at build time
+        # and compiled into the program as a step-predicated jnp.where — the
+        # steady-state program with no faults armed is unchanged
+        from modalities_tpu.resilience.faults import get_fault
+
+        nan_grads_fault = get_fault("nan_grads")
+        loss_spike_fault = get_fault("loss_spike")
 
         model_spec = getattr(model, "config_spec", None)
         head_chunk = getattr(model_spec, "lm_head_chunk_size", None) if model_spec else None
@@ -405,15 +418,47 @@ class TrainStepBuilder:
                 grads = jax.tree.map(lambda g, p: (g / acc_steps).astype(p.dtype), grads, state.params)
                 loss = loss_sum / acc_steps
 
+                if nan_grads_fault is not None:
+                    poison = (
+                        state.step == nan_grads_fault.step
+                        if nan_grads_fault.step is not None
+                        else jnp.asarray(True)
+                    )
+                    grads = jax.tree.map(
+                        lambda g: g * jnp.where(poison, jnp.nan, 1.0).astype(g.dtype), grads
+                    )
+                if loss_spike_fault is not None:
+                    spike = (
+                        state.step == loss_spike_fault.step
+                        if loss_spike_fault.step is not None
+                        else jnp.asarray(True)
+                    )
+                    loss = loss + jnp.where(spike, float(loss_spike_fault.arg or 1e3), 0.0)
+
                 grad_norm = global_norm_by_mode(grads, norm_mode)
                 updates, new_opt_state = tx.update(grads, state.opt_state, state.params)
                 new_params = optax.apply_updates(state.params, updates)
+                if skip_on_anomaly:
+                    # branch-free anomaly skip: a non-finite step keeps the old
+                    # params/opt_state (jnp.where select, no lax.cond divergence
+                    # across ranks) while the step counter still advances — so the
+                    # data stream and sampler position stay aligned with a run that
+                    # consumed the batch normally
+                    ok = jnp.isfinite(loss) & jnp.isfinite(grad_norm)
+                    new_params = jax.tree.map(
+                        lambda new, old: jnp.where(ok, new, old), new_params, state.params
+                    )
+                    new_opt_state = jax.tree.map(
+                        lambda new, old: jnp.where(ok, new, old), new_opt_state, state.opt_state
+                    )
                 new_state = AppState(params=new_params, opt_state=new_opt_state, step=state.step + 1)
                 metrics = {
                     "loss": loss,
                     "grad_norm": grad_norm,
                     "lr": jnp.asarray(lr_fn(state.step), jnp.float32),
                 }
+                if skip_on_anomaly:
+                    metrics["skipped_step"] = (~ok).astype(jnp.int32)
                 if error_if_nonfinite:
                     # consumed by Trainer at the next host sync (async equivalent of
                     # torch clip_grad_norm_(error_if_nonfinite=True) raising inline)
@@ -449,6 +494,8 @@ class TrainStepBuilder:
                 "grad_norm": replicated_sharding,
                 "lr": replicated_sharding,
             }
+            if skip_on_anomaly:
+                metrics_shardings["skipped_step"] = replicated_sharding
             if error_if_nonfinite:
                 metrics_shardings["nonfinite_grads"] = replicated_sharding
             train_step_j = jax.jit(

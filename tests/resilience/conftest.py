@@ -1,0 +1,17 @@
+"""Shared isolation for the resilience suite: armed faults and event counters
+are process-global (that is what lets the harness reach inside a jitted build),
+so every test starts and ends disarmed."""
+
+import pytest
+
+from modalities_tpu.resilience.events import reset_counts
+from modalities_tpu.resilience.faults import clear_faults
+
+
+@pytest.fixture(autouse=True)
+def _isolated_faults():
+    clear_faults()
+    reset_counts()
+    yield
+    clear_faults()
+    reset_counts()

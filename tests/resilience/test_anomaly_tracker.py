@@ -1,0 +1,106 @@
+"""Anomaly-policy unit tests: raise parity with the legacy guard, skip budget
+accounting over the trailing window, loss-spike z-score detection, and the
+rollback escalation."""
+
+import numpy as np
+import pytest
+
+from modalities_tpu.resilience import AnomalyRollback, AnomalyTracker
+from modalities_tpu.resilience.events import counts_since, snapshot_counts
+
+
+def _interval(first_step, flags=None, losses=None, key="skipped_step"):
+    """Metrics dicts as the Trainer hands them over: one dict per step of the
+    interval ending at first_step + len - 1."""
+    n = len(flags) if flags is not None else len(losses)
+    out = []
+    for i in range(n):
+        m = {"loss": 1.0 if losses is None else losses[i]}
+        if flags is not None:
+            m[key] = flags[i]
+        out.append(m)
+    return out
+
+
+def test_policy_name_is_validated():
+    with pytest.raises(ValueError, match="anomaly policy"):
+        AnomalyTracker(policy="ignore")
+
+
+def test_raise_policy_matches_legacy_message_exactly():
+    """`raise` must be bit-identical to the pre-policy guard, down to the error
+    string (tooling greps for it)."""
+    tracker = AnomalyTracker(policy="raise")
+    metrics = _interval(first_step=3, flags=[0, 1], key="nonfinite_grads")
+    with pytest.raises(RuntimeError) as err:
+        tracker.observe_interval(metrics, step_id=4)
+    assert str(err.value) == (
+        "non-finite gradient norm at train step 4 (gradient_clipper.error_if_nonfinite=True)"
+    )
+
+
+def test_should_observe_gates_the_host_sync():
+    assert not AnomalyTracker(policy="raise").should_observe({"loss": 0, "grad_norm": 0})
+    assert AnomalyTracker(policy="raise").should_observe({"loss": 0, "nonfinite_grads": 0})
+    assert AnomalyTracker(policy="skip_step").should_observe({"loss": 0, "skipped_step": 0})
+    assert AnomalyTracker(policy="raise", loss_spike_zscore=6.0).should_observe({"loss": 0})
+
+
+def test_skip_policy_counts_against_budget_and_emits_events():
+    tracker = AnomalyTracker(policy="skip_step", skip_budget=2, window_steps=100)
+    snapshot = snapshot_counts()
+    tracker.observe_interval(_interval(1, flags=[1, 0]), step_id=2)
+    assert tracker.anomalies_in_window(2) == 1
+    tracker.observe_interval(_interval(3, flags=[0, 1]), step_id=4)
+    assert tracker.anomalies_in_window(4) == 2  # budget used up but not exceeded
+    assert counts_since(snapshot).get("anomaly") == 2
+
+    with pytest.raises(RuntimeError, match="skip budget exhausted"):
+        tracker.observe_interval(_interval(5, flags=[1, 0]), step_id=6)
+
+
+def test_window_pruning_recovers_the_budget():
+    tracker = AnomalyTracker(policy="skip_step", skip_budget=1, window_steps=10)
+    tracker.observe_interval(_interval(1, flags=[1]), step_id=1)
+    assert tracker.anomalies_in_window(1) == 1
+    # 10+ steps later the old anomaly has rolled out of the trailing window
+    assert tracker.anomalies_in_window(12) == 0
+    tracker.observe_interval(_interval(12, flags=[1]), step_id=12)  # budget is back
+
+
+def test_rollback_policy_raises_resumable_error_on_exhaustion():
+    tracker = AnomalyTracker(policy="rollback", skip_budget=0, window_steps=100)
+    with pytest.raises(AnomalyRollback, match="rollback warmstart"):
+        tracker.observe_interval(_interval(1, flags=[1]), step_id=1)
+
+
+def test_loss_spike_zscore_detection():
+    tracker = AnomalyTracker(
+        policy="skip_step", skip_budget=5, loss_spike_zscore=4.0, loss_spike_min_history=8
+    )
+    rng = np.random.default_rng(0)
+    history = list(2.0 + 0.05 * rng.standard_normal(10))
+    tracker.observe_interval(_interval(1, losses=history), step_id=10)
+    assert tracker.anomalies_in_window(10) == 0
+
+    snapshot = snapshot_counts()
+    tracker.observe_interval(_interval(11, losses=[2.0, 900.0]), step_id=12)
+    assert tracker.anomalies_in_window(12) == 1
+    assert counts_since(snapshot).get("anomaly") == 1
+    # the spike was excluded from history, so the baseline is unchanged and a
+    # second identical spike is still a spike
+    tracker.observe_interval(_interval(13, losses=[900.0]), step_id=13)
+    assert tracker.anomalies_in_window(13) == 2
+
+
+def test_loss_spike_under_raise_policy_raises():
+    tracker = AnomalyTracker(policy="raise", loss_spike_zscore=4.0, loss_spike_min_history=4)
+    tracker.observe_interval(_interval(1, losses=[2.0, 2.1, 1.9, 2.0]), step_id=4)
+    with pytest.raises(RuntimeError, match="loss anomaly at train step 5"):
+        tracker.observe_interval(_interval(5, losses=[500.0]), step_id=5)
+
+
+def test_nonfinite_loss_counts_without_grad_guard():
+    tracker = AnomalyTracker(policy="skip_step", skip_budget=3, loss_spike_zscore=6.0)
+    tracker.observe_interval(_interval(1, losses=[2.0, float("nan")]), step_id=2)
+    assert tracker.anomalies_in_window(2) == 1

@@ -1,0 +1,95 @@
+"""Static closure check for the fault-injection harness (the resilience
+counterpart of tests/ops/test_kernel_dispatch_closure.py): every registered
+fault point must have (a) a fire site wired into the production code and (b) a
+chaos/unit test that arms it — and every spec a test arms must parse against
+the registry. Pure AST, runs in milliseconds."""
+
+import ast
+from pathlib import Path
+
+import modalities_tpu
+from modalities_tpu.resilience.faults import FAULT_POINTS, parse_faults
+
+TESTS_DIR = Path(__file__).parent
+PACKAGE_DIR = Path(modalities_tpu.__file__).parent
+
+# fault point -> the harness entry point production code must call for it to
+# ever fire. get_fault is the build-time query TrainStepBuilder uses to bake
+# jit-level faults; the others are host-side fire helpers.
+FIRE_SITES = {
+    "checkpoint_io_error": "fire_io_error_if_armed",
+    "nan_grads": "get_fault",
+    "loss_spike": "get_fault",
+    "feeder_wedge": "wedge_if_armed",
+    "sigterm_at_step": "fire_sigterm_if_armed",
+}
+
+
+def _call_arguments(tree, callee_names):
+    """Yield every literal-string first argument of calls to `callee_names`."""
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        name = fn.attr if isinstance(fn, ast.Attribute) else getattr(fn, "id", None)
+        if name in callee_names and node.args:
+            arg = node.args[0]
+            if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                yield arg.value
+
+
+def _iter_test_sources():
+    for path in sorted(TESTS_DIR.glob("test_*.py")):
+        if path.name == Path(__file__).name:
+            continue
+        yield path, path.read_text()
+
+
+def test_registry_matches_fire_sites():
+    assert set(FIRE_SITES) == set(FAULT_POINTS)
+
+
+def test_every_fault_point_has_a_production_fire_site():
+    """A registered fault nobody can fire is dead chaos surface."""
+    called = set()
+    for path in sorted(PACKAGE_DIR.rglob("*.py")):
+        if path.is_relative_to(PACKAGE_DIR / "resilience"):
+            continue  # the harness itself doesn't count as a consumer
+        tree = ast.parse(path.read_text())
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call):
+                fn = node.func
+                name = fn.attr if isinstance(fn, ast.Attribute) else getattr(fn, "id", None)
+                if name in set(FIRE_SITES.values()):
+                    called.add(name)
+    missing = {fault for fault, site in FIRE_SITES.items() if site not in called}
+    assert not missing, (
+        f"fault points with no fire site wired into modalities_tpu/: {sorted(missing)}"
+    )
+
+
+def test_every_fault_point_is_exercised_by_some_test():
+    """...and a fault no test arms is untested chaos surface."""
+    exercised = set()
+    for _path, text in _iter_test_sources():
+        for fault in FAULT_POINTS:
+            if fault in text:
+                exercised.add(fault)
+    missing = set(FAULT_POINTS) - exercised
+    assert not missing, f"fault points never exercised under tests/resilience/: {sorted(missing)}"
+
+
+def test_every_armed_spec_parses_against_the_registry():
+    """Catches drift the other way: a test arming a renamed/misspelled fault
+    would only fail at runtime deep inside an e2e run — fail it statically."""
+    specs = []
+    for path, text in _iter_test_sources():
+        tree = ast.parse(text)
+        # arm_faults only: parse_faults calls include deliberate negative cases
+        specs += [(path.name, spec) for spec in _call_arguments(tree, {"arm_faults"})]
+    assert specs, "no armed fault specs found — did the chaos tests move?"
+    for filename, spec in specs:
+        try:
+            parse_faults(spec)
+        except ValueError as e:  # re-raise with the offending test file
+            raise AssertionError(f"{filename}: unparseable fault spec {spec!r}: {e}") from e

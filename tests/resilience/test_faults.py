@@ -1,0 +1,106 @@
+"""Fault-harness unit tests: spec grammar, arming, one-shot consumption, env
+loading. The chaos e2e tests build on these primitives; here they are exercised
+in isolation."""
+
+import signal
+
+import pytest
+
+from modalities_tpu.resilience import faults
+from modalities_tpu.resilience.faults import (
+    ENV_VAR,
+    FAULT_POINTS,
+    arm_faults,
+    clear_faults,
+    fire_io_error_if_armed,
+    fire_sigterm_if_armed,
+    get_fault,
+    load_faults_from_env,
+    parse_faults,
+    wedge_if_armed,
+)
+
+
+def test_parse_grammar_full():
+    parsed = parse_faults("nan_grads@3, loss_spike@5:250.0, checkpoint_io_error:2")
+    assert parsed["nan_grads"].step == 3
+    assert parsed["nan_grads"].arg is None
+    assert parsed["loss_spike"].step == 5
+    assert parsed["loss_spike"].arg == 250.0
+    # checkpoint_io_error's arg doubles as the shot count
+    assert parsed["checkpoint_io_error"].step is None
+    assert parsed["checkpoint_io_error"].remaining == 2
+
+
+def test_parse_rejects_unknown_fault_point():
+    with pytest.raises(ValueError, match="unknown fault point"):
+        parse_faults("nan_grads@3,reactor_meltdown@7")
+    with pytest.raises(ValueError, match="unknown fault point"):
+        get_fault("reactor_meltdown")
+
+
+def test_parse_empty_entries_are_ignored():
+    assert parse_faults("") == {}
+    assert parse_faults(" , ,nan_grads") .keys() == {"nan_grads"}
+
+
+def test_get_fault_does_not_consume():
+    arm_faults("nan_grads@2")
+    assert get_fault("nan_grads").step == 2
+    assert get_fault("nan_grads") is not None  # still armed: build-time query
+    assert get_fault("loss_spike") is None
+
+
+def test_io_error_fires_exactly_n_shots():
+    arm_faults("checkpoint_io_error:2")
+    with pytest.raises(OSError, match="injected fault"):
+        fire_io_error_if_armed()
+    with pytest.raises(OSError, match="injected fault"):
+        fire_io_error_if_armed()
+    fire_io_error_if_armed()  # shots spent — no-op
+
+
+def test_sigterm_fires_only_at_target_step():
+    arm_faults("sigterm_at_step@6")
+    previous = signal.signal(signal.SIGTERM, lambda *a: None)  # swallow the kill
+    try:
+        assert not fire_sigterm_if_armed(5)
+        assert fire_sigterm_if_armed(6)
+        assert not fire_sigterm_if_armed(6)  # one-shot
+    finally:
+        signal.signal(signal.SIGTERM, previous)
+
+
+def test_wedge_sleeps_configured_seconds(monkeypatch):
+    naps = []
+    monkeypatch.setattr(faults.time, "sleep", naps.append)
+    arm_faults("feeder_wedge@1:0.25")
+    wedge_if_armed(0)
+    assert naps == []
+    wedge_if_armed(1)
+    assert naps == [0.25]
+    wedge_if_armed(1)  # one-shot
+    assert naps == [0.25]
+
+
+def test_env_loading_is_once_per_process(monkeypatch):
+    monkeypatch.setenv(ENV_VAR, "nan_grads@4")
+    load_faults_from_env()
+    assert get_fault("nan_grads").step == 4
+    monkeypatch.setenv(ENV_VAR, "loss_spike@1")
+    load_faults_from_env()  # second call must not re-read the env
+    assert get_fault("loss_spike") is None
+    clear_faults()  # re-arms the env path for fresh processes/tests
+    load_faults_from_env()
+    assert get_fault("loss_spike").step == 1
+
+
+def test_registry_is_the_documented_set():
+    assert FAULT_POINTS == (
+        "checkpoint_io_error",
+        "nan_grads",
+        "loss_spike",
+        "feeder_wedge",
+        "sigterm_at_step",
+    )
+    assert ENV_VAR == "MODALITIES_TPU_FAULTS"

@@ -1,0 +1,200 @@
+"""Checkpoint-integrity unit tests: the retry helper, atomic pointer writes,
+manifest write/verify, and the verified-resume fallback walk."""
+
+import json
+import logging
+from pathlib import Path
+
+import pytest
+
+from modalities_tpu.checkpointing.orbax.orbax_checkpoint_saving import OrbaxCheckpointSaving
+from modalities_tpu.resilience.events import counts_since, snapshot_counts
+from modalities_tpu.resilience.faults import arm_faults, fire_io_error_if_armed
+from modalities_tpu.resilience.manifest import (
+    MANIFEST_FILE_NAME,
+    atomic_write_json,
+    resolve_resume_folder,
+    verify_manifest,
+    write_manifest,
+)
+from modalities_tpu.resilience.retry import retry_io
+from modalities_tpu.training.training_progress import TrainingProgress
+
+# ------------------------------------------------------------------- retry_io
+
+
+def test_retry_returns_value_after_transient_failures():
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise OSError("transient")
+        return "payload"
+
+    snapshot = snapshot_counts()
+    assert retry_io(flaky, what="unit", base_delay_s=0.0) == "payload"
+    assert len(calls) == 3
+    # each retry was recorded (counters keyed by first path segment)
+    assert counts_since(snapshot).get("ckpt_retry") == 2
+
+
+def test_retry_exhaustion_reraises_last_error():
+    def always_down():
+        raise OSError("storage is gone")
+
+    with pytest.raises(OSError, match="storage is gone"):
+        retry_io(always_down, what="unit", attempts=3, base_delay_s=0.0)
+
+
+def test_retry_does_not_catch_non_io_errors():
+    def broken():
+        raise KeyError("logic bug")
+
+    with pytest.raises(KeyError):
+        retry_io(broken, what="unit", attempts=4, base_delay_s=0.0)
+
+
+def test_retry_survives_injected_fault():
+    """The checkpoint_io_error fault point sits INSIDE the retried block, so an
+    armed shot costs a retry, not the run."""
+    arm_faults("checkpoint_io_error:2")
+
+    def save():
+        fire_io_error_if_armed()
+        return "committed"
+
+    assert retry_io(save, what="unit", base_delay_s=0.0) == "committed"
+
+
+# ----------------------------------------------------------- atomic pointer IO
+
+
+def test_atomic_write_json_leaves_no_tmp(tmp_path):
+    target = tmp_path / "last_checkpoint_info.json"
+    atomic_write_json(target, {"checkpoint_folder_path": "x"})
+    atomic_write_json(target, {"checkpoint_folder_path": "y"})  # overwrite path
+    assert json.loads(target.read_text()) == {"checkpoint_folder_path": "y"}
+    assert list(tmp_path.glob("*.tmp")) == []
+
+
+def test_stale_tmp_pointer_is_rejected(tmp_path):
+    stale = tmp_path / "last_checkpoint_info.json.tmp"
+    stale.write_text(json.dumps({"checkpoint_folder_path": str(tmp_path)}))
+    with pytest.raises(ValueError, match="stale temp file"):
+        resolve_resume_folder(stale)
+
+
+# ------------------------------------------------------------------- manifests
+
+
+def _fake_checkpoint(root: Path, name: str, payload: bytes = b"\x00" * 64) -> Path:
+    folder = root / name
+    (folder / "state").mkdir(parents=True)
+    (folder / "state" / "arrays.bin").write_bytes(payload)
+    (folder / "metadata.json").write_text("{}")
+    return folder
+
+
+def test_manifest_roundtrip_verifies(tmp_path):
+    folder = _fake_checkpoint(tmp_path, "eid_a-seen_steps_4-seen_tokens_16-target_steps_8-target_tokens_32")
+    write_manifest(folder)
+    manifest = json.loads((folder / MANIFEST_FILE_NAME).read_text())
+    assert manifest["step"] == 4
+    assert {e["path"] for e in manifest["files"]} == {"state/arrays.bin", "metadata.json"}
+    assert verify_manifest(folder).ok
+
+
+def test_manifest_detects_truncation_and_deletion(tmp_path):
+    folder = _fake_checkpoint(tmp_path, "eid_a-seen_steps_4-x")
+    write_manifest(folder)
+    (folder / "state" / "arrays.bin").write_bytes(b"\x00" * 10)  # truncate
+    check = verify_manifest(folder)
+    assert not check.ok and "size mismatch" in check.reason
+
+    (folder / "state" / "arrays.bin").unlink()
+    check = verify_manifest(folder)
+    assert not check.ok and "missing file" in check.reason
+
+
+def test_manifest_detects_bitflip_via_digest(tmp_path):
+    folder = _fake_checkpoint(tmp_path, "eid_a-seen_steps_4-x", payload=b"\x00" * 64)
+    write_manifest(folder)
+    (folder / "state" / "arrays.bin").write_bytes(b"\x01" + b"\x00" * 63)  # same size
+    check = verify_manifest(folder)
+    assert not check.ok and "digest mismatch" in check.reason
+
+
+def test_digest_check_can_be_disabled(tmp_path, monkeypatch):
+    folder = _fake_checkpoint(tmp_path, "eid_a-seen_steps_4-x", payload=b"\x00" * 64)
+    write_manifest(folder)
+    (folder / "state" / "arrays.bin").write_bytes(b"\x01" + b"\x00" * 63)
+    monkeypatch.setenv("MODALITIES_TPU_VERIFY_DIGESTS", "0")
+    assert verify_manifest(folder).ok  # size-only mode misses the bitflip by design
+
+
+def test_pre_manifest_checkpoint_is_accepted_with_warning(tmp_path):
+    folder = _fake_checkpoint(tmp_path, "eid_old-seen_steps_4-x")
+    check = verify_manifest(folder)
+    assert check.ok and "legacy" in check.reason
+
+
+def test_missing_folder_fails_verification(tmp_path):
+    assert not verify_manifest(tmp_path / "never_saved").ok
+
+
+# ------------------------------------------------- verified resume resolution
+
+
+def _pointer(tmp_path: Path, folder: Path) -> Path:
+    info = tmp_path / "last_checkpoint_info.json"
+    atomic_write_json(info, {"checkpoint_folder_path": str(folder)})
+    return info
+
+
+def test_resolve_returns_pointer_target_when_verified(tmp_path):
+    newest = _fake_checkpoint(tmp_path, "eid_a-seen_steps_8-x")
+    write_manifest(newest)
+    assert resolve_resume_folder(_pointer(tmp_path, newest)) == newest
+
+
+def test_resolve_walks_ring_back_to_newest_verifiable(tmp_path):
+    oldest = _fake_checkpoint(tmp_path, "eid_a-seen_steps_4-x")
+    middle = _fake_checkpoint(tmp_path, "eid_a-seen_steps_8-x")
+    newest = _fake_checkpoint(tmp_path, "eid_a-seen_steps_12-x")
+    for folder in (oldest, middle, newest):
+        write_manifest(folder)
+    (newest / "metadata.json").write_text("{ corrupted")  # sizes change -> fails
+    (middle / "state" / "arrays.bin").unlink()
+
+    snapshot = snapshot_counts()
+    assert resolve_resume_folder(_pointer(tmp_path, newest)) == oldest
+    assert counts_since(snapshot).get("rollback", 0) >= 2  # pointer + candidate events
+
+
+def test_resolve_raises_when_nothing_verifies(tmp_path):
+    newest = _fake_checkpoint(tmp_path, "eid_a-seen_steps_8-x")
+    write_manifest(newest)
+    (newest / "metadata.json").unlink()
+    with pytest.raises(FileNotFoundError, match="no verifiable checkpoint"):
+        resolve_resume_folder(_pointer(tmp_path, newest))
+
+
+# ------------------------------------------ ring deletion of a missing folder
+
+
+def test_delete_checkpoint_missing_folder_is_a_warning(tmp_path, caplog, monkeypatch):
+    """An already-gone ring folder (external cleanup, replayed delete after a
+    crash) must not kill a healthy run."""
+    # the package logger doesn't propagate to root, where caplog listens
+    monkeypatch.setattr(logging.getLogger("modalities_tpu"), "propagate", True)
+    saving = OrbaxCheckpointSaving(checkpoint_path=tmp_path, experiment_id="eid")
+    progress = TrainingProgress(
+        num_seen_steps_current_run=4,
+        num_seen_tokens_current_run=16,
+        num_target_steps=8,
+        num_target_tokens=32,
+    )
+    with caplog.at_level("WARNING"):
+        saving._delete_checkpoint(progress)  # folder never existed
+    assert any("already gone" in r.getMessage() for r in caplog.records)

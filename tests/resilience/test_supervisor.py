@@ -1,0 +1,195 @@
+"""Supervisor + preemption-handler unit tests: restart-on-resumable-exit with a
+fake runner (no child processes), backoff bookkeeping, crash-loop bounds, and
+the signal handler's flag semantics."""
+
+import json
+import os
+import signal
+
+from modalities_tpu.resilience import RESUMABLE_EXIT_CODE, PreemptionHandler
+from modalities_tpu.resilience.manifest import atomic_write_json, write_manifest
+from modalities_tpu.resilience.supervisor import build_child_command, run_resilient
+
+# ------------------------------------------------------------------ supervisor
+
+
+class FakeRunner:
+    def __init__(self, exit_codes):
+        self.exit_codes = list(exit_codes)
+        self.commands = []
+
+    def __call__(self, cmd):
+        self.commands.append(cmd)
+        return self.exit_codes.pop(0)
+
+
+def _supervise(tmp_path, exit_codes, **kwargs):
+    runner = FakeRunner(exit_codes)
+    naps = []
+    code = run_resilient(
+        config_file_path=tmp_path / "config.yaml",
+        last_checkpoint_info_file_path=tmp_path / "last_checkpoint_info.json",
+        max_restarts=kwargs.pop("max_restarts", 3),
+        backoff_base_s=kwargs.pop("backoff_base_s", 1.0),
+        runner=runner,
+        sleep_fn=naps.append,
+        **kwargs,
+    )
+    return code, runner, naps
+
+
+def _seal_pointer(tmp_path):
+    """A verified checkpoint folder + resume pointer, as a crashed child leaves them."""
+    folder = tmp_path / "eid_x-seen_steps_4-seen_tokens_16-target_steps_8-target_tokens_32"
+    folder.mkdir()
+    (folder / "blob.bin").write_bytes(b"\x00" * 16)
+    write_manifest(folder)
+    atomic_write_json(
+        tmp_path / "last_checkpoint_info.json", {"checkpoint_folder_path": str(folder)}
+    )
+    return folder
+
+
+def test_clean_run_is_one_cold_start(tmp_path):
+    code, runner, naps = _supervise(tmp_path, [0])
+    assert code == 0
+    assert len(runner.commands) == 1
+    assert "run" in runner.commands[0] and "warmstart" not in runner.commands[0]
+    assert naps == []
+
+
+def test_resumable_exits_warmstart_with_exponential_backoff(tmp_path):
+    _seal_pointer(tmp_path)
+    code, runner, naps = _supervise(
+        tmp_path, [RESUMABLE_EXIT_CODE, RESUMABLE_EXIT_CODE, 0], backoff_base_s=0.5
+    )
+    assert code == 0
+    assert len(runner.commands) == 3
+    # pointer exists from the start, so every incarnation resumes
+    assert all("warmstart" in cmd for cmd in runner.commands)
+    assert naps == [0.5, 1.0]  # base * 2^(n-1)
+
+
+def test_cold_start_until_pointer_appears(tmp_path):
+    """No pointer yet: the child never checkpointed before dying, so the
+    supervisor restarts COLD instead of warmstarting into nothing."""
+
+    class PointerAfterFirstExit(FakeRunner):
+        def __call__(self, cmd):
+            code = super().__call__(cmd)
+            if len(self.commands) == 1:
+                _seal_pointer(tmp_path)
+            return code
+
+    runner = PointerAfterFirstExit([RESUMABLE_EXIT_CODE, 0])
+    code = run_resilient(
+        config_file_path=tmp_path / "config.yaml",
+        last_checkpoint_info_file_path=tmp_path / "last_checkpoint_info.json",
+        runner=runner,
+        sleep_fn=lambda _s: None,
+    )
+    assert code == 0
+    assert "run" in runner.commands[0] and "warmstart" not in runner.commands[0]
+    assert "warmstart" in runner.commands[1]
+
+
+def test_non_resumable_crash_stops_immediately(tmp_path):
+    code, runner, naps = _supervise(tmp_path, [1])
+    assert code == 1
+    assert len(runner.commands) == 1
+
+
+def test_restart_on_crash_opts_into_retrying_failures(tmp_path):
+    code, runner, _ = _supervise(tmp_path, [1, 0], restart_on_crash=True)
+    assert code == 0
+    assert len(runner.commands) == 2
+
+
+def test_crash_loop_budget_is_bounded(tmp_path):
+    codes = [RESUMABLE_EXIT_CODE] * 4
+    code, runner, naps = _supervise(tmp_path, codes, max_restarts=3)
+    assert code == RESUMABLE_EXIT_CODE  # budget exhausted: surface the last exit
+    assert len(runner.commands) == 4  # initial + 3 restarts
+    assert naps == [1.0, 2.0, 4.0]
+
+
+def test_unverifiable_pointer_fails_fast(tmp_path):
+    folder = _seal_pointer(tmp_path)
+    (folder / "blob.bin").unlink()  # corrupt the only checkpoint
+    code, runner, _ = _supervise(tmp_path, [0])
+    assert code == 1
+    assert runner.commands == []  # never even started a child
+
+
+def test_warmstart_child_uses_dedicated_warmstart_config(tmp_path):
+    """A cold config pins progress at zero, so resumes must be able to swap in a
+    warmstart YAML; without one the cold config is the (legacy) fallback."""
+    cmd = build_child_command(
+        tmp_path / "cold.yaml",
+        tmp_path / "info.json",
+        resume=True,
+        warmstart_config_file_path=tmp_path / "warm.yaml",
+    )
+    assert str(tmp_path / "warm.yaml") in cmd
+    assert str(tmp_path / "cold.yaml") not in cmd
+
+    fallback = build_child_command(tmp_path / "cold.yaml", tmp_path / "info.json", resume=True)
+    assert str(tmp_path / "cold.yaml") in fallback
+
+    # and the supervisor threads it through to every resumed incarnation
+    _seal_pointer(tmp_path)
+    _, runner, _ = _supervise(
+        tmp_path, [RESUMABLE_EXIT_CODE, 0],
+        warmstart_config_file_path=tmp_path / "warm.yaml",
+    )
+    assert all(str(tmp_path / "warm.yaml") in cmd for cmd in runner.commands)
+
+
+def test_child_command_never_recurses_into_supervisor(tmp_path):
+    for resume in (False, True):
+        cmd = build_child_command(
+            tmp_path / "c.yaml",
+            tmp_path / "info.json",
+            experiments_root_path=tmp_path / "exp",
+            resume=resume,
+        )
+        assert "--resilient" not in cmd
+        assert ("warmstart" in cmd) == resume
+        assert str(tmp_path / "exp") in cmd
+
+
+# ------------------------------------------------------------------ preemption
+
+
+def test_preemption_handler_flags_sigterm():
+    handler = PreemptionHandler().install()
+    try:
+        assert not handler.should_stop()
+        os.kill(os.getpid(), signal.SIGTERM)
+        assert handler.should_stop()
+        assert handler.received_signal == "SIGTERM"
+    finally:
+        handler.uninstall()
+
+
+def test_preemption_handler_restores_previous_handlers():
+    before = signal.getsignal(signal.SIGTERM)
+    handler = PreemptionHandler()
+    with handler:
+        assert signal.getsignal(signal.SIGTERM) == handler._on_signal
+    assert signal.getsignal(signal.SIGTERM) == before
+
+
+def test_request_stop_and_reset_without_signals():
+    handler = PreemptionHandler()  # never installed: inert but pollable
+    handler.request_stop()
+    assert handler.should_stop()
+    assert handler.received_signal is None
+    handler.reset()
+    assert not handler.should_stop()
+
+
+def test_pointer_file_is_valid_json_after_write(tmp_path):
+    folder = _seal_pointer(tmp_path)
+    info = json.loads((tmp_path / "last_checkpoint_info.json").read_text())
+    assert info["checkpoint_folder_path"] == str(folder)
