@@ -81,6 +81,18 @@ def main() -> None:
               default=None,
               help="Config the --resilient supervisor uses for resume children; a cold "
               "config pins progress at zero, so most runs need a distinct warmstart YAML.")
+@click.option("--host_count", type=int, default=1, show_default=True,
+              help="Number of hosts running a --resilient supervisor; >1 enables the "
+              "cross-host resume vote (resume target must verify on a quorum of hosts).")
+@click.option("--host_id", type=int, default=0, show_default=True,
+              help="This host's index in [0, host_count) for the resume vote.")
+@click.option("--resume_quorum", type=int, default=None,
+              help="Hosts that must vote before resuming (default: all of host_count).")
+@click.option("--resume_vote_deadline_s", type=float, default=120.0, show_default=True,
+              help="How long a --resilient supervisor waits for the resume quorum.")
+@click.option("--coordination_dir_path", type=click.Path(path_type=Path), default=None,
+              help="Shared directory for resume vote files (default: a supervisor_votes "
+              "folder next to the resume pointer).")
 @_exception_handling
 def entry_point_run(
     config_file_path: Path,
@@ -91,6 +103,11 @@ def entry_point_run(
     max_restarts: int,
     backoff_base_s: float,
     warmstart_config_file_path: Optional[Path],
+    host_count: int,
+    host_id: int,
+    resume_quorum: Optional[int],
+    resume_vote_deadline_s: float,
+    coordination_dir_path: Optional[Path],
 ) -> None:
     """Train from a YAML config."""
     if resilient:
@@ -105,6 +122,11 @@ def entry_point_run(
             warmstart_config_file_path=warmstart_config_file_path,
             max_restarts=max_restarts,
             backoff_base_s=backoff_base_s,
+            host_count=host_count,
+            host_id=host_id,
+            resume_quorum=resume_quorum,
+            resume_vote_deadline_s=resume_vote_deadline_s,
+            coordination_dir=coordination_dir_path,
         )
         if code != 0:
             raise SystemExit(code)

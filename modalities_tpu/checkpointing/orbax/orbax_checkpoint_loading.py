@@ -17,6 +17,7 @@ import jax
 
 from modalities_tpu.checkpointing.stateful.app_state import AppState, AppStateHandle
 from modalities_tpu.exceptions import CheckpointingError
+from modalities_tpu.resilience.heartbeat import rendezvous
 from modalities_tpu.resilience.manifest import verify_manifest
 from modalities_tpu.resilience.retry import retry_io
 from modalities_tpu.utils.logging import get_logger
@@ -62,10 +63,13 @@ class OrbaxCheckpointLoading(CheckpointLoadingIF):
             abstract = jax.tree.map(lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), state)
 
         logger.info("Restoring sharded checkpoint from %s ...", checkpoint_dir_path)
-        restored: AppState = retry_io(
-            lambda: ocp.StandardCheckpointer().restore(checkpoint_dir_path.absolute(), abstract),
-            what="orbax_restore",
-        )
+        # the sharded restore is collective across hosts: the rendezvous guard
+        # (resilience/heartbeat.py) bounds how long a dead peer can wedge it
+        with rendezvous("checkpoint_restore"):
+            restored: AppState = retry_io(
+                lambda: ocp.StandardCheckpointer().restore(checkpoint_dir_path.absolute(), abstract),
+                what="orbax_restore",
+            )
         app_state_handle.mark_loaded()  # only after a successful restore
         app_state_handle.state = restored
         logger.info("Checkpoint restored at step %d.", int(restored.step))

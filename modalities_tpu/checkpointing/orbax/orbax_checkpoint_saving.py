@@ -21,6 +21,7 @@ from pathlib import Path
 from modalities_tpu.checkpointing.checkpoint_saving_execution import CheckpointSavingExecutionABC
 from modalities_tpu.checkpointing.stateful.app_state import AppStateHandle
 from modalities_tpu.resilience.faults import fire_io_error_if_armed
+from modalities_tpu.resilience.heartbeat import rendezvous
 from modalities_tpu.resilience.manifest import atomic_write_json, write_manifest
 from modalities_tpu.resilience.retry import retry_io
 from modalities_tpu.training.training_progress import TrainingProgress
@@ -93,15 +94,19 @@ class OrbaxCheckpointSaving(CheckpointSavingExecutionABC):
             # starting the new one, so the pending pointer below is safe to flush)
             checkpointer.save(folder.absolute(), app_state_handle.state, force=True)
 
-        retry_io(_save, what="orbax_save")
-        self._flush_pending_info()
-        if self.use_async:
-            self._pending_info_folder = folder
-        else:
-            # block until the atomic commit (tmp-dir rename) completes — the fence the
-            # reference implements with dist.barrier() (fsdp_checkpoint_saving.py:259-263)
-            checkpointer.wait_until_finished()
-            self._seal_committed(folder)
+        # the save is a cross-host collective: under a deadline-bounded rendezvous
+        # guard a dead/wedged peer turns this from an infinite hang into a
+        # diagnosed resumable exit (resilience/heartbeat.py)
+        with rendezvous("checkpoint_save"):
+            retry_io(_save, what="orbax_save")
+            self._flush_pending_info()
+            if self.use_async:
+                self._pending_info_folder = folder
+            else:
+                # block until the atomic commit (tmp-dir rename) completes — the fence the
+                # reference implements with dist.barrier() (fsdp_checkpoint_saving.py:259-263)
+                checkpointer.wait_until_finished()
+                self._seal_committed(folder)
         logger.info("Checkpoint saved.")
 
     def _seal_committed(self, folder: Path) -> None:
@@ -151,9 +156,12 @@ class OrbaxCheckpointSaving(CheckpointSavingExecutionABC):
         shutil.rmtree(folder)
 
     def wait_until_finished(self) -> None:
-        if self._checkpointer is not None:
-            self._checkpointer.wait_until_finished()
-        self._flush_pending_info()
+        # draining an async commit blocks on the other hosts' writes too —
+        # same deadline-bounded guard as the save itself
+        with rendezvous("checkpoint_drain"):
+            if self._checkpointer is not None:
+                self._checkpointer.wait_until_finished()
+            self._flush_pending_info()
 
 
 def _process_index() -> int:

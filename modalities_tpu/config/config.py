@@ -455,6 +455,21 @@ class ResilienceConfig(BaseModel):
     install_signal_handlers: SIGTERM/SIGINT -> graceful out-of-schedule
     checkpoint + resumable exit.
     max_restarts/backoff_base_s: crash-loop cap and backoff for `run --resilient`.
+
+    Cluster coordination (multi-host; all "auto" modes resolve to no-ops in a
+    single process so the default single-host program is unchanged):
+    stop_consensus: "auto" folds local stop/rollback votes into the jitted step
+    as ONE replicated scalar all-reduce when process_count > 1, so every host
+    exits at the same step boundary; "on"/"off" force it.
+    heartbeat: out-of-band peer-health transport — "auto" (KV store when
+    jax.distributed is up, else UDP when MODALITIES_TPU_HB_PORT is set, else
+    off), "kv", "udp", or "off".
+    heartbeat_interval_s / peer_deadline_s: beat cadence and how long a peer may
+    stay silent before this process exits resumable with a peer-failure dump.
+    rendezvous_deadline_s: bound on cross-host rendezvous (checkpoint
+    save/drain/restore) before declaring a wedged peer; 0 disables.
+    resume_quorum / resume_vote_deadline_s: multi-host supervisor resume
+    agreement — how many hosts must vote (default: all) and how long to wait.
     """
 
     anomaly_policy: Literal["raise", "skip_step", "rollback"] = "raise"
@@ -465,6 +480,13 @@ class ResilienceConfig(BaseModel):
     install_signal_handlers: bool = True
     max_restarts: Annotated[int, Field(strict=True, ge=0)] = 3
     backoff_base_s: Annotated[float, Field(ge=0)] = 1.0
+    stop_consensus: Literal["auto", "on", "off"] = "auto"
+    heartbeat: Literal["auto", "kv", "udp", "off"] = "auto"
+    heartbeat_interval_s: Annotated[float, Field(gt=0)] = 5.0
+    peer_deadline_s: Annotated[float, Field(gt=0)] = 30.0
+    rendezvous_deadline_s: Annotated[float, Field(ge=0)] = 300.0
+    resume_quorum: Optional[Annotated[int, Field(strict=True, gt=0)]] = None
+    resume_vote_deadline_s: Annotated[float, Field(gt=0)] = 120.0
 
 
 # ---------------------------------------------------------------------- tokenizers

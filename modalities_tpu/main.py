@@ -123,6 +123,44 @@ class Main:
         step_profile = settings.step_profile
         resilience = getattr(components, "resilience", None)
 
+        # stop-flag consensus resolved ONCE here so the builder (which compiles
+        # the ballot read into the step) and the trainer (which injects the
+        # vote) can never disagree. Probe ballot construction up front: if it
+        # fails on this topology, run uncoordinated rather than crash at step 1.
+        consensus_enabled = resilience is not None and resilience.consensus_enabled()
+        if consensus_enabled:
+            from modalities_tpu.resilience.coordination import VOTE_CONTINUE, make_ballot
+
+            try:
+                make_ballot(VOTE_CONTINUE, components.device_mesh)
+            except Exception:
+                logger.warning(
+                    "stop-flag consensus disabled: ballot construction failed on "
+                    "this topology — preemption falls back to local-only handling",
+                    exc_info=True,
+                )
+                consensus_enabled = False
+
+        # out-of-band peer-health heartbeat: detects the peers that can NEVER
+        # vote in the stop ballot (dead or wedged processes) and converts the
+        # otherwise-infinite collective hang into a diagnosed resumable exit
+        heartbeat = None
+        if resilience is not None:
+            from modalities_tpu.resilience.heartbeat import cluster_context, set_active_monitor
+
+            artifact_dir = (
+                self.experiments_root_path / self.experiment_id / "telemetry"
+                if self.experiments_root_path is not None
+                else None
+            )
+            heartbeat = resilience.build_heartbeat(artifact_dir=artifact_dir)
+            if heartbeat is not None:
+                heartbeat.start()
+                set_active_monitor(heartbeat)
+            # the cluster view (rank/world/phase/peer ages) rides every watchdog
+            # dump even when the heartbeat transport resolves disabled
+            telemetry.register_watchdog_state_provider(lambda: {"cluster": cluster_context()})
+
         # debugging_enriched model variant -> per-rank stats logger + grads exposure
         debug_cfg = getattr(app_state_spec.model, "debugging_config", None)
         debug_stats_logger = None
@@ -156,6 +194,7 @@ class Main:
                 grad_clipper=clipper if hasattr(clipper, "build_transform") else None,
                 expose_grads=debug_stats_logger is not None,
                 anomaly_policy=resilience.anomaly_policy if resilience is not None else None,
+                stop_consensus=consensus_enabled,
             )
             step_functions = builder.build()
 
@@ -213,6 +252,7 @@ class Main:
             telemetry=telemetry,
             anomaly_tracker=resilience.anomaly if resilience is not None else None,
             preemption=resilience.preemption if resilience is not None else None,
+            stop_consensus=consensus_enabled,
         )
         evaluator = Evaluator(
             progress_publisher=progress_publisher,
@@ -236,6 +276,11 @@ class Main:
                 checkpointing_interval_in_steps=settings.intervals.checkpointing_interval_in_steps,
             )
         finally:
+            if heartbeat is not None:
+                from modalities_tpu.resilience.heartbeat import set_active_monitor
+
+                set_active_monitor(None)
+                heartbeat.stop()
             if resilience is not None and resilience.preemption is not None:
                 resilience.preemption.uninstall()
             # the rich live display is process-global; leaving it running after a

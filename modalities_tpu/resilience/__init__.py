@@ -24,6 +24,18 @@ Four pillars, each testable on CPU via the fault harness (`faults.py`):
 - **Fault injection** (`faults.py`): named fault points armed via env/config,
   exercised by the CPU chaos tests under tests/resilience/.
 
+Cluster coordination (this PR's pillar set, multi-host by construction):
+
+- **Stop-flag consensus** (`coordination.py`): local stop/rollback votes ride
+  the jitted step as ONE replicated scalar all-reduce, so every process exits
+  the loop at the same step boundary (see preemption.py's docstring).
+- **Peer-health heartbeat** (`heartbeat.py`): out-of-band beats + last-seen
+  table + deadline-bounded rendezvous guards convert a dead or wedged peer
+  from an infinite collective hang into a diagnosed resumable exit.
+- **Multi-host supervisor** (`supervisor.py` + `coordination.py`): cross-host
+  votes agree on the newest checkpoint that verifies on ALL hosts before any
+  warmstart, quorum-gated.
+
 `Resilience` is the registry component ("resilience", "default") wired through
 Main into the Trainer and TrainStepBuilder.
 """
@@ -36,6 +48,7 @@ from modalities_tpu.resilience.anomaly import AnomalyTracker
 from modalities_tpu.resilience.errors import (
     RESUMABLE_EXIT_CODE,
     AnomalyRollback,
+    PeerFailure,
     PreemptionShutdown,
     ResumableError,
 )
@@ -58,11 +71,27 @@ class Resilience:
         install_signal_handlers: bool = True,
         max_restarts: int = 3,
         backoff_base_s: float = 1.0,
+        stop_consensus: str = "auto",
+        heartbeat: str = "auto",
+        heartbeat_interval_s: float = 5.0,
+        peer_deadline_s: float = 30.0,
+        rendezvous_deadline_s: float = 300.0,
+        resume_quorum: Optional[int] = None,
+        resume_vote_deadline_s: float = 120.0,
     ):
         self.anomaly_policy = anomaly_policy
         self.install_signal_handlers = install_signal_handlers
         self.max_restarts = max_restarts
         self.backoff_base_s = backoff_base_s
+        # cluster coordination knobs ("auto": multi-process runs only, so the
+        # single-process program and behavior stay byte-identical by default)
+        self.stop_consensus = stop_consensus
+        self.heartbeat = heartbeat
+        self.heartbeat_interval_s = heartbeat_interval_s
+        self.peer_deadline_s = peer_deadline_s
+        self.rendezvous_deadline_s = rendezvous_deadline_s
+        self.resume_quorum = resume_quorum
+        self.resume_vote_deadline_s = resume_vote_deadline_s
         self.anomaly = AnomalyTracker(
             policy=anomaly_policy,
             skip_budget=skip_budget,
@@ -72,11 +101,42 @@ class Resilience:
         )
         self.preemption = PreemptionHandler() if install_signal_handlers else None
 
+    def consensus_enabled(self) -> bool:
+        """Resolve the stop_consensus mode against the live process topology."""
+        from modalities_tpu.resilience.coordination import resolve_consensus
+
+        return resolve_consensus(self.stop_consensus)
+
+    def build_heartbeat(self, artifact_dir=None):
+        """A started-on-demand HeartbeatMonitor, or None when the transport
+        resolves disabled (single process, heartbeat=off)."""
+        from modalities_tpu.resilience.heartbeat import HeartbeatMonitor, resolve_transport
+
+        try:
+            import jax
+
+            rank, world = jax.process_index(), jax.process_count()
+        except Exception:
+            rank, world = 0, 1
+        transport = resolve_transport(self.heartbeat, rank=rank, world=world)
+        if transport is None:
+            return None
+        return HeartbeatMonitor(
+            rank=rank,
+            world=world,
+            transport=transport,
+            interval_s=self.heartbeat_interval_s,
+            peer_deadline_s=self.peer_deadline_s,
+            rendezvous_deadline_s=self.rendezvous_deadline_s,
+            artifact_dir=artifact_dir,
+        )
+
 
 __all__ = [
     "RESUMABLE_EXIT_CODE",
     "AnomalyRollback",
     "AnomalyTracker",
+    "PeerFailure",
     "PreemptionHandler",
     "PreemptionShutdown",
     "Resilience",

@@ -1,0 +1,184 @@
+"""Cluster coordination: the stop-flag consensus ballot + cross-host resume votes.
+
+**Stop ballot.** Under SPMD every process must execute the same program, so any
+host-local stop decision (SIGTERM on one pod, an anomaly-rollback escalation on
+one rank) that is not replicated cluster-wide is a deadlock, not a degraded
+mode. The protocol folds the local vote into the jitted train step as ONE tiny
+replicated all-reduce: each process contributes its current vote as a
+device-sharded int32 row riding the batch dict (`BALLOT_KEY`), the step reduces
+it with `jnp.max` into a replicated scalar metric, and every process reads the
+*same* reduced value — so all ranks leave the loop at the same step boundary
+and the forced checkpoint stays a well-formed collective. The Trainer fetches
+the ballot one step late (the previous step's reduction, which has already
+completed by then), so consensus costs no per-step host sync.
+
+Vote values are ordered by severity and reduced with max:
+``VOTE_CONTINUE (0) < VOTE_STOP (1, preemption) < VOTE_ROLLBACK (2, anomaly)``.
+
+**Resume votes.** `run --resilient` on a multi-host cluster must not let hosts
+with divergent filesystem views warmstart from different steps. Each host's
+supervisor writes its locally-verified checkpoint steps to a vote file on the
+shared filesystem, waits for a quorum, and resumes from the NEWEST step present
+in every vote (deterministic max-of-intersection — all hosts compute the same
+answer from the same vote set).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from pathlib import Path
+from typing import Callable, Optional
+
+import numpy as np
+
+from modalities_tpu.resilience.events import record_event
+from modalities_tpu.resilience.manifest import (
+    _seen_steps_of,
+    atomic_write_json,
+    verify_manifest,
+)
+from modalities_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+# the batch-dict key the Trainer injects and the jitted step reduces; the key is
+# only ever present when consensus is enabled, so the disabled program (and its
+# HLO) is byte-identical to a build without this feature
+BALLOT_KEY = "stop_ballot"
+
+VOTE_CONTINUE = 0
+VOTE_STOP = 1  # preemption signal / request_stop on some rank
+VOTE_ROLLBACK = 2  # anomaly skip budget exhausted under the rollback policy
+
+
+def resolve_consensus(mode: str) -> bool:
+    """"on" / "off" / "auto" (enabled iff the run spans processes — the
+    single-process compiled step stays byte-identical by default)."""
+    if mode == "on":
+        return True
+    if mode == "off":
+        return False
+    if mode != "auto":
+        raise ValueError(f"unknown stop_consensus mode {mode!r}")
+    try:
+        import jax
+
+        return jax.process_count() > 1
+    except Exception:
+        return False
+
+
+def make_ballot(vote: int, mesh_handle):
+    """One int32 element per mesh device, sharded so every device holds its own
+    process's current vote; `jnp.max` over it inside the step is the consensus
+    all-reduce. Raises on mesh layouts where this process's rows are not
+    expressible as process-local data (caller falls back to consensus-off)."""
+    import jax
+    import jax.numpy as jnp
+
+    if mesh_handle is None:
+        # no-mesh path (single process by construction): a plain device array —
+        # the reduction still folds the vote into the step's metrics
+        return jnp.full((jax.local_device_count(),), vote, jnp.int32)
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    mesh = mesh_handle.mesh
+    sharding = NamedSharding(mesh, PartitionSpec(tuple(mesh.axis_names)))
+    if jax.process_count() == 1:
+        return jax.device_put(np.full((mesh.devices.size,), vote, np.int32), sharding)
+    local = np.full((jax.local_device_count(),), vote, np.int32)
+    return jax.make_array_from_process_local_data(sharding, local)
+
+
+# ------------------------------------------------------- supervisor resume votes
+
+
+def collect_verified_steps(info_path: Path) -> dict[int, Path]:
+    """Every locally-verified checkpoint folder in the resume ring, keyed by its
+    seen-steps count (the pointer's target plus its siblings)."""
+    info_path = Path(info_path)
+    candidates: dict[int, Path] = {}
+    pointed: Optional[Path] = None
+    try:
+        info = json.loads(info_path.read_text())
+        pointed = Path(info["checkpoint_folder_path"])
+    except (OSError, KeyError, ValueError):
+        pass
+    ring_parent = pointed.parent if pointed is not None and pointed.parent.is_dir() else info_path.parent
+    for folder in ring_parent.glob("eid_*-seen_steps_*"):
+        step = _seen_steps_of(folder)
+        if step < 0 or not folder.is_dir():
+            continue
+        if verify_manifest(folder).ok:
+            candidates[step] = folder
+    return candidates
+
+
+def agree_resume_folder(
+    info_path: Path,
+    coordination_dir: Path,
+    host_id: int,
+    host_count: int,
+    attempt: int,
+    quorum: Optional[int] = None,
+    deadline_s: float = 120.0,
+    poll_interval_s: float = 0.5,
+    sleep_fn: Callable[[float], None] = time.sleep,
+    clock: Callable[[], float] = time.monotonic,
+) -> Path:
+    """Cross-host agreement on the resume target: publish this host's verified
+    steps as a vote file, wait for `quorum` votes (default: all hosts), resume
+    from the newest step EVERY voter verified. Deterministic — all hosts derive
+    the same folder from the same vote set. Raises FileNotFoundError when the
+    quorum never forms or no step is commonly verified (fail fast, like the
+    single-host `resolve_resume_folder` path)."""
+    coordination_dir = Path(coordination_dir)
+    coordination_dir.mkdir(parents=True, exist_ok=True)
+    quorum = host_count if quorum is None or quorum <= 0 else min(quorum, host_count)
+    local = collect_verified_steps(info_path)
+    atomic_write_json(
+        coordination_dir / f"resume_vote_a{attempt}_h{host_id}.json",
+        {"host_id": host_id, "attempt": attempt, "steps": sorted(local)},
+    )
+    record_event(
+        "consensus/resume_vote_cast",
+        host_id=host_id, attempt=attempt, steps=sorted(local),
+    )
+
+    deadline_at = clock() + deadline_s
+    while True:
+        votes = []
+        for vote_path in sorted(coordination_dir.glob(f"resume_vote_a{attempt}_h*.json")):
+            try:
+                votes.append(json.loads(vote_path.read_text()))
+            except (OSError, ValueError):
+                continue  # a vote mid-atomic-write on NFS: retry next poll
+        if len(votes) >= quorum:
+            break
+        if clock() >= deadline_at:
+            raise FileNotFoundError(
+                f"resume quorum not reached: {len(votes)}/{quorum} hosts voted "
+                f"within {deadline_s}s (attempt {attempt})"
+            )
+        sleep_fn(poll_interval_s)
+
+    common = set(votes[0].get("steps", []))
+    for vote in votes[1:]:
+        common &= set(vote.get("steps", []))
+    common &= set(local)  # this host must be able to open what it resumes from
+    if not common:
+        raise FileNotFoundError(
+            f"no checkpoint step verifies on all {len(votes)} voting hosts "
+            f"(local steps: {sorted(local)})"
+        )
+    step = max(common)
+    record_event(
+        "consensus/resume_agreed", host_id=host_id, attempt=attempt,
+        step=step, votes=len(votes),
+    )
+    logger.info(
+        "supervisor consensus: %d/%d hosts agree on checkpoint step %d",
+        len(votes), host_count, step,
+    )
+    return local[step]

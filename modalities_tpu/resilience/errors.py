@@ -19,3 +19,8 @@ class PreemptionShutdown(ResumableError):
 class AnomalyRollback(ResumableError):
     """Anomaly skip budget exhausted under the rollback policy; exit resumable so
     the supervisor warmstarts from the newest verified checkpoint."""
+
+
+class PeerFailure(ResumableError):
+    """A peer process died or wedged past its heartbeat/rendezvous deadline; this
+    process exits resumable instead of hanging in a collective forever."""

@@ -19,6 +19,17 @@ preemptible pods. Spec grammar (env ``MODALITIES_TPU_FAULTS`` or `arm_faults`):
   chaos).
 - ``sigterm_at_step@step`` — the Trainer sends SIGTERM to its own process after
   completing `step` (preemption chaos without an external killer).
+- ``sigterm_one_rank@step[:rank]`` — SIGTERM ONLY on process `rank` (default 0)
+  after `step`: the staggered-preemption chaos the stop-flag consensus exists
+  for. Other ranks leave the fault armed (it is rank-targeted, not one-shot
+  globally).
+- ``peer_hang@step[:seconds]`` — the Trainer's step loop sleeps `seconds`
+  (default 30) after completing `step` on whichever process armed it: a wedged
+  peer whose heartbeat thread keeps beating, caught by the OTHER ranks'
+  rendezvous deadline.
+- ``peer_death@step`` — `os._exit(1)` after completing `step` on whichever
+  process armed it: an abrupt peer death (no signal, no cleanup), caught by the
+  peer-health heartbeat deadline.
 
 Unknown names are rejected at parse time; the static closure test
 (tests/resilience/test_fault_point_closure.py) keeps FAULT_POINTS and the chaos
@@ -46,6 +57,9 @@ FAULT_POINTS = (
     "loss_spike",
     "feeder_wedge",
     "sigterm_at_step",
+    "sigterm_one_rank",
+    "peer_hang",
+    "peer_death",
 )
 
 
@@ -146,6 +160,60 @@ def fire_sigterm_if_armed(step: int) -> bool:
     logger.warning("FAULT FIRING: sigterm_at_step at step %d", step)
     os.kill(os.getpid(), signal.SIGTERM)
     return True
+
+
+def fire_sigterm_one_rank_if_armed(step: int) -> bool:
+    """SIGTERM this process at `step` ONLY when its jax.process_index matches the
+    fault's target rank (arg, default 0) — the staggered-preemption chaos that
+    exercises the stop-flag consensus. Non-target ranks do not consume a shot."""
+    fault = _armed.get("sigterm_one_rank")
+    if fault is None or fault.remaining <= 0:
+        return False
+    if fault.step is not None and step != fault.step:
+        return False
+    if _process_index() != (int(fault.arg) if fault.arg is not None else 0):
+        return False
+    fault = _consume("sigterm_one_rank", step=step)
+    record_event("fault/sigterm_one_rank", step=step, rank=_process_index())
+    logger.warning("FAULT FIRING: sigterm_one_rank at step %d (rank %d)", step, _process_index())
+    os.kill(os.getpid(), signal.SIGTERM)
+    return True
+
+
+def peer_hang_if_armed(step: int) -> bool:
+    """Wedge this process's step loop for `arg` seconds (default 30) at `step` —
+    its heartbeat thread keeps beating, so the hang is detected by the OTHER
+    ranks' rendezvous deadline, not the peer-death table."""
+    fault = _consume("peer_hang", step=step)
+    if fault is None:
+        return False
+    seconds = fault.arg if fault.arg is not None else 30.0
+    record_event("fault/peer_hang", step=step, seconds=seconds)
+    logger.warning("FAULT FIRING: peer_hang for %.1fs at step %d", seconds, step)
+    time.sleep(seconds)
+    return True
+
+
+def peer_death_if_armed(step: int) -> bool:
+    """Abrupt process death (`os._exit(1)`: no signal, no cleanup, no final
+    heartbeat) at `step` — peers must convert the resulting silence into a
+    resumable exit within their heartbeat deadline."""
+    fault = _consume("peer_death", step=step)
+    if fault is None:
+        return False
+    record_event("fault/peer_death", step=step)
+    logger.error("FAULT FIRING: peer_death at step %d — exiting abruptly", step)
+    os._exit(1)
+    return True  # unreachable outside tests that stub os._exit
+
+
+def _process_index() -> int:
+    try:
+        import jax
+
+        return jax.process_index()
+    except Exception:
+        return 0
 
 
 def wedge_if_armed(index: int) -> None:

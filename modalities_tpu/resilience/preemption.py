@@ -6,13 +6,18 @@ lets the in-flight step finish, forces an out-of-schedule checkpoint, and
 raises `PreemptionShutdown` — which drains async commits on the way out (Gym's
 finally) and maps to `RESUMABLE_EXIT_CODE` at the CLI.
 
-Rank coordination: preemptible-pod managers deliver SIGTERM to every host of
-the slice at once, and the forced save is an Orbax *collective* — every process
-reaches it at the same step boundary because all ranks run the same step loop
-over the same global batch stream. No extra barrier is introduced; the
-collective save IS the rendezvous (same argument as the normal checkpoint
-path). A single straggler rank receiving the signal one step later than the
-rest simply joins the collective its peers already entered.
+Rank coordination: a local signal is a *vote*, not a decision. With the
+stop-flag consensus enabled (resilience.stop_consensus, auto-on across
+processes), the Trainer folds each process's vote into the jitted step as one
+replicated scalar all-reduce — the "stop ballot" (coordination.py) riding the
+batch dict. Every process reads the same reduced ballot, so a SIGTERM (or
+rollback escalation) delivered to ONE host makes ALL hosts leave the loop at
+the same step boundary, and the forced save stays a well-formed Orbax
+collective. No simultaneous-delivery assumption remains: staggered signals
+only stagger the *vote*, never the exit step. Single-process runs (and
+consensus-off) keep the local fast path: the flag alone stops the loop. A peer
+that dies without voting at all is the heartbeat monitor's job
+(heartbeat.py), not this protocol's.
 """
 
 from __future__ import annotations

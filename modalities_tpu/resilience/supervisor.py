@@ -5,7 +5,18 @@ exiting with `RESUMABLE_EXIT_CODE` (preemption, anomaly rollback) is restarted
 as a *warmstart* from the resume pointer — with `resolve_resume_folder` picking
 the newest VERIFIED checkpoint, so a corrupt newest folder rolls back to its
 predecessor instead of crash-looping. Restarts are bounded (`max_restarts`) and
-exponentially backed off, so a deterministic crash cannot spin the pod.
+exponentially backed off, so a deterministic crash cannot spin the pod. The
+budget measures *crash-looping*, not total lifetime restarts: whenever the
+resume target has ADVANCED since the previous restart (the run made checkpoint
+progress before dying again), the restart counter and backoff reset — a
+long-lived run on a preemptible pool can absorb unlimited preemptions, while a
+run that keeps dying at the same step still exhausts the budget.
+
+Multi-host: with `host_count > 1`, one supervisor per host runs this loop and
+resumes must agree on a target. Each supervisor votes with its locally
+verifiable checkpoint steps (coordination.agree_resume_folder); the agreed
+folder is the newest step verifiable on a quorum (default: ALL hosts), so no
+host warmstarts from a folder a peer cannot open.
 
 The child-process design (rather than an in-process loop) is deliberate: a
 warmstart derives progress/sampler state from the checkpoint folder name at
@@ -23,8 +34,9 @@ import time
 from pathlib import Path
 from typing import Callable, Optional
 
+from modalities_tpu.resilience.coordination import agree_resume_folder
 from modalities_tpu.resilience.errors import RESUMABLE_EXIT_CODE
-from modalities_tpu.resilience.manifest import resolve_resume_folder
+from modalities_tpu.resilience.manifest import _seen_steps_of, atomic_write_json, resolve_resume_folder
 from modalities_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
@@ -71,30 +83,76 @@ def run_resilient(
     restart_on_crash: bool = False,
     runner: Callable[[list[str]], int] = _default_runner,
     sleep_fn: Callable[[float], None] = time.sleep,
+    host_count: int = 1,
+    host_id: int = 0,
+    resume_quorum: Optional[int] = None,
+    resume_vote_deadline_s: float = 120.0,
+    coordination_dir: Optional[Path] = None,
 ) -> int:
     """Supervise the run; returns the final exit code (0 on success).
 
     `last_checkpoint_info_file_path` is where the resume pointer WILL appear
     (it need not exist yet — a cold start that never checkpoints never resumes).
     `restart_on_crash=True` also restarts non-resumable failures, still bounded
-    by `max_restarts`."""
+    by `max_restarts`. With `host_count > 1`, resumes go through the cross-host
+    vote (coordination.agree_resume_folder) over `coordination_dir` (default:
+    a `supervisor_votes` folder next to the resume pointer) and the child is
+    pointed at the agreed folder instead of the raw pointer."""
     config_file_path = Path(config_file_path)
     info_path = Path(last_checkpoint_info_file_path)
+    if coordination_dir is None:
+        coordination_dir = info_path.parent / "supervisor_votes"
+    coordination_dir = Path(coordination_dir)
     restarts = 0
+    last_resume_step: Optional[int] = None
     while True:
         resume = info_path.is_file()
+        child_info_path = info_path
         if resume:
             # fail fast (and loudly) here if every checkpoint is unverifiable,
             # rather than letting the child crash-loop through the budget
             try:
-                folder = resolve_resume_folder(info_path)
+                if host_count > 1:
+                    folder = agree_resume_folder(
+                        info_path,
+                        coordination_dir,
+                        host_id=host_id,
+                        host_count=host_count,
+                        attempt=restarts,
+                        quorum=resume_quorum,
+                        deadline_s=resume_vote_deadline_s,
+                        sleep_fn=sleep_fn,
+                    )
+                else:
+                    folder = resolve_resume_folder(info_path)
                 logger.info("supervisor: resuming from verified checkpoint %s", folder)
             except (FileNotFoundError, ValueError) as e:
                 logger.error("supervisor: no verifiable checkpoint to resume from: %s", e)
                 return 1
+            # crash-LOOP detection, not a lifetime cap: a resume target that
+            # advanced since the previous restart means the child made real
+            # checkpoint progress before dying — reset the budget and backoff
+            step = _seen_steps_of(folder)
+            if last_resume_step is not None and step > last_resume_step and restarts > 0:
+                logger.info(
+                    "supervisor: checkpoint progressed (step %d -> %d) since the "
+                    "last restart — resetting the restart budget",
+                    last_resume_step, step,
+                )
+                restarts = 0
+            last_resume_step = step
+            if host_count > 1:
+                # hand the child the AGREED folder, not the raw pointer (whose
+                # target may not verify on a peer): a per-host pointer file with
+                # the same shape the warmstart CLI already reads
+                child_info_path = coordination_dir / f"agreed_checkpoint_info_h{host_id}.json"
+                atomic_write_json(
+                    child_info_path,
+                    {"checkpoint_folder_path": str(Path(folder).absolute())},
+                )
         cmd = build_child_command(
             config_file_path,
-            info_path,
+            child_info_path,
             experiments_root_path,
             resume=resume,
             warmstart_config_file_path=warmstart_config_file_path,

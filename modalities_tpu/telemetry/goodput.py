@@ -67,6 +67,8 @@ _NAME_TO_BUCKET = {
     "anomaly": "recovery",
     "rollback": "recovery",
     "recovery": "recovery",
+    "heartbeat": "recovery",
+    "consensus": "recovery",
 }
 
 

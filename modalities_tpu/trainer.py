@@ -43,9 +43,21 @@ from modalities_tpu.batch import EvaluationResultBatch, ResultItem
 from modalities_tpu.dataloader.device_feeder import DeviceBatchIterator, DeviceFeeder
 from modalities_tpu.logging_broker.messages import ExperimentStatus, MessageTypes, ProgressUpdate
 from modalities_tpu.logging_broker.publisher import MessagePublisher
-from modalities_tpu.resilience.errors import PreemptionShutdown
+from modalities_tpu.resilience.coordination import (
+    BALLOT_KEY,
+    VOTE_CONTINUE,
+    VOTE_ROLLBACK,
+    VOTE_STOP,
+    make_ballot,
+)
+from modalities_tpu.resilience.errors import AnomalyRollback, PreemptionShutdown
 from modalities_tpu.resilience.events import record_event
-from modalities_tpu.resilience.faults import fire_sigterm_if_armed
+from modalities_tpu.resilience.faults import (
+    fire_sigterm_if_armed,
+    fire_sigterm_one_rank_if_armed,
+    peer_death_if_armed,
+    peer_hang_if_armed,
+)
 from modalities_tpu.telemetry import Telemetry, get_active_telemetry
 from modalities_tpu.training.train_step import StepFunctions
 from modalities_tpu.training.training_progress import TrainingProgress
@@ -72,6 +84,7 @@ class Trainer:
         telemetry: Optional[Telemetry] = None,
         anomaly_tracker=None,
         preemption=None,
+        stop_consensus: bool = False,
     ) -> None:
         self.progress_publisher = progress_publisher
         self.evaluation_result_publisher = evaluation_result_publisher
@@ -95,6 +108,10 @@ class Trainer:
         # SIGTERM into a forced checkpoint + PreemptionShutdown
         self.anomaly_tracker = anomaly_tracker
         self.preemption = preemption
+        # stop-flag consensus (must match the TrainStepBuilder's flag): local
+        # stop/rollback votes ride the step as a replicated ballot so every
+        # process exits at the same step boundary (resilience/coordination.py)
+        self.stop_consensus = stop_consensus
         self._boundary_stall_s = 0.0
 
     def _telemetry(self) -> Telemetry:
@@ -129,6 +146,19 @@ class Trainer:
         self._boundary_stall_s = 0.0
         exhausted = False
 
+        # --- stop-flag consensus state: each dispatch carries this process's
+        # current vote as a device-sharded ballot; the decision is the PREVIOUS
+        # step's reduced ballot (complete by the time the next dispatch returns,
+        # so reading it costs no per-step stall). All processes read the same
+        # replicated value and exit at the same step boundary.
+        consensus = self.stop_consensus
+        mesh_handle = getattr(step_functions, "mesh_handle", None)
+        if mesh_handle is None:
+            consensus = False  # step functions built without a mesh can't ballot
+        local_vote = VOTE_CONTINUE
+        prev_ballot = None
+        pending_rollback: Optional[AnomalyRollback] = None
+
         feed = self.device_feeder.feed_train(
             train_loader, step_functions.put_batch, self.gradient_acc_steps
         )
@@ -157,10 +187,35 @@ class Trainer:
                     and (step_id + 1) % self.debug_stats_logger.log_interval_steps == 0
                 )
                 step_fn = step_functions.train_step_debug if debug_tick else train_step
+                if consensus:
+                    # fold the local stop flag into this dispatch's vote NOW (not
+                    # via the feeder) so the ballot is never stale by prefetch depth
+                    if (
+                        self.preemption is not None
+                        and self.preemption.should_stop()
+                        and local_vote < VOTE_STOP
+                    ):
+                        local_vote = VOTE_STOP
+                        record_event(
+                            "consensus/stop_vote_cast",
+                            step=step_id,
+                            signal=self.preemption.received_signal or "request_stop",
+                        )
+                    device_batch = dict(device_batch)
+                    device_batch[BALLOT_KEY] = make_ballot(local_vote, mesh_handle)
                 with telemetry.step_annotation(step_id + 1):
                     with telemetry.span("first_step" if step_id == first_step_id else "train_step"):
                         state, metrics = step_fn(state, device_batch)
                 debug_grads = metrics.pop("grads", None)  # exposed only when debugging
+                decided = VOTE_CONTINUE
+                if consensus:
+                    # read the PREVIOUS step's reduced ballot: with this step's
+                    # dispatch already in flight that value is long complete, so
+                    # the fetch costs no device idle time. Every process reads
+                    # the same replicated scalar -> same decision, same boundary.
+                    if prev_ballot is not None:
+                        decided = int(np.asarray(prev_ballot).max())
+                    prev_ballot = metrics.pop(BALLOT_KEY, None)
                 # publish the PREVIOUS interval now, with this step already in
                 # flight: the publish's metrics fetch blocks until that interval's
                 # last step completed, but the device is not idle while it does —
@@ -194,7 +249,18 @@ class Trainer:
                     if self.anomaly_tracker is not None and self.anomaly_tracker.should_observe(
                         pending_metrics[0]
                     ):
-                        self.anomaly_tracker.observe_interval(pending_metrics, step_id)
+                        try:
+                            self.anomaly_tracker.observe_interval(pending_metrics, step_id)
+                        except AnomalyRollback as rollback:
+                            if not consensus:
+                                raise
+                            # under consensus a rollback escalation is a VOTE, not
+                            # a unilateral exit: hold the exception, ride the
+                            # ballot, and raise it when every rank has agreed
+                            pending_rollback = rollback
+                            if local_vote < VOTE_ROLLBACK:
+                                local_vote = VOTE_ROLLBACK
+                                record_event("consensus/rollback_vote_cast", step=step_id)
                     elif "nonfinite_grads" in pending_metrics[0]:
                         self._raise_on_nonfinite(pending_metrics, step_id)
                     # snapshot the token count AT the boundary: by publish time the
@@ -227,14 +293,21 @@ class Trainer:
                 # deadline for the next one
                 telemetry.beat_watchdog(step_id)
 
+                # distributed chaos fire sites (multi-process tests arm these in
+                # ONE rank's environment): a wedged peer, an abrupt peer death,
+                # a SIGTERM delivered to a single rank
+                peer_hang_if_armed(step_id)
+                peer_death_if_armed(step_id)
                 if self.preemption is not None:
-                    if fire_sigterm_if_armed(step_id):  # chaos tests: sigterm_at_step@N
+                    fired = fire_sigterm_if_armed(step_id)  # chaos: sigterm_at_step@N
+                    fired = fire_sigterm_one_rank_if_armed(step_id) or fired
+                    if fired:
                         # the real SIGTERM is in flight, but Python runs signal
                         # handlers at a later bytecode boundary — request the stop
                         # directly so the chaos test is deterministic about WHICH
                         # step the shutdown lands on
                         self.preemption.request_stop()
-                    if self.preemption.should_stop() and step_id < target_steps:
+                    if not consensus and self.preemption.should_stop() and step_id < target_steps:
                         # the in-flight step has completed (we are past the
                         # callbacks); force an out-of-schedule checkpoint at this
                         # exact step so the supervisor can warmstart from it, then
@@ -255,6 +328,12 @@ class Trainer:
                             f"preempted by {signal_name} at step {step_id}; "
                             "checkpoint saved — warmstart to resume"
                         )
+
+                if consensus and decided != VOTE_CONTINUE and step_id < target_steps:
+                    self._coordinated_stop(
+                        decided, step_id, pending_rollback, training_progress,
+                        checkpointing_callback, telemetry,
+                    )
 
                 if step_id >= target_steps:
                     break
@@ -301,6 +380,52 @@ class Trainer:
             )
 
         step_functions.app_state_handle.state = state
+
+    def _coordinated_stop(
+        self,
+        decided: int,
+        step_id: int,
+        pending_rollback: Optional[AnomalyRollback],
+        training_progress: TrainingProgress,
+        checkpointing_callback: Callable[[TrainingProgress], None],
+        telemetry: Telemetry,
+    ) -> None:
+        """The stop ballot came back nonzero: EVERY process sees the same reduced
+        vote at the same step boundary, so the exits below are cluster-wide
+        collective-safe (the forced save is a well-formed Orbax collective)."""
+        if decided >= VOTE_ROLLBACK:
+            record_event("consensus/rollback_agreed", step=step_id)
+            logger.warning(
+                "stop ballot agreed on anomaly rollback at step %d — exiting "
+                "resumable (no forced checkpoint: the newest verified one wins)",
+                step_id,
+            )
+            # the local tracker raised (pending_rollback) or a PEER escalated —
+            # either way the run exits resumable without checkpointing the
+            # possibly-poisoned state
+            raise pending_rollback or AnomalyRollback(
+                f"peer-escalated anomaly rollback at step {step_id} (stop ballot)"
+            )
+        signal_name = None
+        if self.preemption is not None and self.preemption.should_stop():
+            signal_name = self.preemption.received_signal or "request_stop"
+        signal_name = signal_name or "peer_vote"
+        record_event("consensus/shutdown_agreed", step=step_id, signal=signal_name)
+        # mirror the local-path preempt/* events so supervisor tooling and the
+        # goodput ledger see one uniform shutdown shape either way
+        record_event("preempt/shutdown_requested", step=step_id, signal=signal_name)
+        logger.warning(
+            "stop ballot agreed (%s) — saving out-of-schedule checkpoint at "
+            "step %d on all ranks and exiting resumable",
+            signal_name, step_id,
+        )
+        with telemetry.span("preempt/forced_checkpoint"):
+            checkpointing_callback(training_progress, force=True)
+        record_event("preempt/checkpoint_saved", step=step_id)
+        raise PreemptionShutdown(
+            f"coordinated stop agreed ({signal_name}) at step {step_id}; "
+            "checkpoint saved — warmstart to resume"
+        )
 
     @staticmethod
     def _raise_on_nonfinite(pending_metrics: list[dict], step_id: int) -> None:

@@ -105,6 +105,7 @@ class TrainStepBuilder:
         sequence_parallel: bool = True,
         expose_grads: bool = False,
         anomaly_policy: Optional[str] = None,
+        stop_consensus: bool = False,
     ):
         self.model = model
         self.loss_fn = loss_fn
@@ -118,6 +119,11 @@ class TrainStepBuilder:
         # "skip_step"/"rollback" compile the branch-free optimizer-update skip into
         # the step; None/"raise" leaves the program bit-identical to before
         self.anomaly_policy = anomaly_policy
+        # stop-flag consensus: the step reduces a per-device "stop ballot" riding
+        # the batch dict into one replicated scalar metric (resilience/
+        # coordination.py). False leaves the batch structure AND the compiled
+        # program byte-identical to a build without the feature.
+        self.stop_consensus = stop_consensus
         self.rules = (
             default_logical_axis_rules(mesh_handle, sequence_parallel) if mesh_handle is not None else ()
         )
@@ -246,6 +252,8 @@ class TrainStepBuilder:
         acc_steps = self.gradient_acc_steps
         expose_grads = self.expose_grads
         skip_on_anomaly = self.anomaly_policy in ("skip_step", "rollback")
+        stop_consensus = self.stop_consensus
+        from modalities_tpu.resilience.coordination import BALLOT_KEY
 
         # fault baking (chaos tests): armed faults are resolved ONCE at build time
         # and compiled into the program as a step-predicated jnp.where — the
@@ -466,6 +474,12 @@ class TrainStepBuilder:
                 if with_grads:
                     # debugging_enriched path: Trainer feeds these to DebugStatsLogger
                     metrics["grads"] = grads
+                if stop_consensus:
+                    # the ONE consensus collective: max over every device's
+                    # locally-cast vote. The replicated scalar result is read
+                    # identically by all processes, so they exit the loop at the
+                    # same step boundary (resilience/coordination.py).
+                    metrics[BALLOT_KEY] = jnp.max(batch[BALLOT_KEY])
                 return new_state, metrics
 
             return train_step
@@ -498,6 +512,8 @@ class TrainStepBuilder:
                 metrics_shardings["skipped_step"] = replicated_sharding
             if error_if_nonfinite:
                 metrics_shardings["nonfinite_grads"] = replicated_sharding
+            if stop_consensus:
+                metrics_shardings[BALLOT_KEY] = replicated_sharding
             train_step_j = jax.jit(
                 train_step,
                 donate_argnums=(0,),

@@ -1,0 +1,158 @@
+"""Single-process consensus chaos e2e: the stop-ballot path through the full
+config-driven app (Main -> component graph -> Gym) with `stop_consensus: on`.
+
+A SIGTERM is folded into the jitted step as a ballot vote instead of being
+acted on locally: the vote rides the NEXT dispatched step, and the decision is
+read one step later still (the ballot of step N is inspected at step N+1, so no
+extra host sync blocks the dispatch pipeline). The observable contract:
+
+    sigterm after step 5 -> vote cast with step 6 -> agreed at step 7 ->
+    forced checkpoint at step 7 -> warmstart matches the uninterrupted twin.
+
+The uninterrupted twin runs WITHOUT the consensus, so the same comparison also
+proves the ballot all-reduce is numerically inert: the balloted run's published
+lines before the stop must be bit-identical to the plain run's.
+
+The 2-process version of this scenario (sigterm_one_rank, both ranks exiting at
+the same step) is tests/resilience/test_multihost.py; this test pins down the
+protocol timing and numerics where tier-1 can always run it.
+"""
+
+import json
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from modalities_tpu.dataloader.packed_data import write_pbin_file
+from modalities_tpu.main import Main
+from modalities_tpu.resilience import PreemptionShutdown
+from modalities_tpu.resilience.events import counts_since, snapshot_counts
+from modalities_tpu.resilience.faults import arm_faults
+from modalities_tpu.resilience.manifest import MANIFEST_FILE_NAME, resolve_resume_folder
+
+CONFIG = Path(__file__).parent.parent.parent / "configs" / "config_lorem_ipsum_tpu.yaml"
+WARMSTART_CONFIG = (
+    Path(__file__).parent.parent.parent / "configs" / "config_lorem_ipsum_tpu_warmstart.yaml"
+)
+
+
+@pytest.fixture
+def workdir(tmp_path, monkeypatch):
+    rng = np.random.default_rng(0)
+    (tmp_path / "data").mkdir()
+    tokens = rng.integers(0, 256, size=56000)
+    write_pbin_file(tmp_path / "data" / "lorem_ipsum.pbin", iter([tokens]), token_size_in_bytes=2)
+    monkeypatch.chdir(tmp_path)
+    return tmp_path
+
+
+def _twelve_step_config(workdir, consensus: bool):
+    """12-step retarget of the base config, optionally with the stop-flag
+    consensus forced on (auto would resolve to off in a single-process session)."""
+    text = (
+        CONFIG.read_text()
+        .replace("num_target_tokens: 32768", "num_target_tokens: 49152")
+        .replace("num_target_steps: 8", "num_target_steps: 12")
+    )
+    if consensus:
+        text = text.replace(
+            "    anomaly_policy: raise", "    anomaly_policy: raise\n    stop_consensus: \"on\""
+        )
+    path = workdir / f"config_12_steps_consensus_{consensus}.yaml"
+    path.write_text(text)
+    return path
+
+
+def _run(config_path, experiment_id, workdir, resolver=None):
+    main = Main(
+        config_path,
+        experiments_root_path=workdir / "data" / "experiments",
+        experiment_id=experiment_id,
+        additional_resolver_funs=resolver,
+    )
+    main.run(main.build_components())
+    return _train_lines_of(workdir, experiment_id)
+
+
+def _train_lines_of(workdir, experiment_id):
+    results = workdir / "data" / "experiments" / experiment_id / "evaluation_results.jsonl"
+    lines = [json.loads(line) for line in results.read_text().splitlines()]
+    return [r for r in lines if r["dataloader_tag"] == "train"]
+
+
+def test_sigterm_under_consensus_stops_via_ballot_and_warmstart_matches(workdir):
+    # uninterrupted twin WITHOUT the ballot: the balloted run must match it
+    # bit-for-bit below, proving the consensus collective is numerically inert
+    ref = _run(_twelve_step_config(workdir, consensus=False), "ref", workdir)
+    assert ref[-1]["num_train_steps_done"] == 12
+    ref_by_step = {r["num_train_steps_done"]: r for r in ref}
+
+    # SIGTERM lands after step 5 completes; under consensus nothing stops
+    # locally — the vote rides step 6's ballot, and the one-step-lagged decision
+    # is read at step 7: the whole "cluster" (of one) exits at the SAME boundary
+    arm_faults("sigterm_at_step@5")
+    snapshot = snapshot_counts()
+    main = Main(
+        _twelve_step_config(workdir, consensus=True),
+        experiments_root_path=workdir / "data" / "experiments",
+        experiment_id="balloted",
+    )
+    with pytest.raises(PreemptionShutdown, match="coordinated stop agreed .* at step 7"):
+        main.run(main.build_components())
+
+    events = counts_since(snapshot)
+    assert events.get("fault") == 1
+    assert events.get("consensus") == 2  # stop_vote_cast + shutdown_agreed
+    assert events.get("preempt") == 2  # shutdown_requested + checkpoint_saved
+
+    # everything the balloted run published before the stop is bit-identical to
+    # the consensus-free twin: the extra all-reduce never touches the numerics
+    balloted = _train_lines_of(workdir, "balloted")
+    assert [r["num_train_steps_done"] for r in balloted] == [2, 4, 6]
+    for line in balloted:
+        twin = ref_by_step[line["num_train_steps_done"]]
+        np.testing.assert_array_equal(
+            line["losses"]["train loss last"], twin["losses"]["train loss last"]
+        )
+        np.testing.assert_array_equal(
+            line["losses"]["train loss avg"], twin["losses"]["train loss avg"]
+        )
+
+    # the agreed stop forced an out-of-schedule checkpoint at step 7 (not a
+    # multiple of the interval 4), sealed and targeted by the resume pointer
+    ring = workdir / "data" / "checkpoints"
+    forced = [p for p in ring.glob("eid_balloted-*") if "seen_steps_7-" in p.name]
+    assert len(forced) == 1
+    assert (forced[0] / MANIFEST_FILE_NAME).is_file()
+    resume_folder = resolve_resume_folder(ring / "last_checkpoint_info.json")
+    assert resume_folder == forced[0]
+
+    # warmstart resumes from step 7; overlapping published intervals (8, 10, 12)
+    # match the uninterrupted twin
+    warm_text = WARMSTART_CONFIG.read_text().replace(
+        "num_target_tokens: 24576", "num_target_tokens: 49152"
+    )
+    warm_config = workdir / "config_warmstart_consensus.yaml"
+    warm_config.write_text(warm_text)
+    resumed = _run(
+        warm_config,
+        "resumed",
+        workdir,
+        resolver={"warmstart_env": lambda key: str(resume_folder)},
+    )
+    assert resumed[0]["num_train_steps_done"] == 8
+    assert resumed[-1]["num_train_steps_done"] == 12
+    for line in resumed:
+        twin = ref_by_step[line["num_train_steps_done"]]
+        assert line["metrics"]["consumed tokens"] == twin["metrics"]["consumed tokens"]
+        np.testing.assert_allclose(
+            line["losses"]["train loss last"], twin["losses"]["train loss last"], rtol=1e-5
+        )
+        # the agreed stop at 7 is OFF the log boundary (interval 2), so the
+        # resumed run's first avg window is steps {8} vs the twin's {7,8}; once
+        # the windows realign (10, 12) the averages must match too
+        if line["num_train_steps_done"] > 8:
+            np.testing.assert_allclose(
+                line["losses"]["train loss avg"], twin["losses"]["train loss avg"], rtol=1e-5
+            )

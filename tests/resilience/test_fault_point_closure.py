@@ -22,6 +22,9 @@ FIRE_SITES = {
     "loss_spike": "get_fault",
     "feeder_wedge": "wedge_if_armed",
     "sigterm_at_step": "fire_sigterm_if_armed",
+    "sigterm_one_rank": "fire_sigterm_one_rank_if_armed",
+    "peer_hang": "peer_hang_if_armed",
+    "peer_death": "peer_death_if_armed",
 }
 
 

@@ -102,5 +102,47 @@ def test_registry_is_the_documented_set():
         "loss_spike",
         "feeder_wedge",
         "sigterm_at_step",
+        "sigterm_one_rank",
+        "peer_hang",
+        "peer_death",
     )
     assert ENV_VAR == "MODALITIES_TPU_FAULTS"
+
+
+def test_sigterm_one_rank_targets_only_its_rank():
+    from modalities_tpu.resilience.faults import fire_sigterm_one_rank_if_armed
+
+    # default target is rank 0 == this process: fires like sigterm_at_step
+    arm_faults("sigterm_one_rank@3")
+    previous = signal.signal(signal.SIGTERM, lambda *a: None)  # swallow the kill
+    try:
+        assert not fire_sigterm_one_rank_if_armed(2)
+        assert fire_sigterm_one_rank_if_armed(3)
+        assert not fire_sigterm_one_rank_if_armed(3)  # one-shot
+        # targeting another rank: this process must NOT fire and must NOT
+        # consume the shot (the target rank would never see it otherwise)
+        arm_faults("sigterm_one_rank@5:1")
+        assert not fire_sigterm_one_rank_if_armed(5)
+        assert get_fault("sigterm_one_rank") is not None
+    finally:
+        signal.signal(signal.SIGTERM, previous)
+        clear_faults()
+
+
+def test_peer_hang_sleeps_and_peer_death_exits(monkeypatch):
+    from modalities_tpu.resilience.faults import peer_death_if_armed, peer_hang_if_armed
+
+    naps = []
+    monkeypatch.setattr(faults.time, "sleep", naps.append)
+    arm_faults("peer_hang@2:0.5")
+    assert not peer_hang_if_armed(1)
+    assert peer_hang_if_armed(2)
+    assert naps == [0.5]
+    assert not peer_hang_if_armed(2)  # one-shot
+
+    exits = []
+    monkeypatch.setattr(faults.os, "_exit", exits.append)
+    arm_faults("peer_death@4")
+    assert not peer_death_if_armed(3)
+    assert peer_death_if_armed(4)
+    assert exits == [1]

@@ -158,6 +158,130 @@ def test_child_command_never_recurses_into_supervisor(tmp_path):
         assert str(tmp_path / "exp") in cmd
 
 
+def test_restart_budget_resets_on_checkpoint_progress(tmp_path):
+    """The budget detects crash LOOPS ('dies at the same step over and over'),
+    not lifetime restarts: a resume target that advanced since the last restart
+    resets the counter, so a long preemptible run survives > max_restarts
+    preemptions as long as each incarnation checkpoints new progress."""
+
+    def _seal_step(step):
+        folder = tmp_path / (
+            f"eid_x-seen_steps_{step}-seen_tokens_{step * 4}-target_steps_99-target_tokens_396"
+        )
+        folder.mkdir()
+        (folder / "blob.bin").write_bytes(b"\x00" * 16)
+        write_manifest(folder)
+        atomic_write_json(
+            tmp_path / "last_checkpoint_info.json", {"checkpoint_folder_path": str(folder)}
+        )
+
+    class ProgressingRunner(FakeRunner):
+        def __call__(self, cmd):
+            code = super().__call__(cmd)
+            # every incarnation checkpoints 4 steps further before dying
+            _seal_step(4 * len(self.commands))
+            return code
+
+    # 5 resumable exits with max_restarts=3 would exhaust a naive budget; with
+    # progress-reset every post-progress restart counts as the FIRST restart
+    runner = ProgressingRunner([RESUMABLE_EXIT_CODE] * 5 + [0])
+    naps = []
+    code = run_resilient(
+        config_file_path=tmp_path / "config.yaml",
+        last_checkpoint_info_file_path=tmp_path / "last_checkpoint_info.json",
+        max_restarts=3,
+        backoff_base_s=1.0,
+        runner=runner,
+        sleep_fn=naps.append,
+    )
+    assert code == 0
+    assert len(runner.commands) == 6
+    # the first resume has no progress baseline, so backoff escalates once;
+    # every later restart observed a newer checkpoint and resets to base
+    assert naps == [1.0, 2.0, 1.0, 1.0, 1.0]
+
+
+def test_restart_budget_still_bounds_stuck_runs(tmp_path):
+    """The inverse guard: a run that keeps dying WITHOUT advancing its resume
+    target exhausts the budget exactly as before (the reset must not turn the
+    supervisor into an infinite crash loop)."""
+    _seal_pointer(tmp_path)  # step 4, never advances
+    code, runner, naps = _supervise(
+        tmp_path, [RESUMABLE_EXIT_CODE] * 4, max_restarts=3
+    )
+    assert code == RESUMABLE_EXIT_CODE
+    assert len(runner.commands) == 4
+    assert naps == [1.0, 2.0, 4.0]
+
+
+# ------------------------------------------------------------------ multi-host
+
+
+def _seal_host_ring(ring, steps):
+    folders = {}
+    for step in steps:
+        folder = ring / (
+            f"eid_x-seen_steps_{step}-seen_tokens_{step * 4}-target_steps_99-target_tokens_396"
+        )
+        folder.mkdir(parents=True)
+        (folder / "blob.bin").write_bytes(b"\x00" * 16)
+        write_manifest(folder)
+        folders[step] = folder
+    atomic_write_json(
+        ring / "last_checkpoint_info.json",
+        {"checkpoint_folder_path": str(folders[max(steps)])},
+    )
+    return folders
+
+
+def test_multihost_resume_goes_through_the_vote_and_agreed_pointer(tmp_path):
+    """host_count=2: the supervisor votes, agrees on the newest COMMON step, and
+    points the warmstart child at a per-host agreed pointer — not the raw resume
+    pointer (whose target the peer may not verify)."""
+    ring = tmp_path / "ring"
+    folders = _seal_host_ring(ring, [4, 8])
+    votes = tmp_path / "votes"
+    votes.mkdir()
+    # the peer host only verified step 4
+    atomic_write_json(
+        votes / "resume_vote_a0_h1.json", {"host_id": 1, "attempt": 0, "steps": [4]}
+    )
+
+    runner = FakeRunner([0])
+    code = run_resilient(
+        config_file_path=tmp_path / "config.yaml",
+        last_checkpoint_info_file_path=ring / "last_checkpoint_info.json",
+        runner=runner,
+        sleep_fn=lambda _s: None,
+        host_count=2,
+        host_id=0,
+        coordination_dir=votes,
+    )
+    assert code == 0
+    agreed_pointer = votes / "agreed_checkpoint_info_h0.json"
+    assert str(agreed_pointer) in runner.commands[0]
+    agreed = json.loads(agreed_pointer.read_text())
+    assert agreed["checkpoint_folder_path"] == str(folders[4].absolute())
+
+
+def test_multihost_resume_quorum_timeout_fails_fast(tmp_path):
+    ring = tmp_path / "ring"
+    _seal_host_ring(ring, [4])
+    runner = FakeRunner([0])
+    code = run_resilient(
+        config_file_path=tmp_path / "config.yaml",
+        last_checkpoint_info_file_path=ring / "last_checkpoint_info.json",
+        runner=runner,
+        sleep_fn=lambda _s: None,
+        host_count=2,
+        host_id=0,
+        resume_vote_deadline_s=0.0,  # nobody else ever votes
+        coordination_dir=tmp_path / "votes",
+    )
+    assert code == 1
+    assert runner.commands == []  # no child started on a divergent cluster
+
+
 # ------------------------------------------------------------------ preemption
 
 

@@ -13,6 +13,10 @@ import pytest
 def bench(monkeypatch):
     monkeypatch.delenv("JAX_PLATFORMS", raising=False)
     monkeypatch.setenv("BENCH_PROBE_LADDER", "0,0,0")
+    # the budget guard pins its deadline in this env var; setting it to "" here
+    # makes monkeypatch restore "" afterwards, so no test leaks a deadline into
+    # the next one (an expired inherited deadline would os._exit the test runner)
+    monkeypatch.setenv("BENCH_DEADLINE_TS", "")
     spec = importlib.util.spec_from_file_location(
         "bench_under_test", Path(__file__).parents[1] / "bench.py"
     )
@@ -257,6 +261,115 @@ def test_wedged_ladder_emits_probe_wedged_json_and_exits_clean(bench, monkeypatc
     out = json.loads(json_lines[0])
     assert out["probe_wedged"] is True
     assert out["value"] == 0.0 and out["vs_baseline"] == 0.0
+    assert out["detail"]["last_verified_tpu"]["mfu"] == pytest.approx(0.6882)
+
+
+def test_provisional_json_emitted_before_nonzero_retry_sleep(bench, monkeypatch, capsys):
+    """A wedged probe about to sleep a retry rung must FIRST leave a parsed
+    provisional line on stdout: a driver kill mid-sleep then still parses the
+    last JSON line instead of scoring null. Emitted once, before the sleep."""
+    import json
+
+    monkeypatch.setenv("BENCH_PROBE_LADDER", "0,7,7")
+    naps = []
+    monkeypatch.setattr(bench.time, "sleep", naps.append)
+    bench._probe_tpu = lambda timeout_s=180: "wedged"
+    assert bench._probe_tpu_ladder() is False
+    assert naps == [7, 7]
+    json_lines = [ln for ln in capsys.readouterr().out.splitlines() if ln.startswith("{")]
+    assert len(json_lines) == 1  # once, not once per rung
+    out = json.loads(json_lines[0])
+    assert out["provisional"] is True and out["probe_wedged"] is True
+    assert out["value"] == 0.0
+    assert out["detail"]["last_verified_tpu"]["mfu"] == pytest.approx(0.6882)
+
+
+def test_zero_sleep_ladder_emits_no_provisional_line(bench, capsys):
+    """The exactly-one-JSON-line contract of the smoke/wedged paths: a ladder
+    with no retry sleeps (the test default "0,0,0") never needs — and never
+    gets — a provisional line."""
+    bench._probe_tpu = lambda timeout_s=180: "wedged"
+    assert bench._probe_tpu_ladder() is False
+    assert [ln for ln in capsys.readouterr().out.splitlines() if ln.startswith("{")] == []
+
+
+# --------------------------------------------------- total wall-time budget
+
+
+def test_budget_guard_emits_fallback_line_and_exits_when_budget_expires(bench, monkeypatch, capsys):
+    import json
+    import time as _time
+
+    monkeypatch.setenv("BENCH_TOTAL_BUDGET_S", "0.05")
+    exits = []
+    thread = bench._arm_total_budget_guard(exit_fn=exits.append)
+    deadline = _time.monotonic() + 10.0
+    while not exits and _time.monotonic() < deadline:
+        _time.sleep(0.01)
+    thread.join(timeout=10.0)
+    assert exits == [0]  # exit 0: a parsed line beats an rc=124 kill
+    json_lines = [ln for ln in capsys.readouterr().out.splitlines() if ln.startswith("{")]
+    assert len(json_lines) == 1
+    out = json.loads(json_lines[0])
+    assert out["budget_exhausted"] is True and out["value"] == 0.0
+    assert out["detail"]["last_verified_tpu"]["mfu"] == pytest.approx(0.6882)
+
+
+def test_budget_guard_stands_down_once_the_result_is_out(bench, monkeypatch, capsys):
+    monkeypatch.setenv("BENCH_TOTAL_BUDGET_S", "0.2")
+    thread = bench._arm_total_budget_guard(exit_fn=lambda code: pytest.fail("guard must not fire"))
+    bench._BENCH_DONE.set()  # what main() does right after printing the result
+    thread.join(timeout=10.0)
+    assert not thread.is_alive()
+    assert [ln for ln in capsys.readouterr().out.splitlines() if ln.startswith("{")] == []
+
+
+def test_budget_deadline_is_pinned_across_reexec(bench, monkeypatch):
+    """_reexec_on_cpu's child must inherit the ORIGINAL absolute deadline via
+    BENCH_DEADLINE_TS, not grant itself a fresh BENCH_TOTAL_BUDGET_S."""
+    import os
+
+    monkeypatch.setenv("BENCH_TOTAL_BUDGET_S", "3300")
+    bench._arm_total_budget_guard(exit_fn=lambda code: None)
+    pinned = os.environ["BENCH_DEADLINE_TS"]
+    assert float(pinned) > 0
+    bench._BENCH_DONE.set()
+    # a second arming (= the re-exec'd child) reuses the pinned deadline verbatim
+    bench._arm_total_budget_guard(exit_fn=lambda code: None)
+    assert os.environ["BENCH_DEADLINE_TS"] == pinned
+
+
+def test_budget_guard_disabled_with_zero_budget(bench, monkeypatch):
+    monkeypatch.setenv("BENCH_TOTAL_BUDGET_S", "0")
+    assert bench._arm_total_budget_guard(exit_fn=lambda code: None) is None
+
+
+@pytest.mark.slow  # subprocess jax import dominates; the guard logic is unit-tested above
+def test_bench_subprocess_respects_total_budget_end_to_end():
+    """The whole bench under a tiny wall-time budget, as the driver would run a
+    pathologically slow window: must exit 0 WELL before the CPU run could finish,
+    with exactly one parseable JSON line flagged budget_exhausted."""
+    import json
+    import os
+    import subprocess
+    import sys
+    import time
+
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", "BENCH_TPU_PROBE": "0",
+           "BENCH_TOTAL_BUDGET_S": "3", "PALLAS_AXON_POOL_IPS": ""}
+    env.pop("BENCH_DEADLINE_TS", None)
+    t0 = time.monotonic()
+    proc = subprocess.run(
+        [sys.executable, str(Path(__file__).parents[1] / "bench.py")],
+        capture_output=True, text=True, timeout=120, env=env,
+    )
+    elapsed = time.monotonic() - t0
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    assert elapsed < 60, elapsed  # the guard fired, not the full CPU bench
+    json_lines = [ln for ln in proc.stdout.splitlines() if ln.startswith("{")]
+    assert len(json_lines) == 1, proc.stdout
+    out = json.loads(json_lines[0])
+    assert out["budget_exhausted"] is True
     assert out["detail"]["last_verified_tpu"]["mfu"] == pytest.approx(0.6882)
 
 
