@@ -93,6 +93,11 @@ def main() -> None:
 @click.option("--coordination_dir_path", type=click.Path(path_type=Path), default=None,
               help="Shared directory for resume vote files (default: a supervisor_votes "
               "folder next to the resume pointer).")
+@click.option("--min_hosts", type=int, default=None,
+              help="Elastic repair: if the resume vote deadline expires with fewer voters "
+              "than the quorum but at least this many, resume anyway on the surviving "
+              "hosts with a recomputed (shrunk) mesh. Default: disabled (missed quorum "
+              "fails the resume).")
 @_exception_handling
 def entry_point_run(
     config_file_path: Path,
@@ -108,6 +113,7 @@ def entry_point_run(
     resume_quorum: Optional[int],
     resume_vote_deadline_s: float,
     coordination_dir_path: Optional[Path],
+    min_hosts: Optional[int],
 ) -> None:
     """Train from a YAML config."""
     if resilient:
@@ -127,6 +133,7 @@ def entry_point_run(
             resume_quorum=resume_quorum,
             resume_vote_deadline_s=resume_vote_deadline_s,
             coordination_dir=coordination_dir_path,
+            min_hosts=min_hosts,
         )
         if code != 0:
             raise SystemExit(code)

@@ -16,7 +16,9 @@ from pathlib import Path
 import jax
 
 from modalities_tpu.checkpointing.stateful.app_state import AppState, AppStateHandle
+from modalities_tpu.checkpointing.topology import describe_topology, diff_topology, read_topology
 from modalities_tpu.exceptions import CheckpointingError
+from modalities_tpu.resilience.events import record_event
 from modalities_tpu.resilience.heartbeat import rendezvous
 from modalities_tpu.resilience.manifest import verify_manifest
 from modalities_tpu.resilience.retry import retry_io
@@ -31,8 +33,80 @@ class CheckpointLoadingIF(ABC):
 
 
 class OrbaxCheckpointLoading(CheckpointLoadingIF):
-    def __init__(self, global_rank: int = 0):
+    def __init__(self, global_rank: int = 0, elastic: bool = True):
         self.global_rank = global_rank
+        # elastic=False skips the topology comparison entirely: the same-topology
+        # restore path is byte-identical to the pre-topology loader (pinned by
+        # tests/checkpointing/test_topology.py)
+        self.elastic = elastic
+
+    def _detect_reshard(self, checkpoint_dir_path: Path, shardings) -> bool:
+        """Compare the checkpoint's saved topology record against the current
+        mesh. A mismatch is NOT an error — the restore target below is built from
+        the current mesh's NamedShardings, so Orbax reshards natively — but it is
+        surfaced as an explicit `elastic/reshard` telemetry event."""
+        if not self.elastic or shardings is None:
+            return False
+        saved = read_topology(checkpoint_dir_path)
+        if saved is None:
+            return False  # pre-topology checkpoint: nothing to compare against
+        current = describe_topology(shardings)
+        if current is None:
+            return False
+        mismatches = diff_topology(saved, current)
+        if not mismatches:
+            return False
+        logger.warning(
+            "checkpoint %s was written under a different topology — resharding at "
+            "load onto the current mesh: %s",
+            checkpoint_dir_path.name, "; ".join(mismatches),
+        )
+        record_event(
+            "elastic/reshard",
+            folder=str(checkpoint_dir_path),
+            mismatches=mismatches,
+            saved_mesh=saved.get("mesh_axes"),
+            current_mesh=current.get("mesh_axes"),
+            saved_processes=saved.get("process_count"),
+            current_processes=current.get("process_count"),
+            saved_sampler=saved.get("sampler_state"),
+        )
+        return True
+
+    @staticmethod
+    def _path_names(key_path) -> tuple[str, ...]:
+        # normalize dict keys / dataclass attrs / sequence indices to one spelling
+        # so the metadata tree (nested dicts) lines up with the AppState pytree
+        return tuple(
+            str(getattr(k, "key", getattr(k, "name", getattr(k, "idx", k)))) for k in key_path
+        )
+
+    def _reject_shape_mismatch(self, checkpointer, checkpoint_dir_path: Path, abstract) -> None:
+        """Global logical shapes must match the restore target exactly. Sharding
+        may differ (that is the elastic reshard path), but a shape difference
+        means a DIFFERENT architecture — and Orbax's readers can be lenient
+        enough to materialize one from a valid checkpoint instead of raising."""
+        try:
+            meta = checkpointer.metadata(checkpoint_dir_path.absolute())
+            tree_meta = getattr(meta, "item_metadata", meta)
+            saved = {
+                self._path_names(kp): tuple(getattr(m, "shape", None) or ())
+                for kp, m in jax.tree_util.tree_flatten_with_path(tree_meta)[0]
+            }
+        except Exception as e:  # metadata-less/legacy layout: Orbax arbitrates
+            logger.warning("checkpoint metadata unavailable (%r); skipping shape gate", e)
+            return
+        mismatched = []
+        for kp, leaf in jax.tree_util.tree_flatten_with_path(abstract)[0]:
+            key = self._path_names(kp)
+            if key in saved and saved[key] != tuple(leaf.shape):
+                mismatched.append(f"{'.'.join(key)}: saved {saved[key]} != target {tuple(leaf.shape)}")
+        if mismatched:
+            shown = "; ".join(mismatched[:5])
+            more = f" (+{len(mismatched) - 5} more)" if len(mismatched) > 5 else ""
+            raise CheckpointingError(
+                f"refusing to restore {checkpoint_dir_path}: architecture mismatch — {shown}{more}"
+            )
 
     def load_app_state(self, app_state_handle: AppStateHandle, checkpoint_dir_path: Path) -> AppState:
         import orbax.checkpoint as ocp
@@ -40,6 +114,11 @@ class OrbaxCheckpointLoading(CheckpointLoadingIF):
         checkpoint_dir_path = Path(checkpoint_dir_path)
         if not checkpoint_dir_path.exists():
             raise FileNotFoundError(f"Checkpoint directory {checkpoint_dir_path} does not exist.")
+
+        state = app_state_handle.state
+        shardings = app_state_handle.state_shardings
+        resharding = self._detect_reshard(checkpoint_dir_path, shardings)
+
         # integrity gate: refuse to restore a folder that fails its manifest (a
         # folder WITHOUT a manifest is accepted — legacy checkpoints). Fallback to
         # an older verifiable folder is NOT done here: the folder name is the
@@ -47,12 +126,24 @@ class OrbaxCheckpointLoading(CheckpointLoadingIF):
         # BEFORE config build (resilience.manifest.resolve_resume_folder).
         verification = verify_manifest(checkpoint_dir_path)
         if not verification.ok:
-            raise CheckpointingError(
-                f"refusing to restore {checkpoint_dir_path}: {verification.reason}"
-            )
-
-        state = app_state_handle.state
-        shardings = app_state_handle.state_shardings
+            if resharding:
+                # elastic restore across a topology change: a lost host's
+                # per-process files legitimately fail the file-level manifest.
+                # Downgrade the digest gate to the reshard event trail — the
+                # Orbax restore below is the real arbiter of restorability.
+                logger.warning(
+                    "manifest verification downgraded for elastic reshard-at-load "
+                    "of %s: %s", checkpoint_dir_path, verification.reason,
+                )
+                record_event(
+                    "elastic/verification_downgraded",
+                    folder=str(checkpoint_dir_path),
+                    reason=verification.reason,
+                )
+            else:
+                raise CheckpointingError(
+                    f"refusing to restore {checkpoint_dir_path}: {verification.reason}"
+                )
 
         def make_abstract(x, s):
             return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=s)
@@ -62,12 +153,15 @@ class OrbaxCheckpointLoading(CheckpointLoadingIF):
         else:
             abstract = jax.tree.map(lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), state)
 
+        checkpointer = ocp.StandardCheckpointer()
+        self._reject_shape_mismatch(checkpointer, checkpoint_dir_path, abstract)
+
         logger.info("Restoring sharded checkpoint from %s ...", checkpoint_dir_path)
         # the sharded restore is collective across hosts: the rendezvous guard
         # (resilience/heartbeat.py) bounds how long a dead peer can wedge it
         with rendezvous("checkpoint_restore"):
             restored: AppState = retry_io(
-                lambda: ocp.StandardCheckpointer().restore(checkpoint_dir_path.absolute(), abstract),
+                lambda: checkpointer.restore(checkpoint_dir_path.absolute(), abstract),
                 what="orbax_restore",
             )
         app_state_handle.mark_loaded()  # only after a successful restore

@@ -20,6 +20,7 @@ from pathlib import Path
 
 from modalities_tpu.checkpointing.checkpoint_saving_execution import CheckpointSavingExecutionABC
 from modalities_tpu.checkpointing.stateful.app_state import AppStateHandle
+from modalities_tpu.checkpointing.topology import write_topology
 from modalities_tpu.resilience.faults import fire_io_error_if_armed
 from modalities_tpu.resilience.heartbeat import rendezvous
 from modalities_tpu.resilience.manifest import atomic_write_json, write_manifest
@@ -72,6 +73,9 @@ class OrbaxCheckpointSaving(CheckpointSavingExecutionABC):
         # on reading the rank-0-written pointer file (stale shared-fs reads would let
         # ranks diverge and deadlock in the Orbax commit barrier)
         self._last_info_folder: Path | None = None
+        # shardings of the most recent save, for the sealed topology.json (async
+        # saves seal at the NEXT save/drain, after the handle reference was taken)
+        self._last_state_shardings = None
 
     def _get_checkpointer(self):
         # StandardCheckpointer is async under the hood (background commit thread);
@@ -87,6 +91,7 @@ class OrbaxCheckpointSaving(CheckpointSavingExecutionABC):
         folder.parent.mkdir(parents=True, exist_ok=True)
         logger.info("Saving sharded checkpoint to %s ...", folder)
         checkpointer = self._get_checkpointer()
+        self._last_state_shardings = app_state_handle.state_shardings
 
         def _save():
             fire_io_error_if_armed()
@@ -110,10 +115,12 @@ class OrbaxCheckpointSaving(CheckpointSavingExecutionABC):
         logger.info("Checkpoint saved.")
 
     def _seal_committed(self, folder: Path) -> None:
-        """Post-commit sealing: manifest first (its presence certifies a complete
-        folder), then the resume pointer (which names the folder the manifest
-        just certified)."""
+        """Post-commit sealing: topology record, then manifest (its presence
+        certifies a complete folder and its digests cover the topology file),
+        then the resume pointer (which names the folder the manifest just
+        certified)."""
         if _process_index() == 0:
+            write_topology(folder, self._last_state_shardings)
             write_manifest(folder)
         self._write_info(folder)
 

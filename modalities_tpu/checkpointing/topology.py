@@ -1,0 +1,126 @@
+"""Checkpoint topology record: the mesh a checkpoint was written under.
+
+Every sealed checkpoint folder gains a ``topology.json`` next to its
+``manifest.json`` recording the saving run's mesh axis sizes, process/device
+counts, per-leaf sharding specs, and the sampler-state layout. The file is
+written BEFORE the manifest, so the manifest's size+sha256 entries seal it like
+any other committed file.
+
+The record exists for *elastic resume*: a checkpoint must not pin the topology
+that wrote it (the mesh is a run-time choice — SimpleFSDP's mesh-as-annotation
+philosophy). The Orbax restore path already reshards natively (the restore
+target is built from the CURRENT mesh's NamedShardings), so the loader's job is
+only to *detect* the mismatch, surface it as an explicit ``elastic/reshard``
+telemetry event, and relax the file-level digest gate that a lost host's
+missing per-process files would otherwise fail (Orbax itself remains the
+arbiter of whether the array data is actually restorable).
+
+The sampler-state layout documents why a dp resize keeps the data stream
+aligned: ``skip_num_global_samples`` is a GLOBAL sample count and the epoch
+permutation is seeded independently of the topology, so only the striding of
+samples onto dp ranks changes — the *set and order* of consumed global samples
+per optimizer step does not (see dataloader/samplers.py).
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Any, Optional
+
+from modalities_tpu.resilience.manifest import atomic_write_json
+from modalities_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+TOPOLOGY_FILE_NAME = "topology.json"
+TOPOLOGY_VERSION = 1
+
+
+def _first_named_sharding(shardings) -> Optional[Any]:
+    import jax
+
+    found = None
+    for leaf in jax.tree.leaves(shardings):
+        if hasattr(leaf, "mesh") and hasattr(leaf, "spec"):
+            found = leaf
+            break
+    return found
+
+
+def describe_topology(state_shardings) -> Optional[dict]:
+    """The topology record for a sharding pytree; None when no NamedSharding leaf
+    exists (unsharded single-device state has no mesh to record)."""
+    import jax
+
+    anchor = _first_named_sharding(state_shardings)
+    if anchor is None:
+        return None
+    mesh = anchor.mesh
+    mesh_axes = {name: int(size) for name, size in zip(mesh.axis_names, mesh.devices.shape)}
+    dp_degree = mesh_axes.get("dp_replicate", 1) * mesh_axes.get("dp_shard", 1)
+
+    leaf_specs: dict[str, str] = {}
+    leaves_with_paths = jax.tree_util.tree_flatten_with_path(state_shardings)[0]
+    for path, leaf in leaves_with_paths:
+        key = jax.tree_util.keystr(path)
+        spec = getattr(leaf, "spec", None)
+        leaf_specs[key] = str(tuple(spec)) if spec is not None else str(leaf)
+
+    return {
+        "version": TOPOLOGY_VERSION,
+        "mesh_axes": mesh_axes,
+        "process_count": int(jax.process_count()),
+        "device_count": int(mesh.devices.size),
+        "leaf_specs": leaf_specs,
+        "sampler_state": {
+            # skip_num_global_samples is topology-free by construction; the dp
+            # degree documents the save-time striding for post-mortem accounting
+            "dp_degree": dp_degree,
+            "skip_semantics": "global",
+        },
+    }
+
+
+def write_topology(folder: Path, state_shardings) -> Optional[Path]:
+    """Write the topology record into a committed checkpoint folder (call before
+    `write_manifest` so the manifest seals it). Advisory metadata: a failure to
+    describe the mesh must not kill an otherwise-successful save."""
+    try:
+        record = describe_topology(state_shardings)
+        if record is None:
+            return None
+        path = Path(folder) / TOPOLOGY_FILE_NAME
+        atomic_write_json(path, record)
+        return path
+    except Exception as e:  # never fail a save over metadata
+        logger.warning("could not write checkpoint topology record: %r", e)
+        return None
+
+
+def read_topology(folder: Path) -> Optional[dict]:
+    """The saved topology record, or None for pre-topology checkpoints (legacy
+    folders restore exactly as before — no record, no comparison, no event)."""
+    path = Path(folder) / TOPOLOGY_FILE_NAME
+    if not path.is_file():
+        return None
+    try:
+        return json.loads(path.read_text())
+    except (OSError, json.JSONDecodeError) as e:
+        logger.warning("unreadable %s in %s: %r", TOPOLOGY_FILE_NAME, folder, e)
+        return None
+
+
+def diff_topology(saved: dict, current: dict) -> list[str]:
+    """Human-readable mismatch lines between a saved record and the current one;
+    empty when the checkpoint was written under this exact topology."""
+    mismatches: list[str] = []
+    for key in ("mesh_axes", "process_count", "device_count"):
+        if saved.get(key) != current.get(key):
+            mismatches.append(f"{key}: saved {saved.get(key)} != current {current.get(key)}")
+    saved_specs = saved.get("leaf_specs") or {}
+    current_specs = current.get("leaf_specs") or {}
+    changed = sum(1 for k, v in current_specs.items() if k in saved_specs and saved_specs[k] != v)
+    if changed:
+        mismatches.append(f"leaf_specs: {changed} leaves shard differently")
+    return mismatches

@@ -470,6 +470,11 @@ class ResilienceConfig(BaseModel):
     save/drain/restore) before declaring a wedged peer; 0 disables.
     resume_quorum / resume_vote_deadline_s: multi-host supervisor resume
     agreement — how many hosts must vote (default: all) and how long to wait.
+    min_hosts: elastic degraded-quorum floor — when the vote deadline expires
+    with fewer voters than the quorum but at least min_hosts, the supervisor
+    recomputes a feasible mesh for the surviving host set, rewrites the
+    warmstart config, and resumes on the reduced topology instead of failing
+    (None: disabled — quorum timeout fails fast as before).
     """
 
     anomaly_policy: Literal["raise", "skip_step", "rollback"] = "raise"
@@ -487,6 +492,7 @@ class ResilienceConfig(BaseModel):
     rendezvous_deadline_s: Annotated[float, Field(ge=0)] = 300.0
     resume_quorum: Optional[Annotated[int, Field(strict=True, gt=0)]] = None
     resume_vote_deadline_s: Annotated[float, Field(gt=0)] = 120.0
+    min_hosts: Optional[Annotated[int, Field(strict=True, gt=0)]] = None
 
 
 # ---------------------------------------------------------------------- tokenizers
@@ -530,7 +536,14 @@ class CheckpointSavingConfig(BaseModel):
 
 
 class OrbaxCheckpointLoadingConfig(BaseModel):
+    """elastic (default on): compare the checkpoint's sealed topology.json
+    against the current mesh at restore; on mismatch reshard onto the current
+    mesh's NamedShardings and emit an `elastic/reshard` telemetry event instead
+    of failing. Off: the topology record is never read — the same-topology
+    restore path is byte-identical to the pre-elastic loader."""
+
     global_rank: Annotated[int, Field(strict=True, ge=0)] = 0
+    elastic: bool = True
 
 
 class FSDP1CheckpointedGuardConfig(BaseModel):

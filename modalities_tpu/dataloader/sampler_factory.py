@@ -14,6 +14,9 @@ from typing import Optional
 
 from modalities_tpu.dataloader.samplers import BatchSampler, ResumableDistributedSampler
 from modalities_tpu.running_env.device_mesh import DeviceMeshHandle, get_data_loading_info
+from modalities_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
 
 
 class SamplerFactory:
@@ -77,5 +80,27 @@ class BatchSamplerFactory:
         if device_mesh is not None:
             num_loading_ranks, _ = get_data_loading_info(device_mesh)
             dp_degree = device_mesh.dp_degree
+            # elastic-resume guard: a warmstart skip is a GLOBAL sample count, so
+            # it survives any dp resize — but it only marks a whole-step boundary
+            # when divisible by the CURRENT global batch (mbs * dp). A misaligned
+            # skip (mbs changed between save and resume, or a hand-edited config)
+            # silently shears step boundaries across the resume; flag it loudly.
+            skip = getattr(sampler, "skip_num_global_samples", 0)
+            global_batch_size = batch_size * dp_degree
+            if skip and global_batch_size and skip % global_batch_size != 0:
+                from modalities_tpu.resilience.events import record_event
+
+                logger.warning(
+                    "resume skip of %d global samples is not a whole number of steps "
+                    "under the current global batch size %d (mbs %d * dp %d): step "
+                    "boundaries will not align with the saved run",
+                    skip, global_batch_size, batch_size, dp_degree,
+                )
+                record_event(
+                    "elastic/sampler_skip_misaligned",
+                    skip_num_global_samples=skip,
+                    global_batch_size=global_batch_size,
+                    dp_degree=dp_degree,
+                )
             batch_size = batch_size * (dp_degree // num_loading_ranks)
         return BatchSampler(sampler=sampler, batch_size=batch_size, drop_last=drop_last)

@@ -48,8 +48,23 @@ class HuggingFacePretrainedModel(NNModel):
         return {"params": self._hf_model.params}
 
     def apply(self, params, inputs: dict, train: bool = False, rngs=None) -> dict:
-        outputs = self._hf_model.module.apply(
-            params, inputs[self.sample_key], rngs=rngs
-        )
+        import inspect
+
+        import jax.numpy as jnp
+
+        tokens = inputs[self.sample_key]
+        # HF Flax modules differ in which of these they require (FlaxGPT2LMHead
+        # takes attention_mask/position_ids positionally); supply the full-
+        # attention defaults for whatever the module's signature accepts
+        accepted = inspect.signature(type(self._hf_model.module).__call__).parameters
+        optional = {
+            "attention_mask": jnp.ones_like(tokens),
+            "position_ids": jnp.broadcast_to(
+                jnp.arange(tokens.shape[-1], dtype=jnp.int32), tokens.shape
+            ),
+            "deterministic": not train,
+        }
+        kwargs = {k: v for k, v in optional.items() if k in accepted}
+        outputs = self._hf_model.module.apply(params, tokens, rngs=rngs, **kwargs)
         logits = outputs.logits if hasattr(outputs, "logits") else outputs[0]
         return {self.prediction_key: logits}

@@ -102,21 +102,22 @@ def _fsdp1_checkpointed_guard(**kwargs):
 
 
 def _fsdp1_alias_checkpoint_loading(
-    global_rank=0, block_names=None, mixed_precision_settings=None, sharding_strategy=None
+    global_rank=0, elastic=True, block_names=None, mixed_precision_settings=None,
+    sharding_strategy=None,
 ):
     """checkpoint_loading.fsdp1: Orbax loader behind the reference's name; the
     FSDP1 wrapper-rebuild knobs are config-parity only (see
     FSDP1AliasCheckpointLoadingConfig)."""
     del block_names, mixed_precision_settings, sharding_strategy
-    return OrbaxCheckpointLoading(global_rank=global_rank)
+    return OrbaxCheckpointLoading(global_rank=global_rank, elastic=elastic)
 
 
-def _torch_alias_checkpoint_loading(global_rank=0, device=None, precision=None):
+def _torch_alias_checkpoint_loading(global_rank=0, elastic=True, device=None, precision=None):
     """checkpoint_loading.torch: Orbax loader behind the reference's name; the
     torch-only device/precision knobs were already warned about at config
     validation (TorchAliasCheckpointLoadingConfig) and are dropped here."""
     del device, precision
-    return OrbaxCheckpointLoading(global_rank=global_rank)
+    return OrbaxCheckpointLoading(global_rank=global_rank, elastic=elastic)
 
 
 def _random_batch_generator(**kwargs):

@@ -78,6 +78,7 @@ class Resilience:
         rendezvous_deadline_s: float = 300.0,
         resume_quorum: Optional[int] = None,
         resume_vote_deadline_s: float = 120.0,
+        min_hosts: Optional[int] = None,
     ):
         self.anomaly_policy = anomaly_policy
         self.install_signal_handlers = install_signal_handlers
@@ -92,6 +93,7 @@ class Resilience:
         self.rendezvous_deadline_s = rendezvous_deadline_s
         self.resume_quorum = resume_quorum
         self.resume_vote_deadline_s = resume_vote_deadline_s
+        self.min_hosts = min_hosts
         self.anomaly = AnomalyTracker(
             policy=anomaly_policy,
             skip_budget=skip_budget,

@@ -21,14 +21,23 @@ supervisor writes its locally-verified checkpoint steps to a vote file on the
 shared filesystem, waits for a quorum, and resumes from the NEWEST step present
 in every vote (deterministic max-of-intersection — all hosts compute the same
 answer from the same vote set).
+
+**Degraded quorum (elastic resume).** With `min_hosts` set, a vote deadline
+that expires with fewer voters than the quorum but at least `min_hosts` does
+NOT fail fast: the agreement is computed over the hosts that DID vote and
+flagged `degraded`, and the supervisor resumes the surviving host set on a
+recomputed (smaller) topology — permanent host loss becomes repair instead of
+an outage. All surviving hosts see the same vote files, so they derive the
+same degraded agreement.
 """
 
 from __future__ import annotations
 
 import json
 import time
+from dataclasses import dataclass, field
 from pathlib import Path
-from typing import Callable, Optional
+from typing import AbstractSet, Callable, Optional
 
 import numpy as np
 
@@ -94,9 +103,12 @@ def make_ballot(vote: int, mesh_handle):
 # ------------------------------------------------------- supervisor resume votes
 
 
-def collect_verified_steps(info_path: Path) -> dict[int, Path]:
+def collect_verified_steps(
+    info_path: Path, exclude_steps: AbstractSet[int] = frozenset()
+) -> dict[int, Path]:
     """Every locally-verified checkpoint folder in the resume ring, keyed by its
-    seen-steps count (the pointer's target plus its siblings)."""
+    seen-steps count (the pointer's target plus its siblings). `exclude_steps`
+    drops steps burned by the degradation ladder (repeatedly failed resumes)."""
     info_path = Path(info_path)
     candidates: dict[int, Path] = {}
     pointed: Optional[Path] = None
@@ -108,14 +120,24 @@ def collect_verified_steps(info_path: Path) -> dict[int, Path]:
     ring_parent = pointed.parent if pointed is not None and pointed.parent.is_dir() else info_path.parent
     for folder in ring_parent.glob("eid_*-seen_steps_*"):
         step = _seen_steps_of(folder)
-        if step < 0 or not folder.is_dir():
+        if step < 0 or not folder.is_dir() or step in exclude_steps:
             continue
         if verify_manifest(folder).ok:
             candidates[step] = folder
     return candidates
 
 
-def agree_resume_folder(
+@dataclass
+class ResumeAgreement:
+    """The outcome of a cross-host resume vote."""
+
+    folder: Path
+    step: int
+    voters: list[int] = field(default_factory=list)  # host_ids that cast a vote
+    degraded: bool = False  # quorum missed but >= min_hosts: elastic resume
+
+
+def agree_resume(
     info_path: Path,
     coordination_dir: Path,
     host_id: int,
@@ -126,17 +148,23 @@ def agree_resume_folder(
     poll_interval_s: float = 0.5,
     sleep_fn: Callable[[float], None] = time.sleep,
     clock: Callable[[], float] = time.monotonic,
-) -> Path:
+    min_hosts: Optional[int] = None,
+    exclude_steps: AbstractSet[int] = frozenset(),
+) -> ResumeAgreement:
     """Cross-host agreement on the resume target: publish this host's verified
     steps as a vote file, wait for `quorum` votes (default: all hosts), resume
     from the newest step EVERY voter verified. Deterministic — all hosts derive
-    the same folder from the same vote set. Raises FileNotFoundError when the
-    quorum never forms or no step is commonly verified (fail fast, like the
-    single-host `resolve_resume_folder` path)."""
+    the same folder from the same vote set.
+
+    Raises FileNotFoundError when the quorum never forms or no step is commonly
+    verified — UNLESS `min_hosts` is set and at least that many hosts voted by
+    the deadline, in which case the agreement is computed over the surviving
+    voter set and flagged `degraded` (the caller's cue to recompute the mesh
+    for the shrunk topology)."""
     coordination_dir = Path(coordination_dir)
     coordination_dir.mkdir(parents=True, exist_ok=True)
     quorum = host_count if quorum is None or quorum <= 0 else min(quorum, host_count)
-    local = collect_verified_steps(info_path)
+    local = collect_verified_steps(info_path, exclude_steps=exclude_steps)
     atomic_write_json(
         coordination_dir / f"resume_vote_a{attempt}_h{host_id}.json",
         {"host_id": host_id, "attempt": attempt, "steps": sorted(local)},
@@ -146,6 +174,7 @@ def agree_resume_folder(
         host_id=host_id, attempt=attempt, steps=sorted(local),
     )
 
+    degraded = False
     deadline_at = clock() + deadline_s
     while True:
         votes = []
@@ -157,6 +186,20 @@ def agree_resume_folder(
         if len(votes) >= quorum:
             break
         if clock() >= deadline_at:
+            if min_hosts is not None and len(votes) >= max(min_hosts, 1):
+                # degraded quorum: the voters ARE the surviving host set
+                degraded = True
+                record_event(
+                    "elastic/degraded_quorum",
+                    host_id=host_id, attempt=attempt,
+                    voters=len(votes), quorum=quorum, min_hosts=min_hosts,
+                )
+                logger.warning(
+                    "resume quorum degraded: %d/%d hosts voted within %.1fs "
+                    "(min_hosts=%d) — proceeding with the surviving host set",
+                    len(votes), quorum, deadline_s, min_hosts,
+                )
+                break
             raise FileNotFoundError(
                 f"resume quorum not reached: {len(votes)}/{quorum} hosts voted "
                 f"within {deadline_s}s (attempt {attempt})"
@@ -173,12 +216,33 @@ def agree_resume_folder(
             f"(local steps: {sorted(local)})"
         )
     step = max(common)
+    voters = sorted(int(v.get("host_id", -1)) for v in votes)
     record_event(
         "consensus/resume_agreed", host_id=host_id, attempt=attempt,
-        step=step, votes=len(votes),
+        step=step, votes=len(votes), degraded=degraded,
     )
     logger.info(
-        "supervisor consensus: %d/%d hosts agree on checkpoint step %d",
-        len(votes), host_count, step,
+        "supervisor consensus: %d/%d hosts agree on checkpoint step %d%s",
+        len(votes), host_count, step, " (degraded quorum)" if degraded else "",
     )
-    return local[step]
+    return ResumeAgreement(folder=local[step], step=step, voters=voters, degraded=degraded)
+
+
+def agree_resume_folder(
+    info_path: Path,
+    coordination_dir: Path,
+    host_id: int,
+    host_count: int,
+    attempt: int,
+    quorum: Optional[int] = None,
+    deadline_s: float = 120.0,
+    poll_interval_s: float = 0.5,
+    sleep_fn: Callable[[float], None] = time.sleep,
+    clock: Callable[[], float] = time.monotonic,
+) -> Path:
+    """Path-only wrapper of `agree_resume` (the pre-elastic signature)."""
+    return agree_resume(
+        info_path, coordination_dir, host_id=host_id, host_count=host_count,
+        attempt=attempt, quorum=quorum, deadline_s=deadline_s,
+        poll_interval_s=poll_interval_s, sleep_fn=sleep_fn, clock=clock,
+    ).folder

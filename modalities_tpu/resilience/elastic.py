@@ -1,0 +1,176 @@
+"""Elastic topology repair: recompute a feasible mesh for a shrunk host set and
+rewrite the warmstart config for it.
+
+When the supervisor's resume vote ends with a *degraded* quorum (fewer voters
+than hosts, but at least ``min_hosts``), the run does not wait for hardware
+that may never come back: the surviving hosts resume on a smaller mesh. The
+model-parallel axes (tp/pp/cp) are shape-pinned by the checkpointed program, so
+the shrink happens along the data-parallel axes — dp_replicate collapses to 1
+and dp_shard is re-inferred from the new world size via `DeviceMeshConfig`'s
+``-1`` auto-infer. The Orbax reshard-at-load path (checkpointing/topology.py)
+lays the old shards out for the new mesh.
+
+Token accounting moves with the mesh: fewer dp ranks means fewer tokens per
+step, so ``num_target_tokens`` is recomputed from the agreed checkpoint's
+folder name (`seen_tokens_*` / `seen_steps_*`) to keep the config's
+tokens-per-step consistency check meaningful:
+
+    new_target = seen_tokens + (target_steps - seen_steps) * mbs * seq * acc * new_dp
+
+The sampler needs no rewrite — ``skip_num_global_samples`` is derived from seen
+tokens (a global count) in the warmstart config, and the global sample order is
+topology-free by construction (dataloader/samplers.py).
+"""
+
+from __future__ import annotations
+
+import re
+from pathlib import Path
+from typing import Optional
+
+import yaml
+
+from modalities_tpu.exceptions import ConfigError
+from modalities_tpu.resilience.events import record_event
+from modalities_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+_SEEN_TOKENS_RE = re.compile(r"seen_tokens_(\d+)")
+_SEEN_STEPS_RE = re.compile(r"seen_steps_(\d+)")
+
+
+def recompute_mesh_degrees(mesh_config: dict, new_world_size: int) -> dict:
+    """Feasible degrees for `new_world_size` devices, shrinking along dp only.
+
+    tp/pp/cp are kept (the checkpointed arrays are sharded over them by shape);
+    dp_replicate collapses to 1 and dp_shard is auto-inferred (-1) from what is
+    left. Raises ConfigError when the model-parallel product does not divide the
+    new world size — that loss is not repairable by a dp shrink."""
+    from modalities_tpu.running_env.device_mesh import DeviceMeshConfig
+
+    kept = {
+        key: mesh_config.get(key, 1)
+        for key in (
+            "tensor_parallel_degree",
+            "pipeline_parallel_degree",
+            "context_parallel_degree",
+        )
+    }
+    for key, value in kept.items():
+        if not isinstance(value, int):
+            raise ConfigError(
+                f"elastic rewrite needs a concrete {key} (got {value!r}); interpolated "
+                "mesh degrees cannot be recomputed for a shrunk host set"
+            )
+    model_parallel = kept["tensor_parallel_degree"] * kept["pipeline_parallel_degree"] * kept["context_parallel_degree"]
+    if new_world_size % model_parallel != 0 or new_world_size < model_parallel:
+        raise ConfigError(
+            f"no feasible mesh for {new_world_size} devices: model-parallel degrees "
+            f"(tp*pp*cp={model_parallel}) must divide the surviving world size"
+        )
+    inferred = DeviceMeshConfig(
+        device_type=mesh_config.get("device_type", "tpu"),
+        data_parallel_replicate_degree=1,
+        data_parallel_shard_degree=-1,
+        world_size=new_world_size,
+        **kept,
+    )
+    return {
+        "device_type": mesh_config.get("device_type", "tpu"),
+        "data_parallel_replicate_degree": 1,
+        "data_parallel_shard_degree": inferred.data_parallel_shard_degree,
+        "tensor_parallel_degree": kept["tensor_parallel_degree"],
+        "pipeline_parallel_degree": kept["pipeline_parallel_degree"],
+        "context_parallel_degree": kept["context_parallel_degree"],
+        "world_size": new_world_size,
+    }
+
+
+def _parse_folder_counts(folder_name: str) -> tuple[Optional[int], Optional[int]]:
+    tokens = _SEEN_TOKENS_RE.search(folder_name)
+    steps = _SEEN_STEPS_RE.search(folder_name)
+    return (
+        int(tokens.group(1)) if tokens else None,
+        int(steps.group(1)) if steps else None,
+    )
+
+
+def rewrite_warmstart_config_for_hosts(
+    warmstart_config_path: Path,
+    out_path: Path,
+    surviving_hosts: int,
+    total_hosts: int,
+    resume_folder_name: Optional[str] = None,
+) -> Path:
+    """Write an elastic variant of the warmstart config for `surviving_hosts` of
+    `total_hosts`: the device_mesh block carries the recomputed degrees and
+    world size, and `num_target_tokens` is re-derived from the resume folder's
+    seen counts under the NEW tokens-per-step (so the config's consistency
+    check still holds). Everything else — including `${...}` interpolations,
+    which survive the YAML round-trip as plain strings — is preserved."""
+    warmstart_config_path = Path(warmstart_config_path)
+    raw = yaml.safe_load(warmstart_config_path.read_text())
+
+    mesh_block = (raw.get("device_mesh") or {}).get("config")
+    if not isinstance(mesh_block, dict) or not isinstance(mesh_block.get("world_size"), int):
+        raise ConfigError(
+            f"elastic rewrite: {warmstart_config_path} has no concrete "
+            "device_mesh.config.world_size to shrink"
+        )
+    old_world = mesh_block["world_size"]
+    if total_hosts <= 0 or old_world % total_hosts != 0:
+        raise ConfigError(
+            f"elastic rewrite: world_size {old_world} is not evenly split over "
+            f"{total_hosts} hosts"
+        )
+    new_world = old_world // total_hosts * surviving_hosts
+    new_mesh = recompute_mesh_degrees(mesh_block, new_world)
+    raw["device_mesh"]["config"] = new_mesh
+
+    new_dp = new_mesh["data_parallel_replicate_degree"] * new_mesh["data_parallel_shard_degree"]
+    retarget = _retarget_tokens(raw, new_dp, resume_folder_name)
+
+    out_path = Path(out_path)
+    out_path.parent.mkdir(parents=True, exist_ok=True)
+    out_path.write_text(yaml.safe_dump(raw, sort_keys=False))
+    record_event(
+        "elastic/config_rewritten",
+        surviving_hosts=surviving_hosts, total_hosts=total_hosts,
+        old_world_size=old_world, new_world_size=new_world,
+        new_mesh={k: v for k, v in new_mesh.items() if k != "device_type"},
+        num_target_tokens=retarget,
+    )
+    logger.warning(
+        "elastic resume: rewrote %s -> %s (world %d -> %d, dp -> %d%s)",
+        warmstart_config_path.name, out_path.name, old_world, new_world, new_dp,
+        f", target tokens -> {retarget}" if retarget is not None else "",
+    )
+    return out_path
+
+
+def _retarget_tokens(raw: dict, new_dp: int, resume_folder_name: Optional[str]) -> Optional[int]:
+    """Recompute settings.training_target.num_target_tokens for the new dp
+    degree; None (config untouched) when any required count is not concrete."""
+    if resume_folder_name is None:
+        return None
+    seen_tokens, seen_steps = _parse_folder_counts(resume_folder_name)
+    settings = raw.get("settings") or {}
+    profile = settings.get("step_profile") or {}
+    target = settings.get("training_target") or {}
+    mbs = profile.get("local_train_micro_batch_size")
+    seq = profile.get("sequence_length")
+    acc = profile.get("gradient_accumulation_steps", 1)
+    target_steps = target.get("num_target_steps")
+    concrete = all(
+        isinstance(v, int) for v in (seen_tokens, seen_steps, mbs, seq, acc, target_steps)
+    )
+    if not concrete or target_steps <= seen_steps:
+        logger.warning(
+            "elastic rewrite: cannot re-derive num_target_tokens (non-concrete step "
+            "profile or no remaining steps) — leaving training_target untouched"
+        )
+        return None
+    new_target = seen_tokens + (target_steps - seen_steps) * mbs * seq * acc * new_dp
+    target["num_target_tokens"] = new_target
+    return new_target

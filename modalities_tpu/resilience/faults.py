@@ -30,6 +30,11 @@ preemptible pods. Spec grammar (env ``MODALITIES_TPU_FAULTS`` or `arm_faults`):
 - ``peer_death@step`` — `os._exit(1)` after completing `step` on whichever
   process armed it: an abrupt peer death (no signal, no cleanup), caught by the
   peer-health heartbeat deadline.
+- ``host_loss@step[:host]`` — PERMANENT loss of host `host` (default 0) after
+  `step`: SIGKILLs that host's supervisor (so nothing restarts the dead host)
+  and then dies abruptly itself. The surviving supervisors' next resume vote
+  misses the quorum — the elastic-resume chaos (degraded quorum, shrunk-mesh
+  warmstart) exists for exactly this.
 
 Unknown names are rejected at parse time; the static closure test
 (tests/resilience/test_fault_point_closure.py) keeps FAULT_POINTS and the chaos
@@ -60,6 +65,7 @@ FAULT_POINTS = (
     "sigterm_one_rank",
     "peer_hang",
     "peer_death",
+    "host_loss",
 )
 
 
@@ -203,6 +209,35 @@ def peer_death_if_armed(step: int) -> bool:
         return False
     record_event("fault/peer_death", step=step)
     logger.error("FAULT FIRING: peer_death at step %d — exiting abruptly", step)
+    os._exit(1)
+    return True  # unreachable outside tests that stub os._exit
+
+
+def host_loss_if_armed(step: int) -> bool:
+    """Permanent whole-host loss at `step`: fires only on the host whose id
+    matches the fault's target (arg, default 0) — the id a supervising parent
+    exported as MODALITIES_TPU_HOST_ID, falling back to the process index. The
+    supervisor itself is SIGKILLed FIRST (via its exported
+    MODALITIES_TPU_SUPERVISOR_PID), so nothing restarts the lost host: unlike
+    peer_death, this host is gone for good and the survivors must repair around
+    it. Non-target hosts do not consume a shot."""
+    fault = _armed.get("host_loss")
+    if fault is None or fault.remaining <= 0:
+        return False
+    if fault.step is not None and step != fault.step:
+        return False
+    host_id = int(os.environ.get("MODALITIES_TPU_HOST_ID", _process_index()))
+    if host_id != (int(fault.arg) if fault.arg is not None else 0):
+        return False
+    _consume("host_loss", step=step)
+    record_event("fault/host_loss", step=step, host_id=host_id)
+    logger.error("FAULT FIRING: host_loss at step %d — host %d is gone for good", step, host_id)
+    supervisor_pid = os.environ.get("MODALITIES_TPU_SUPERVISOR_PID")
+    if supervisor_pid and int(supervisor_pid) != os.getpid():
+        try:
+            os.kill(int(supervisor_pid), signal.SIGKILL)
+        except (OSError, ValueError):
+            pass  # supervisor already gone: the host is just as lost
     os._exit(1)
     return True  # unreachable outside tests that stub os._exit
 

@@ -137,11 +137,17 @@ def _seen_steps_of(folder: Path) -> int:
     return int(match.group(1)) if match else -1
 
 
-def resolve_resume_folder(last_checkpoint_info_path: Path) -> Path:
+def resolve_resume_folder(
+    last_checkpoint_info_path: Path, exclude_steps: frozenset[int] | set[int] = frozenset()
+) -> Path:
     """The verified warmstart target: read the resume pointer, verify the folder
     it names, and on failure walk the sibling checkpoint ring (sorted by the
     seen-steps count in the folder name, newest first) to the newest verifiable
     folder. Raises FileNotFoundError when nothing survives verification.
+
+    `exclude_steps` treats those ring slots as unusable even when they verify —
+    the supervisor's degradation ladder burns a step after repeated failed
+    resumes from it, walking the ring back one slot at a time.
 
     A stale ``*.tmp`` pointer path (leftover of a crashed atomic write) is
     rejected — only the committed pointer file is trusted."""
@@ -156,19 +162,31 @@ def resolve_resume_folder(last_checkpoint_info_path: Path) -> Path:
     info = json.loads(info_path.read_text())
     pointed = Path(info["checkpoint_folder_path"])
 
-    verification = verify_manifest(pointed)
-    if verification.ok:
-        return pointed
-
-    logger.warning(
-        "resume pointer names an unverifiable checkpoint (%s) — walking the ring "
-        "for the newest verifiable folder", verification.reason,
-    )
-    record_event("rollback/pointer_target_corrupt", folder=str(pointed), reason=verification.reason)
+    if _seen_steps_of(pointed) not in exclude_steps:
+        verification = verify_manifest(pointed)
+        if verification.ok:
+            return pointed
+        logger.warning(
+            "resume pointer names an unverifiable checkpoint (%s) — walking the ring "
+            "for the newest verifiable folder", verification.reason,
+        )
+        record_event(
+            "rollback/pointer_target_corrupt", folder=str(pointed), reason=verification.reason
+        )
+    else:
+        verification = ManifestVerification(False, "step burned by the degradation ladder")
+        logger.warning(
+            "resume pointer target %s is burned by the degradation ladder — walking "
+            "the ring for the newest usable folder", pointed.name,
+        )
+        record_event("rollback/pointer_target_burned", folder=str(pointed))
 
     ring_parent = pointed.parent if pointed.parent.is_dir() else info_path.parent
     candidates = sorted(
-        (p for p in ring_parent.glob("eid_*-seen_steps_*") if p.is_dir() and p != pointed),
+        (
+            p for p in ring_parent.glob("eid_*-seen_steps_*")
+            if p.is_dir() and p != pointed and _seen_steps_of(p) not in exclude_steps
+        ),
         key=_seen_steps_of,
         reverse=True,
     )

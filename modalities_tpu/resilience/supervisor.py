@@ -14,9 +14,26 @@ run that keeps dying at the same step still exhausts the budget.
 
 Multi-host: with `host_count > 1`, one supervisor per host runs this loop and
 resumes must agree on a target. Each supervisor votes with its locally
-verifiable checkpoint steps (coordination.agree_resume_folder); the agreed
-folder is the newest step verifiable on a quorum (default: ALL hosts), so no
-host warmstarts from a folder a peer cannot open.
+verifiable checkpoint steps (coordination.agree_resume); the agreed folder is
+the newest step verifiable on a quorum (default: ALL hosts), so no host
+warmstarts from a folder a peer cannot open.
+
+Elastic repair: with `min_hosts` set, a vote deadline that expires with fewer
+voters than the quorum but at least `min_hosts` resumes anyway — on a SHRUNK
+topology. The surviving voter set defines the new world: the warmstart config
+is rewritten for it (elastic.rewrite_warmstart_config_for_hosts recomputes the
+mesh along dp and re-derives the token target) and the child is launched with
+`JAX_NUM_PROCESSES`/`JAX_PROCESS_ID` overridden to the surviving set, so the
+running_env initializes the smaller cluster and the Orbax reshard-at-load path
+lays the old shards onto the new mesh.
+
+Degradation ladder: a child that keeps dying right after resuming from the
+same checkpoint (`ladder_after` consecutive failures at one step) has its
+resume target BURNED — the step is excluded from resolution and the ring walks
+back one slot, trading recent progress for a checkpoint that actually restores.
+Burning consumes ring slots monotonically and never torches the LAST usable
+slot (a bounded retry loop on the newest checkpoint beats an outage), so the
+ladder terminates and the restart budget still bounds the whole loop.
 
 The child-process design (rather than an in-process loop) is deliberate: a
 warmstart derives progress/sampler state from the checkpoint folder name at
@@ -24,26 +41,29 @@ CONFIG BUILD time, and a fresh process guarantees no poisoned device state,
 wedged threads, or stale jit caches survive into the resumed incarnation.
 
 `runner` is injectable for unit tests (fake exit-code sequences, no processes).
-"""
+It is called as `runner(cmd)` — plus `runner(cmd, env=...)` only for elastic
+children that need process-topology env overrides."""
 
 from __future__ import annotations
 
+import os
 import subprocess
 import sys
 import time
 from pathlib import Path
 from typing import Callable, Optional
 
-from modalities_tpu.resilience.coordination import agree_resume_folder
+from modalities_tpu.resilience.coordination import agree_resume, collect_verified_steps
 from modalities_tpu.resilience.errors import RESUMABLE_EXIT_CODE
+from modalities_tpu.resilience.events import record_event
 from modalities_tpu.resilience.manifest import _seen_steps_of, atomic_write_json, resolve_resume_folder
 from modalities_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
 
 
-def _default_runner(cmd: list[str]) -> int:
-    return subprocess.call(cmd)
+def _default_runner(cmd: list[str], env: Optional[dict] = None) -> int:
+    return subprocess.call(cmd, env=env)
 
 
 def build_child_command(
@@ -88,6 +108,8 @@ def run_resilient(
     resume_quorum: Optional[int] = None,
     resume_vote_deadline_s: float = 120.0,
     coordination_dir: Optional[Path] = None,
+    min_hosts: Optional[int] = None,
+    ladder_after: int = 2,
 ) -> int:
     """Supervise the run; returns the final exit code (0 on success).
 
@@ -95,25 +117,38 @@ def run_resilient(
     (it need not exist yet — a cold start that never checkpoints never resumes).
     `restart_on_crash=True` also restarts non-resumable failures, still bounded
     by `max_restarts`. With `host_count > 1`, resumes go through the cross-host
-    vote (coordination.agree_resume_folder) over `coordination_dir` (default:
-    a `supervisor_votes` folder next to the resume pointer) and the child is
-    pointed at the agreed folder instead of the raw pointer."""
+    vote (coordination.agree_resume) over `coordination_dir` (default: a
+    `supervisor_votes` folder next to the resume pointer) and the child is
+    pointed at the agreed folder instead of the raw pointer. `min_hosts`
+    enables elastic repair (degraded-quorum resume on a shrunk topology);
+    `ladder_after` consecutive failed resumes from one step burn it and walk
+    the ring back a slot."""
     config_file_path = Path(config_file_path)
     info_path = Path(last_checkpoint_info_file_path)
     if coordination_dir is None:
         coordination_dir = info_path.parent / "supervisor_votes"
     coordination_dir = Path(coordination_dir)
+    # exported (not passed per-child) so fakes keep their runner(cmd) signature;
+    # the host_loss fault point reads these to target a whole host (faults.py)
+    os.environ["MODALITIES_TPU_HOST_ID"] = str(host_id)
+    os.environ["MODALITIES_TPU_SUPERVISOR_PID"] = str(os.getpid())
     restarts = 0
     last_resume_step: Optional[int] = None
+    burned_steps: set[int] = set()
+    ladder_step: Optional[int] = None  # step of the last FAILED resume
+    ladder_failures = 0
     while True:
         resume = info_path.is_file()
         child_info_path = info_path
+        child_warmstart_config = warmstart_config_file_path
+        child_env_overrides: dict[str, str] = {}
+        step: Optional[int] = None
         if resume:
             # fail fast (and loudly) here if every checkpoint is unverifiable,
             # rather than letting the child crash-loop through the budget
             try:
                 if host_count > 1:
-                    folder = agree_resume_folder(
+                    agreement = agree_resume(
                         info_path,
                         coordination_dir,
                         host_id=host_id,
@@ -122,13 +157,55 @@ def run_resilient(
                         quorum=resume_quorum,
                         deadline_s=resume_vote_deadline_s,
                         sleep_fn=sleep_fn,
+                        min_hosts=min_hosts,
+                        exclude_steps=frozenset(burned_steps),
                     )
+                    folder = agreement.folder
                 else:
-                    folder = resolve_resume_folder(info_path)
+                    agreement = None
+                    folder = resolve_resume_folder(
+                        info_path, exclude_steps=frozenset(burned_steps)
+                    )
                 logger.info("supervisor: resuming from verified checkpoint %s", folder)
             except (FileNotFoundError, ValueError) as e:
                 logger.error("supervisor: no verifiable checkpoint to resume from: %s", e)
                 return 1
+            if agreement is not None and agreement.degraded:
+                # elastic repair: the voters ARE the new topology — rewrite the
+                # warmstart config for it and override the child's process env
+                # (1 surviving process disables distributed init entirely)
+                surviving = len(agreement.voters)
+                try:
+                    from modalities_tpu.resilience.elastic import (
+                        rewrite_warmstart_config_for_hosts,
+                    )
+
+                    child_warmstart_config = rewrite_warmstart_config_for_hosts(
+                        warmstart_config_file_path or config_file_path,
+                        coordination_dir / f"elastic_warmstart_a{restarts}_h{host_id}.yaml",
+                        surviving_hosts=surviving,
+                        total_hosts=host_count,
+                        resume_folder_name=Path(folder).name,
+                    )
+                except Exception as e:
+                    logger.error("supervisor: elastic config rewrite failed: %s", e)
+                    return 1
+                child_env_overrides = {
+                    "JAX_NUM_PROCESSES": str(surviving),
+                    "JAX_PROCESS_ID": str(agreement.voters.index(host_id)),
+                }
+                record_event(
+                    "elastic/degraded_resume",
+                    host_id=host_id, voters=agreement.voters,
+                    surviving_hosts=surviving, total_hosts=host_count,
+                    step=agreement.step,
+                )
+                logger.warning(
+                    "supervisor: elastic resume as process %s of %d surviving hosts "
+                    "(of %d) from step %d",
+                    child_env_overrides["JAX_PROCESS_ID"], surviving, host_count,
+                    agreement.step,
+                )
             # crash-LOOP detection, not a lifetime cap: a resume target that
             # advanced since the previous restart means the child made real
             # checkpoint progress before dying — reset the budget and backoff
@@ -141,11 +218,14 @@ def run_resilient(
                 )
                 restarts = 0
             last_resume_step = step
-            if host_count > 1:
-                # hand the child the AGREED folder, not the raw pointer (whose
-                # target may not verify on a peer): a per-host pointer file with
-                # the same shape the warmstart CLI already reads
+            if host_count > 1 or burned_steps:
+                # hand the child the RESOLVED folder, not the raw pointer: the
+                # pointer's target may not verify on a peer (multi-host vote) or
+                # may be a burned ladder slot the child would otherwise re-pick.
+                # A per-host pointer file with the same shape the warmstart CLI
+                # already reads
                 child_info_path = coordination_dir / f"agreed_checkpoint_info_h{host_id}.json"
+                coordination_dir.mkdir(parents=True, exist_ok=True)
                 atomic_write_json(
                     child_info_path,
                     {"checkpoint_folder_path": str(Path(folder).absolute())},
@@ -155,16 +235,48 @@ def run_resilient(
             child_info_path,
             experiments_root_path,
             resume=resume,
-            warmstart_config_file_path=warmstart_config_file_path,
+            warmstart_config_file_path=child_warmstart_config,
         )
         logger.info(
             "supervisor: starting %s attempt (restart %d/%d)",
             "warmstart" if resume else "cold", restarts, max_restarts,
         )
-        code = runner(cmd)
+        if child_env_overrides:
+            code = runner(cmd, env={**os.environ, **child_env_overrides})
+        else:
+            code = runner(cmd)
         if code == 0:
             logger.info("supervisor: run completed successfully")
             return 0
+        # degradation ladder: repeated deaths right after resuming from the
+        # same step mean that checkpoint does not restore a viable run — burn
+        # it so the next resolution walks the ring back one slot
+        if step is not None:
+            if step == ladder_step:
+                ladder_failures += 1
+            else:
+                ladder_step, ladder_failures = step, 1
+            # burn only when the ring HAS an older usable slot: torching the
+            # last restorable checkpoint would turn a bounded retry loop into
+            # an immediate outage, which is strictly worse
+            fallback_exists = bool(
+                collect_verified_steps(
+                    info_path, exclude_steps=frozenset(burned_steps | {step})
+                )
+            )
+            if ladder_failures >= ladder_after and fallback_exists:
+                burned_steps.add(step)
+                ladder_step, ladder_failures = None, 0
+                record_event(
+                    "elastic/degradation_ladder",
+                    host_id=host_id, burned_step=step,
+                    burned_steps=sorted(burned_steps), after_failures=ladder_after,
+                )
+                logger.warning(
+                    "supervisor: degradation ladder burned checkpoint step %d after "
+                    "%d consecutive failed resumes — walking the ring back",
+                    step, ladder_after,
+                )
         resumable = code == RESUMABLE_EXIT_CODE
         if not (resumable or restart_on_crash):
             logger.error("supervisor: child failed non-resumably (exit %d) — giving up", code)

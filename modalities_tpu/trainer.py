@@ -55,6 +55,7 @@ from modalities_tpu.resilience.events import record_event
 from modalities_tpu.resilience.faults import (
     fire_sigterm_if_armed,
     fire_sigterm_one_rank_if_armed,
+    host_loss_if_armed,
     peer_death_if_armed,
     peer_hang_if_armed,
 )
@@ -295,9 +296,10 @@ class Trainer:
 
                 # distributed chaos fire sites (multi-process tests arm these in
                 # ONE rank's environment): a wedged peer, an abrupt peer death,
-                # a SIGTERM delivered to a single rank
+                # a permanently lost host, a SIGTERM delivered to a single rank
                 peer_hang_if_armed(step_id)
                 peer_death_if_armed(step_id)
+                host_loss_if_armed(step_id)
                 if self.preemption is not None:
                     fired = fire_sigterm_if_armed(step_id)  # chaos: sigterm_at_step@N
                     fired = fire_sigterm_one_rank_if_armed(step_id) or fired

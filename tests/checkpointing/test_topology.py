@@ -1,0 +1,174 @@
+"""Topology record + elastic reshard-at-load tests: the sealed topology.json
+round-trip, mismatch detection as telemetry (not as an error), the manifest
+downgrade during an elastic restore, and the elastic=False pin that keeps the
+same-topology load path byte-identical to the pre-topology loader."""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from modalities_tpu.checkpointing.orbax.orbax_checkpoint_loading import OrbaxCheckpointLoading
+from modalities_tpu.checkpointing.stateful.app_state import AppState, AppStateHandle
+from modalities_tpu.checkpointing.topology import (
+    TOPOLOGY_FILE_NAME,
+    describe_topology,
+    diff_topology,
+    read_topology,
+    write_topology,
+)
+from modalities_tpu.exceptions import CheckpointingError
+from modalities_tpu.resilience.events import counts_since, snapshot_counts
+from modalities_tpu.resilience.manifest import MANIFEST_FILE_NAME, write_manifest
+
+
+def _mesh(n_devices):
+    devices = np.array(jax.devices()[:n_devices]).reshape((n_devices,))
+    return Mesh(devices, ("dp_shard",))
+
+
+def _state_and_shardings(mesh):
+    sharded = NamedSharding(mesh, PartitionSpec("dp_shard"))
+    replicated = NamedSharding(mesh, PartitionSpec())
+    state = AppState(
+        params={"w": jax.device_put(jnp.arange(16, dtype=jnp.float32), sharded)},
+        opt_state={"m": jax.device_put(jnp.ones(16, dtype=jnp.float32), sharded)},
+        step=jax.device_put(jnp.asarray(3, dtype=jnp.int32), replicated),
+    )
+    shardings = AppState(
+        params={"w": sharded}, opt_state={"m": sharded}, step=replicated
+    )
+    return state, shardings
+
+
+def _save_checkpoint(tmp_path, state):
+    import orbax.checkpoint as ocp
+
+    folder = tmp_path / "eid_x-seen_steps_3-seen_tokens_12-target_steps_8-target_tokens_32"
+    checkpointer = ocp.StandardCheckpointer()
+    checkpointer.save(folder.absolute(), state)
+    checkpointer.wait_until_finished()
+    return folder
+
+
+# ----------------------------------------------------------------- record units
+
+
+def test_topology_round_trip_and_self_diff(tmp_path):
+    _, shardings = _state_and_shardings(_mesh(8))
+    write_topology(tmp_path, shardings)
+    saved = read_topology(tmp_path)
+    assert saved is not None
+    assert saved["mesh_axes"] == {"dp_shard": 8}
+    assert saved["device_count"] == 8
+    assert saved["sampler_state"]["dp_degree"] == 8
+    assert saved["sampler_state"]["skip_semantics"] == "global"
+    assert any("params" in k and "w" in k for k in saved["leaf_specs"])
+    assert diff_topology(saved, describe_topology(shardings)) == []
+
+
+def test_topology_diff_reports_mesh_change(tmp_path):
+    _, shardings_8 = _state_and_shardings(_mesh(8))
+    _, shardings_4 = _state_and_shardings(_mesh(4))
+    mismatches = diff_topology(describe_topology(shardings_8), describe_topology(shardings_4))
+    assert mismatches, "an 8->4 device mesh change must be reported"
+    assert any("dp_shard" in m or "device" in m for m in mismatches)
+
+
+def test_read_topology_tolerates_legacy_and_garbage(tmp_path):
+    assert read_topology(tmp_path) is None  # pre-topology checkpoint
+    (tmp_path / TOPOLOGY_FILE_NAME).write_text("{not json")
+    assert read_topology(tmp_path) is None
+
+
+def test_write_topology_is_advisory(tmp_path):
+    # a save must never fail because the topology record could not be written
+    write_topology(tmp_path / "missing" / "folder", object())  # no raise
+    _, shardings = _state_and_shardings(_mesh(4))
+    write_topology(tmp_path / "also" / "missing", shardings)  # no raise
+
+
+# ------------------------------------------------- elastic reshard-at-load e2e
+
+
+def test_reshard_at_load_restores_on_smaller_mesh(tmp_path):
+    """Save under an 8-way dp mesh, restore under a 4-way one: values must come
+    back exactly, and the topology mismatch must surface as elastic/* events —
+    including the manifest downgrade when the folder fails verification."""
+    state_8, shardings_8 = _state_and_shardings(_mesh(8))
+    folder = _save_checkpoint(tmp_path, state_8)
+    write_topology(folder, shardings_8)
+    write_manifest(folder)
+
+    state_4, shardings_4 = _state_and_shardings(_mesh(4))
+    handle = AppStateHandle(state_4, shardings_4, tx=None, lr_fn=None, model=None)
+    before = snapshot_counts()
+    restored = OrbaxCheckpointLoading(elastic=True).load_app_state(handle, folder)
+    assert counts_since(before).get("elastic", 0) == 1  # the reshard event
+    np.testing.assert_array_equal(np.asarray(restored.params["w"]), np.arange(16, dtype=np.float32))
+    np.testing.assert_array_equal(np.asarray(restored.opt_state["m"]), np.ones(16, dtype=np.float32))
+    assert int(restored.step) == 3
+    assert restored.params["w"].sharding.mesh.devices.size == 4
+
+
+def test_reshard_downgrades_manifest_failure_to_event(tmp_path):
+    """During an elastic restore a manifest failure (a lost host's files) is an
+    event, not an error; the SAME failure without a topology change still
+    refuses the restore."""
+    state_8, shardings_8 = _state_and_shardings(_mesh(8))
+    folder = _save_checkpoint(tmp_path, state_8)
+    write_topology(folder, shardings_8)
+    write_manifest(folder)
+    manifest = json.loads((folder / MANIFEST_FILE_NAME).read_text())
+    manifest["files"][0]["size"] += 1  # verification now fails, data is intact
+    (folder / MANIFEST_FILE_NAME).write_text(json.dumps(manifest))
+
+    state_4, shardings_4 = _state_and_shardings(_mesh(4))
+    handle = AppStateHandle(state_4, shardings_4, tx=None, lr_fn=None, model=None)
+    before = snapshot_counts()
+    restored = OrbaxCheckpointLoading(elastic=True).load_app_state(handle, folder)
+    assert int(restored.step) == 3
+    assert counts_since(before).get("elastic", 0) == 2  # reshard + downgrade
+
+    # same corrupt manifest, same topology: the integrity gate still holds
+    state_8b, shardings_8b = _state_and_shardings(_mesh(8))
+    handle_same = AppStateHandle(state_8b, shardings_8b, tx=None, lr_fn=None, model=None)
+    with pytest.raises(CheckpointingError, match="refusing to restore"):
+        OrbaxCheckpointLoading(elastic=True).load_app_state(handle_same, folder)
+
+
+# -------------------------------------------------------------- elastic=False pin
+
+
+def test_elastic_off_is_the_pre_topology_loader(tmp_path, monkeypatch):
+    """elastic=False must never even READ the topology record (pinning the
+    pre-topology load path), must restore a same-topology checkpoint, and must
+    keep raising on manifest failure regardless of any topology mismatch."""
+    import modalities_tpu.checkpointing.orbax.orbax_checkpoint_loading as loading_mod
+
+    def _boom(*_a, **_k):
+        raise AssertionError("elastic=False read the topology record")
+
+    monkeypatch.setattr(loading_mod, "read_topology", _boom)
+
+    state_8, shardings_8 = _state_and_shardings(_mesh(8))
+    folder = _save_checkpoint(tmp_path, state_8)
+    write_topology(folder, shardings_8)
+    write_manifest(folder)
+
+    state_b, shardings_b = _state_and_shardings(_mesh(8))
+    handle = AppStateHandle(state_b, shardings_b, tx=None, lr_fn=None, model=None)
+    restored = OrbaxCheckpointLoading(elastic=False).load_app_state(handle, folder)
+    assert int(restored.step) == 3
+
+    # manifest failure + topology mismatch: still a hard error with elastic off
+    manifest = json.loads((folder / MANIFEST_FILE_NAME).read_text())
+    manifest["files"][0]["size"] += 1
+    (folder / MANIFEST_FILE_NAME).write_text(json.dumps(manifest))
+    state_4, shardings_4 = _state_and_shardings(_mesh(4))
+    handle_4 = AppStateHandle(state_4, shardings_4, tx=None, lr_fn=None, model=None)
+    with pytest.raises(CheckpointingError, match="refusing to restore"):
+        OrbaxCheckpointLoading(elastic=False).load_app_state(handle_4, folder)

@@ -96,9 +96,74 @@ def test_len_matches_iteration_length():
         assert len(list(s)) == len(s)
 
 
+def _global_order(n, replicas, skip, shuffle=True, seed=7):
+    """Round-robin interleave of the per-rank streams — the order the cluster as
+    a whole consumes samples (rank r holds indices[r::replicas] of the tail)."""
+    shards = [
+        list(
+            ResumableDistributedSampler(
+                _Dataset(n), rank=r, num_replicas=replicas, drop_last=True,
+                shuffle=shuffle, seed=seed, skip_num_global_samples=skip,
+            )
+        )
+        for r in range(replicas)
+    ]
+    return [idx for row in zip(*shards) for idx in row]
+
+
+@pytest.mark.parametrize("shuffle", [False, True])
+def test_dp_resize_preserves_global_sample_order(shuffle):
+    """THE elastic-resume invariant: the skip is a GLOBAL count over an
+    epoch-seeded permutation, so resuming the same skip on ANY dp degree
+    consumes the identical remaining samples in the identical global order —
+    a topology change only restripes rows over ranks."""
+    orders = {dp: _global_order(64, dp, skip=16, shuffle=shuffle) for dp in (1, 2, 4, 8)}
+    for dp, order in orders.items():
+        assert order == orders[1], f"dp={dp} changed the global consumption order"
+
+
+def test_dp_resize_preserves_token_accounting():
+    """Shrinking dp=4 to dp=2 at a step boundary: the first post-resume global
+    batch under the new topology starts exactly where the old one stopped, so
+    seen-token counts stay truthful across the resize."""
+    n, skip, mbs = 64, 16, 2
+    old_consumed = set(range(skip))  # global skip marks what the dp=4 run consumed
+    resumed = _global_order(n, 2, skip=skip, shuffle=True)
+    full = _global_order(n, 1, skip=0, shuffle=True)
+    assert set(full[:skip]) | set(resumed) == set(full)
+    assert len(old_consumed) + len(resumed) == n
+    # the first new-global-batch (mbs * dp = 4 rows) is the old stream's next 4
+    assert resumed[: mbs * 2] == full[skip : skip + mbs * 2]
+
+
 def test_batch_sampler_respects_drop_last():
     inner = ResumableDistributedSampler(_Dataset(22), rank=0, num_replicas=2, drop_last=True)
     dropped = list(BatchSampler(inner, batch_size=4, drop_last=True))
     kept = list(BatchSampler(inner, batch_size=4, drop_last=False))
     assert all(len(b) == 4 for b in dropped)
     assert len(kept) == len(dropped) + 1 and len(kept[-1]) == 11 % 4
+
+
+def test_batch_sampler_factory_flags_misaligned_resume_skip():
+    """After an elastic dp resize the global skip must still be a whole number
+    of steps under the NEW global batch; a misaligned skip is flagged as an
+    elastic/* event (the run proceeds — order is still correct, only step
+    boundaries shear)."""
+    from modalities_tpu.dataloader.sampler_factory import BatchSamplerFactory
+    from modalities_tpu.resilience.events import counts_since, snapshot_counts
+    from modalities_tpu.running_env.device_mesh import get_device_mesh
+
+    mesh = get_device_mesh(device_type="cpu", data_parallel_shard_degree=4, world_size=4)
+    aligned = ResumableDistributedSampler(
+        _Dataset(64), rank=0, num_replicas=4, skip_num_global_samples=16
+    )
+    misaligned = ResumableDistributedSampler(
+        _Dataset(64), rank=0, num_replicas=4, skip_num_global_samples=18
+    )
+
+    before = snapshot_counts()
+    BatchSamplerFactory.create_batch_sampler(aligned, batch_size=2, device_mesh=mesh)
+    assert counts_since(before).get("elastic", 0) == 0
+    # skip=18 is not a multiple of the global batch (mbs 2 * dp 4 = 8)
+    BatchSamplerFactory.create_batch_sampler(misaligned, batch_size=2, device_mesh=mesh)
+    assert counts_since(before).get("elastic", 0) == 1

@@ -1,0 +1,49 @@
+"""First coverage for the HuggingFace passthrough model: offline load of a
+locally-saved tiny Flax checkpoint through the NNModel interface, and the
+clear torch-only/unloadable error contract."""
+
+import numpy as np
+import pytest
+
+from modalities_tpu.models.huggingface.huggingface_model import HuggingFacePretrainedModel
+
+
+@pytest.fixture(scope="module")
+def tiny_flax_gpt2_dir(tmp_path_factory):
+    transformers = pytest.importorskip("transformers")
+    config = transformers.GPT2Config(
+        vocab_size=128, n_positions=32, n_embd=16, n_layer=1, n_head=2
+    )
+    model = transformers.FlaxGPT2LMHeadModel(config, seed=0)
+    path = tmp_path_factory.mktemp("hf") / "tiny_gpt2"
+    model.save_pretrained(path)
+    return path
+
+
+def test_loads_local_flax_checkpoint_through_nnmodel_interface(tiny_flax_gpt2_dir):
+    import jax
+
+    model = HuggingFacePretrainedModel(
+        model_type="gpt2",
+        model_name=str(tiny_flax_gpt2_dir),
+        sample_key="input_ids",
+        prediction_key="logits",
+    )
+    params = model.init_params(jax.random.PRNGKey(0))
+    tokens = np.arange(8, dtype=np.int32).reshape(1, 8) % 128
+    out = model.apply(params, {"input_ids": tokens})
+    assert set(out) == {"logits"}
+    assert out["logits"].shape == (1, 8, 128)
+    # deterministic apply: same params + inputs -> same logits
+    again = model.apply(params, {"input_ids": tokens})
+    np.testing.assert_array_equal(np.asarray(out["logits"]), np.asarray(again["logits"]))
+
+
+def test_unloadable_model_raises_the_clear_flax_error(tmp_path):
+    with pytest.raises(RuntimeError, match="as a Flax model"):
+        HuggingFacePretrainedModel(
+            model_type="gpt2",
+            model_name=str(tmp_path / "not_a_model"),
+            sample_key="input_ids",
+            prediction_key="logits",
+        )

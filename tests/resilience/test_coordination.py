@@ -13,6 +13,7 @@ from modalities_tpu.resilience.coordination import (
     VOTE_CONTINUE,
     VOTE_ROLLBACK,
     VOTE_STOP,
+    agree_resume,
     agree_resume_folder,
     collect_verified_steps,
     make_ballot,
@@ -153,6 +154,133 @@ def test_agree_resume_folder_quorum_below_host_count(tmp_path):
         quorum=1, deadline_s=5.0, sleep_fn=lambda s: None,
     )
     assert agreed == ok8
+
+
+def test_collect_verified_steps_excludes_burned(tmp_path):
+    ring = tmp_path / "checkpoints"
+    ok4 = _seal(ring, 4)
+    _seal(ring, 8)
+    info_path = _pointer(ring, ok4)
+    assert sorted(collect_verified_steps(info_path)) == [4, 8]
+    assert sorted(collect_verified_steps(info_path, exclude_steps={8})) == [4]
+
+
+def test_three_disagreeing_rings_agree_on_the_common_step(tmp_path):
+    """Three hosts with genuinely different ring views — overlapping but
+    unequal step sets — must all derive the same answer: the newest step in the
+    full intersection, not any host's local newest."""
+    ring = tmp_path / "checkpoints"
+    _seal(ring, 4)
+    _seal(ring, 8)
+    ok12 = _seal(ring, 12)
+    info_path = _pointer(ring, ok12)  # this host (0) verified {4, 8, 12}
+    votes = tmp_path / "votes"
+    votes.mkdir()
+    # host 1 lost step 12 to corruption; host 2 only ever synced up to step 8
+    atomic_write_json(
+        votes / "resume_vote_a0_h1.json", {"host_id": 1, "attempt": 0, "steps": [4, 8]}
+    )
+    atomic_write_json(
+        votes / "resume_vote_a0_h2.json", {"host_id": 2, "attempt": 0, "steps": [8]}
+    )
+    agreement = agree_resume(
+        info_path, votes, host_id=0, host_count=3, attempt=0, deadline_s=5.0,
+        sleep_fn=lambda s: None,
+    )
+    assert agreement.step == 8  # in all three rings; 12 is not
+    assert agreement.voters == [0, 1, 2]
+    assert not agreement.degraded
+
+
+def test_disagreeing_rings_with_empty_three_way_intersection_fail(tmp_path):
+    """Pairwise overlap is not enough: {12}, {8}, {8,12} share no common step,
+    and a resume from ANY of them would leave some host unable to restore."""
+    ring = tmp_path / "checkpoints"
+    ok12 = _seal(ring, 12)
+    info_path = _pointer(ring, ok12)  # host 0 verified only {12}
+    votes = tmp_path / "votes"
+    votes.mkdir()
+    atomic_write_json(
+        votes / "resume_vote_a0_h1.json", {"host_id": 1, "attempt": 0, "steps": [8]}
+    )
+    atomic_write_json(
+        votes / "resume_vote_a0_h2.json", {"host_id": 2, "attempt": 0, "steps": [8, 12]}
+    )
+    with pytest.raises(FileNotFoundError, match="no checkpoint step verifies"):
+        agree_resume(
+            info_path, votes, host_id=0, host_count=3, attempt=0, deadline_s=5.0,
+            sleep_fn=lambda s: None,
+        )
+
+
+def _expiring_clock():
+    state = [0.0]
+
+    def clock():
+        return state[0]
+
+    def sleep(seconds):
+        state[0] += seconds
+
+    return clock, sleep
+
+
+def test_agree_resume_degraded_quorum_on_min_hosts(tmp_path):
+    """Deadline expiry with voters >= min_hosts: the agreement is computed over
+    the surviving voter set and flagged degraded — the supervisor's cue to
+    shrink the topology instead of failing the resume."""
+    ring = tmp_path / "checkpoints"
+    _seal(ring, 4)
+    ok8 = _seal(ring, 8)
+    info_path = _pointer(ring, ok8)
+    votes = tmp_path / "votes"
+    votes.mkdir()
+    atomic_write_json(
+        votes / "resume_vote_a0_h2.json", {"host_id": 2, "attempt": 0, "steps": [4, 8]}
+    )
+    clock, sleep = _expiring_clock()
+    agreement = agree_resume(
+        info_path, votes, host_id=0, host_count=3, attempt=0, deadline_s=3.0,
+        sleep_fn=sleep, clock=clock, min_hosts=2,
+    )
+    assert agreement.degraded
+    assert agreement.voters == [0, 2]  # host 1 is the casualty
+    assert agreement.step == 8
+    assert agreement.folder == ok8
+
+
+def test_agree_resume_below_min_hosts_still_fails(tmp_path):
+    """min_hosts is a floor, not a bypass: fewer voters than min_hosts at the
+    deadline is still a fatal missed quorum."""
+    ring = tmp_path / "checkpoints"
+    info_path = _pointer(ring, _seal(ring, 4))
+    clock, sleep = _expiring_clock()
+    with pytest.raises(FileNotFoundError, match="quorum"):
+        agree_resume(
+            info_path, tmp_path / "votes", host_id=0, host_count=3, attempt=0,
+            deadline_s=3.0, sleep_fn=sleep, clock=clock, min_hosts=2,
+        )
+
+
+def test_agree_resume_excludes_burned_steps_from_votes(tmp_path):
+    """A burned ladder step must vanish from this host's OWN vote, so the whole
+    cluster converges below it."""
+    ring = tmp_path / "checkpoints"
+    ok4 = _seal(ring, 4)
+    ok8 = _seal(ring, 8)
+    info_path = _pointer(ring, ok8)
+    votes = tmp_path / "votes"
+    votes.mkdir()
+    atomic_write_json(
+        votes / "resume_vote_a1_h1.json", {"host_id": 1, "attempt": 1, "steps": [4, 8]}
+    )
+    agreement = agree_resume(
+        info_path, votes, host_id=0, host_count=2, attempt=1, deadline_s=5.0,
+        sleep_fn=lambda s: None, exclude_steps=frozenset({8}),
+    )
+    assert agreement.step == 4 and agreement.folder == ok4
+    vote_0 = json.loads((votes / "resume_vote_a1_h0.json").read_text())
+    assert vote_0["steps"] == [4]
 
 
 # ------------------------------------------------------------- HLO contract

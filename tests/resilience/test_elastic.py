@@ -1,0 +1,110 @@
+"""Elastic config-rewrite units: feasible-mesh recomputation for a shrunk host
+set, token retargeting from the resume folder name, and the hard edges
+(infeasible model-parallel product, interpolated degrees, uneven host split)."""
+
+import pytest
+import yaml
+
+from modalities_tpu.exceptions import ConfigError
+from modalities_tpu.resilience.elastic import (
+    recompute_mesh_degrees,
+    rewrite_warmstart_config_for_hosts,
+)
+
+
+def _mesh(**overrides):
+    base = {
+        "device_type": "cpu",
+        "data_parallel_replicate_degree": 2,
+        "data_parallel_shard_degree": 2,
+        "tensor_parallel_degree": 2,
+        "pipeline_parallel_degree": 1,
+        "context_parallel_degree": 1,
+        "world_size": 8,
+    }
+    base.update(overrides)
+    return base
+
+
+def test_recompute_shrinks_along_dp_keeping_model_parallel():
+    new = recompute_mesh_degrees(_mesh(), new_world_size=4)
+    assert new["world_size"] == 4
+    assert new["tensor_parallel_degree"] == 2  # shape-pinned: kept
+    assert new["data_parallel_replicate_degree"] == 1  # collapsed
+    assert new["data_parallel_shard_degree"] == 2  # 4 // (tp 2)
+
+
+def test_recompute_rejects_infeasible_model_parallel_product():
+    with pytest.raises(ConfigError, match="no feasible mesh"):
+        recompute_mesh_degrees(_mesh(tensor_parallel_degree=4), new_world_size=6)
+    with pytest.raises(ConfigError, match="no feasible mesh"):
+        recompute_mesh_degrees(_mesh(tensor_parallel_degree=4), new_world_size=2)
+
+
+def test_recompute_rejects_interpolated_degrees():
+    with pytest.raises(ConfigError, match="concrete tensor_parallel_degree"):
+        recompute_mesh_degrees(_mesh(tensor_parallel_degree="${oops}"), new_world_size=4)
+
+
+def _config(tmp_path, mesh=None, profile=None):
+    raw = {
+        "device_mesh": {"config": mesh or _mesh()},
+        "settings": {
+            "step_profile": profile
+            or {
+                "local_train_micro_batch_size": 4,
+                "sequence_length": 8,
+                "gradient_accumulation_steps": 1,
+            },
+            "training_target": {"num_target_steps": 10, "num_target_tokens": 999},
+            "interp": "${device_mesh.config.world_size}",
+        },
+    }
+    path = tmp_path / "warm.yaml"
+    path.write_text(yaml.safe_dump(raw))
+    return path
+
+
+def test_rewrite_shrinks_world_and_retargets_tokens(tmp_path):
+    out = rewrite_warmstart_config_for_hosts(
+        _config(tmp_path), tmp_path / "elastic.yaml", surviving_hosts=1, total_hosts=2,
+        resume_folder_name="eid_x-seen_steps_6-seen_tokens_768-target_steps_10-target_tokens_999",
+    )
+    rewritten = yaml.safe_load(out.read_text())
+    mesh = rewritten["device_mesh"]["config"]
+    assert mesh["world_size"] == 4 and mesh["data_parallel_shard_degree"] == 2
+    # 768 seen + 4 remaining steps * mbs 4 * seq 8 * acc 1 * dp 2
+    assert rewritten["settings"]["training_target"]["num_target_tokens"] == 768 + 4 * 4 * 8 * 2
+    # ${...} interpolation strings must survive the round-trip untouched
+    assert rewritten["settings"]["interp"] == "${device_mesh.config.world_size}"
+
+
+def test_rewrite_leaves_tokens_alone_without_concrete_profile(tmp_path):
+    cfg = _config(
+        tmp_path,
+        profile={
+            "local_train_micro_batch_size": "${oops}",
+            "sequence_length": 8,
+            "gradient_accumulation_steps": 1,
+        },
+    )
+    out = rewrite_warmstart_config_for_hosts(
+        cfg, tmp_path / "elastic.yaml", surviving_hosts=1, total_hosts=2,
+        resume_folder_name="eid_x-seen_steps_6-seen_tokens_768-target_steps_10-target_tokens_999",
+    )
+    rewritten = yaml.safe_load(out.read_text())
+    assert rewritten["settings"]["training_target"]["num_target_tokens"] == 999  # untouched
+    assert rewritten["device_mesh"]["config"]["world_size"] == 4  # mesh still shrunk
+
+
+def test_rewrite_rejects_uneven_host_split_and_missing_world(tmp_path):
+    with pytest.raises(ConfigError, match="not evenly split"):
+        rewrite_warmstart_config_for_hosts(
+            _config(tmp_path), tmp_path / "e.yaml", surviving_hosts=2, total_hosts=3
+        )
+    bad = tmp_path / "bad.yaml"
+    bad.write_text(yaml.safe_dump({"device_mesh": {"config": {"world_size": "${ws}"}}}))
+    with pytest.raises(ConfigError, match="no concrete"):
+        rewrite_warmstart_config_for_hosts(
+            bad, tmp_path / "e.yaml", surviving_hosts=1, total_hosts=2
+        )

@@ -25,6 +25,7 @@ FIRE_SITES = {
     "sigterm_one_rank": "fire_sigterm_one_rank_if_armed",
     "peer_hang": "peer_hang_if_armed",
     "peer_death": "peer_death_if_armed",
+    "host_loss": "host_loss_if_armed",
 }
 
 

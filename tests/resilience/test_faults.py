@@ -105,6 +105,7 @@ def test_registry_is_the_documented_set():
         "sigterm_one_rank",
         "peer_hang",
         "peer_death",
+        "host_loss",
     )
     assert ENV_VAR == "MODALITIES_TPU_FAULTS"
 
@@ -146,3 +147,32 @@ def test_peer_hang_sleeps_and_peer_death_exits(monkeypatch):
     assert not peer_death_if_armed(3)
     assert peer_death_if_armed(4)
     assert exits == [1]
+
+
+def test_host_loss_kills_supervisor_then_itself(monkeypatch):
+    """host_loss must take the TARGET host's supervisor down first (SIGKILL to
+    the exported pid) and then die abruptly — and must ignore non-target hosts
+    without consuming the shot (they would otherwise mask the real loss)."""
+    from modalities_tpu.resilience.faults import host_loss_if_armed
+
+    exits, kills = [], []
+    monkeypatch.setattr(faults.os, "_exit", exits.append)
+    monkeypatch.setattr(faults.os, "kill", lambda pid, sig: kills.append((pid, sig)))
+
+    # this process is host 1; the fault targets host 0 — never fires here
+    monkeypatch.setenv("MODALITIES_TPU_HOST_ID", "1")
+    monkeypatch.setenv("MODALITIES_TPU_SUPERVISOR_PID", "54321")
+    arm_faults("host_loss@2:0")
+    assert not host_loss_if_armed(2)
+    assert get_fault("host_loss") is not None  # shot NOT consumed off-target
+    assert exits == [] and kills == []
+
+    # retargeted at host 1: fires at its step only, supervisor kill first
+    clear_faults()
+    arm_faults("host_loss@2:1")
+    assert not host_loss_if_armed(1)
+    assert host_loss_if_armed(2)
+    assert kills == [(54321, signal.SIGKILL)]
+    assert exits == [1]
+    assert not host_loss_if_armed(2)  # one-shot
+    clear_faults()

@@ -282,6 +282,152 @@ def test_multihost_resume_quorum_timeout_fails_fast(tmp_path):
     assert runner.commands == []  # no child started on a divergent cluster
 
 
+# ----------------------------------------------------- elastic repair + ladder
+
+
+def test_degradation_ladder_burns_repeatedly_failing_step(tmp_path):
+    """Two consecutive failed resumes from the same step burn it: the third
+    incarnation walks the ring back a slot and is pointed at the OLDER folder
+    via the override pointer (the raw pointer still names the burned step)."""
+    ring = tmp_path / "ring"
+    folders = _seal_host_ring(ring, [4, 8])
+    votes = tmp_path / "votes"
+    runner = FakeRunner([RESUMABLE_EXIT_CODE, RESUMABLE_EXIT_CODE, 0])
+    code = run_resilient(
+        config_file_path=tmp_path / "config.yaml",
+        last_checkpoint_info_file_path=ring / "last_checkpoint_info.json",
+        runner=runner,
+        sleep_fn=lambda _s: None,
+        coordination_dir=votes,
+        ladder_after=2,
+    )
+    assert code == 0
+    assert len(runner.commands) == 3
+    # incarnations 1+2 got the raw pointer (step 8); incarnation 3 the override
+    override = votes / "agreed_checkpoint_info_h0.json"
+    assert str(override) not in runner.commands[0]
+    assert str(override) in runner.commands[2]
+    agreed = json.loads(override.read_text())
+    assert agreed["checkpoint_folder_path"] == str(folders[4].absolute())
+
+
+def test_ladder_never_burns_the_last_usable_slot(tmp_path):
+    """With a single checkpoint in the ring the ladder must stand down: burning
+    the only restorable folder would turn a bounded retry loop into an outage.
+    The restart budget still bounds the loop exactly as pre-ladder."""
+    folder = _seal_pointer(tmp_path)
+    code, runner, naps = _supervise(
+        tmp_path, [RESUMABLE_EXIT_CODE] * 4, max_restarts=3, ladder_after=1
+    )
+    assert code == RESUMABLE_EXIT_CODE
+    assert len(runner.commands) == 4
+    # every incarnation resumed from the one (never-burned) folder
+    assert all(str(tmp_path / "last_checkpoint_info.json") in c for c in runner.commands)
+
+
+class FakeEnvRunner(FakeRunner):
+    """The elastic child protocol: runner(cmd, env=...) only for children that
+    need process-topology overrides; plain runner(cmd) otherwise."""
+
+    def __init__(self, exit_codes):
+        super().__init__(exit_codes)
+        self.envs = []
+
+    def __call__(self, cmd, env=None):
+        self.envs.append(env)
+        return super().__call__(cmd)
+
+
+def test_degraded_quorum_resumes_elastic_on_shrunk_topology(tmp_path):
+    """host 2 of 3 is gone for good: the vote deadline expires with 2 voters >=
+    min_hosts, and the supervisor launches the child on the surviving topology —
+    rewritten warmstart config (world 6 -> 4, dp re-inferred around the kept tp)
+    plus JAX process-env overrides for the shrunk cluster."""
+    import yaml
+
+    ring = tmp_path / "ring"
+    _seal_host_ring(ring, [4, 8])
+    votes = tmp_path / "votes"
+    votes.mkdir()
+    atomic_write_json(
+        votes / "resume_vote_a0_h1.json", {"host_id": 1, "attempt": 0, "steps": [4, 8]}
+    )
+    warm = tmp_path / "warm.yaml"
+    warm.write_text(
+        yaml.safe_dump(
+            {
+                "device_mesh": {
+                    "config": {
+                        "device_type": "cpu",
+                        "data_parallel_replicate_degree": 1,
+                        "data_parallel_shard_degree": 3,
+                        "tensor_parallel_degree": 2,
+                        "world_size": 6,
+                    }
+                },
+                "settings": {
+                    "step_profile": {
+                        "local_train_micro_batch_size": 2,
+                        "sequence_length": 4,
+                        "gradient_accumulation_steps": 1,
+                    },
+                    "training_target": {"num_target_steps": 12, "num_target_tokens": 999},
+                },
+            }
+        )
+    )
+
+    runner = FakeEnvRunner([0])
+    code = run_resilient(
+        config_file_path=tmp_path / "config.yaml",
+        last_checkpoint_info_file_path=ring / "last_checkpoint_info.json",
+        warmstart_config_file_path=warm,
+        runner=runner,
+        sleep_fn=lambda _s: None,
+        host_count=3,
+        host_id=0,
+        resume_vote_deadline_s=0.0,  # host 2 never votes
+        min_hosts=2,
+        coordination_dir=votes,
+    )
+    assert code == 0
+    assert len(runner.commands) == 1
+    env = runner.envs[0]
+    assert env["JAX_NUM_PROCESSES"] == "2"
+    assert env["JAX_PROCESS_ID"] == "0"  # host 0's index in voters [0, 1]
+
+    elastic_cfg_path = votes / "elastic_warmstart_a0_h0.yaml"
+    assert str(elastic_cfg_path) in runner.commands[0]
+    rewritten = yaml.safe_load(elastic_cfg_path.read_text())
+    mesh = rewritten["device_mesh"]["config"]
+    assert mesh["world_size"] == 4  # 6 devices / 3 hosts * 2 survivors
+    assert mesh["tensor_parallel_degree"] == 2  # shape-pinned axes kept
+    assert mesh["data_parallel_replicate_degree"] == 1
+    assert mesh["data_parallel_shard_degree"] == 2  # re-inferred from what's left
+    # agreed step 8 (seen_tokens 32): 32 + (12-8) steps * mbs 2 * seq 4 * dp 2
+    assert rewritten["settings"]["training_target"]["num_target_tokens"] == 96
+
+
+def test_min_hosts_unset_keeps_missed_quorum_fatal(tmp_path):
+    """Without min_hosts the elastic path must not engage: a missed quorum is
+    the same fail-fast outage as pre-elastic (pinned behavior)."""
+    ring = tmp_path / "ring"
+    _seal_host_ring(ring, [4])
+    runner = FakeEnvRunner([0])
+    code = run_resilient(
+        config_file_path=tmp_path / "config.yaml",
+        last_checkpoint_info_file_path=ring / "last_checkpoint_info.json",
+        runner=runner,
+        sleep_fn=lambda _s: None,
+        host_count=3,
+        host_id=0,
+        resume_vote_deadline_s=0.0,
+        coordination_dir=tmp_path / "votes",
+    )
+    assert code == 1
+    assert runner.commands == []
+
+
 # ------------------------------------------------------------------ preemption
 
 

@@ -1,0 +1,29 @@
+"""Static closure: every MODALITIES_TPU_* environment variable the code reads
+must be documented by its FULL name in docs/components.md's environment-variable
+reference. An undocumented knob is an ops hazard — it changes behavior on a pod
+without appearing in any runbook."""
+
+import re
+from pathlib import Path
+
+REPO = Path(__file__).parent.parent
+ENV_VAR = re.compile(r"MODALITIES_TPU_[A-Z0-9_]+")
+
+
+def _vars_in(text: str) -> set[str]:
+    return set(ENV_VAR.findall(text))
+
+
+def test_every_env_var_read_by_the_code_is_documented():
+    code_vars: dict[str, str] = {}
+    for path in sorted((REPO / "modalities_tpu").rglob("*.py")):
+        for var in _vars_in(path.read_text()):
+            code_vars.setdefault(var, str(path.relative_to(REPO)))
+    assert code_vars, "env-var scan found nothing — repo layout changed?"
+
+    doc_vars = _vars_in((REPO / "docs" / "components.md").read_text())
+    missing = {v: where for v, where in code_vars.items() if v not in doc_vars}
+    assert not missing, (
+        "environment variables read by the code but absent from "
+        f"docs/components.md: {missing}"
+    )
