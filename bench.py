@@ -511,6 +511,17 @@ def _run_candidate(cand, iters: int):
     on_tpu = dev.platform == "tpu"
 
     n_params = sum(int(np.prod(x.shape)) for x in jax.tree.leaves(state.params))
+    # per-device optimizer-state footprint from the ACTUAL shard shapes, so a
+    # zero_stage=1 run shows the 1/dp_replicate shrink in the scoreboard line
+    opt_state_bytes_per_device = sum(
+        int(np.prod(x.sharding.shard_shape(x.shape))) * x.dtype.itemsize
+        for x in jax.tree.leaves(state.opt_state)
+        if hasattr(x, "sharding") and hasattr(x, "shape")
+    )
+    try:
+        peak_hbm_bytes = (dev.memory_stats() or {}).get("peak_bytes_in_use")
+    except Exception:
+        peak_hbm_bytes = None
     # train FLOPs/token ~ 6N + 12*L*s*h (reference mfu.py:178-180 formula)
     flops_per_token = 6 * n_params + 12 * n_layer * seq * n_embd
     mfu = tokens_per_sec * flops_per_token / peak_flops_per_chip()
@@ -562,6 +573,9 @@ def _run_candidate(cand, iters: int):
             "degraded": bool(resilience_events),
             "resilience_events": resilience_events,
             "params": n_params,
+            "zero_stage": getattr(mesh, "zero_stage", 0),
+            "opt_state_bytes_per_device": opt_state_bytes_per_device,
+            "peak_hbm_bytes": peak_hbm_bytes,
             "device": dev.device_kind,
             "seq": seq,
             "micro_batch": mb,

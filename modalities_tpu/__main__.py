@@ -141,8 +141,11 @@ def entry_point_run(
 
     from modalities_tpu.main import Main
     from modalities_tpu.running_env.env import TpuEnv
+    from modalities_tpu.running_env.xla_flags import apply_xla_flags_from_config
     from modalities_tpu.utils.communication_test import run_communication_test
 
+    # performance flags must land before the first backend touch inside TpuEnv
+    apply_xla_flags_from_config(config_file_path)
     with TpuEnv():
         if test_comm:
             run_communication_test()
@@ -171,7 +174,9 @@ def entry_point_warmstart(
     from modalities_tpu.main import Main
     from modalities_tpu.resilience.manifest import resolve_resume_folder
     from modalities_tpu.running_env.env import TpuEnv
+    from modalities_tpu.running_env.xla_flags import apply_xla_flags_from_config
 
+    apply_xla_flags_from_config(config_file_path)
     resume_folder = str(resolve_resume_folder(last_checkpoint_info_file_path))
 
     def warmstart_env(key: str):

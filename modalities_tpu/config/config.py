@@ -75,6 +75,8 @@ class DeviceMeshConfig(BaseModel):
     pipeline_parallel_degree: Annotated[int, Field(strict=True, gt=0)] = 1
     context_parallel_degree: Annotated[int, Field(strict=True, gt=0)] = 1
     enable_loss_parallel: Optional[bool] = False
+    # ZeRO-1 optimizer-state sharding over dp_replicate (see running_env/device_mesh.py)
+    zero_stage: Annotated[int, Field(strict=True, ge=0, le=1)] = 0
     world_size: Annotated[int, Field(strict=True, gt=0)]
 
 
@@ -493,6 +495,36 @@ class ResilienceConfig(BaseModel):
     resume_quorum: Optional[Annotated[int, Field(strict=True, gt=0)]] = None
     resume_vote_deadline_s: Annotated[float, Field(gt=0)] = 120.0
     min_hosts: Optional[Annotated[int, Field(strict=True, gt=0)]] = None
+
+
+class XlaFlagsConfig(BaseModel):
+    """XLA performance-flag component (performance.xla_flags): assembles the
+    latency-hiding-scheduler / async-collective / collective-combining settings
+    into LIBTPU_INIT_ARGS (+ optional XLA_FLAGS extras) BEFORE backend init —
+    see running_env/xla_flags.py. All TPU-runtime flags ride LIBTPU_INIT_ARGS
+    because this jaxlib's XLA_FLAGS parser hard-aborts on flags the current
+    backend does not know (CPU runs must stay untouched).
+
+    latency_hiding_scheduler: enable XLA's LHS so the reduce-scatter/all-gather
+    pairs the ZeRO update inserts overlap with compute.
+    async_collectives: async all-gather/reduce-scatter + collective fusion.
+    *_combine_threshold_bytes: gate below which small collectives are combined
+    into one (None: leave the compiler default).
+    extra_libtpu_args / extra_xla_flags: escape hatches appended verbatim.
+
+    extra="forbid": a typo'd knob must fail the run, not silently leave the
+    scheduler at its default while the operator believes it is tuned.
+    """
+
+    model_config = {"extra": "forbid"}
+
+    latency_hiding_scheduler: bool = True
+    async_collectives: bool = True
+    all_gather_combine_threshold_bytes: Optional[Annotated[int, Field(strict=True, ge=0)]] = None
+    reduce_scatter_combine_threshold_bytes: Optional[Annotated[int, Field(strict=True, ge=0)]] = None
+    all_reduce_combine_threshold_bytes: Optional[Annotated[int, Field(strict=True, ge=0)]] = None
+    extra_libtpu_args: list[str] = []
+    extra_xla_flags: list[str] = []
 
 
 # ---------------------------------------------------------------------- tokenizers

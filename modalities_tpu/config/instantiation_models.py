@@ -29,6 +29,7 @@ from modalities_tpu.config.pydantic_if_types import (
     PydanticMFUCalculatorIFType,
     PydanticPipelineIFType,
     PydanticProfilerIFType,
+    PydanticPerformanceIFType,
     PydanticResilienceIFType,
     PydanticTelemetryIFType,
     PydanticTokenizerIFType,
@@ -200,6 +201,7 @@ class TrainingComponentsInstantiationModel(BaseModel):
     device_feeder: Optional[PydanticDeviceFeederIFType] = None
     telemetry: Optional[PydanticTelemetryIFType] = None
     resilience: Optional[PydanticResilienceIFType] = None
+    performance: Optional[PydanticPerformanceIFType] = None
     model_raw: Optional[Any] = None
 
     @model_validator(mode="after")

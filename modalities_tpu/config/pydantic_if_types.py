@@ -67,6 +67,7 @@ PydanticLLMDataLoaderIFType = _lazy("modalities_tpu.dataloader.dataloader", "LLM
 PydanticDeviceFeederIFType = _lazy("modalities_tpu.dataloader.device_feeder", "DeviceFeeder")
 PydanticTelemetryIFType = _lazy("modalities_tpu.telemetry", "Telemetry")
 PydanticResilienceIFType = _lazy("modalities_tpu.resilience", "Resilience")
+PydanticPerformanceIFType = _lazy("modalities_tpu.running_env.xla_flags", "XlaPerformanceFlags")
 PydanticTokenizerIFType = _lazy("modalities_tpu.tokenization.tokenizer_wrapper", "TokenizerWrapper")
 PydanticAppStateType = _lazy("modalities_tpu.checkpointing.stateful.app_state_factory", "AppStateSpec")
 PydanticCheckpointSavingIFType = _lazy("modalities_tpu.checkpointing.checkpoint_saving", "CheckpointSaving")

@@ -24,6 +24,7 @@ from __future__ import annotations
 from typing import Optional
 
 import jax
+import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from modalities_tpu.running_env.device_mesh import DeviceMeshHandle
@@ -161,6 +162,84 @@ def constrain_activation(x, logical_axes, explicit: bool = False):
         return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
     except ValueError:
         return x
+
+
+# ------------------------------------------------------------ ZeRO optimizer state
+# Cross-replica sharding of the weight update (arXiv 2004.13336, ZeRO-1 semantics):
+# every dp_replicate replica holding a full copy of the Adam moments is pure waste —
+# the moments are only read/written inside `tx.update`. Expressed GSPMD-style: the
+# moment leaves (and the grads feeding them) get the replica axis added onto their
+# largest divisible non-model-parallel dim, XLA lowers the grad reduction into a
+# reduce-scatter over dp_replicate and re-materializes updated params with an
+# all-gather (SimpleFSDP, arXiv 2411.00284, does the same through the partitioner).
+
+ZERO_REPLICA_AXIS = "dp_replicate"
+# axes carrying model parallelism: adding the replica axis to a dim they shard would
+# entangle the update layout with TP/CP/PP resharding — never candidates
+_MODEL_PARALLEL_AXES = frozenset({"tp", "cp", "pp"})
+
+
+def zero_partition_spec(
+    shape: tuple[int, ...],
+    param_spec: P,
+    mesh: Mesh,
+    replica_axis: str = ZERO_REPLICA_AXIS,
+) -> P:
+    """ZeRO spec for one moment/grad leaf: the param spec with `replica_axis`
+    prepended onto the largest divisible dim not sharded over a model-parallel axis
+    (so a dim already carrying dp_shard becomes ``(dp_replicate, dp_shard)``).
+    Leaves with no divisible dim keep the param spec — they stay replicated across
+    dp_replicate, which is always correct, just not smaller."""
+    axis_sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    replica_size = axis_sizes.get(replica_axis, 1)
+    if replica_size <= 1:
+        return param_spec
+    entries = list(param_spec) + [None] * (len(shape) - len(param_spec))
+
+    def axes_of(entry) -> tuple[str, ...]:
+        if entry is None:
+            return ()
+        return entry if isinstance(entry, tuple) else (entry,)
+
+    if any(replica_axis in axes_of(e) for e in entries):
+        return param_spec  # already sharded over the replica axis
+
+    best = None  # (dim size, carries dp_shard, -index) — largest wins, dp_shard breaks ties
+    for i, dim in enumerate(shape):
+        axes = axes_of(entries[i])
+        if any(a in _MODEL_PARALLEL_AXES for a in axes):
+            continue
+        factor = int(np.prod([axis_sizes[a] for a in axes])) if axes else 1
+        if dim % (factor * replica_size) != 0:
+            continue
+        key = (dim, "dp_shard" in axes, -i)
+        if best is None or key > best[0]:
+            best = (key, i)
+    if best is None:
+        return param_spec
+    i = best[1]
+    existing = axes_of(entries[i])
+    entries[i] = (replica_axis, *existing) if existing else replica_axis
+    return P(*entries)
+
+
+def zero_params_shardings(
+    abstract_params,
+    param_shardings,
+    mesh_handle: DeviceMeshHandle,
+    replica_axis: str = ZERO_REPLICA_AXIS,
+):
+    """Param-tree of NamedShardings for ZeRO-sharded grads/moments: each leaf's
+    param sharding widened by `zero_partition_spec`. Shapes come from the abstract
+    param tree (divisibility is a shape property, not a spec property)."""
+    mesh = mesh_handle.mesh
+
+    def one(leaf, sharding):
+        return NamedSharding(
+            mesh, zero_partition_spec(tuple(leaf.shape), sharding.spec, mesh, replica_axis)
+        )
+
+    return jax.tree.map(one, abstract_params, param_shardings)
 
 
 def batch_sharding(mesh_handle: DeviceMeshHandle) -> NamedSharding:

@@ -23,6 +23,7 @@ from modalities_tpu.dataloader.dataloader_factory import DataloaderFactory
 from modalities_tpu.dataloader.device_feeder import DeviceFeeder
 from modalities_tpu.telemetry import Telemetry
 from modalities_tpu.resilience import Resilience
+from modalities_tpu.running_env.xla_flags import XlaPerformanceFlags
 from modalities_tpu.dataloader.dataset import DummyDataset, DummyDatasetConfig
 from modalities_tpu.dataloader.dataset_factory import DatasetFactory
 from modalities_tpu.dataloader.sampler_factory import BatchSamplerFactory, SamplerFactory
@@ -311,6 +312,9 @@ COMPONENTS: list[ComponentEntity] = [
     ComponentEntity("telemetry", "default", Telemetry, cfg.TelemetryConfig),
     # resilience (anomaly policy + preemption shutdown + supervisor knobs)
     ComponentEntity("resilience", "default", Resilience, cfg.ResilienceConfig),
+    # performance (XLA latency-hiding / async-collective flags; the CLI applies the
+    # same block pre-backend-init, this entity validates it and exposes it to code)
+    ComponentEntity("performance", "xla_flags", XlaPerformanceFlags, cfg.XlaFlagsConfig),
     # checkpointing
     ComponentEntity(
         "checkpoint_saving_strategy",

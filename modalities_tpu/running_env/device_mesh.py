@@ -54,6 +54,12 @@ class DeviceMeshConfig(BaseModel):
     pipeline_parallel_degree: Annotated[int, Field(strict=True, gt=0)] = 1
     context_parallel_degree: Annotated[int, Field(strict=True, gt=0)] = 1
     enable_loss_parallel: Optional[bool] = False
+    # ZeRO-style optimizer-state sharding over dp_replicate (arXiv 2004.13336):
+    # 0 = every replica holds full Adam moments (today's behavior, byte-identical
+    # programs); 1 = moments and the weight update are sharded across dp_replicate
+    # (grad reduce-scatter + param all-gather inserted by GSPMD). A no-op when
+    # data_parallel_replicate_degree == 1.
+    zero_stage: Annotated[int, Field(strict=True, ge=0, le=1)] = 0
     world_size: Annotated[int, Field(strict=True, gt=0)]
 
     @model_validator(mode="after")
@@ -92,10 +98,17 @@ class DeviceMeshConfig(BaseModel):
 class DeviceMeshHandle:
     """A jax Mesh plus the full degree table (including non-materialized size-1 axes)."""
 
-    def __init__(self, mesh, degrees: dict[str, int], enable_loss_parallel: bool = False):
+    def __init__(
+        self,
+        mesh,
+        degrees: dict[str, int],
+        enable_loss_parallel: bool = False,
+        zero_stage: int = 0,
+    ):
         self.mesh = mesh
         self.degrees = degrees
         self.enable_loss_parallel = enable_loss_parallel
+        self.zero_stage = zero_stage
 
     @property
     def axis_names(self) -> tuple[str, ...]:
@@ -119,7 +132,10 @@ class DeviceMeshHandle:
         return tuple(n for n in ("dp_replicate", "dp_shard") if n in self.axis_names)
 
     def __repr__(self) -> str:
-        return f"DeviceMeshHandle(axes={dict(zip(self.axis_names, self.mesh.shape.values()))}, degrees={self.degrees})"
+        return (
+            f"DeviceMeshHandle(axes={dict(zip(self.axis_names, self.mesh.shape.values()))}, "
+            f"degrees={self.degrees}, zero_stage={self.zero_stage})"
+        )
 
 
 def get_device_mesh(
@@ -130,6 +146,7 @@ def get_device_mesh(
     pipeline_parallel_degree: int = 1,
     context_parallel_degree: int = 1,
     enable_loss_parallel: bool = False,
+    zero_stage: int = 0,
     world_size: Optional[int] = None,
     devices=None,
 ) -> DeviceMeshHandle:
@@ -151,6 +168,7 @@ def get_device_mesh(
         pipeline_parallel_degree=pipeline_parallel_degree,
         context_parallel_degree=context_parallel_degree,
         enable_loss_parallel=enable_loss_parallel,
+        zero_stage=zero_stage,
         world_size=world_size,
     )
     if world_size > len(devices):
@@ -187,8 +205,19 @@ def get_device_mesh(
             names.append(name)
     device_grid = np.asarray(devices).reshape(dims)
     mesh = jax.sharding.Mesh(device_grid, tuple(names))
-    logger.info("device mesh: %s | world_size=%d | loss_parallel=%s", dict(zip(names, dims)), world_size, enable_loss_parallel)
-    return DeviceMeshHandle(mesh, degrees, enable_loss_parallel=cfg.enable_loss_parallel)
+    if cfg.zero_stage > 0 and cfg.data_parallel_replicate_degree <= 1:
+        logger.info(
+            "zero_stage=%d requested but data_parallel_replicate_degree=1: nothing to "
+            "shard the optimizer state over, running as zero_stage=0",
+            cfg.zero_stage,
+        )
+    logger.info(
+        "device mesh: %s | world_size=%d | loss_parallel=%s | zero_stage=%d",
+        dict(zip(names, dims)), world_size, enable_loss_parallel, cfg.zero_stage,
+    )
+    return DeviceMeshHandle(
+        mesh, degrees, enable_loss_parallel=cfg.enable_loss_parallel, zero_stage=cfg.zero_stage
+    )
 
 
 def current_mesh():

@@ -1,0 +1,192 @@
+"""XLA performance flags: latency-hiding scheduler + async collectives, from config.
+
+The ZeRO update path (training/train_step.py) makes XLA insert a grad
+reduce-scatter over dp_replicate and a param all-gather after the update. Whether
+those collectives cost a step's latency or disappear under compute is decided by
+XLA's latency-hiding scheduler and the async-collective runtime — both controlled
+by process-level flags that must be set BEFORE the backend initializes
+(SimpleFSDP, arXiv 2411.00284, relies on the same scheduler for its overlap).
+
+This module assembles those settings from the ``performance.xla_flags`` component
+config into environment variables:
+
+- ``LIBTPU_INIT_ARGS`` carries every TPU-runtime flag. On CPU/GPU the variable is
+  simply never read, so tests and local runs are untouched.
+- ``XLA_FLAGS`` is only extended with ``extra_xla_flags`` the operator explicitly
+  configured: this jaxlib's ``XLA_FLAGS`` parser hard-aborts the process on flag
+  names the current backend does not compile in, so nothing is added implicitly.
+
+Application order: config-assembled args first, any pre-existing operator-set
+value appended after, so an explicit environment override always wins.
+
+``MODALITIES_TPU_XLA_FLAGS=0`` (or ``off``/``false``/empty) is the kill switch —
+the component then assembles nothing, leaving the environment untouched.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from pathlib import Path
+from typing import Optional
+
+from modalities_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+DISABLE_ENV_VAR = "MODALITIES_TPU_XLA_FLAGS"
+
+# Latency-hiding scheduler: overlap the ZeRO/FSDP collectives with compute.
+_LHS_ARGS = ("--xla_tpu_enable_latency_hiding_scheduler=true",)
+
+# Async collective execution + fusion: all-gather/reduce-scatter run on the
+# collective core while the TensorCore keeps computing.
+_ASYNC_COLLECTIVE_ARGS = (
+    "--xla_enable_async_all_gather=true",
+    "--xla_tpu_enable_async_collective_fusion=true",
+    "--xla_tpu_enable_async_collective_fusion_fuse_all_gather=true",
+    "--xla_tpu_enable_async_collective_fusion_multiple_steps=true",
+    "--xla_tpu_overlap_compute_collective_tc=true",
+    "--xla_tpu_enable_data_parallel_all_reduce_opt=true",
+    "--xla_tpu_data_parallel_opt_different_sized_ops=true",
+)
+
+
+def backend_initialized() -> bool:
+    """True when a jax backend already exists in this process — flags set after
+    that point silently do nothing, which is exactly the bug class this check
+    exists to surface."""
+    xla_bridge = sys.modules.get("jax._src.xla_bridge")
+    if xla_bridge is None:
+        return False
+    return bool(getattr(xla_bridge, "_backends", None))
+
+
+def _disabled(environ) -> bool:
+    value = environ.get(DISABLE_ENV_VAR)
+    if value is None:
+        return False
+    return value.strip().lower() in ("", "0", "off", "false", "no")
+
+
+class XlaPerformanceFlags:
+    """The performance.xla_flags component: a pure assembler over the config knobs.
+
+    Construction never touches the environment; ``apply()`` does, and the CLI
+    calls it from the raw YAML block before ``TpuEnv`` so the flags land ahead of
+    backend init (by component-build time the backend is already up).
+    """
+
+    def __init__(
+        self,
+        latency_hiding_scheduler: bool = True,
+        async_collectives: bool = True,
+        all_gather_combine_threshold_bytes: Optional[int] = None,
+        reduce_scatter_combine_threshold_bytes: Optional[int] = None,
+        all_reduce_combine_threshold_bytes: Optional[int] = None,
+        extra_libtpu_args: Optional[list[str]] = None,
+        extra_xla_flags: Optional[list[str]] = None,
+    ):
+        self.latency_hiding_scheduler = latency_hiding_scheduler
+        self.async_collectives = async_collectives
+        self.all_gather_combine_threshold_bytes = all_gather_combine_threshold_bytes
+        self.reduce_scatter_combine_threshold_bytes = reduce_scatter_combine_threshold_bytes
+        self.all_reduce_combine_threshold_bytes = all_reduce_combine_threshold_bytes
+        self.extra_libtpu_args = list(extra_libtpu_args or ())
+        self.extra_xla_flags = list(extra_xla_flags or ())
+
+    # ---------------------------------------------------------------- assembly
+    def libtpu_args(self) -> list[str]:
+        args: list[str] = []
+        if self.latency_hiding_scheduler:
+            args.extend(_LHS_ARGS)
+        if self.async_collectives:
+            args.extend(_ASYNC_COLLECTIVE_ARGS)
+        thresholds = (
+            ("all_gather", self.all_gather_combine_threshold_bytes),
+            ("reduce_scatter", self.reduce_scatter_combine_threshold_bytes),
+            ("all_reduce", self.all_reduce_combine_threshold_bytes),
+        )
+        for name, value in thresholds:
+            if value is not None:
+                args.append(f"--xla_tpu_{name}_combine_threshold_bytes={value}")
+        args.extend(self.extra_libtpu_args)
+        return args
+
+    def xla_flags(self) -> list[str]:
+        return list(self.extra_xla_flags)
+
+    def environment(self, environ=None) -> dict[str, str]:
+        """The variables `apply` would set: assembled args first, any existing
+        operator-set value appended (later flags win in both parsers)."""
+        environ = os.environ if environ is None else environ
+        merged: dict[str, str] = {}
+        for var, assembled in (
+            ("LIBTPU_INIT_ARGS", self.libtpu_args()),
+            ("XLA_FLAGS", self.xla_flags()),
+        ):
+            if not assembled:
+                continue
+            existing = environ.get(var, "").strip()
+            merged[var] = " ".join(assembled + ([existing] if existing else []))
+        return merged
+
+    # ------------------------------------------------------------- application
+    def apply(self, environ=None) -> dict[str, str]:
+        """Merge the assembled flags into `environ` (default os.environ).
+        Returns what was set; empty when disabled via MODALITIES_TPU_XLA_FLAGS."""
+        environ = os.environ if environ is None else environ
+        if _disabled(environ):
+            logger.info("%s disables the xla_flags performance component", DISABLE_ENV_VAR)
+            return {}
+        if backend_initialized():
+            logger.warning(
+                "xla_flags applied AFTER backend init: the runtime will not see them "
+                "this process; move the performance component application before the "
+                "first jax.devices() call"
+            )
+        merged = self.environment(environ)
+        environ.update(merged)
+        if merged:
+            logger.info("xla_flags performance component set: %s", merged)
+        return merged
+
+
+def performance_block_from_yaml(config_file_path) -> Optional[dict]:
+    """The raw `performance.xla_flags` config dict from a YAML file, or None.
+
+    A plain yaml.safe_load — NOT the full interpolating config build (which may
+    need resolvers and imports the world): the block must therefore hold literal
+    values only, which the reference configs do.
+    """
+    import yaml
+
+    try:
+        raw = yaml.safe_load(Path(config_file_path).read_text())
+    except Exception as e:  # malformed YAML fails later with the full loader's error
+        logger.warning("xla_flags pre-scan could not parse %s: %s", config_file_path, e)
+        return None
+    if not isinstance(raw, dict):
+        return None
+    for block in raw.values():
+        if (
+            isinstance(block, dict)
+            and block.get("component_key") == "performance"
+            and block.get("variant_key") == "xla_flags"
+        ):
+            config = block.get("config") or {}
+            return config if isinstance(config, dict) else None
+    return None
+
+
+def apply_xla_flags_from_config(config_file_path, environ=None) -> dict[str, str]:
+    """CLI pre-init hook: scan the YAML for a performance.xla_flags block and
+    apply it. Validation errors raise (a typo'd perf config must not silently
+    run unoptimized); a missing block is a no-op."""
+    block = performance_block_from_yaml(config_file_path)
+    if block is None:
+        return {}
+    from modalities_tpu.config.config import XlaFlagsConfig
+
+    cfg = XlaFlagsConfig(**block)
+    return XlaPerformanceFlags(**cfg.model_dump()).apply(environ)

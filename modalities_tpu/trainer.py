@@ -500,6 +500,9 @@ class Trainer:
         peak_mb = self._peak_memory_mb()
         if peak_mb is not None:
             throughput["peak memory [MB]"] = ResultItem(peak_mb, 1)
+        headroom_mb = self._hbm_headroom_mb()
+        if headroom_mb is not None:
+            throughput["HBM headroom [MB]"] = ResultItem(headroom_mb, 1)
         goodput_metrics = telemetry.throughput_metrics()
         if goodput_metrics:
             # cumulative since run start: goodput % plus per-bucket wall seconds
@@ -547,3 +550,23 @@ class Trainer:
                 continue
             peak_bytes = max(peak_bytes, stats.get("peak_bytes_in_use", 0))
         return peak_bytes / 2**20 if peak_bytes else None
+
+    @classmethod
+    def _hbm_headroom_mb(cls) -> Optional[float]:
+        """Min over local devices of ``bytes_limit - peak_bytes_in_use``, in MB —
+        the tightest remaining on-device allocation margin. None when the backend
+        does not report a bytes_limit (CPU), so the key is simply absent there."""
+        if cls._local_devices is None:
+            cls._peak_memory_mb()  # populates the cached device list
+        headroom_bytes = None
+        for device in cls._local_devices or []:
+            try:
+                stats = device.memory_stats() or {}
+            except Exception:
+                continue
+            limit = stats.get("bytes_limit")
+            if not limit:
+                continue
+            margin = limit - stats.get("peak_bytes_in_use", 0)
+            headroom_bytes = margin if headroom_bytes is None else min(headroom_bytes, margin)
+        return headroom_bytes / 2**20 if headroom_bytes is not None else None

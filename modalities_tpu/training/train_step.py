@@ -29,6 +29,7 @@ from modalities_tpu.parallel.sharding import (
     default_logical_axis_rules,
     logical_to_mesh_spec,
     replicated,
+    zero_params_shardings,
 )
 from modalities_tpu.running_env.device_mesh import DeviceMeshHandle
 from modalities_tpu.utils.logging import get_logger
@@ -106,6 +107,7 @@ class TrainStepBuilder:
         expose_grads: bool = False,
         anomaly_policy: Optional[str] = None,
         stop_consensus: bool = False,
+        zero_stage: Optional[int] = None,
     ):
         self.model = model
         self.loss_fn = loss_fn
@@ -124,6 +126,17 @@ class TrainStepBuilder:
         # coordination.py). False leaves the batch structure AND the compiled
         # program byte-identical to a build without the feature.
         self.stop_consensus = stop_consensus
+        # ZeRO-1 optimizer-state sharding over dp_replicate: None inherits the mesh
+        # handle's configured stage; 0 keeps the program byte-identical to a build
+        # without the feature (the knob compiles to nothing, like stop_consensus)
+        resolved_zero = (
+            zero_stage
+            if zero_stage is not None
+            else (getattr(mesh_handle, "zero_stage", 0) if mesh_handle is not None else 0)
+        )
+        if resolved_zero not in (0, 1):
+            raise ValueError(f"zero_stage must be 0 or 1, got {resolved_zero}")
+        self.zero_stage = resolved_zero
         self.rules = (
             default_logical_axis_rules(mesh_handle, sequence_parallel) if mesh_handle is not None else ()
         )
@@ -190,6 +203,22 @@ class TrainStepBuilder:
 
         # --- optimizer over unboxed abstract params
         abstract_params = _unbox(boxed_abstract)
+
+        # ZeRO-1 (arXiv 2004.13336): grads and Adam moments carry the dp_replicate
+        # axis on their largest divisible dim, so the grad reduction lowers to a
+        # reduce-scatter and tx.update runs on 1/dp_replicate-sized slices; the
+        # updated params re-materialize with one all-gather below. Inactive (None)
+        # means zero new ops — the program stays byte-identical to stage 0.
+        zero_active = (
+            self.zero_stage >= 1
+            and mesh_handle is not None
+            and mesh_handle.degrees.get("dp_replicate", 1) > 1
+        )
+        zero_grad_shardings = (
+            zero_params_shardings(abstract_params, param_shardings, mesh_handle)
+            if zero_active
+            else None
+        )
         schedule = self.scheduler_spec.absolute_lr_schedule() if self.scheduler_spec is not None else None
         tx = self.optimizer_spec.build(abstract_params, schedule)
         from modalities_tpu.training.gradient_clipping import (
@@ -223,7 +252,10 @@ class TrainStepBuilder:
             abstract_state = jax.eval_shape(init_state, rng)
             param_treedef = jax.tree.structure(abstract_state.params)
             opt_shardings = _substitute_param_subtrees(
-                abstract_state.opt_state, param_treedef, param_shardings, replicated_sharding
+                abstract_state.opt_state,
+                param_treedef,
+                zero_grad_shardings if zero_active else param_shardings,
+                replicated_sharding,
             )
             state_shardings = AppState(
                 params=param_shardings, opt_state=opt_shardings, step=replicated_sharding
@@ -417,9 +449,17 @@ class TrainStepBuilder:
                     g_acc, l_acc = acc
                     # accumulate in reduce_dtype (fp32 by default) even when grads are bf16
                     g_acc = jax.tree.map(lambda a, g: a + g.astype(reduce_dtype), g_acc, grads)
+                    if zero_grad_shardings is not None:
+                        # each microbatch's partial-sum grads reshard into the ZeRO
+                        # layout here — this is the constraint GSPMD lowers to the
+                        # reduce-scatter over dp_replicate (instead of the stage-0
+                        # all-reduce that would replicate the full grads)
+                        g_acc = jax.lax.with_sharding_constraint(g_acc, zero_grad_shardings)
                     return (g_acc, l_acc + loss), None
 
                 zero_grads = jax.tree.map(lambda p: jnp.zeros(p.shape, reduce_dtype), state.params)
+                if zero_grad_shardings is not None:
+                    zero_grads = jax.lax.with_sharding_constraint(zero_grads, zero_grad_shardings)
                 (grads, loss_sum), _ = jax.lax.scan(
                     micro, (zero_grads, 0.0), (jnp.arange(acc_steps), samples, targets)
                 )
@@ -446,6 +486,10 @@ class TrainStepBuilder:
                 grad_norm = global_norm_by_mode(grads, norm_mode)
                 updates, new_opt_state = tx.update(grads, state.opt_state, state.params)
                 new_params = optax.apply_updates(state.params, updates)
+                if zero_grad_shardings is not None and param_shardings is not None:
+                    # re-materialize full (dp_replicate-replicated) params: the one
+                    # all-gather paired with the reduce-scatter above
+                    new_params = jax.lax.with_sharding_constraint(new_params, param_shardings)
                 if skip_on_anomaly:
                     # branch-free anomaly skip: a non-finite step keeps the old
                     # params/opt_state (jnp.where select, no lax.cond divergence
@@ -542,7 +586,10 @@ class TrainStepBuilder:
 
             train_step_debug_c = None
             if expose_grads:
-                debug_metrics_shardings = dict(metrics_shardings, grads=param_shardings)
+                debug_metrics_shardings = dict(
+                    metrics_shardings,
+                    grads=zero_grad_shardings if zero_active else param_shardings,
+                )
                 train_step_debug_j = jax.jit(
                     make_train_step(True),
                     donate_argnums=(0,),

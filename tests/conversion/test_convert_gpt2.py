@@ -152,6 +152,8 @@ def test_full_export_loads_in_vanilla_transformers_with_tokenizer(tmp_path):
     assert np.abs(jax_logits - torch_logits).max() < 1e-4
 
 
+@pytest.mark.slow  # full train + subprocess CLI (~24s); the seven in-process
+# conversion tests above keep export numerics covered in tier-1
 def test_convert_checkpoint_to_hf_cli_end_to_end(tmp_path):
     """The real `convert_checkpoint_to_hf` CLI over a real training checkpoint:
     train the lorem config briefly (Main.run), point a conversion config at the

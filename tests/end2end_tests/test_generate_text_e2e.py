@@ -69,13 +69,38 @@ def test_generate_text_from_training_checkpoint(workdir, monkeypatch, capsys):  
     toks = completion.split()
     assert all(t.startswith("t") or t == "<eod>" for t in toks), completion
 
-    # restored params are the trained ones, not the fresh init: generating from a
-    # freshly-initialized model must differ from the checkpoint-restored output
-    cfg["settings"].pop("checkpoint_folder_path")
-    fresh_cfg_path = workdir / "gen_config_fresh.yaml"
-    fresh_cfg_path.write_text(yaml.safe_dump(cfg))
-    prompts = iter(["t5 t6 t7"])
-    generate_text(fresh_cfg_path)
-    fresh_out = capsys.readouterr().out
-    fresh_completion = [line for line in fresh_out.splitlines() if line.strip()][-1]
-    assert fresh_completion != completion, "restore had no effect on greedy decode"
+    # restored params are the trained ones, not the fresh init. Greedy TEXT is a
+    # degenerate discriminator — after 8 steps on random tokens both models can
+    # emit the same repetition (docs/known_failures.md round 6) — so compare the
+    # LOGITS of the restored vs freshly-initialized params on a fixed input.
+    import jax
+    import numpy as np
+    from flax.core import meta
+    from pydantic import BaseModel
+
+    from modalities_tpu.checkpointing.orbax.orbax_checkpoint_loading import (
+        restore_tree_single_device,
+    )
+    from modalities_tpu.config.component_factory import ComponentFactory
+    from modalities_tpu.config.pydantic_if_types import PydanticModelIFType
+    from modalities_tpu.config.yaml_interp import load_app_config_dict
+    from modalities_tpu.registry.components import COMPONENTS
+    from modalities_tpu.registry.registry import Registry
+
+    class _ModelOnly(BaseModel):
+        model: PydanticModelIFType
+
+    model = (
+        ComponentFactory(Registry(COMPONENTS))
+        .build_components({"model": load_app_config_dict(gen_cfg_path)["model"]}, _ModelOnly)
+        .model
+    )
+    restored_params = restore_tree_single_device(Path(ckpt))["params"]
+    fresh_params = meta.unbox(model.init_params(jax.random.PRNGKey(0)))
+    tokens = (np.arange(8, dtype=np.int32) % 250)[None, :]
+    logits_restored = model.apply(restored_params, {model.sample_key: tokens})[model.prediction_key]
+    logits_fresh = model.apply(fresh_params, {model.sample_key: tokens})[model.prediction_key]
+    assert np.asarray(logits_restored).shape == np.asarray(logits_fresh).shape
+    assert not np.allclose(
+        np.asarray(logits_restored), np.asarray(logits_fresh)
+    ), "restored checkpoint logits identical to fresh init — restore had no effect"

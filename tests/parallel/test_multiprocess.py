@@ -204,6 +204,8 @@ def test_two_process_ring_attention_crosses_process_boundary():
     _run_two_process_vs_single("cp")
 
 
+@pytest.mark.slow  # two subprocess compiles (~25s) of a stable subsystem; tier-1
+# wall-time budget (see docs) — run with -m slow
 def test_single_process_cp_feeder_async_matches_sync():
     """Async vs sync feeder over an 8-device cp mesh in ONE process: put_batch's
     cp seq-dim slicing (`local_seq_slice`) runs on the feeder's background thread

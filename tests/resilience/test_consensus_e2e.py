@@ -81,6 +81,8 @@ def _train_lines_of(workdir, experiment_id):
     return [r for r in lines if r["dataloader_tag"] == "train"]
 
 
+@pytest.mark.slow  # 3 full compile+train runs (~37s); 2-process sibling in test_multihost.py,
+# protocol units in test_coordination.py keep the ballot covered in tier-1
 def test_sigterm_under_consensus_stops_via_ballot_and_warmstart_matches(workdir):
     # uninterrupted twin WITHOUT the ballot: the balloted run must match it
     # bit-for-bit below, proving the consensus collective is numerically inert

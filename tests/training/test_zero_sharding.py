@@ -1,0 +1,323 @@
+"""ZeRO-1 optimizer-state sharding: spec rules, HLO contract, numerics, memory.
+
+The HLO pin encodes the *semantic* reduce-scatter contract rather than grepping
+for a literal ``reduce-scatter`` op: this jaxlib's CPU backend never runs the
+reduce-scatter-creator pass, so the SPMD partitioner lowers the pattern to a
+full-product all-reduce followed by a dynamic-slice instead. What stage 1 must
+guarantee — and what these tests pin — is that no cross-replica all-reduce of a
+FULL gradient shard survives (replica groups of size dp_replicate on non-scalar
+tensors), the optimizer update runs on 1/dp_replicate-sized tensors, and the
+updated params are re-materialized with all-gathers. On TPU the literal op
+exists and is accepted as the primary signal.
+"""
+
+import re
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from modalities_tpu.checkpointing.topology import describe_topology, diff_topology
+from modalities_tpu.loss_functions import CLMCrossEntropyLoss
+from modalities_tpu.optimizers.optimizer_factory import OptimizerFactory
+from modalities_tpu.optimizers.scheduler_factory import DummyLRScheduler
+from modalities_tpu.parallel.sharding import zero_partition_spec, zero_params_shardings
+from modalities_tpu.running_env.device_mesh import get_device_mesh
+from modalities_tpu.training.train_step import TrainStepBuilder
+from tests.models.test_gpt2_model import tiny_gpt2
+from tests.training.test_train_step import _batch, _builder
+
+DP_REPLICATE, DP_SHARD = 2, 4
+
+
+def _hsdp_mesh(zero_stage=0):
+    return get_device_mesh(
+        device_type="cpu",
+        data_parallel_replicate_degree=DP_REPLICATE,
+        data_parallel_shard_degree=DP_SHARD,
+        world_size=8,
+        zero_stage=zero_stage,
+    )
+
+
+# ---------------------------------------------------------------- spec rules
+
+
+def test_zero_partition_spec_rules():
+    mesh = _hsdp_mesh().mesh
+    # dim already carrying dp_shard and divisible by 8 -> widened to (dp_replicate, dp_shard)
+    assert zero_partition_spec((64, 32), P("dp_shard", None), mesh) == P(("dp_replicate", "dp_shard"), None)
+    # unsharded leaf: largest divisible dim gets the replica axis alone
+    assert zero_partition_spec((16, 64), P(), mesh) == P(None, "dp_replicate")
+    # no dim divisible by factor*replica -> unchanged (stays replicated, still correct)
+    assert zero_partition_spec((3, 5), P(), mesh) == P()
+    # already sharded over dp_replicate -> unchanged
+    spec = P(("dp_replicate", "dp_shard"), None)
+    assert zero_partition_spec((64, 32), spec, mesh) == spec
+
+
+def test_zero_partition_spec_skips_model_parallel_dims():
+    mesh = get_device_mesh(
+        device_type="cpu",
+        data_parallel_replicate_degree=2,
+        data_parallel_shard_degree=2,
+        tensor_parallel_degree=2,
+        world_size=8,
+        zero_stage=1,
+    ).mesh
+    # dim 0 is tp-sharded: never a candidate even though divisible; dim 1 wins
+    assert zero_partition_spec((64, 32), P("tp", None), mesh) == P("tp", "dp_replicate")
+    # both dims model-parallel -> unchanged
+    assert zero_partition_spec((64, 32), P("tp", "cp"), mesh) == P("tp", "cp")
+
+
+def test_zero_inert_without_replica_axis():
+    mesh = get_device_mesh(
+        device_type="cpu", data_parallel_shard_degree=8, world_size=8, zero_stage=1
+    ).mesh
+    # dp_replicate has size 1 on this mesh: every spec passes through untouched
+    assert zero_partition_spec((64, 32), P("dp_shard", None), mesh) == P("dp_shard", None)
+
+
+def test_zero_stage_knob_validation():
+    with pytest.raises(Exception):
+        get_device_mesh(
+            device_type="cpu", data_parallel_shard_degree=8, world_size=8, zero_stage=2
+        )
+
+
+# ---------------------------------------------------------------- HLO contract
+
+_AR_RE = re.compile(r"= (\S+) all-reduce\(.*?replica_groups=(\[[0-9,]+\]|\{\{[0-9, ]+\})")
+
+
+def _allreduce_profile(hlo: str):
+    """(shape_str, group_size) for every all-reduce; group_size is the number of
+    participants per replica group, parsed from either the iota ``[G,S]<=...``
+    form or the explicit ``{{a,b},...}`` form."""
+    out = []
+    for shape, groups in _AR_RE.findall(hlo):
+        if groups.startswith("["):
+            group_size = int(groups[1:-1].split(",")[1])
+        else:
+            group_size = len(groups[2:].split(","))
+        out.append((shape, group_size))
+    return out
+
+
+def _is_scalar(shape: str) -> bool:
+    inner = shape.split("[", 1)[1].split("]", 1)[0]
+    return inner == ""
+
+
+@pytest.fixture(scope="module")
+def hsdp_compiles():
+    """One compile each of baseline / stage0 / stage1 on the 2x4 HSDP mesh,
+    shared across the HLO, donation, and memory tests."""
+    raw = _batch(np.random.default_rng(3), 1, 8, 16)
+    abstract = jax.tree.map(lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), raw)
+
+    baseline_mesh = get_device_mesh(
+        device_type="cpu",
+        data_parallel_replicate_degree=DP_REPLICATE,
+        data_parallel_shard_degree=DP_SHARD,
+        world_size=8,
+    )
+    fns_base = _builder(tiny_gpt2("pytorch_flash"), baseline_mesh, clip=1.0).build(
+        seed=0, materialize=False
+    )
+    compiled_base = fns_base.lower_train_step(abstract).compile()
+
+    fns0 = _builder(tiny_gpt2("pytorch_flash"), _hsdp_mesh(0), clip=1.0).build(
+        seed=0, materialize=False
+    )
+    compiled0 = fns0.lower_train_step(abstract).compile()
+
+    fns1 = _builder(tiny_gpt2("pytorch_flash"), _hsdp_mesh(1), clip=1.0).build(
+        seed=0, materialize=False
+    )
+    lowered1 = fns1.lower_train_step(abstract)
+    compiled1 = lowered1.compile()
+
+    return {
+        "hlo_base": compiled_base.as_text(),
+        "hlo0": compiled0.as_text(),
+        "hlo1": compiled1.as_text(),
+        "mlir1": lowered1.as_text(),
+        "mem0": compiled0.memory_analysis(),
+        "mem1": compiled1.memory_analysis(),
+        "n_state_leaves": len(jax.tree.leaves(fns1.app_state_handle.state)),
+    }
+
+
+def test_zero_stage0_is_byte_identical(hsdp_compiles):
+    # the knob at its default must not perturb the program AT ALL
+    assert hsdp_compiles["hlo0"] == hsdp_compiles["hlo_base"]
+
+
+def test_zero_stage1_reduce_scatter_contract(hsdp_compiles):
+    hlo0, hlo1 = hsdp_compiles["hlo0"], hsdp_compiles["hlo1"]
+    assert hlo1 != hlo0
+
+    # stage 0 reduces full gradient shards across replicas: non-scalar
+    # all-reduces with replica groups of exactly dp_replicate participants
+    stage0_cross_replica = [
+        (s, g) for s, g in _allreduce_profile(hlo0) if g == DP_REPLICATE and not _is_scalar(s)
+    ]
+    assert stage0_cross_replica, "stage 0 lost its cross-replica grad all-reduce baseline"
+
+    if "reduce-scatter" in hlo1:
+        return  # literal op present (TPU-style lowering) — contract satisfied directly
+
+    # CPU decomposed form: NO surviving sub-world all-reduce of a non-scalar
+    # tensor — grad reduction fused into the full dp product and sliced
+    world = DP_REPLICATE * DP_SHARD
+    surviving = [
+        (s, g) for s, g in _allreduce_profile(hlo1) if g != world and not _is_scalar(s)
+    ]
+    assert not surviving, f"stage 1 still all-reduces full grad shards: {surviving}"
+    # param re-materialization: stage 1 must all-gather strictly more than stage 0
+    assert hlo1.count("all-gather") > hlo0.count("all-gather")
+
+
+def test_zero_stage1_donation_audit(hsdp_compiles):
+    # every AppState leaf must be donated into the step (aliased input->output);
+    # a missing alias doubles that leaf's live footprint at the update
+    aliased = hsdp_compiles["mlir1"].count("tf.aliasing_output")
+    assert aliased >= hsdp_compiles["n_state_leaves"]
+
+
+def test_zero_stage1_shrinks_argument_bytes(hsdp_compiles):
+    mem0, mem1 = hsdp_compiles["mem0"], hsdp_compiles["mem1"]
+    # AdamW state is 2/3 of (params+moments) bytes; sharding the moments over
+    # dp_replicate=2 removes half of that -> at least a 25% argument shrink
+    assert mem1.argument_size_in_bytes < 0.8 * mem0.argument_size_in_bytes
+
+
+# ---------------------------------------------------------------- state layout
+
+
+@pytest.fixture(scope="module")
+def hsdp_states():
+    """Materialized stage0 + stage1 states on the 2x4 mesh (init compile only)."""
+    states = {}
+    for zero in (0, 1):
+        fns = _builder(tiny_gpt2("pytorch_flash"), _hsdp_mesh(zero), clip=1.0).build(seed=0)
+        states[zero] = fns.app_state_handle.state
+    return states
+
+
+def test_zero_moment_shards_shrink(hsdp_states):
+    import jax.tree_util as jtu
+
+    shrunk = 0
+    for path, leaf in jtu.tree_leaves_with_path(hsdp_states[1].opt_state):
+        if not hasattr(leaf, "sharding") or leaf.ndim < 2:
+            continue
+        spec_axes = {
+            a
+            for entry in leaf.sharding.spec
+            if entry is not None
+            for a in (entry if isinstance(entry, tuple) else (entry,))
+        }
+        if "dp_replicate" in spec_axes:
+            shard = int(np.prod(leaf.sharding.shard_shape(leaf.shape)))
+            assert shard * DP_REPLICATE <= int(np.prod(leaf.shape)), jtu.keystr(path)
+            shrunk += 1
+    # every 2D+ kernel moment in tiny_gpt2 has a divisible dim — all must shard
+    assert shrunk >= 14, f"only {shrunk} moment leaves zero-sharded"
+
+    # params themselves stay on their fsdp layout (ZeRO-1, not ZeRO-3): no
+    # param leaf may carry dp_replicate
+    for path, leaf in jtu.tree_leaves_with_path(hsdp_states[1].params):
+        spec = getattr(leaf.sharding, "spec", P())
+        for entry in spec:
+            axes = entry if isinstance(entry, tuple) else (entry,)
+            assert "dp_replicate" not in axes, jtu.keystr(path)
+
+
+def test_zero_topology_record_round_trips(hsdp_states):
+    records = {
+        z: describe_topology(jax.tree.map(lambda x: x.sharding, hsdp_states[z]))
+        for z in (0, 1)
+    }
+    assert records[0]["mesh_axes"] == records[1]["mesh_axes"]
+    # stage-1 record names the replica axis on optimizer-state leaves
+    zero_leaves = [
+        k for k, v in records[1]["leaf_specs"].items() if "opt_state" in k and "dp_replicate" in v
+    ]
+    assert zero_leaves
+    # elastic resume detection: the same mesh with a different zero_stage is a
+    # leaf_specs reshard, not a mesh_axes mismatch
+    mismatches = diff_topology(records[0], records[1])
+    assert any("leaf_specs" in m for m in mismatches)
+    assert not any("mesh_axes" in m for m in mismatches)
+
+
+# ---------------------------------------------------------------- numerics
+
+
+def _lr_builder(model, mesh_handle, lr):
+    opt = OptimizerFactory.get_adam_w(
+        lr=lr,
+        betas=(0.9, 0.95),
+        eps=1e-8,
+        weight_decay=0.1,
+        weight_decay_groups_excluded=["norm", "embedding"],
+        wrapped_model=model,
+    )
+    return TrainStepBuilder(
+        model=model,
+        loss_fn=CLMCrossEntropyLoss(target_key="target_ids", prediction_key="logits"),
+        optimizer_spec=opt,
+        scheduler_spec=DummyLRScheduler(name="dummy", optimizer=opt),
+        mesh_handle=mesh_handle,
+        gradient_acc_steps=1,
+        grad_clip_norm=1.0,
+    )
+
+
+def test_zero_numeric_equivalence():
+    """stage 1 == stage 0 losses to rtol 1e-5 over 8 steps on a pure
+    dp_replicate=2 mesh. lr=1e-4 keeps the comparison below this CPU backend's
+    FMA-contraction noise floor (at lr>=3e-4 a 1-ulp difference in the
+    partitioned update program amplifies chaotically past 1e-5 by step ~4 —
+    measured, not a ZeRO semantics issue; params stay bit-identical per step)."""
+    raw = _batch(np.random.default_rng(3), 1, 8, 16)
+    losses = {}
+    for zero in (0, 1):
+        mesh = get_device_mesh(
+            device_type="cpu",
+            data_parallel_replicate_degree=2,
+            data_parallel_shard_degree=1,
+            world_size=2,
+            zero_stage=zero,
+        )
+        fns = _lr_builder(tiny_gpt2("pytorch_flash"), mesh, lr=1e-4).build(seed=0)
+        state = fns.app_state_handle.state
+        batch = fns.put_batch(raw)
+        ls = []
+        for _ in range(8):
+            state, metrics = fns.train_step(state, batch)
+            ls.append(float(metrics["loss"]))
+        losses[zero] = ls
+    np.testing.assert_allclose(losses[0], losses[1], rtol=1e-5)
+    assert losses[1][-1] < losses[1][0]  # and it actually trains
+
+
+def test_zero_zero_params_shardings_tree_shape():
+    mesh_handle = _hsdp_mesh(1)
+    abstract = {
+        "w": jax.ShapeDtypeStruct((64, 32), np.float32),
+        "b": jax.ShapeDtypeStruct((3,), np.float32),
+    }
+    from jax.sharding import NamedSharding
+
+    params_sh = {
+        "w": NamedSharding(mesh_handle.mesh, P("dp_shard", None)),
+        "b": NamedSharding(mesh_handle.mesh, P()),
+    }
+    out = zero_params_shardings(abstract, params_sh, mesh_handle)
+    assert out["w"].spec == P(("dp_replicate", "dp_shard"), None)
+    assert out["b"].spec == P()  # 3 not divisible by 2 -> stays replicated
