@@ -1,0 +1,248 @@
+"""Serving load generator: continuous-batching throughput + latency on a tiny model.
+
+Prints ONE final JSON line (the driver/CI reads the LAST JSON line on stdout):
+
+  {"bench": "serve", "tokens_per_s": ..., "baseline_tokens_per_s": ...,
+   "speedup": ..., "ttft_p50_ms": ..., "ttft_p99_ms": ..., "tpot_p50_ms": ...,
+   "tpot_p99_ms": ..., "slot_occupancy": ..., "requests": N, "slots": B, ...}
+
+Method: replay a synthetic trace (seeded Poisson arrivals, mixed prompt/output
+lengths, mixed greedy/sampled temperatures) through the continuous-batching engine
+(serving/engine.py) at `--slots` batch slots, after a warmup pass on the SAME
+engine so compile time stays out of the latency numbers. The sequential baseline
+replays the identical requests through a one-slot engine (one-request-at-a-time) —
+`speedup` is the aggregate decode tokens/s ratio, the PR-8 CPU oracle being >= 4x
+at 8 slots with a full queue.
+
+Discipline learned in PR 3/5 (bench.py): a PROVISIONAL fallback line is emitted
+first so a mid-run kill still parses, and a budget-guard daemon thread
+(BENCH_SERVE_BUDGET_S, default 600 s; 0 disables) prints a final fallback line and
+exits 0 if the run outlives its budget.
+
+Knobs: --slots N, --requests N, --rate R (Poisson arrivals/s; 0 = all at t=0),
+--max-new N, --seed S, --smoke (6 requests, 2 slots, no baseline — the tier-1
+smoke test's fast path).
+"""
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+METRIC_KEYS = (
+    "tokens_per_s",
+    "baseline_tokens_per_s",
+    "speedup",
+    "ttft_p50_ms",
+    "ttft_p99_ms",
+    "tpot_p50_ms",
+    "tpot_p99_ms",
+    "slot_occupancy",
+)
+
+
+def _line(extra: dict) -> str:
+    base = {"bench": "serve", **{k: None for k in METRIC_KEYS}}
+    base.update(extra)
+    return json.dumps(base)
+
+
+def _arm_budget_guard():
+    budget_s = float(os.environ.get("BENCH_SERVE_BUDGET_S", "600"))
+    if budget_s <= 0:
+        return
+    deadline = time.monotonic() + budget_s
+
+    def guard():
+        while time.monotonic() < deadline:
+            time.sleep(1.0)
+        print(_line({"provisional": False, "reason": f"budget exhausted ({budget_s:.0f}s)"}), flush=True)
+        os._exit(0)
+
+    threading.Thread(target=guard, name="bench-serve-budget-guard", daemon=True).start()
+
+
+def _tiny_model():
+    """Self-contained tiny GPT2 (the test suite's tiny_gpt2 shape) — constructed
+    directly so the bench has no pydantic/config dependency."""
+    from modalities_tpu.models.gpt2.gpt2_model import AttentionConfig, GPT2LLM
+
+    return GPT2LLM(
+        sample_key="input_ids",
+        prediction_key="logits",
+        poe_type="NOPE",
+        sequence_length=64,
+        vocab_size=128,
+        n_layer=2,
+        n_head_q=4,
+        n_head_kv=2,
+        n_embd=128,
+        ffn_hidden=128,
+        dropout=0.0,
+        bias=False,
+        attention_config=AttentionConfig(
+            qkv_transforms=[
+                {
+                    "type_hint": "RotaryTransform",
+                    "config": {"n_embd": 128, "n_head": 4, "base_freq": 10000},
+                }
+            ]
+        ),
+        attention_implementation="manual",
+        activation_type="swiglu",
+        attention_norm_config={"norm_type": "rms_norm", "config": {"ndim": 128, "bias": False}},
+        ffn_norm_config={"norm_type": "rms_norm", "config": {"ndim": 128, "bias": False}},
+        lm_head_norm_config={"norm_type": "rms_norm", "config": {"ndim": 128, "bias": False}},
+        use_weight_tying=True,
+        seed=0,
+    )
+
+
+def _make_trace(n: int, rate: float, max_new: int, seed: int):
+    """Seeded synthetic trace: Poisson arrivals (exponential interarrivals at
+    `rate`/s; rate 0 = full queue at t=0), prompt lengths 4..12, budgets
+    max_new/2..max_new (decode-heavy — the regime continuous batching targets),
+    alternating greedy / temperature 0.8."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    t = 0.0
+    trace = []
+    for i in range(n):
+        if rate > 0:
+            t += float(rng.exponential(1.0 / rate))
+        plen = int(rng.integers(4, 13))
+        trace.append(
+            {
+                "prompt": [int(x) for x in rng.integers(0, 127, size=plen)],
+                "max_new_tokens": int(rng.integers(max(2, max_new // 2), max_new + 1)),
+                "temperature": 0.0 if i % 2 == 0 else 0.8,
+                "seed": i,
+                "arrival_offset_s": t,
+            }
+        )
+    return trace
+
+
+def _replay(engine, trace, arrivals: bool):
+    t0 = time.monotonic()
+    rids = [
+        engine.submit(
+            r["prompt"],
+            r["max_new_tokens"],
+            temperature=r["temperature"],
+            seed=r["seed"],
+            arrival_offset_s=r["arrival_offset_s"] if arrivals else 0.0,
+        )
+        for r in trace
+    ]
+    results = engine.run()
+    wall = time.monotonic() - t0
+    return [results[r] for r in rids], wall
+
+
+def _percentiles_ms(values):
+    import numpy as np
+
+    if not values:
+        return None, None
+    arr = np.asarray(values, dtype=float) * 1000.0
+    return float(np.percentile(arr, 50)), float(np.percentile(arr, 99))
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__.split("\n", 1)[0])
+    parser.add_argument("--slots", type=int, default=8)
+    parser.add_argument("--requests", type=int, default=48)
+    parser.add_argument("--rate", type=float, default=500.0, help="Poisson arrivals/s; 0 = full queue at t=0")
+    parser.add_argument("--max-new", type=int, default=44)
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--smoke", action="store_true", help="6 requests, 2 slots, no baseline")
+    args = parser.parse_args()
+    if args.smoke:
+        args.requests, args.slots, args.max_new = 6, 2, 6
+
+    print(_line({"provisional": True, "reason": "startup"}), flush=True)
+    _arm_budget_guard()
+
+    import jax
+    from flax.core import meta
+
+    from modalities_tpu.serving.engine import ServingEngine
+
+    model = _tiny_model()
+    params = meta.unbox(model.init_params(jax.random.PRNGKey(0)))
+
+    def fresh_engine(slots: int) -> ServingEngine:
+        return ServingEngine(model, params, max_batch_slots=slots, eod_token_id=-1)
+
+    def warmup(engine):
+        # cover the prefill ladder (21 -> 16+4+1) and the decode step once, so
+        # compile time never lands in the measured latencies
+        engine.submit(list(range(21)), 2, temperature=0.0, seed=0)
+        engine.submit(list(range(5)), 2, temperature=0.8, seed=1)
+        engine.run()
+
+    trace = _make_trace(args.requests, args.rate, args.max_new, args.seed)
+
+    engine = fresh_engine(args.slots)
+    warmup(engine)
+    warm_tokens = engine.decode_token_count
+    results, wall = _replay(engine, trace, arrivals=True)
+    generated = sum(len(r.tokens) for r in results)
+    # throughput counts ALL emitted tokens (prefill-sampled first tokens included)
+    tokens_per_s = generated / wall if wall > 0 else 0.0
+
+    ttfts = [r.ttft_s for r in results]
+    tpots = []
+    for r in results:
+        ts = r.token_times_s
+        tpots.extend(b - a for a, b in zip(ts, ts[1:]))
+    ttft_p50, ttft_p99 = _percentiles_ms(ttfts)
+    tpot_p50, tpot_p99 = _percentiles_ms(tpots)
+
+    stats = engine.stats()
+    # occupancy over the measured window only (warmup steps excluded)
+    _ = warm_tokens
+
+    baseline_tokens_per_s = None
+    speedup = None
+    if not args.smoke:
+        baseline = fresh_engine(1)
+        warmup(baseline)
+        base_results, base_wall = _replay(baseline, trace, arrivals=False)
+        base_generated = sum(len(r.tokens) for r in base_results)
+        baseline_tokens_per_s = base_generated / base_wall if base_wall > 0 else 0.0
+        if baseline_tokens_per_s:
+            speedup = tokens_per_s / baseline_tokens_per_s
+
+    print(
+        _line(
+            {
+                "provisional": False,
+                "tokens_per_s": tokens_per_s,
+                "baseline_tokens_per_s": baseline_tokens_per_s,
+                "speedup": speedup,
+                "ttft_p50_ms": ttft_p50,
+                "ttft_p99_ms": ttft_p99,
+                "tpot_p50_ms": tpot_p50,
+                "tpot_p99_ms": tpot_p99,
+                "slot_occupancy": stats["slot_occupancy"],
+                "requests": args.requests,
+                "slots": args.slots,
+                "generated_tokens": generated,
+                "wall_s": wall,
+                "decode_steps": stats["decode_steps"],
+                "decode_executables": stats["decode_executables"],
+                "smoke": args.smoke,
+            }
+        ),
+        flush=True,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

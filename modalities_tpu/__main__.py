@@ -203,6 +203,25 @@ def entry_point_generate_text(config_file_path: Path) -> None:
     generate_text(config_file_path)
 
 
+@main.command(name="serve")
+@click.option("--config_file_path", type=click.Path(exists=True, path_type=Path), required=True)
+@click.option(
+    "--requests_file_path",
+    type=click.Path(exists=True, path_type=Path),
+    default=None,
+    help="JSONL of requests to replay through the continuous-batching engine; omit for an interactive loop.",
+)
+@click.option("--output_file_path", type=click.Path(path_type=Path), default=None)
+@_exception_handling
+def entry_point_serve(
+    config_file_path: Path, requests_file_path: Optional[Path], output_file_path: Optional[Path]
+) -> None:
+    """Continuous-batching text serving (serving/engine.py) from a sealed checkpoint."""
+    from modalities_tpu.api import serve_text
+
+    serve_text(config_file_path, requests_file_path, output_file_path)
+
+
 @main.command(name="convert_checkpoint_to_hf")
 @click.option("--config_file_path", type=click.Path(exists=True, path_type=Path), required=True)
 @click.option("--output_hf_checkpoint_dir", type=click.Path(path_type=Path), required=True)

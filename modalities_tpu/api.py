@@ -224,3 +224,19 @@ def generate_text(config_file_path: Path) -> None:
     from modalities_tpu.inference.inference import generate_text as _generate_text
 
     _generate_text(Path(config_file_path))
+
+
+def serve_text(
+    config_file_path: Path,
+    requests_file_path: Path | None = None,
+    output_file_path: Path | None = None,
+) -> None:
+    """Config-driven continuous-batching serving (serving/serve.py): replay a JSONL
+    request file, or run the interactive loop when no file is given."""
+    from modalities_tpu.serving.serve import serve
+
+    serve(
+        Path(config_file_path),
+        Path(requests_file_path) if requests_file_path else None,
+        Path(output_file_path) if output_file_path else None,
+    )

@@ -270,3 +270,16 @@ class TextGenerationSettings(BaseModel):
 class TextGenerationInstantiationModel(BaseModel):
     text_inference_component: Any
     settings: TextGenerationSettings
+
+
+class ServeSettings(BaseModel):
+    """Settings for the continuous-batching `serve` entry (serving/serve.py):
+    params come from a sealed (manifest-verified) checkpoint folder; None serves
+    fresh-init params (tests/demos)."""
+
+    checkpoint_folder_path: Optional[Path] = None
+
+
+class ServeInstantiationModel(BaseModel):
+    serving_component: Any
+    settings: ServeSettings = ServeSettings()

@@ -60,7 +60,9 @@ def generate_text(config_file_path: Path) -> None:
         tokenizer=components.tokenizer,
         prompt_template=settings.get("prompt_template", "{prompt}"),
         sequence_length=int(settings.get("sequence_length", model.sequence_length)),
-        temperature=float(settings.get("temperature", 1.0)),
+        # a YAML `temperature: null` means greedy — float(None) would raise
+        temperature=(lambda t: None if t is None else float(t))(settings.get("temperature", 1.0)),
+        seed=int(settings.get("seed", 0)),
         eod_token=settings.get("eod_token", "<eod>"),
     )
     component.run()

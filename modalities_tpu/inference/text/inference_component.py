@@ -27,6 +27,7 @@ class TextInferenceComponentConfig(BaseModel):
     prompt_template: str
     sequence_length: int
     temperature: Optional[float] = 1.0
+    seed: int = 0
     eod_token: Optional[str] = "<eod>"
     device: Optional[int | str] = None
 
@@ -38,7 +39,8 @@ class TextInferenceComponent:
         tokenizer: TokenizerWrapper,
         prompt_template: str,
         sequence_length: int,
-        temperature: float = 1.0,
+        temperature: Optional[float] = 1.0,
+        seed: int = 0,
         eod_token: str = "<eod>",
         device=None,  # accepted for config parity
         params=None,
@@ -48,7 +50,10 @@ class TextInferenceComponent:
         self.tokenizer = tokenizer
         self.prompt_template = prompt_template
         self.sequence_length = sequence_length
-        self.temperature = temperature
+        # the config declares Optional[float]: None means greedy, same as 0.0 —
+        # normalize here so every `temperature > 0` comparison downstream is safe
+        self.temperature = 0.0 if temperature is None else float(temperature)
+        self.seed = seed
         self.eod_token = eod_token
         self._jitted_forward = None
 
@@ -137,7 +142,9 @@ class TextInferenceComponent:
             self._jitted_decode_many = jax.jit(loop)
         return self._jitted_decode_many
 
-    def generate_tokens(self, context: str, max_new_tokens: Optional[int] = None) -> str:
+    def generate_tokens(
+        self, context: str, max_new_tokens: Optional[int] = None, seed: Optional[int] = None
+    ) -> str:
         import jax
 
         token_ids = list(self.tokenizer.tokenize(context))
@@ -146,7 +153,10 @@ class TextInferenceComponent:
         except Exception:
             eod_id = -1
         budget = max_new_tokens if max_new_tokens is not None else self.sequence_length - len(token_ids)
-        rng = jax.random.PRNGKey(0)
+        # sampling is reproducible but configurable: the configured seed is the
+        # default, a per-call seed overrides it (both feed the same key-split
+        # sequence through the cached and re-forward paths)
+        rng = jax.random.PRNGKey(self.seed if seed is None else seed)
         if hasattr(self.model, "decode_step") and hasattr(self.model, "init_decode_cache"):
             generated = self._generate_cached(token_ids, eod_id, max(0, budget), rng)
         else:

@@ -163,6 +163,21 @@ def swiglu_hidden_dim(ffn_hidden: int, multiple_of: int = 256) -> int:
 
 
 @dataclass(frozen=True)
+class SlotDecodeSpec:
+    """Static shape of the serving engine's batched ring KV cache (serving/engine.py).
+
+    `mode="prefill"` runs a batch-1 forward over a prompt chunk and writes its k/v
+    into cache slot `slot` starting at position `positions` (both traced scalars);
+    `mode="decode"` advances every slot by one token — tokens [slots, 1] written at
+    per-slot `positions` [slots]. Shapes are static so ONE compiled decode step (plus
+    a bounded prefill-chunk ladder) serves every request mix."""
+
+    mode: str  # "prefill" | "decode"
+    slots: int
+    capacity: int
+
+
+@dataclass(frozen=True)
 class GPT2ModelSpec:
     """Static (hashable) hyperparameters consumed by the linen modules."""
 
@@ -291,14 +306,21 @@ def _rotate_half(x):
 
 
 def apply_rope(x, cos, sin):
-    """x: [B, S, H, D]; cos/sin: [S, D]."""
-    cos = cos[None, :, None, :]
-    sin = sin[None, :, None, :]
+    """x: [B, S, H, D]; cos/sin: [S, D] shared across the batch, or [B, S, D]
+    per-batch-row (slot decode: every slot sits at its own position)."""
+    if cos.ndim == 2:
+        cos = cos[None, :, None, :]
+        sin = sin[None, :, None, :]
+    else:
+        cos = cos[:, :, None, :]
+        sin = sin[:, :, None, :]
     return x * cos + _rotate_half(x) * sin
 
 
 def masked_attention(q, k, v, mask, dropout_rate: float = 0.0, dropout_rng=None):
-    """einsum + fp32 softmax attention with an explicit [Sq, Sk] boolean mask.
+    """einsum + fp32 softmax attention with an explicit boolean mask — [Sq, Sk]
+    shared across the batch, or [B, Sq, Sk] per-batch-row (slot decode: each slot
+    attends up to its own cache length).
     q: [B,Sq,Hq,D], k/v: [B,Sk,Hkv,D]; GQA convention: q head h uses kv head h // group.
 
     `dropout_rate` > 0 applies inverted dropout to the attention *probabilities*
@@ -309,7 +331,8 @@ def masked_attention(q, k, v, mask, dropout_rate: float = 0.0, dropout_rng=None)
     group = hq // hkv
     qg = q.reshape(b, sq, hkv, group, d)
     logits = jnp.einsum("bshgd,bthd->bhgst", qg, k).astype(jnp.float32) / math.sqrt(d)
-    logits = jnp.where(mask[None, None, None, :, :], logits, jnp.finfo(jnp.float32).min)
+    mask_b = mask[None, None, None, :, :] if mask.ndim == 2 else mask[:, None, None, :, :]
+    logits = jnp.where(mask_b, logits, jnp.finfo(jnp.float32).min)
     probs = jax.nn.softmax(logits, axis=-1)
     if dropout_rate > 0.0:
         if dropout_rng is None:
@@ -370,9 +393,10 @@ class CausalSelfAttention(nn.Module):
     spec: GPT2ModelSpec
     deterministic: bool = True
     decode: bool = False
+    slot_spec: Optional[SlotDecodeSpec] = None
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, slot=None, positions=None):
         spec = self.spec
         head_dim = spec.head_dim
         q = _dense_general(spec, (spec.n_head_q, head_dim), "q_attn", ("embed", "heads", "head_dim"), x.dtype)(x)
@@ -382,6 +406,9 @@ class CausalSelfAttention(nn.Module):
         if spec.use_qk_norm and spec.qk_norm is not None:
             q = build_norm(spec.qk_norm, "q_norm", dtype=x.dtype)(q)
             k = build_norm(spec.qk_norm, "k_norm", dtype=x.dtype)(k)
+
+        if self.slot_spec is not None:
+            return self._slot_attention(x, q, k, v, slot, positions)
 
         if self.decode:
             return self._decode_attention(x, q, k, v)
@@ -485,6 +512,71 @@ class CausalSelfAttention(nn.Module):
         y = masked_attention(q, k_all, v_all, mask)
         return self._project_out(x, y)
 
+    def _slot_attention(self, x, q, k, v, slot, positions):
+        """Serving engine's batched ring KV cache (slot_spec; serving/engine.py).
+
+        Unlike `_decode_attention` there is NO in-cache position counter: positions
+        are explicit traced arguments, so one compiled step serves every slot state.
+        Cache layout: [slots, capacity, Hkv, D] per layer (leading "layers" axis added
+        by the scan). Prefill (batch 1): write a prompt chunk into row `slot` starting
+        at scalar `positions`. Decode: write one token per slot at its own
+        `positions[b]` and attend each row up to its own length — the math per slot is
+        bitwise the batch=1 `_decode_attention` step (same table rows, same update,
+        same masked softmax), which is what the batch-invariance test pins."""
+        spec = self.spec
+        ss = self.slot_spec
+        head_dim = spec.head_dim
+        cap, slots = ss.capacity, ss.slots
+
+        cached_k = self.variable(
+            "cache", "cached_key", jnp.zeros, (slots, cap, spec.n_head_kv, head_dim), k.dtype
+        )
+        cached_v = self.variable(
+            "cache", "cached_value", jnp.zeros, (slots, cap, spec.n_head_kv, head_dim), v.dtype
+        )
+
+        if ss.mode == "prefill":
+            s_in = x.shape[1]
+            start = positions  # scalar: tokens occupy cache positions start..start+s_in-1
+            if spec.use_rope:
+                cos, sin = _rope_tables(head_dim, cap, spec.rope_base_freq, dtype=x.dtype)
+                cos_i = jax.lax.dynamic_slice_in_dim(cos, start, s_in)
+                sin_i = jax.lax.dynamic_slice_in_dim(sin, start, s_in)
+                q = apply_rope(q, cos_i, sin_i)
+                k = apply_rope(k, cos_i, sin_i)
+            row_k = jax.lax.dynamic_slice(
+                cached_k.value, (slot, 0, 0, 0), (1, cap, spec.n_head_kv, head_dim)
+            )
+            row_v = jax.lax.dynamic_slice(
+                cached_v.value, (slot, 0, 0, 0), (1, cap, spec.n_head_kv, head_dim)
+            )
+            k_all = jax.lax.dynamic_update_slice(row_k, k, (0, start, 0, 0))
+            v_all = jax.lax.dynamic_update_slice(row_v, v, (0, start, 0, 0))
+            if not self.is_initializing():
+                cached_k.value = jax.lax.dynamic_update_slice(cached_k.value, k_all, (slot, 0, 0, 0))
+                cached_v.value = jax.lax.dynamic_update_slice(cached_v.value, v_all, (slot, 0, 0, 0))
+            mask = jnp.arange(cap)[None, :] <= (start + jnp.arange(s_in))[:, None]
+            y = masked_attention(q, k_all, v_all, mask)
+        else:  # decode: one new token per slot, each at its own position
+            if spec.use_rope:
+                cos, sin = _rope_tables(head_dim, cap, spec.rope_base_freq, dtype=x.dtype)
+                cos_i = jnp.take(cos, positions, axis=0)[:, None, :]
+                sin_i = jnp.take(sin, positions, axis=0)[:, None, :]
+                q = apply_rope(q, cos_i, sin_i)
+                k = apply_rope(k, cos_i, sin_i)
+
+            def write_row(buf, new, p):
+                return jax.lax.dynamic_update_slice(buf, new, (p, 0, 0))
+
+            k_all = jax.vmap(write_row)(cached_k.value, k, positions)
+            v_all = jax.vmap(write_row)(cached_v.value, v, positions)
+            if not self.is_initializing():
+                cached_k.value = k_all
+                cached_v.value = v_all
+            mask = jnp.arange(cap)[None, None, :] <= positions[:, None, None]
+            y = masked_attention(q, k_all, v_all, mask)
+        return self._project_out(x, y)
+
     def _project_out(self, x, y):
         # no dropout on y here: the reference drops attention *probabilities* inside
         # the attention op (handled in __call__) and residuals after c_proj — never
@@ -534,13 +626,16 @@ class GPT2Block(nn.Module):
     spec: GPT2ModelSpec
     deterministic: bool = True
     decode: bool = False
+    slot_spec: Optional[SlotDecodeSpec] = None
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, slot=None, positions=None):
         spec = self.spec
         x = with_logical_constraint(x, ("batch", "seq", "embed"), spec)
         h = build_norm(spec.attn_norm, "attention_norm", dtype=x.dtype)(x)
-        x = x + CausalSelfAttention(spec, self.deterministic, self.decode, name="attn")(h)
+        x = x + CausalSelfAttention(
+            spec, self.deterministic, self.decode, slot_spec=self.slot_spec, name="attn"
+        )(h, slot, positions)
         h2 = build_norm(spec.ffn_norm, "ffn_norm", dtype=x.dtype)(x)
         x = x + MLP(spec, self.deterministic, name="mlp")(h2)
         if spec.debug_print_activations == "shape":
@@ -620,6 +715,25 @@ class _BlockScanBody(nn.Module):
         return x, None
 
 
+class _SlotBlockScanBody(nn.Module):
+    """scan body for the serving slot cache: carry = (activations, slot, positions).
+    slot/positions must ride the carry — they are traced values, and module
+    attributes must be static. Inner block named "block" so trained params line up
+    with the `_BlockScanBody` layout exactly."""
+
+    spec: GPT2ModelSpec
+    deterministic: bool = True
+    slot_spec: Optional[SlotDecodeSpec] = None
+
+    @nn.compact
+    def __call__(self, carry, _):
+        x, slot, positions = carry
+        x = GPT2Block(
+            self.spec, self.deterministic, False, slot_spec=self.slot_spec, name="block"
+        )(x, slot, positions)
+        return (x, slot, positions), None
+
+
 class GPT2Module(nn.Module):
     """The linen module behind GPT2LLM: wte/wpe -> blocks -> lm_head_norm -> lm_head.
 
@@ -633,9 +747,10 @@ class GPT2Module(nn.Module):
     deterministic: bool = True
     decode: bool = False
     output_hidden: bool = False
+    slot_spec: Optional[SlotDecodeSpec] = None
 
     @nn.compact
-    def __call__(self, input_ids):
+    def __call__(self, input_ids, slot=None, positions=None):
         spec = self.spec
         compute_dtype = jnp.dtype(spec.compute_dtype)
         param_dtype = jnp.dtype(spec.param_dtype)
@@ -661,7 +776,15 @@ class GPT2Module(nn.Module):
                 (spec.sequence_length, spec.n_embd),
                 param_dtype,
             )
-            if self.decode:
+            if self.slot_spec is not None:
+                # positions are explicit (no wpe_index counter): prefill gets the
+                # scalar chunk start, decode a per-slot position vector
+                if self.slot_spec.mode == "prefill":
+                    pos = positions + jnp.arange(input_ids.shape[1])
+                    x = x + jnp.take(wpe, pos, axis=0)[None].astype(compute_dtype)
+                else:
+                    x = x + jnp.take(wpe, positions, axis=0)[:, None, :].astype(compute_dtype)
+            elif self.decode:
                 pos_var = self.variable("cache", "wpe_index", lambda: jnp.zeros((), jnp.int32))
                 pos = pos_var.value + jnp.arange(input_ids.shape[1])
                 if not self.is_initializing():
@@ -672,7 +795,18 @@ class GPT2Module(nn.Module):
         x = nn.Dropout(rate=spec.dropout)(x, deterministic=self.deterministic or spec.dropout == 0.0)
         x = with_logical_constraint(x, ("batch", "seq", "embed"))
 
-        if spec.scan_layers:
+        if spec.scan_layers and self.slot_spec is not None:
+            # serving slot-cache path: slot/positions are traced values and must ride
+            # the scan carry; same "blocks"/"block" naming so trained params apply
+            scanned = nn.scan(
+                _SlotBlockScanBody,
+                variable_axes={"params": 0, "cache": 0},
+                split_rngs={"params": True, "dropout": True},
+                length=spec.n_layer,
+                metadata_params={nn.meta.PARTITION_NAME: "layers"},
+            )(spec, self.deterministic, self.slot_spec, name="blocks")
+            (x, _, _), _ = scanned((x, slot, positions), None)
+        elif spec.scan_layers:
             scanned = nn.scan(
                 _BlockScanBody,
                 variable_axes={"params": 0, "cache": 0},
@@ -724,10 +858,12 @@ class GPT2Module(nn.Module):
             for i in range(spec.n_layer):
                 block_cls = (
                     _remat_block_cls(spec)
-                    if not self.decode and _layer_remats(spec, i)
+                    if not self.decode and self.slot_spec is None and _layer_remats(spec, i)
                     else GPT2Block
                 )
-                x = block_cls(spec, self.deterministic, self.decode, name=f"h_{i}")(x)
+                x = block_cls(
+                    spec, self.deterministic, self.decode, slot_spec=self.slot_spec, name=f"h_{i}"
+                )(x, slot, positions)
 
         x = build_norm(spec.lm_head_norm, "lm_head_norm")(x)
         x = with_logical_constraint(x, ("batch", "seq", "embed"))
@@ -908,6 +1044,73 @@ class GPT2LLM(NNModel):
         module = GPT2Module(self.config_spec, deterministic=True, decode=True)
         logits, mutated = module.apply(
             {**params, "cache": cache}, tokens, mutable=["cache"]
+        )
+        return logits, mutated["cache"]
+
+    # ------------------------------------------------- slot-batched serving decode
+    # The continuous-batching engine's model surface (serving/engine.py): a batched
+    # ring KV cache of static [slots, capacity] shape with EXPLICIT per-slot
+    # positions (no in-cache counter), so one compiled decode step plus a bounded
+    # prefill ladder serves every request mix without recompiles.
+
+    @staticmethod
+    def _slot_cache_dims(cache) -> tuple[int, int]:
+        """(slots, capacity) recovered from the cache leaf shapes — static, so the
+        engine never has to thread them alongside the tree."""
+        for leaf in jax.tree.leaves(cache):
+            if leaf.ndim == 5:  # scanned: [layers, slots, capacity, Hkv, D]
+                return int(leaf.shape[1]), int(leaf.shape[2])
+            if leaf.ndim == 4:  # unrolled blocks: [slots, capacity, Hkv, D]
+                return int(leaf.shape[0]), int(leaf.shape[1])
+        raise ValueError("not a slot KV cache: no [.., slots, capacity, heads, head_dim] leaf")
+
+    def init_slot_cache(self, params, max_batch_slots: int, cache_capacity: Optional[int] = None):
+        """Zeroed [slots, capacity] ring KV cache for `prefill_slot`/`decode_slots`.
+        Shapes via abstract init (eval_shape) — no materialization."""
+        cap = self.config_spec.sequence_length if cache_capacity is None else int(cache_capacity)
+        if (
+            cap > self.config_spec.sequence_length
+            and self.config_spec.poe_type == PositionTypes.ABSOLUTE.value
+        ):
+            raise ValueError(
+                f"cache_capacity {cap} exceeds sequence_length "
+                f"{self.config_spec.sequence_length}: ABSOLUTE position embeddings "
+                "have no rows past the trained sequence length"
+            )
+        sspec = SlotDecodeSpec("decode", int(max_batch_slots), cap)
+        module = GPT2Module(self.config_spec, deterministic=True, slot_spec=sspec)
+        tokens = jnp.zeros((int(max_batch_slots), 1), dtype=jnp.int32)
+        positions = jnp.zeros((int(max_batch_slots),), dtype=jnp.int32)
+        abstract = jax.eval_shape(
+            lambda: module.init(jax.random.PRNGKey(0), tokens, None, positions)
+        )
+        return jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), abstract["cache"])
+
+    def prefill_slot(self, params, cache, tokens, slot, start_pos):
+        """Forward a [1, C] prompt chunk, writing k/v into cache row `slot` at
+        positions start_pos..start_pos+C-1. Returns (logits [1, C, V], cache).
+        Chunk length C is the only shape that varies — the engine buckets it on the
+        power-of-two ladder so the jit cache stays bounded."""
+        slots, cap = self._slot_cache_dims(cache)
+        module = GPT2Module(
+            self.config_spec, deterministic=True, slot_spec=SlotDecodeSpec("prefill", slots, cap)
+        )
+        logits, mutated = module.apply(
+            {**params, "cache": cache}, tokens, slot, start_pos, mutable=["cache"]
+        )
+        return logits, mutated["cache"]
+
+    def decode_slots(self, params, cache, tokens, positions):
+        """ONE batched decode step: tokens [slots, 1] written at per-slot
+        `positions` [slots]; every slot advances one token per dispatch. Returns
+        (logits [slots, 1, V], cache). Idle slots compute garbage harmlessly — the
+        engine masks them on the host and re-prefills over their rows."""
+        slots, cap = self._slot_cache_dims(cache)
+        module = GPT2Module(
+            self.config_spec, deterministic=True, slot_spec=SlotDecodeSpec("decode", slots, cap)
+        )
+        logits, mutated = module.apply(
+            {**params, "cache": cache}, tokens, None, positions, mutable=["cache"]
         )
         return logits, mutated["cache"]
 

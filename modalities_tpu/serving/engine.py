@@ -1,0 +1,489 @@
+"""Continuous-batching decode engine over the slot-indexed GPT2 KV cache.
+
+Design (the GSPMD serving argument, arXiv 2105.04663): training already produced
+mesh-sharded params and sharding rules; serving reuses them unchanged. The batched
+ring KV cache is allocated ONCE at a static [max_batch_slots, cache_capacity] shape
+and annotated with the same NamedShardings (slots ride the "batch" logical axis,
+kv heads the "kv_heads"/tp axis, layers the pp axis), so XLA partitions the decode
+step exactly like a train step — no serving-specific parallelism code.
+
+Execution model:
+- prefill: shape-bucketed jitted forward of one prompt (batch 1) into an arbitrary
+  cache slot, chunked on the `_PREFILL_CHUNKS` power-of-two ladder the interactive
+  path uses (inference/text/inference_component.py) — bounded compile count.
+- decode: ONE compiled step advances every slot by one token per dispatch.
+  Per-slot temperature/greedy sampling and per-slot eod/budget stopping are folded
+  into the step via `jnp.where` — no per-request recompiles, no host round-trip
+  per token beyond the single small (tokens, finished) fetch that drives the
+  scheduler.
+- scheduling (plain Python, off the jitted path): a FIFO queue admits requests
+  into idle slots at token boundaries; finished slots are evicted immediately, so
+  under load the batch stays full instead of draining to the slowest request.
+
+Batch-invariance contract (pinned by tests/serving/test_engine.py): with exactly
+one active slot the engine emits token-for-token what the interactive
+`_generate_cached` path emits for the same (prompt, budget, temperature, seed) —
+same key-split sequence, same categorical shapes, bitwise-identical logits rows.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+from modalities_tpu.telemetry import span
+
+# mirror of TextInferenceComponent._PREFILL_CHUNKS: the same power-of-two ladder,
+# overridable via MODALITIES_TPU_SERVE_PREFILL_CHUNKS (comma list, descending,
+# must end in 1 so any prompt length decomposes)
+_DEFAULT_PREFILL_CHUNKS = (64, 16, 4, 1)
+
+_IDLE_REMAINING = np.int32(2**30)  # idle slots never trip the budget stop
+
+
+def _prefill_chunks_from_env() -> tuple[int, ...]:
+    raw = os.environ.get("MODALITIES_TPU_SERVE_PREFILL_CHUNKS")
+    if not raw:
+        return _DEFAULT_PREFILL_CHUNKS
+    chunks = tuple(int(c) for c in raw.split(",") if c.strip())
+    if not chunks or chunks[-1] != 1 or list(chunks) != sorted(chunks, reverse=True):
+        raise ValueError(
+            f"MODALITIES_TPU_SERVE_PREFILL_CHUNKS={raw!r}: need a descending comma "
+            "list ending in 1 (e.g. '64,16,4,1')"
+        )
+    return chunks
+
+
+@dataclass
+class ServeRequest:
+    """One generation request. `temperature=None` inherits the engine default
+    (which itself defaults to greedy); `arrival_offset_s` is seconds after
+    `run()` starts — the load generator replays traces with it."""
+
+    rid: int
+    prompt_tokens: list[int]
+    max_new_tokens: int
+    temperature: Optional[float] = None
+    seed: int = 0
+    arrival_offset_s: float = 0.0
+
+
+@dataclass
+class ServeResult:
+    rid: int
+    tokens: list[int] = field(default_factory=list)
+    finish_reason: str = ""  # "eod" | "budget" | "capacity"
+    prompt_len: int = 0
+    arrival_s: float = 0.0  # engine-clock arrival
+    first_token_s: float = 0.0  # engine-clock time the first token was available
+    finish_s: float = 0.0
+    token_times_s: list[float] = field(default_factory=list)
+
+    @property
+    def ttft_s(self) -> float:
+        return self.first_token_s - self.arrival_s
+
+
+@dataclass
+class _SlotState:
+    request: ServeRequest
+    result: ServeResult
+    remaining: int  # tokens still allowed, counting the one in flight
+
+
+class ServingEngine:
+    """See module docstring. `params` is the unboxed variables dict
+    ({"params": ...}); `mesh_handle` (optional) shards params + cache over the
+    training mesh via parallel/sharding.py rules."""
+
+    def __init__(
+        self,
+        model,
+        params,
+        *,
+        max_batch_slots: int = 8,
+        cache_capacity: Optional[int] = None,
+        eod_token_id: int = -1,
+        default_temperature: Optional[float] = None,
+        prefill_chunks: Optional[tuple[int, ...]] = None,
+        mesh_handle=None,
+        time_fn=None,
+    ):
+        if not (hasattr(model, "init_slot_cache") and hasattr(model, "decode_slots")):
+            raise ValueError(
+                f"{type(model).__name__} does not expose the slot-cache decode API "
+                "(init_slot_cache/prefill_slot/decode_slots)"
+            )
+        spec_len = int(model.config_spec.sequence_length)
+        self.model = model
+        self.params = params
+        self.slots = int(max_batch_slots)
+        self.capacity = min(int(cache_capacity), spec_len) if cache_capacity else spec_len
+        self.eod_token_id = int(eod_token_id)
+        self.default_temperature = default_temperature
+        self.prefill_chunks = tuple(prefill_chunks) if prefill_chunks else _prefill_chunks_from_env()
+        self._now = time_fn if time_fn is not None else time.monotonic
+        if self.slots < 1:
+            raise ValueError("max_batch_slots must be >= 1")
+        if self.capacity < 2:
+            raise ValueError("cache_capacity must be >= 2 (1 prompt token + 1 generated)")
+
+        self._mesh_handle = mesh_handle
+        self._rules = None
+        self._cache_shardings = None
+        if mesh_handle is not None:
+            self._install_shardings(mesh_handle)
+
+        import jax
+        import jax.numpy as jnp
+
+        self._jnp = jnp
+        self.cache = model.init_slot_cache(params, self.slots, self.capacity)
+        if self._cache_shardings is not None:
+            self.cache = jax.device_put(self.cache, self._cache_shardings)
+
+        # host-side mirrors of the per-slot device state
+        b = self.slots
+        self._tokens = np.zeros((b, 1), np.int32)
+        self._positions = np.zeros((b,), np.int32)
+        self._keys = np.zeros((b, 2), np.uint32)
+        self._temps = np.ones((b,), np.float32)
+        self._eods = np.full((b,), -1, np.int32)
+        self._remaining = np.full((b,), _IDLE_REMAINING, np.int32)
+        self._slot_states: list[Optional[_SlotState]] = [None] * b
+
+        self._queue: deque[ServeRequest] = deque()
+        self._results: dict[int, ServeResult] = {}
+        self._next_rid = 0
+
+        # trace counters: the traced fn bodies run once per COMPILATION, so these
+        # pin "one decode executable, bounded prefill ladder" in tests
+        self._decode_traces = 0
+        self._prefill_traces = 0
+        self.decode_steps = 0
+        self.decode_token_count = 0
+        self._occupancy_sum = 0
+        self.max_concurrent = 0
+
+        self._build_jits()
+
+    # ------------------------------------------------------------------ sharding
+    def _install_shardings(self, mesh_handle) -> None:
+        import jax
+        from jax.sharding import NamedSharding
+
+        from modalities_tpu.parallel.sharding import (
+            default_logical_axis_rules,
+            logical_to_mesh_spec,
+            params_shardings,
+        )
+
+        self._rules = default_logical_axis_rules(mesh_handle)
+        dp = int(mesh_handle.degrees.get("dp_replicate", 1)) * int(
+            mesh_handle.degrees.get("dp_shard", 1)
+        )
+        if self.slots % max(dp, 1) != 0:
+            raise ValueError(
+                f"max_batch_slots={self.slots} must be divisible by the mesh's data-"
+                f"parallel degree {dp}: cache slots ride the 'batch' logical axis"
+            )
+        mesh = mesh_handle.mesh
+
+        def leaf_sharding(leaf):
+            # scanned cache leaf: [layers, slots, capacity, kv_heads, head_dim]
+            if leaf.ndim == 5:
+                axes = ("layers", "batch", None, "kv_heads", "head_dim")
+            elif leaf.ndim == 4:  # unrolled blocks
+                axes = ("batch", None, "kv_heads", "head_dim")
+            else:
+                axes = (None,) * leaf.ndim
+            logical = tuple(a if a is not None else "head_dim" for a in axes)
+            spec = logical_to_mesh_spec(logical, self._rules)
+            # "head_dim" resolves to None in the rules — used here as the
+            # explicit "replicated dim" placeholder
+            return NamedSharding(mesh, spec)
+
+        abstract_cache = jax.eval_shape(
+            lambda: self.model.init_slot_cache(self.params, self.slots, self.capacity)
+        )
+        self._cache_shardings = jax.tree.map(leaf_sharding, abstract_cache)
+
+        abstract_params = jax.eval_shape(
+            lambda: self.model.init_params(jax.random.PRNGKey(0))
+        )
+        self.params = jax.device_put(
+            self.params, params_shardings(abstract_params, self._rules, mesh)
+        )
+
+    def _rules_ctx(self):
+        from contextlib import nullcontext
+
+        if self._rules is None:
+            return nullcontext()
+        from modalities_tpu.parallel.sharding import activation_rules
+
+        return activation_rules(self._rules, self._mesh_handle.mesh)
+
+    # ---------------------------------------------------------------- jitted fns
+    def _build_jits(self) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        model = self.model
+        cache_shardings = self._cache_shardings
+        engine = self
+
+        def _constrain_cache(cache):
+            if cache_shardings is None:
+                return cache
+            return jax.tree.map(
+                lambda x, s: jax.lax.with_sharding_constraint(x, s), cache, cache_shardings
+            )
+
+        def prefill_fn(params, cache, tokens, slot, start, key, temp, sample_flag):
+            engine._prefill_traces += 1  # trace-time side effect: 1 per compiled shape
+            logits, cache = model.prefill_slot(params, cache, tokens, slot, start)
+            last = logits[:, -1, :]  # [1, V] — same shape the interactive path samples
+            greedy = temp <= 0.0
+            ks = jax.random.split(key)
+            tok_s = jax.random.categorical(ks[1], last / jnp.maximum(temp, 1e-6))[0]
+            tok_g = jnp.argmax(last, axis=-1)[0]
+            tok = jnp.where(greedy, tok_g, tok_s).astype(jnp.int32)
+            # the key advances only when a sample was actually drawn (last chunk,
+            # non-greedy) — exactly the interactive path's key-split discipline
+            new_key = jnp.where(sample_flag & ~greedy, ks[0], key)
+            tok = jnp.where(sample_flag, tok, jnp.int32(-1))
+            return _constrain_cache(cache), tok, new_key
+
+        def decode_fn(params, cache, tokens, positions, keys, temps, eods, remaining):
+            engine._decode_traces += 1  # must stay 1: ONE executable for the whole trace
+            logits, cache = model.decode_slots(params, cache, tokens, positions)
+            rows = logits[:, 0, :]  # [slots, V]
+
+            def samp(key, row, temp):
+                greedy = temp <= 0.0
+                ks = jax.random.split(key)
+                # row[None, :]: categorical must see the interactive path's [1, V]
+                # operand so the gumbel draw is bitwise identical per key
+                tok_s = jax.random.categorical(ks[1], row[None, :] / jnp.maximum(temp, 1e-6))[0]
+                tok_g = jnp.argmax(row)
+                tok = jnp.where(greedy, tok_g, tok_s).astype(jnp.int32)
+                return tok, jnp.where(greedy, key, ks[0])
+
+            toks, new_keys = jax.vmap(samp)(keys, rows, temps)
+            # per-slot stopping folded into the step: eod never emits, budget
+            # emits its last token then stops — the host only reads flags
+            finished = (toks == eods) | (remaining <= 1)
+            return _constrain_cache(cache), toks, new_keys, finished
+
+        self._prefill_jit = jax.jit(prefill_fn, donate_argnums=(1,))
+        self._decode_jit = jax.jit(decode_fn, donate_argnums=(1,))
+
+    # ---------------------------------------------------------------- submission
+    def submit(
+        self,
+        prompt_tokens: list[int],
+        max_new_tokens: int,
+        temperature: Optional[float] = ...,
+        seed: int = 0,
+        arrival_offset_s: float = 0.0,
+    ) -> int:
+        if not prompt_tokens:
+            raise ValueError("empty prompt: the engine needs at least one prompt token")
+        rid = self._next_rid
+        self._next_rid += 1
+        temp = self.default_temperature if temperature is ... else temperature
+        self._queue.append(
+            ServeRequest(
+                rid=rid,
+                prompt_tokens=[int(t) for t in prompt_tokens],
+                max_new_tokens=int(max_new_tokens),
+                temperature=temp,
+                seed=int(seed),
+                arrival_offset_s=float(arrival_offset_s),
+            )
+        )
+        return rid
+
+    # ---------------------------------------------------------------- scheduling
+    def _finish(self, slot: int, reason: str, now: float) -> None:
+        state = self._slot_states[slot]
+        state.result.finish_reason = reason
+        state.result.finish_s = now
+        self._results[state.request.rid] = state.result
+        self._slot_states[slot] = None
+        self._remaining[slot] = _IDLE_REMAINING
+        self._eods[slot] = -1
+        self._temps[slot] = 1.0
+
+    def _admit(self, t0: float) -> None:
+        """Fill idle slots from the queue (FIFO, arrival-gated): chunked prefill
+        into the freed slot, first token sampled on-device by the last chunk."""
+        import jax
+
+        jnp = self._jnp
+        for slot in range(self.slots):
+            if not self._queue:
+                break
+            if self._slot_states[slot] is not None:
+                continue
+            now = self._now() - t0
+            req = self._queue[0]
+            if req.arrival_offset_s > now:
+                break  # FIFO: later requests can't jump an unarrived head
+            self._queue.popleft()
+            with span("serve/admission"):
+                window = req.prompt_tokens[-(self.capacity - 1) :]
+                temp = req.temperature if req.temperature is not None else 0.0
+                result = ServeResult(
+                    rid=req.rid, prompt_len=len(req.prompt_tokens),
+                    arrival_s=max(req.arrival_offset_s, 0.0),
+                )
+                if req.max_new_tokens <= 0:
+                    result.finish_reason = "budget"
+                    now2 = self._now() - t0
+                    result.first_token_s = now2
+                    result.finish_s = now2
+                    self._results[req.rid] = result
+                    continue
+                key = jax.random.PRNGKey(req.seed)
+                pos = 0
+                with span("serve/prefill"):
+                    while pos < len(window):
+                        chunk = next(c for c in self.prefill_chunks if c <= len(window) - pos)
+                        toks = np.asarray([window[pos : pos + chunk]], dtype=np.int32)
+                        is_last = pos + chunk >= len(window)
+                        with self._rules_ctx():
+                            self.cache, tok, key = self._prefill_jit(
+                                self.params, self.cache, jnp.asarray(toks),
+                                np.int32(slot), np.int32(pos), key,
+                                np.float32(temp), np.bool_(is_last),
+                            )
+                        pos += chunk
+                first_tok = int(tok)  # device sync: the request's TTFT point
+                now2 = self._now() - t0
+                result.first_token_s = now2
+                if first_tok == self.eod_token_id:
+                    self._finish_immediate(result, "eod", now2)
+                    continue
+                result.tokens.append(first_tok)
+                result.token_times_s.append(now2)
+                if req.max_new_tokens == 1:
+                    self._finish_immediate(result, "budget", now2)
+                    continue
+                # arm the slot: the admitted request joins the next decode dispatch
+                self._slot_states[slot] = _SlotState(
+                    request=req, result=result, remaining=req.max_new_tokens - 1
+                )
+                self._tokens[slot, 0] = first_tok
+                self._positions[slot] = len(window)
+                self._keys[slot] = np.asarray(key)
+                self._temps[slot] = temp
+                self._eods[slot] = self.eod_token_id
+                self._remaining[slot] = req.max_new_tokens - 1
+
+    def _finish_immediate(self, result: ServeResult, reason: str, now: float) -> None:
+        result.finish_reason = reason
+        result.finish_s = now
+        self._results[result.rid] = result
+
+    def _active_count(self) -> int:
+        return sum(1 for s in self._slot_states if s is not None)
+
+    def _decode_dispatch(self, t0: float) -> None:
+        """ONE compiled step for the whole batch, then host bookkeeping on the
+        small (tokens, finished) fetch. Idle slots compute garbage harmlessly:
+        their positions never advance and admission re-prefills over their rows."""
+        import jax
+
+        jnp = self._jnp
+        with span("serve/decode"):
+            with self._rules_ctx():
+                self.cache, toks_d, keys_d, fin_d = self._decode_jit(
+                    self.params, self.cache,
+                    jnp.asarray(self._tokens), jnp.asarray(self._positions),
+                    jnp.asarray(self._keys), jnp.asarray(self._temps),
+                    jnp.asarray(self._eods), jnp.asarray(self._remaining),
+                )
+            toks, keys, finished = jax.device_get((toks_d, keys_d, fin_d))
+        now = self._now() - t0
+        self.decode_steps += 1
+        active = self._active_count()
+        self._occupancy_sum += active
+        self.max_concurrent = max(self.max_concurrent, active)
+        for slot in range(self.slots):
+            state = self._slot_states[slot]
+            if state is None:
+                continue
+            self._positions[slot] += 1  # the fed token landed in the cache
+            tok = int(toks[slot])
+            self._keys[slot] = keys[slot]
+            if tok == self.eod_token_id:
+                self._finish(slot, "eod", now)
+                continue
+            state.result.tokens.append(tok)
+            state.result.token_times_s.append(now)
+            self.decode_token_count += 1
+            if finished[slot]:  # budget exhausted (eod handled above)
+                self._finish(slot, "budget", now)
+                continue
+            state.remaining -= 1
+            self._remaining[slot] = state.remaining
+            self._tokens[slot, 0] = tok
+            if self._positions[slot] >= self.capacity:
+                # ring full: the interactive path falls back to a sliding-window
+                # re-forward; the engine finishes the request instead (documented
+                # divergence — docs/components.md serving section)
+                self._finish(slot, "capacity", now)
+
+    def run(self) -> dict[int, ServeResult]:
+        """Serve until queue and slots drain. Returns rid -> ServeResult."""
+        t0 = self._now()
+        while self._queue or self._active_count():
+            self._admit(t0)
+            if self._active_count() == 0:
+                if not self._queue:
+                    break
+                # nothing running and the head hasn't arrived: wait for it
+                wait = self._queue[0].arrival_offset_s - (self._now() - t0)
+                if wait > 0:
+                    time.sleep(min(wait, 0.05))
+                continue
+            self._decode_dispatch(t0)
+        return self._results
+
+    # -------------------------------------------------------------------- stats
+    def stats(self) -> dict:
+        occupancy = (
+            self._occupancy_sum / (self.decode_steps * self.slots)
+            if self.decode_steps
+            else 0.0
+        )
+        return {
+            "decode_steps": self.decode_steps,
+            "decode_tokens": self.decode_token_count,
+            "slot_occupancy": occupancy,
+            "max_concurrent": self.max_concurrent,
+            "decode_executables": self._decode_traces,
+            "prefill_executables": self._prefill_traces,
+            "slots": self.slots,
+            "capacity": self.capacity,
+        }
+
+    def decode_lowered_text(self) -> str:
+        """Lowered HLO of the decode step with the CURRENT arg shardings — the
+        sharding acceptance test greps this for mesh annotations."""
+        jnp = self._jnp
+        with self._rules_ctx():
+            lowered = self._decode_jit.lower(
+                self.params, self.cache,
+                jnp.asarray(self._tokens), jnp.asarray(self._positions),
+                jnp.asarray(self._keys), jnp.asarray(self._temps),
+                jnp.asarray(self._eods), jnp.asarray(self._remaining),
+            )
+        return lowered.as_text()

@@ -1,0 +1,230 @@
+"""`serve` CLI glue: DI component + config surface for the continuous-batching
+engine (serving/engine.py).
+
+Mirrors the generate_text wiring (inference/inference.py): the
+`inference_component.serve` variant is registered dynamically against the shared
+registry, params come from a sealed checkpoint (manifest-verified,
+resilience/manifest.py) or a fresh init, and the component either replays a JSONL
+request file (batch mode — the bench path) or runs an interactive loop."""
+
+from __future__ import annotations
+
+import json
+import logging
+from pathlib import Path
+from typing import Optional
+
+from pydantic import BaseModel
+
+from modalities_tpu.config.pydantic_if_types import (
+    PydanticDeviceMeshIFType,
+    PydanticModelIFType,
+    PydanticTokenizerIFType,
+)
+from modalities_tpu.config.yaml_interp import load_app_config_dict
+
+logger = logging.getLogger(__name__)
+
+
+class ServingComponentConfig(BaseModel):
+    """Schema of the `serving_component` node in configs/config_serve.yaml."""
+
+    model: PydanticModelIFType
+    tokenizer: PydanticTokenizerIFType
+    device_mesh: Optional[PydanticDeviceMeshIFType] = None
+    max_batch_slots: int = 8
+    cache_capacity: Optional[int] = None
+    max_new_tokens: int = 64
+    temperature: Optional[float] = None  # None = greedy
+    seed: int = 0
+    prompt_template: str = "{prompt}"
+    eod_token: Optional[str] = "<eod>"
+
+
+class ServingComponent:
+    """Continuous-batching serving as a DI component: holds the engine knobs,
+    builds the `ServingEngine` lazily once params are resolved."""
+
+    def __init__(
+        self,
+        model,
+        tokenizer,
+        device_mesh=None,
+        max_batch_slots: int = 8,
+        cache_capacity: Optional[int] = None,
+        max_new_tokens: int = 64,
+        temperature: Optional[float] = None,
+        seed: int = 0,
+        prompt_template: str = "{prompt}",
+        eod_token: Optional[str] = "<eod>",
+        params=None,
+    ):
+        self.model = model
+        self.tokenizer = tokenizer
+        self.device_mesh = device_mesh
+        self.max_batch_slots = max_batch_slots
+        self.cache_capacity = cache_capacity
+        self.max_new_tokens = max_new_tokens
+        self.temperature = temperature
+        self.seed = seed
+        self.prompt_template = prompt_template
+        self.eod_token = eod_token
+        self.params = params
+        self._engine = None
+
+    def _eod_id(self) -> int:
+        try:
+            return self.tokenizer.get_token_id(self.eod_token)
+        except Exception:
+            return -1
+
+    def build_engine(self):
+        from modalities_tpu.serving.engine import ServingEngine
+
+        if self._engine is None:
+            if self.params is None:
+                raise ValueError("params not resolved — serve() loads them first")
+            self._engine = ServingEngine(
+                self.model,
+                self.params,
+                max_batch_slots=self.max_batch_slots,
+                cache_capacity=self.cache_capacity,
+                eod_token_id=self._eod_id(),
+                default_temperature=self.temperature,
+                mesh_handle=self.device_mesh,
+            )
+        return self._engine
+
+    def run_requests(self, requests: list[dict]) -> list[dict]:
+        """Replay parsed requests ({"prompt", "max_new_tokens"?, "temperature"?,
+        "seed"?, "arrival_offset_s"?}) through the engine; returns JSONL-ready rows."""
+        engine = self.build_engine()
+        rid_to_req = {}
+        for req in requests:
+            text = self.prompt_template.format(prompt=req["prompt"])
+            rid = engine.submit(
+                list(self.tokenizer.tokenize(text)),
+                int(req.get("max_new_tokens", self.max_new_tokens)),
+                temperature=req.get("temperature", self.temperature),
+                seed=int(req.get("seed", self.seed)),
+                arrival_offset_s=float(req.get("arrival_offset_s", 0.0)),
+            )
+            rid_to_req[rid] = req
+        results = engine.run()
+        rows = []
+        for rid, req in rid_to_req.items():
+            res = results[rid]
+            rows.append(
+                {
+                    "rid": rid,
+                    "prompt": req["prompt"],
+                    "completion": self.tokenizer.decode(res.tokens),
+                    "tokens": res.tokens,
+                    "finish_reason": res.finish_reason,
+                    "ttft_s": res.ttft_s,
+                    "latency_s": res.finish_s - res.arrival_s,
+                }
+            )
+        return rows
+
+    def run(self) -> None:
+        """Interactive loop (parity with TextInferenceComponent.run)."""
+        engine = self.build_engine()
+        while True:
+            try:
+                prompt = input("serve> ").strip()
+            except (EOFError, KeyboardInterrupt):
+                print()
+                break
+            if not prompt:
+                continue
+            text = self.prompt_template.format(prompt=prompt) if self.prompt_template else prompt
+            rid = engine.submit(
+                list(self.tokenizer.tokenize(text)),
+                self.max_new_tokens,
+                temperature=self.temperature,
+                seed=self.seed,
+            )
+            res = engine.run()[rid]
+            print(self.tokenizer.decode(res.tokens))
+
+
+def build_serving_components(config_dict: dict):
+    from modalities_tpu.config.component_factory import ComponentFactory
+    from modalities_tpu.config.instantiation_models import ServeInstantiationModel
+    from modalities_tpu.registry.components import COMPONENTS
+    from modalities_tpu.registry.registry import ComponentEntity, Registry
+
+    registry = Registry(COMPONENTS)
+    registry.add_entity(
+        ComponentEntity("inference_component", "serve", ServingComponent, ServingComponentConfig)
+    )
+    return ComponentFactory(registry).build_components(config_dict, ServeInstantiationModel)
+
+
+def _resolve_params(component, checkpoint_folder_path) -> None:
+    """Sealed-checkpoint param loading: manifest-verify the folder (refusing a
+    corrupt one beats serving garbage), restore single-device, extract the params
+    subtree from AppState checkpoints. No checkpoint -> fresh init (tests/demos)."""
+    import jax
+
+    from flax.core import meta
+
+    if component.params is not None:
+        return
+    if checkpoint_folder_path:
+        folder = Path(checkpoint_folder_path)
+        from modalities_tpu.resilience.manifest import verify_manifest
+
+        verification = verify_manifest(folder)
+        if not verification.ok:
+            raise ValueError(
+                f"refusing to serve from {folder}: checkpoint failed manifest "
+                f"verification ({verification.reason})"
+            )
+        from modalities_tpu.checkpointing.orbax.orbax_checkpoint_loading import (
+            restore_tree_single_device,
+        )
+
+        restored = restore_tree_single_device(folder)
+        if isinstance(restored, dict) and "opt_state" in restored:
+            component.params = restored["params"]
+        else:
+            component.params = restored
+    else:
+        logger.warning("serve: no checkpoint_folder_path — serving fresh-init params")
+        component.params = meta.unbox(component.model.init_params(jax.random.PRNGKey(0)))
+
+
+def serve(
+    config_file_path: Path,
+    requests_file_path: Optional[Path] = None,
+    output_file_path: Optional[Path] = None,
+) -> None:
+    """Entry point behind `python -m modalities_tpu serve`. With a JSONL requests
+    file: replay it and write result rows (stdout or --output_file_path). Without:
+    interactive prompt loop."""
+    config_dict = load_app_config_dict(config_file_path)
+    components = build_serving_components(config_dict)
+    component = components.serving_component
+    _resolve_params(component, getattr(components.settings, "checkpoint_folder_path", None))
+
+    if requests_file_path is None:
+        component.run()
+        return
+
+    requests = []
+    with open(requests_file_path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                requests.append(json.loads(line))
+    rows = component.run_requests(requests)
+    out_lines = [json.dumps(row) for row in rows]
+    if output_file_path is not None:
+        Path(output_file_path).write_text("\n".join(out_lines) + "\n")
+    else:
+        for line in out_lines:
+            print(line)
+    stats = component.build_engine().stats()
+    logger.info("serve stats: %s", json.dumps(stats))

@@ -44,6 +44,7 @@ BUCKETS = (
     "checkpoint",
     "publish",
     "recovery",
+    "serve",
     "other",
 )
 
@@ -69,6 +70,10 @@ _NAME_TO_BUCKET = {
     "recovery": "recovery",
     "heartbeat": "recovery",
     "consensus": "recovery",
+    # serving engine (serving/engine.py): "serve/prefill", "serve/decode",
+    # "serve/admission" all land in one bucket — decode-step seconds over total
+    # serve seconds is the engine's goodput
+    "serve": "serve",
 }
 
 

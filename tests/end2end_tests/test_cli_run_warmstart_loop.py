@@ -60,6 +60,11 @@ def _train_lines(workdir, exclude=()):
     return dirs[0].name, [r for r in lines if r["dataloader_tag"] == "train"]
 
 
+@pytest.mark.xfail(
+    strict=False,
+    reason="jax 0.4.37: partial-auto shard_map (auto axes) unsupported — "
+    "parallel/jax_compat.py guard; see docs/known_failures.md",
+)
 def test_cli_run_then_warmstart_subprocess_loop(workdir):
     _cli(
         ["run", "--config_file_path", str(RUN_CONFIG),

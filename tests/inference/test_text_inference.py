@@ -98,6 +98,47 @@ def test_fused_loop_temperature_sampling_matches_reforward_path():
     assert out_cached == reforward.tokenizer.decode(ids)
 
 
+def test_temperature_none_is_treated_as_greedy():
+    """temperature: null in YAML reaches the component as None; it used to crash
+    at `self.temperature > 0` — None must mean greedy (PR 8 satellite). The
+    __init__ normalization makes None == 0.0 by construction, so one component
+    (no second compile) pins both the crash and the equivalence."""
+    from flax.core import meta
+
+    model = tiny_gpt2("manual")
+    params = meta.unbox(model.init_params(jax.random.PRNGKey(0)))
+    comp = TextInferenceComponent(
+        model=model, params=params, tokenizer=_Tok(), prompt_template="{prompt}",
+        sequence_length=32, temperature=None, eod_token="<eod>",
+    )
+    assert comp.temperature == 0.0  # greedy, same traced path as temperature: 0
+    out = comp.generate_tokens("hello", max_new_tokens=8)
+    assert out == comp.generate_tokens("hello", max_new_tokens=8)  # deterministic
+
+
+def test_seed_knob_reproduces_and_varies_sampled_output():
+    """The sampling key comes from the configured `seed` (no more hardcoded
+    PRNGKey(0)); a per-call seed overrides it; both are reproducible."""
+    from flax.core import meta
+
+    model = tiny_gpt2("manual")
+    params = meta.unbox(model.init_params(jax.random.PRNGKey(0)))
+    comp = TextInferenceComponent(
+        model=model, params=params, tokenizer=_Tok(), prompt_template="{prompt}",
+        sequence_length=32, temperature=0.9, eod_token="<eod>", seed=3,
+    )
+    out_a = comp.generate_tokens("hello world", max_new_tokens=10)
+    # the configured seed is the default; an equal per-call seed reproduces it
+    assert out_a == comp.generate_tokens("hello world", max_new_tokens=10)
+    assert out_a == comp.generate_tokens("hello world", max_new_tokens=10, seed=3)
+    # some other seed draws a different continuation (the chance that all 4
+    # collide across 10 sampled tokens each is ~0)
+    others = {
+        comp.generate_tokens("hello world", max_new_tokens=10, seed=s) for s in range(4, 8)
+    }
+    assert others != {out_a}
+
+
 def test_kv_cache_greedy_matches_reforward_path():
     """The cached generation loop must emit the same greedy tokens as the full
     re-forward fallback (VERDICT r1 #8 acceptance: identical output, O(1) steps)."""

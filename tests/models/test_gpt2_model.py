@@ -182,6 +182,7 @@ def test_swiglu_hidden_dim():
     assert swiglu_hidden_dim(768, 256) == 512
 
 
+@pytest.mark.slow  # ~15 s remat-policy variant; scan-path remat is the production config
 def test_selective_layer_remat_honored_on_unrolled_blocks():
     """SELECTIVE_LAYER ac_freq > 1 (remat every freq-th block) needs per-layer remat
     decisions: honored on the unrolled-blocks model, numerics identical to no-remat;
@@ -320,6 +321,7 @@ def test_weight_tying_parameter_count_and_absence_of_head():
     assert not any("lm_head" in n and "norm" not in n for n in names)
 
 
+@pytest.mark.slow  # ~10 s; tying is pinned by the parameter-count test and every tied e2e run
 def test_weight_tying_gradient_flows_through_both_uses():
     """Reference test_weight_tying_behavior, functional form. The discriminating
     signal is an UNSEEN vocab row: a lookup-only (untied) embedding gets exactly

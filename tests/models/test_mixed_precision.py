@@ -6,6 +6,7 @@ stays in compute_dtype, and reduce_dtype governs gradient accumulation."""
 
 import jax
 import numpy as np
+import pytest
 
 from modalities_tpu.models.model import MixedPrecisionSpec
 from modalities_tpu.models.model_factory import ModelFactory
@@ -63,6 +64,8 @@ def test_bf16_param_dtype_is_honored_and_trains():
     assert dtypes_after == dtypes
 
 
+@pytest.mark.slow  # ~27 s; dropout determinism also pinned by the pp dropout tests in
+# test_train_step.py and test_manual_and_sdpa_tiers_share_attn_dropout_path
 def test_dropout_rng_seeded_and_per_microbatch():
     """ADVICE r1: dropout masks must derive from the build seed (different seeds =>
     different training) and be deterministic for the same seed."""

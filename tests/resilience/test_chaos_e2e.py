@@ -98,6 +98,8 @@ def _warmstart(workdir, experiment_id, resume_folder):
 # ----------------------------------------------------------- (a) preemption
 
 
+@pytest.mark.slow  # ~37 s; sealed-checkpoint + resume equivalence stays pinned in tier-1
+# by the 2p7b recipe twin and the nan-policy chaos tests; full sigterm loop runs in slow tier
 def test_sigterm_forces_checkpoint_and_warmstart_matches_uninterrupted_run(workdir):
     config = _twelve_step_config(workdir)
 
@@ -197,6 +199,8 @@ def test_nan_grads_default_raise_policy_is_legacy_identical(workdir):
 # ------------------------------------------- (c) corruption -> ring fallback
 
 
+@pytest.mark.slow  # ~23 s; corrupt-checkpoint rejection + intact-restore are pinned fast in
+# tests/checkpointing/test_corrupt_checkpoint_rejection.py
 def test_corrupt_newest_checkpoint_falls_back_and_resumes(workdir):
     # 8 steps -> ring holds verified checkpoints at steps 4 and 8
     base = _train_lines(_run(CONFIG, "base", workdir))

@@ -1,0 +1,67 @@
+"""`serve` entry end-to-end (api.serve_text -> serving/serve.py): the shipped
+configs/config_serve.yaml drives YAML -> component graph -> ServingEngine ->
+JSONL result rows, with fresh-init params (checkpoint_folder_path: null)."""
+
+import json
+
+import pytest
+import yaml
+
+CFG = "configs/config_serve.yaml"
+
+
+def _byte_tokenizer_dir(dst):
+    from tests.conftest import make_word_level_tokenizer
+
+    vocab = {f"t{i}": i for i in range(256)}
+    vocab["<eod>"] = 255
+    del vocab["t255"]
+    make_word_level_tokenizer(vocab, dst, unk_token="t0", pad_token="t0", eos_token="<eod>")
+
+
+@pytest.fixture(scope="module")
+def served_rows(tmp_path_factory):
+    from pathlib import Path
+
+    from modalities_tpu.api import serve_text
+
+    workdir = tmp_path_factory.mktemp("serve_cli")
+    _byte_tokenizer_dir(workdir / "tokenizer")
+    cfg = yaml.safe_load(Path(CFG).read_text())
+    cfg["serving_component"]["config"]["tokenizer"]["config"][
+        "pretrained_model_name_or_path"
+    ] = str(workdir / "tokenizer")
+    cfg["serving_component"]["config"]["max_batch_slots"] = 2
+    # halve the depth (the shipped config's wiring is what's under test, not its
+    # exact size; widths are already at the validator's floor of 128) — keeps
+    # the compile out of the tier-1 budget
+    cfg["serving_component"]["config"]["model"]["config"]["n_layer"] = 1
+    cfg_path = workdir / "config_serve.yaml"
+    cfg_path.write_text(yaml.safe_dump(cfg))
+
+    requests = [
+        {"prompt": "t5 t6 t7", "max_new_tokens": 6},
+        {"prompt": "t9 t10", "max_new_tokens": 4, "temperature": 0.8, "seed": 3},
+        {"prompt": "t1", "max_new_tokens": 3},
+    ]
+    req_path = workdir / "requests.jsonl"
+    req_path.write_text("\n".join(json.dumps(r) for r in requests) + "\n")
+    out_path = workdir / "results.jsonl"
+    serve_text(cfg_path, requests_file_path=req_path, output_file_path=out_path)
+    return [json.loads(line) for line in out_path.read_text().splitlines() if line.strip()]
+
+
+def test_serve_cli_replays_jsonl_requests(served_rows):
+    assert len(served_rows) == 3
+    for row in served_rows:
+        for key in ("rid", "prompt", "completion", "tokens", "finish_reason", "ttft_s", "latency_s"):
+            assert key in row, (key, sorted(row))
+        assert row["finish_reason"] in ("eod", "budget", "capacity")
+        assert row["latency_s"] >= row["ttft_s"] >= 0.0
+
+
+def test_serve_cli_completions_decode_to_known_vocab(served_rows):
+    for row in served_rows:
+        assert len(row["tokens"]) <= {0: 6, 1: 4, 2: 3}[row["rid"]]
+        for tok in row["completion"].split():
+            assert tok.startswith("t") or tok == "<eod>", row["completion"]

@@ -1,0 +1,53 @@
+"""bench_serve.py contract: the serving load generator must leave a parseable
+JSON line on stdout (the driver reads the LAST one) carrying the throughput +
+latency-percentile schema; the full run must hit the PR-8 CPU speedup oracle
+(>= 4x at 8 slots vs the one-slot sequential baseline)."""
+
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+BENCH = Path(__file__).parents[1] / "bench_serve.py"
+
+LATENCY_KEYS = ("ttft_p50_ms", "ttft_p99_ms", "tpot_p50_ms", "tpot_p99_ms")
+
+
+def _run(*argv, timeout):
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", "BENCH_SERVE_BUDGET_S": str(timeout - 30)}
+    proc = subprocess.run(
+        [sys.executable, str(BENCH), *argv],
+        capture_output=True, text=True, timeout=timeout, env=env,
+    )
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    json_lines = [ln for ln in proc.stdout.splitlines() if ln.startswith("{")]
+    assert json_lines, proc.stdout
+    return json.loads(json_lines[-1])
+
+
+def test_bench_serve_smoke_emits_parseable_json_line():
+    out = _run("--smoke", timeout=300)
+    assert out["bench"] == "serve"
+    assert out["smoke"] is True
+    assert out["tokens_per_s"] > 0
+    for key in LATENCY_KEYS:
+        assert isinstance(out[key], float), (key, out)
+    assert 0.0 < out["slot_occupancy"] <= 1.0
+    assert out["decode_executables"] == 1  # ONE compiled decode step end to end
+    assert out["requests"] == 6
+
+
+@pytest.mark.slow  # full load run + sequential baseline (two engines, ~2 min CPU)
+def test_bench_serve_full_run_hits_speedup_oracle():
+    out = _run(timeout=540)
+    assert out["smoke"] is False
+    assert out["baseline_tokens_per_s"] > 0
+    # ISSUE PR-8 acceptance: continuous batching at 8 slots beats the sequential
+    # baseline by >= 4x on the same trace (dispatch-bound tiny model on CPU)
+    assert out["speedup"] >= 4.0, out
+    assert out["slots"] == 8
+    for key in LATENCY_KEYS:
+        assert isinstance(out[key], float), (key, out)

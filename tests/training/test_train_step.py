@@ -135,6 +135,8 @@ def test_params_actually_sharded():
     assert big and any(not x.sharding.is_fully_replicated for x in big)
 
 
+@pytest.mark.slow  # ~15 s; one of the dp/pp/cp equivalence family — dp_tp,
+# loss_parallel and the pp combinations keep the mesh-equivalence net in tier-1
 def test_dp_hsdp_equivalence():
     """dp8 vs HSDP (dp_replicate2 x dp_shard4): the reference's HYBRID_SHARD
     headline layout (model_factory.py:205-211, BASELINE.md HYBRID rows) — params
@@ -879,6 +881,8 @@ def test_fused_ce_matches_chunked_and_elides_logits_hlo(monkeypatch):
     assert "8x8x384" in hlos["off"]
 
 
+@pytest.mark.slow  # ~19 s edge case; the main chunked-vs-full equivalence pin
+# (test_chunked_lm_head_loss_equivalence) stays in tier-1
 def test_chunked_lm_head_ragged_tail():
     """A chunk size that does not divide the sequence (5 into 32) runs the scan
     over the divisible prefix plus one short tail chunk — same losses as the
@@ -939,6 +943,7 @@ def test_chunked_lm_head_ragged_tail_under_scheduled_pp():
     np.testing.assert_allclose(losses[None], losses[5], rtol=3e-4, atol=3e-4)
 
 
+@pytest.mark.slow  # ~16 s; kernel numerics pinned op-level in tests/ops/test_fused_rmsnorm.py
 def test_fused_rmsnorm_forced_matches_reference(monkeypatch):
     """MODALITIES_TPU_FUSED_RMSNORM=1 swaps every norm in the model for the
     Pallas kernel (interpret on CPU); training losses must match the reference
