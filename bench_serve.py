@@ -20,8 +20,10 @@ first so a mid-run kill still parses, and a budget-guard daemon thread
 exits 0 if the run outlives its budget.
 
 Knobs: --slots N, --requests N, --rate R (Poisson arrivals/s; 0 = all at t=0),
---max-new N, --seed S, --smoke (6 requests, 2 slots, no baseline — the tier-1
-smoke test's fast path).
+--max-new N, --seed S, --cache ring|paged (KV-cache layout; paged = PR-9 block
+pool), --long N (append N requests whose prompt+budget exceeds the ring
+capacity — ring finishes them "capacity", paged completes them), --smoke
+(6 requests, 2 slots, no baseline — the tier-1 smoke test's fast path).
 """
 
 import argparse
@@ -40,6 +42,9 @@ METRIC_KEYS = (
     "tpot_p50_ms",
     "tpot_p99_ms",
     "slot_occupancy",
+    "capacity_finishes",
+    "preemptions",
+    "truncated_requests",
 )
 
 
@@ -100,24 +105,27 @@ def _tiny_model():
     )
 
 
-def _make_trace(n: int, rate: float, max_new: int, seed: int):
+def _make_trace(n: int, rate: float, max_new: int, seed: int, long_n: int = 0, capacity: int = 64):
     """Seeded synthetic trace: Poisson arrivals (exponential interarrivals at
     `rate`/s; rate 0 = full queue at t=0), prompt lengths 4..12, budgets
     max_new/2..max_new (decode-heavy — the regime continuous batching targets),
-    alternating greedy / temperature 0.8."""
+    alternating greedy / temperature 0.8. `long_n` appends requests with budget
+    == capacity, so prompt+budget overflows a ring of that capacity: ring stops
+    them at "capacity", paged (with a lifted max_len) runs them to "budget"."""
     import numpy as np
 
     rng = np.random.default_rng(seed)
     t = 0.0
     trace = []
-    for i in range(n):
+    for i in range(n + long_n):
         if rate > 0:
             t += float(rng.exponential(1.0 / rate))
-        plen = int(rng.integers(4, 13))
+        long = i >= n
+        plen = int(rng.integers(8, 17) if long else rng.integers(4, 13))
         trace.append(
             {
                 "prompt": [int(x) for x in rng.integers(0, 127, size=plen)],
-                "max_new_tokens": int(rng.integers(max(2, max_new // 2), max_new + 1)),
+                "max_new_tokens": capacity if long else int(rng.integers(max(2, max_new // 2), max_new + 1)),
                 "temperature": 0.0 if i % 2 == 0 else 0.8,
                 "seed": i,
                 "arrival_offset_s": t,
@@ -159,6 +167,13 @@ def main() -> int:
     parser.add_argument("--rate", type=float, default=500.0, help="Poisson arrivals/s; 0 = full queue at t=0")
     parser.add_argument("--max-new", type=int, default=44)
     parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--cache", choices=("ring", "paged"), default="ring", help="KV-cache layout")
+    parser.add_argument(
+        "--long",
+        type=int,
+        default=0,
+        help="append N requests whose prompt+budget exceeds the ring capacity",
+    )
     parser.add_argument("--smoke", action="store_true", help="6 requests, 2 slots, no baseline")
     args = parser.parse_args()
     if args.smoke:
@@ -175,8 +190,17 @@ def main() -> int:
     model = _tiny_model()
     params = meta.unbox(model.init_params(jax.random.PRNGKey(0)))
 
+    capacity = 64  # _tiny_model sequence_length == default ring cache_capacity
+    trace = _make_trace(args.requests, args.rate, args.max_new, args.seed, args.long, capacity)
+    need_len = max(len(r["prompt"]) + r["max_new_tokens"] for r in trace)
+
     def fresh_engine(slots: int) -> ServingEngine:
-        return ServingEngine(model, params, max_batch_slots=slots, eod_token_id=-1)
+        kwargs = {}
+        if args.cache == "paged":
+            # lift the per-request ceiling past the ring capacity so the --long
+            # requests actually finish (NOPE+rotary model: no wpe table to outgrow)
+            kwargs = {"kv_cache": "paged", "paged_max_len": max(need_len, capacity)}
+        return ServingEngine(model, params, max_batch_slots=slots, eod_token_id=-1, **kwargs)
 
     def warmup(engine):
         # cover the prefill ladder (21 -> 16+4+1) and the decode step once, so
@@ -184,8 +208,6 @@ def main() -> int:
         engine.submit(list(range(21)), 2, temperature=0.0, seed=0)
         engine.submit(list(range(5)), 2, temperature=0.8, seed=1)
         engine.run()
-
-    trace = _make_trace(args.requests, args.rate, args.max_new, args.seed)
 
     engine = fresh_engine(args.slots)
     warmup(engine)
@@ -230,7 +252,12 @@ def main() -> int:
                 "tpot_p50_ms": tpot_p50,
                 "tpot_p99_ms": tpot_p99,
                 "slot_occupancy": stats["slot_occupancy"],
+                "capacity_finishes": sum(1 for r in results if r.finish_reason == "capacity"),
+                "preemptions": stats.get("preemptions", 0),
+                "truncated_requests": stats.get("truncated_requests", 0),
+                "cache": args.cache,
                 "requests": args.requests,
+                "long_requests": args.long,
                 "slots": args.slots,
                 "generated_tokens": generated,
                 "wall_s": wall,

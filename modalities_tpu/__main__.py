@@ -212,14 +212,24 @@ def entry_point_generate_text(config_file_path: Path) -> None:
     help="JSONL of requests to replay through the continuous-batching engine; omit for an interactive loop.",
 )
 @click.option("--output_file_path", type=click.Path(path_type=Path), default=None)
+@click.option(
+    "--http_port",
+    type=int,
+    default=None,
+    help="Start the streaming HTTP front end (SSE POST /generate, GET /healthz, GET /stats) "
+    "on this port (0 = ephemeral) instead of replay/interactive; SIGTERM drains gracefully.",
+)
 @_exception_handling
 def entry_point_serve(
-    config_file_path: Path, requests_file_path: Optional[Path], output_file_path: Optional[Path]
+    config_file_path: Path,
+    requests_file_path: Optional[Path],
+    output_file_path: Optional[Path],
+    http_port: Optional[int],
 ) -> None:
     """Continuous-batching text serving (serving/engine.py) from a sealed checkpoint."""
     from modalities_tpu.api import serve_text
 
-    serve_text(config_file_path, requests_file_path, output_file_path)
+    serve_text(config_file_path, requests_file_path, output_file_path, http_port=http_port)
 
 
 @main.command(name="convert_checkpoint_to_hf")

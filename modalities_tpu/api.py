@@ -230,13 +230,16 @@ def serve_text(
     config_file_path: Path,
     requests_file_path: Path | None = None,
     output_file_path: Path | None = None,
+    http_port: int | None = None,
 ) -> None:
-    """Config-driven continuous-batching serving (serving/serve.py): replay a JSONL
-    request file, or run the interactive loop when no file is given."""
+    """Config-driven continuous-batching serving (serving/serve.py): streaming
+    HTTP front end (`http_port`, SSE /generate), replay of a JSONL request file,
+    or the interactive loop when neither is given."""
     from modalities_tpu.serving.serve import serve
 
     serve(
         Path(config_file_path),
         Path(requests_file_path) if requests_file_path else None,
         Path(output_file_path) if output_file_path else None,
+        http_port=http_port,
     )

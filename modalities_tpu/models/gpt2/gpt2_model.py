@@ -164,17 +164,32 @@ def swiglu_hidden_dim(ffn_hidden: int, multiple_of: int = 256) -> int:
 
 @dataclass(frozen=True)
 class SlotDecodeSpec:
-    """Static shape of the serving engine's batched ring KV cache (serving/engine.py).
+    """Static shape of the serving engine's batched KV cache (serving/engine.py).
 
+    kind="ring" (serving v1): one [slots, capacity] ring row per slot.
     `mode="prefill"` runs a batch-1 forward over a prompt chunk and writes its k/v
     into cache slot `slot` starting at position `positions` (both traced scalars);
     `mode="decode"` advances every slot by one token — tokens [slots, 1] written at
     per-slot `positions` [slots]. Shapes are static so ONE compiled decode step (plus
-    a bounded prefill-chunk ladder) serves every request mix."""
+    a bounded prefill-chunk ladder) serves every request mix.
+
+    kind="paged" (serving v2, vLLM-style): ONE global [num_blocks, block_size] pool
+    per scanned layer; a slot owns an ordered list of blocks (its block table, a
+    traced int32 arg — table entry m covers the slot's logical positions
+    m*block_size..(m+1)*block_size-1, so the gathered K/V sequence is position-
+    ordered regardless of physical block ids). `capacity` is the max gathered length
+    (table width x block_size). Writes carry explicit (block, offset) coordinates;
+    out-of-range block ids are DROPPED (idle slots / padded prefill tails write
+    nowhere instead of clamping onto a live block). `mode="prefill"` packs chunks
+    from several requests as rows of one [rows, chunk] dispatch — the Sarathi-style
+    cross-request prefill step."""
 
     mode: str  # "prefill" | "decode"
     slots: int
-    capacity: int
+    capacity: int  # ring: per-slot ring length; paged: table_width * block_size
+    kind: str = "ring"  # "ring" | "paged"
+    num_blocks: int = 0  # paged only: global pool blocks per layer
+    block_size: int = 0  # paged only: tokens per block
 
 
 @dataclass(frozen=True)
@@ -408,6 +423,8 @@ class CausalSelfAttention(nn.Module):
             k = build_norm(spec.qk_norm, "k_norm", dtype=x.dtype)(k)
 
         if self.slot_spec is not None:
+            if self.slot_spec.kind == "paged":
+                return self._paged_slot_attention(x, q, k, v, positions)
             return self._slot_attention(x, q, k, v, slot, positions)
 
         if self.decode:
@@ -509,6 +526,73 @@ class CausalSelfAttention(nn.Module):
 
         # position t of this call attends to cache positions <= i + t
         mask = jnp.arange(max_len)[None, :] <= (i + jnp.arange(s_in))[:, None]
+        y = masked_attention(q, k_all, v_all, mask)
+        return self._project_out(x, y)
+
+    def _paged_slot_attention(self, x, q, k, v, positions):
+        """Serving v2's paged (block-table) KV cache (serving/paged_cache.py).
+
+        The cache is ONE global pool [num_blocks, block_size, Hkv, D] per layer;
+        `positions` is a pytree of traced arrays:
+          pos    — absolute positions: prefill [R, C] per token, decode [S] per slot
+          tables — [B, MB] int32 block table per row (entry m = pool block holding
+                   logical positions m*bs..(m+1)*bs-1; unused entries are 0 and
+                   masked out by `pos`)
+          wblk/woff — write coordinates per incoming token (prefill [R, C],
+                   decode [S]); wblk >= num_blocks means "write nowhere" (idle
+                   slots, padded prefill tails) — scatter mode="drop"
+        The gathered K/V per row is position-ordered (table order == logical
+        order), so the masked softmax is the same math as the ring row — which is
+        what keeps paged mode inside the batch-invariance contract."""
+        spec = self.spec
+        ss = self.slot_spec
+        head_dim = spec.head_dim
+        nb, bs = ss.num_blocks, ss.block_size
+        pos = positions["pos"]
+        tables = positions["tables"]
+        wblk, woff = positions["wblk"], positions["woff"]
+
+        cached_k = self.variable(
+            "cache", "cached_key", jnp.zeros, (nb, bs, spec.n_head_kv, head_dim), k.dtype
+        )
+        cached_v = self.variable(
+            "cache", "cached_value", jnp.zeros, (nb, bs, spec.n_head_kv, head_dim), v.dtype
+        )
+
+        if spec.use_rope:
+            cos, sin = _rope_tables(head_dim, ss.capacity, spec.rope_base_freq, dtype=x.dtype)
+            if ss.mode == "prefill":  # pos [R, C] -> per-token tables [R, C, D]
+                cos_i, sin_i = jnp.take(cos, pos, axis=0), jnp.take(sin, pos, axis=0)
+            else:  # pos [S] -> [S, 1, D]
+                cos_i = jnp.take(cos, pos, axis=0)[:, None, :]
+                sin_i = jnp.take(sin, pos, axis=0)[:, None, :]
+            q = apply_rope(q, cos_i, sin_i)
+            k = apply_rope(k, cos_i, sin_i)
+
+        # scatter the incoming k/v into the pool at explicit (block, offset)
+        # coordinates; out-of-range blocks are dropped, never clamped
+        k_flat = k.reshape(-1, spec.n_head_kv, head_dim)
+        v_flat = v.reshape(-1, spec.n_head_kv, head_dim)
+        blk, off = wblk.reshape(-1), woff.reshape(-1)
+        k_pool = cached_k.value.at[blk, off].set(k_flat, mode="drop")
+        v_pool = cached_v.value.at[blk, off].set(v_flat, mode="drop")
+        if not self.is_initializing():
+            cached_k.value = k_pool
+            cached_v.value = v_pool
+
+        # gather each row's K/V tiles via its block table -> [B, MB*bs, Hkv, D];
+        # gathered index IS the logical position (tables are position-ordered)
+        b_rows, mb = tables.shape
+
+        def gather(pool):
+            return jnp.take(pool, tables, axis=0).reshape(b_rows, mb * bs, spec.n_head_kv, head_dim)
+
+        k_all, v_all = gather(k_pool), gather(v_pool)
+        key_pos = jnp.arange(mb * bs)
+        if ss.mode == "prefill":
+            mask = key_pos[None, None, :] <= pos[:, :, None]  # [R, C, L]
+        else:
+            mask = key_pos[None, None, :] <= pos[:, None, None]  # [S, 1, L]
         y = masked_attention(q, k_all, v_all, mask)
         return self._project_out(x, y)
 
@@ -777,13 +861,18 @@ class GPT2Module(nn.Module):
                 param_dtype,
             )
             if self.slot_spec is not None:
-                # positions are explicit (no wpe_index counter): prefill gets the
-                # scalar chunk start, decode a per-slot position vector
-                if self.slot_spec.mode == "prefill":
-                    pos = positions + jnp.arange(input_ids.shape[1])
+                # positions are explicit (no wpe_index counter): ring prefill gets
+                # the scalar chunk start, decode a per-slot position vector; paged
+                # mode passes a pytree with per-token absolute positions
+                pos_arr = positions["pos"] if isinstance(positions, dict) else positions
+                if self.slot_spec.kind == "paged" and self.slot_spec.mode == "prefill":
+                    # pos [R, C] per token (cross-request packed rows)
+                    x = x + jnp.take(wpe, pos_arr, axis=0).astype(compute_dtype)
+                elif self.slot_spec.mode == "prefill":
+                    pos = pos_arr + jnp.arange(input_ids.shape[1])
                     x = x + jnp.take(wpe, pos, axis=0)[None].astype(compute_dtype)
                 else:
-                    x = x + jnp.take(wpe, positions, axis=0)[:, None, :].astype(compute_dtype)
+                    x = x + jnp.take(wpe, pos_arr, axis=0)[:, None, :].astype(compute_dtype)
             elif self.decode:
                 pos_var = self.variable("cache", "wpe_index", lambda: jnp.zeros((), jnp.int32))
                 pos = pos_var.value + jnp.arange(input_ids.shape[1])
@@ -1111,6 +1200,78 @@ class GPT2LLM(NNModel):
         )
         logits, mutated = module.apply(
             {**params, "cache": cache}, tokens, None, positions, mutable=["cache"]
+        )
+        return logits, mutated["cache"]
+
+    # --------------------------------------------------- paged (block-table) decode
+    # Serving v2's model surface (serving/paged_cache.py + engine kv_cache="paged"):
+    # ONE global [num_blocks, block_size] K/V pool per scanned layer, per-slot block
+    # tables as traced int32 args, explicit write coordinates. Same ONE-executable
+    # discipline as the ring API; the per-slot length ceiling becomes the table
+    # width instead of a static ring row.
+
+    @staticmethod
+    def _paged_cache_dims(cache) -> tuple[int, int]:
+        """(num_blocks, block_size) recovered from the pool leaf shapes."""
+        for leaf in jax.tree.leaves(cache):
+            if leaf.ndim == 5:  # scanned: [layers, num_blocks, block_size, Hkv, D]
+                return int(leaf.shape[1]), int(leaf.shape[2])
+            if leaf.ndim == 4:  # unrolled blocks
+                return int(leaf.shape[0]), int(leaf.shape[1])
+        raise ValueError("not a paged KV cache: no [.., blocks, block_size, heads, head_dim] leaf")
+
+    def init_paged_cache(self, params, num_blocks: int, block_size: int):
+        """Zeroed global block pool ([num_blocks, block_size, Hkv, D] per layer,
+        leading layers axis added by the scan). Shapes via abstract init."""
+        nb, bs = int(num_blocks), int(block_size)
+        if nb < 1 or bs < 1:
+            raise ValueError(f"paged cache needs num_blocks >= 1 and block_size >= 1, got {nb}/{bs}")
+        sspec = SlotDecodeSpec("decode", 1, bs, kind="paged", num_blocks=nb, block_size=bs)
+        module = GPT2Module(self.config_spec, deterministic=True, slot_spec=sspec)
+        tokens = jnp.zeros((1, 1), dtype=jnp.int32)
+        positions = {
+            "pos": jnp.zeros((1,), jnp.int32),
+            "tables": jnp.zeros((1, 1), jnp.int32),
+            "wblk": jnp.full((1,), nb, jnp.int32),  # out of range: init writes nothing
+            "woff": jnp.zeros((1,), jnp.int32),
+        }
+        abstract = jax.eval_shape(
+            lambda: module.init(jax.random.PRNGKey(0), tokens, None, positions)
+        )
+        return jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), abstract["cache"])
+
+    def prefill_paged(self, params, cache, tokens, positions, tables, wblk, woff):
+        """Cross-request packed prefill: row r of `tokens` [R, C] is a chunk of
+        some request, written at absolute positions `positions` [R, C] through the
+        row's block table `tables` [R, MB] with write coordinates wblk/woff [R, C]
+        (wblk >= num_blocks drops the write — padded tails). Returns
+        (logits [R, C, V], cache)."""
+        nb, bs = self._paged_cache_dims(cache)
+        sspec = SlotDecodeSpec(
+            "prefill", int(tokens.shape[0]), int(tables.shape[1]) * bs,
+            kind="paged", num_blocks=nb, block_size=bs,
+        )
+        module = GPT2Module(self.config_spec, deterministic=True, slot_spec=sspec)
+        pos_tree = {"pos": positions, "tables": tables, "wblk": wblk, "woff": woff}
+        logits, mutated = module.apply(
+            {**params, "cache": cache}, tokens, None, pos_tree, mutable=["cache"]
+        )
+        return logits, mutated["cache"]
+
+    def decode_paged(self, params, cache, tokens, positions, tables, wblk, woff):
+        """ONE batched paged decode step: tokens [S, 1] at per-slot `positions`
+        [S], K/V gathered through per-slot block tables [S, MB]; writes land at
+        wblk/woff [S] (out-of-range = idle slot, dropped). Returns
+        (logits [S, 1, V], cache)."""
+        nb, bs = self._paged_cache_dims(cache)
+        sspec = SlotDecodeSpec(
+            "decode", int(tokens.shape[0]), int(tables.shape[1]) * bs,
+            kind="paged", num_blocks=nb, block_size=bs,
+        )
+        module = GPT2Module(self.config_spec, deterministic=True, slot_spec=sspec)
+        pos_tree = {"pos": positions, "tables": tables, "wblk": wblk, "woff": woff}
+        logits, mutated = module.apply(
+            {**params, "cache": cache}, tokens, None, pos_tree, mutable=["cache"]
         )
         return logits, mutated["cache"]
 

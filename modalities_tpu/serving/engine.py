@@ -1,29 +1,46 @@
 """Continuous-batching decode engine over the slot-indexed GPT2 KV cache.
 
 Design (the GSPMD serving argument, arXiv 2105.04663): training already produced
-mesh-sharded params and sharding rules; serving reuses them unchanged. The batched
-ring KV cache is allocated ONCE at a static [max_batch_slots, cache_capacity] shape
-and annotated with the same NamedShardings (slots ride the "batch" logical axis,
-kv heads the "kv_heads"/tp axis, layers the pp axis), so XLA partitions the decode
-step exactly like a train step — no serving-specific parallelism code.
+mesh-sharded params and sharding rules; serving reuses them unchanged. KV memory
+is allocated ONCE at a static shape and annotated with the same NamedShardings
+(slots/blocks ride the "batch" logical axis, kv heads the "kv_heads"/tp axis,
+layers the pp axis), so XLA partitions the decode step exactly like a train step
+— no serving-specific parallelism code.
+
+Two cache layouts, selected by the static `kv_cache` knob:
+
+- `ring` (serving v1): per-slot ring rows [max_batch_slots, cache_capacity].
+  Prompt prefill is per-request on the `_PREFILL_CHUNKS` power-of-two ladder;
+  a request whose prompt+generation hits the ring end finishes `"capacity"`.
+- `paged` (serving v2, vLLM-style): ONE global block pool per scanned layer
+  [num_blocks, block_size, kv_heads, head_dim] plus host-side block tables
+  (serving/paged_cache.py) passed to the jitted step as traced int32 arrays.
+  Blocks are allocated on demand, so the `"capacity"` finish disappears — the
+  per-request ceiling is the static table width, and the generation budget is
+  clamped to it at admission ("budget", never "capacity"). Pool exhaustion
+  preempts the YOUNGEST slot back to the queue (blocks freed, request requeued
+  — deterministic sampling reproduces the same tokens on re-admission).
+  Prefill is chunked ACROSS requests (Sarathi-style): one fixed-shape
+  [slots, block_size] dispatch packs prompt chunks from several waiting
+  requests, so long prompts no longer head-of-line-block decode.
 
 Execution model:
-- prefill: shape-bucketed jitted forward of one prompt (batch 1) into an arbitrary
-  cache slot, chunked on the `_PREFILL_CHUNKS` power-of-two ladder the interactive
-  path uses (inference/text/inference_component.py) — bounded compile count.
 - decode: ONE compiled step advances every slot by one token per dispatch.
-  Per-slot temperature/greedy sampling and per-slot eod/budget stopping are folded
-  into the step via `jnp.where` — no per-request recompiles, no host round-trip
-  per token beyond the single small (tokens, finished) fetch that drives the
-  scheduler.
+  Per-slot temperature/greedy sampling and per-slot eod/budget stopping are
+  folded into the step via `jnp.where` — no per-request recompiles, no host
+  round-trip per token beyond the single small (tokens, finished) fetch.
 - scheduling (plain Python, off the jitted path): a FIFO queue admits requests
-  into idle slots at token boundaries; finished slots are evicted immediately, so
-  under load the batch stays full instead of draining to the slowest request.
+  into idle slots at token boundaries; finished slots are evicted immediately,
+  so under load the batch stays full instead of draining to the slowest
+  request. `stop_fn` (graceful drain) stops admission; in-flight slots finish.
 
-Batch-invariance contract (pinned by tests/serving/test_engine.py): with exactly
-one active slot the engine emits token-for-token what the interactive
-`_generate_cached` path emits for the same (prompt, budget, temperature, seed) —
-same key-split sequence, same categorical shapes, bitwise-identical logits rows.
+Batch-invariance contract (pinned by tests/serving/test_engine.py and
+test_paged_engine.py): with exactly one active slot the engine emits
+token-for-token what the interactive `_generate_cached` path emits for the same
+(prompt, budget, temperature, seed) — same key-split sequence, same categorical
+shapes, bitwise-identical logits rows — in BOTH cache modes. For paged mode the
+gathered K/V row is position-ordered and garbage positions are masked to exact
+zeros, so the softmax reduction matches the ring row bitwise.
 """
 
 from __future__ import annotations
@@ -32,11 +49,12 @@ import os
 import time
 from collections import deque
 from dataclasses import dataclass, field
-from typing import Optional
+from typing import Callable, Optional
 
 import numpy as np
 
-from modalities_tpu.telemetry import span
+from modalities_tpu.serving.paged_cache import BlockTableState, blocks_for_tokens
+from modalities_tpu.telemetry import get_active_telemetry, span
 
 # mirror of TextInferenceComponent._PREFILL_CHUNKS: the same power-of-two ladder,
 # overridable via MODALITIES_TPU_SERVE_PREFILL_CHUNKS (comma list, descending,
@@ -59,6 +77,15 @@ def _prefill_chunks_from_env() -> tuple[int, ...]:
     return chunks
 
 
+def _kv_cache_from_env() -> str:
+    raw = os.environ.get("MODALITIES_TPU_SERVE_KV_CACHE", "ring")
+    if raw not in ("ring", "paged"):
+        raise ValueError(
+            f"MODALITIES_TPU_SERVE_KV_CACHE={raw!r}: must be 'ring' or 'paged'"
+        )
+    return raw
+
+
 @dataclass
 class ServeRequest:
     """One generation request. `temperature=None` inherits the engine default
@@ -79,6 +106,7 @@ class ServeResult:
     tokens: list[int] = field(default_factory=list)
     finish_reason: str = ""  # "eod" | "budget" | "capacity"
     prompt_len: int = 0
+    truncated: bool = False  # prompt window-clipped at admission
     arrival_s: float = 0.0  # engine-clock arrival
     first_token_s: float = 0.0  # engine-clock time the first token was available
     finish_s: float = 0.0
@@ -94,6 +122,12 @@ class _SlotState:
     request: ServeRequest
     result: ServeResult
     remaining: int  # tokens still allowed, counting the one in flight
+    phase: str = "decode"  # "prefill" (paged, prompt in flight) | "decode"
+    window: Optional[list[int]] = None  # paged: the admitted prompt window
+    prefill_pos: int = 0  # paged: prompt tokens already forwarded
+    key: object = None  # paged: jax PRNG key while prefilling
+    temp: float = 0.0
+    seq: int = 0  # admission order — preemption picks the max (youngest)
 
 
 class ServingEngine:
@@ -111,6 +145,13 @@ class ServingEngine:
         eod_token_id: int = -1,
         default_temperature: Optional[float] = None,
         prefill_chunks: Optional[tuple[int, ...]] = None,
+        kv_cache: Optional[str] = None,
+        paged_block_size: int = 16,
+        paged_num_blocks: Optional[int] = None,
+        paged_max_len: Optional[int] = None,
+        stop_fn: Optional[Callable[[], bool]] = None,
+        on_token: Optional[Callable[[int, int], None]] = None,
+        on_finish: Optional[Callable[[int, ServeResult], None]] = None,
         mesh_handle=None,
         time_fn=None,
     ):
@@ -118,6 +159,14 @@ class ServingEngine:
             raise ValueError(
                 f"{type(model).__name__} does not expose the slot-cache decode API "
                 "(init_slot_cache/prefill_slot/decode_slots)"
+            )
+        self.kv_cache = kv_cache if kv_cache is not None else _kv_cache_from_env()
+        if self.kv_cache not in ("ring", "paged"):
+            raise ValueError(f"kv_cache={self.kv_cache!r}: must be 'ring' or 'paged'")
+        if self.kv_cache == "paged" and not hasattr(model, "init_paged_cache"):
+            raise ValueError(
+                f"{type(model).__name__} does not expose the paged decode API "
+                "(init_paged_cache/prefill_paged/decode_paged)"
             )
         spec_len = int(model.config_spec.sequence_length)
         self.model = model
@@ -128,10 +177,53 @@ class ServingEngine:
         self.default_temperature = default_temperature
         self.prefill_chunks = tuple(prefill_chunks) if prefill_chunks else _prefill_chunks_from_env()
         self._now = time_fn if time_fn is not None else time.monotonic
+        self._stop_fn = stop_fn
+        self._on_token = on_token
+        self._on_finish = on_finish
         if self.slots < 1:
             raise ValueError("max_batch_slots must be >= 1")
         if self.capacity < 2:
             raise ValueError("cache_capacity must be >= 2 (1 prompt token + 1 generated)")
+
+        if self.kv_cache == "paged":
+            from modalities_tpu.models.gpt2.gpt2_model import PositionTypes
+
+            bs = int(paged_block_size)
+            if bs < 1:
+                raise ValueError(f"paged_block_size must be >= 1, got {bs}")
+            # per-request length ceiling = static table width * block size; the
+            # default inherits the ring semantics (cache_capacity / seq len) but
+            # paged_max_len may exceed sequence_length for relative-position
+            # models — that is the length-ceiling lift
+            max_len = int(paged_max_len) if paged_max_len else self.capacity
+            if max_len < 2:
+                raise ValueError("paged_max_len must be >= 2")
+            if (
+                max_len > spec_len
+                and model.config_spec.poe_type == PositionTypes.ABSOLUTE.value
+            ):
+                raise ValueError(
+                    f"paged_max_len {max_len} exceeds sequence_length {spec_len}: "
+                    "ABSOLUTE position embeddings have no rows past the trained "
+                    "sequence length"
+                )
+            self.block_size = bs
+            self.table_width = blocks_for_tokens(max_len, bs)
+            self.max_len = self.table_width * bs  # round the ceiling up to blocks
+            self.num_blocks = (
+                int(paged_num_blocks) if paged_num_blocks else self.slots * self.table_width
+            )
+            if self.num_blocks < self.table_width:
+                raise ValueError(
+                    f"paged_num_blocks {self.num_blocks} < table width "
+                    f"{self.table_width}: one max-length request must fit the pool "
+                    "(otherwise preemption livelocks)"
+                )
+        else:
+            self.block_size = 0
+            self.table_width = 0
+            self.max_len = self.capacity
+            self.num_blocks = 0
 
         self._mesh_handle = mesh_handle
         self._rules = None
@@ -143,7 +235,14 @@ class ServingEngine:
         import jax.numpy as jnp
 
         self._jnp = jnp
-        self.cache = model.init_slot_cache(params, self.slots, self.capacity)
+        if self.kv_cache == "paged":
+            self.cache = model.init_paged_cache(params, self.num_blocks, self.block_size)
+            self._table_state = BlockTableState(
+                self.num_blocks, self.block_size, self.table_width
+            )
+        else:
+            self.cache = model.init_slot_cache(params, self.slots, self.capacity)
+            self._table_state = None
         if self._cache_shardings is not None:
             self.cache = jax.device_put(self.cache, self._cache_shardings)
 
@@ -156,10 +255,17 @@ class ServingEngine:
         self._eods = np.full((b,), -1, np.int32)
         self._remaining = np.full((b,), _IDLE_REMAINING, np.int32)
         self._slot_states: list[Optional[_SlotState]] = [None] * b
+        if self.kv_cache == "paged":
+            self._tables = np.zeros((b, self.table_width), np.int32)
+            self._wblk = np.full((b,), self.num_blocks, np.int32)  # idle: dropped
+            self._woff = np.zeros((b,), np.int32)
 
         self._queue: deque[ServeRequest] = deque()
         self._results: dict[int, ServeResult] = {}
         self._next_rid = 0
+        self._admit_seq = 0
+        self._streamed: dict[int, int] = {}  # rid -> tokens already on_token'd
+        self._truncated_rids: set[int] = set()  # count once even across preemption
 
         # trace counters: the traced fn bodies run once per COMPILATION, so these
         # pin "one decode executable, bounded prefill ladder" in tests
@@ -169,6 +275,8 @@ class ServingEngine:
         self.decode_token_count = 0
         self._occupancy_sum = 0
         self.max_concurrent = 0
+        self.preemptions = 0
+        self.truncated_requests = 0
 
         self._build_jits()
 
@@ -192,10 +300,16 @@ class ServingEngine:
                 f"max_batch_slots={self.slots} must be divisible by the mesh's data-"
                 f"parallel degree {dp}: cache slots ride the 'batch' logical axis"
             )
+        if self.kv_cache == "paged" and self.num_blocks % max(dp, 1) != 0:
+            raise ValueError(
+                f"paged_num_blocks={self.num_blocks} must be divisible by the mesh's "
+                f"data-parallel degree {dp}: pool blocks ride the 'batch' logical axis"
+            )
         mesh = mesh_handle.mesh
 
         def leaf_sharding(leaf):
-            # scanned cache leaf: [layers, slots, capacity, kv_heads, head_dim]
+            # scanned cache leaf: [layers, slots|blocks, capacity|block_size,
+            # kv_heads, head_dim] — ring rows and pool blocks ride the same axes
             if leaf.ndim == 5:
                 axes = ("layers", "batch", None, "kv_heads", "head_dim")
             elif leaf.ndim == 4:  # unrolled blocks
@@ -208,9 +322,16 @@ class ServingEngine:
             # explicit "replicated dim" placeholder
             return NamedSharding(mesh, spec)
 
-        abstract_cache = jax.eval_shape(
-            lambda: self.model.init_slot_cache(self.params, self.slots, self.capacity)
-        )
+        if self.kv_cache == "paged":
+            abstract_cache = jax.eval_shape(
+                lambda: self.model.init_paged_cache(
+                    self.params, self.num_blocks, self.block_size
+                )
+            )
+        else:
+            abstract_cache = jax.eval_shape(
+                lambda: self.model.init_slot_cache(self.params, self.slots, self.capacity)
+            )
         self._cache_shardings = jax.tree.map(leaf_sharding, abstract_cache)
 
         abstract_params = jax.eval_shape(
@@ -245,6 +366,18 @@ class ServingEngine:
                 lambda x, s: jax.lax.with_sharding_constraint(x, s), cache, cache_shardings
             )
 
+        def samp(key, row, temp):
+            greedy = temp <= 0.0
+            ks = jax.random.split(key)
+            # row[None, :]: categorical must see the interactive path's [1, V]
+            # operand so the gumbel draw is bitwise identical per key
+            tok_s = jax.random.categorical(ks[1], row[None, :] / jnp.maximum(temp, 1e-6))[0]
+            tok_g = jnp.argmax(row)
+            tok = jnp.where(greedy, tok_g, tok_s).astype(jnp.int32)
+            # the key advances only when a sample was actually drawn — exactly
+            # the interactive path's key-split discipline
+            return tok, jnp.where(greedy, key, ks[0])
+
         def prefill_fn(params, cache, tokens, slot, start, key, temp, sample_flag):
             engine._prefill_traces += 1  # trace-time side effect: 1 per compiled shape
             logits, cache = model.prefill_slot(params, cache, tokens, slot, start)
@@ -264,25 +397,44 @@ class ServingEngine:
             engine._decode_traces += 1  # must stay 1: ONE executable for the whole trace
             logits, cache = model.decode_slots(params, cache, tokens, positions)
             rows = logits[:, 0, :]  # [slots, V]
-
-            def samp(key, row, temp):
-                greedy = temp <= 0.0
-                ks = jax.random.split(key)
-                # row[None, :]: categorical must see the interactive path's [1, V]
-                # operand so the gumbel draw is bitwise identical per key
-                tok_s = jax.random.categorical(ks[1], row[None, :] / jnp.maximum(temp, 1e-6))[0]
-                tok_g = jnp.argmax(row)
-                tok = jnp.where(greedy, tok_g, tok_s).astype(jnp.int32)
-                return tok, jnp.where(greedy, key, ks[0])
-
             toks, new_keys = jax.vmap(samp)(keys, rows, temps)
             # per-slot stopping folded into the step: eod never emits, budget
             # emits its last token then stops — the host only reads flags
             finished = (toks == eods) | (remaining <= 1)
             return _constrain_cache(cache), toks, new_keys, finished
 
-        self._prefill_jit = jax.jit(prefill_fn, donate_argnums=(1,))
-        self._decode_jit = jax.jit(decode_fn, donate_argnums=(1,))
+        def paged_prefill_fn(
+            params, cache, tokens, pos, tables, wblk, woff, last_idx, keys, temps, flags
+        ):
+            # ONE fixed [slots, block_size] shape -> one compiled prefill for the
+            # whole trace (the cross-request packing replaces the ring's ladder)
+            engine._prefill_traces += 1
+            logits, cache = model.prefill_paged(params, cache, tokens, pos, tables, wblk, woff)
+            # per row: the logits at that row's last valid token ([R, V])
+            rows = jnp.take_along_axis(logits, last_idx[:, None, None], axis=1)[:, 0, :]
+            toks, new_keys = jax.vmap(samp)(keys, rows, temps)
+            toks = jnp.where(flags, toks, jnp.int32(-1))
+            new_keys = jnp.where(flags[:, None], new_keys, keys)
+            return _constrain_cache(cache), toks, new_keys
+
+        def paged_decode_fn(
+            params, cache, tokens, positions, tables, wblk, woff, keys, temps, eods, remaining
+        ):
+            engine._decode_traces += 1  # must stay 1: ONE executable for the whole trace
+            logits, cache = model.decode_paged(
+                params, cache, tokens, positions, tables, wblk, woff
+            )
+            rows = logits[:, 0, :]  # [slots, V]
+            toks, new_keys = jax.vmap(samp)(keys, rows, temps)
+            finished = (toks == eods) | (remaining <= 1)
+            return _constrain_cache(cache), toks, new_keys, finished
+
+        if self.kv_cache == "paged":
+            self._prefill_jit = jax.jit(paged_prefill_fn, donate_argnums=(1,))
+            self._decode_jit = jax.jit(paged_decode_fn, donate_argnums=(1,))
+        else:
+            self._prefill_jit = jax.jit(prefill_fn, donate_argnums=(1,))
+            self._decode_jit = jax.jit(decode_fn, donate_argnums=(1,))
 
     # ---------------------------------------------------------------- submission
     def submit(
@@ -310,20 +462,77 @@ class ServingEngine:
         )
         return rid
 
+    def _stopping(self) -> bool:
+        return self._stop_fn is not None and bool(self._stop_fn())
+
     # ---------------------------------------------------------------- scheduling
-    def _finish(self, slot: int, reason: str, now: float) -> None:
-        state = self._slot_states[slot]
-        state.result.finish_reason = reason
-        state.result.finish_s = now
-        self._results[state.request.rid] = state.result
+    def _emit_token(self, result: ServeResult, tok: int, now: float) -> None:
+        """Append + stream a token. `_streamed` survives preemption (the result
+        list is reset but regenerated tokens are identical by determinism), so
+        `on_token` fires exactly once per final token position."""
+        result.tokens.append(tok)
+        result.token_times_s.append(now)
+        n = len(result.tokens)
+        if n > self._streamed.get(result.rid, 0):
+            self._streamed[result.rid] = n
+            if self._on_token is not None:
+                self._on_token(result.rid, tok)
+
+    def _record_result(self, result: ServeResult, reason: str, now: float) -> None:
+        result.finish_reason = reason
+        result.finish_s = now
+        self._results[result.rid] = result
+        self._streamed.pop(result.rid, None)
+        if self._on_finish is not None:
+            self._on_finish(result.rid, result)
+
+    def _clear_slot(self, slot: int) -> None:
         self._slot_states[slot] = None
         self._remaining[slot] = _IDLE_REMAINING
         self._eods[slot] = -1
         self._temps[slot] = 1.0
+        if self.kv_cache == "paged":
+            self._tables[slot] = 0
+            self._wblk[slot] = self.num_blocks
+            self._positions[slot] = 0
+
+    def _finish(self, slot: int, reason: str, now: float) -> None:
+        state = self._slot_states[slot]
+        if self._table_state is not None:
+            self._table_state.release(state.request.rid)
+        self._record_result(state.result, reason, now)
+        self._clear_slot(slot)
+
+    def _finish_immediate(self, result: ServeResult, reason: str, now: float) -> None:
+        self._record_result(result, reason, now)
+
+    def _truncate_window(self, req: ServeRequest, result: ServeResult) -> list[int]:
+        """Clip the prompt to the admission window (capacity-1 / max_len-1 so at
+        least one token can be generated). Truncation is RECORDED, not silent:
+        result flag + telemetry event + engine counter."""
+        window = req.prompt_tokens[-(self.max_len - 1) :]
+        if len(window) < len(req.prompt_tokens):
+            result.truncated = True
+            if req.rid not in self._truncated_rids:  # once, even across preemption
+                self._truncated_rids.add(req.rid)
+                self.truncated_requests += 1
+                get_active_telemetry().emit_event(
+                    "serve/prompt_truncated",
+                    {"rid": req.rid, "prompt_len": len(req.prompt_tokens), "window": len(window)},
+                )
+        return window
 
     def _admit(self, t0: float) -> None:
-        """Fill idle slots from the queue (FIFO, arrival-gated): chunked prefill
-        into the freed slot, first token sampled on-device by the last chunk."""
+        """Fill idle slots from the queue (FIFO, arrival-gated). Ring: chunked
+        prefill into the freed slot right here, first token sampled on-device by
+        the last chunk. Paged: gate on free blocks for the prompt window, then
+        hand the slot to the cross-request prefill dispatcher. A draining engine
+        (`stop_fn`) admits nothing."""
+        if self._stopping():
+            return
+        if self.kv_cache == "paged":
+            self._admit_paged(t0)
+            return
         import jax
 
         jnp = self._jnp
@@ -338,12 +547,12 @@ class ServingEngine:
                 break  # FIFO: later requests can't jump an unarrived head
             self._queue.popleft()
             with span("serve/admission"):
-                window = req.prompt_tokens[-(self.capacity - 1) :]
                 temp = req.temperature if req.temperature is not None else 0.0
                 result = ServeResult(
                     rid=req.rid, prompt_len=len(req.prompt_tokens),
                     arrival_s=max(req.arrival_offset_s, 0.0),
                 )
+                window = self._truncate_window(req, result)
                 if req.max_new_tokens <= 0:
                     result.finish_reason = "budget"
                     now2 = self._now() - t0
@@ -371,15 +580,16 @@ class ServingEngine:
                 if first_tok == self.eod_token_id:
                     self._finish_immediate(result, "eod", now2)
                     continue
-                result.tokens.append(first_tok)
-                result.token_times_s.append(now2)
+                self._emit_token(result, first_tok, now2)
                 if req.max_new_tokens == 1:
                     self._finish_immediate(result, "budget", now2)
                     continue
                 # arm the slot: the admitted request joins the next decode dispatch
                 self._slot_states[slot] = _SlotState(
-                    request=req, result=result, remaining=req.max_new_tokens - 1
+                    request=req, result=result, remaining=req.max_new_tokens - 1,
+                    seq=self._admit_seq,
                 )
+                self._admit_seq += 1
                 self._tokens[slot, 0] = first_tok
                 self._positions[slot] = len(window)
                 self._keys[slot] = np.asarray(key)
@@ -387,13 +597,191 @@ class ServingEngine:
                 self._eods[slot] = self.eod_token_id
                 self._remaining[slot] = req.max_new_tokens - 1
 
-    def _finish_immediate(self, result: ServeResult, reason: str, now: float) -> None:
-        result.finish_reason = reason
-        result.finish_s = now
-        self._results[result.rid] = result
+    def _admit_paged(self, t0: float) -> None:
+        import jax
+
+        for slot in range(self.slots):
+            if not self._queue:
+                break
+            if self._slot_states[slot] is not None:
+                continue
+            now = self._now() - t0
+            req = self._queue[0]
+            if req.arrival_offset_s > now:
+                break  # FIFO: later requests can't jump an unarrived head
+            with span("serve/admission"):
+                temp = req.temperature if req.temperature is not None else 0.0
+                result = ServeResult(
+                    rid=req.rid, prompt_len=len(req.prompt_tokens),
+                    arrival_s=max(req.arrival_offset_s, 0.0),
+                )
+                window = req.prompt_tokens[-(self.max_len - 1) :]
+                # admission gate: the whole prompt window must fit in free blocks
+                if not self._table_state.ensure(req.rid, len(window)):
+                    break  # head stays queued; decoders will free blocks
+                self._queue.popleft()
+                window = self._truncate_window(req, result)
+                if req.max_new_tokens <= 0:
+                    self._table_state.release(req.rid)
+                    now2 = self._now() - t0
+                    result.first_token_s = now2
+                    self._finish_immediate(result, "budget", now2)
+                    continue
+                self._slot_states[slot] = _SlotState(
+                    request=req, result=result, remaining=0,
+                    phase="prefill", window=window, prefill_pos=0,
+                    key=jax.random.PRNGKey(req.seed), temp=temp, seq=self._admit_seq,
+                )
+                self._admit_seq += 1
 
     def _active_count(self) -> int:
         return sum(1 for s in self._slot_states if s is not None)
+
+    def _decoding_count(self) -> int:
+        return sum(1 for s in self._slot_states if s is not None and s.phase == "decode")
+
+    def _prefilling_slots(self) -> list[int]:
+        order = [
+            (s.seq, i)
+            for i, s in enumerate(self._slot_states)
+            if s is not None and s.phase == "prefill"
+        ]
+        return [i for _, i in sorted(order)]
+
+    def _preempt(self, slot: int, t0: float) -> None:
+        """Pool exhausted: push this slot's request back to the FRONT of the
+        queue (it is older than everything queued) and free its blocks. The
+        request restarts deterministically on re-admission — `_streamed` keeps
+        on_token exactly-once."""
+        state = self._slot_states[slot]
+        rid = state.request.rid
+        freed = self._table_state.release(rid)
+        self.preemptions += 1
+        get_active_telemetry().emit_event(
+            "serve/preempt",
+            {"rid": rid, "blocks_freed": freed, "tokens_discarded": len(state.result.tokens)},
+        )
+        # reset the result: generation restarts from the prompt on re-admission
+        state.result.tokens = []
+        state.result.token_times_s = []
+        self._queue.appendleft(state.request)
+        self._clear_slot(slot)
+
+    def _ensure_decode_blocks(self, t0: float) -> None:
+        """Before a paged decode dispatch: every decoding slot needs the block
+        covering its write position. Allocation failure preempts the YOUNGEST
+        active slot (never an older one — FIFO fairness, no livelock: the
+        pool admits at least one max-length request by construction)."""
+        for slot in range(self.slots):
+            state = self._slot_states[slot]
+            if state is None or state.phase != "decode":
+                continue
+            rid = state.request.rid
+            p = int(self._positions[slot])
+            while not self._table_state.ensure(rid, p + 1):
+                victims = [
+                    (s.seq, i) for i, s in enumerate(self._slot_states) if s is not None
+                ]
+                _, victim = max(victims)
+                self._preempt(victim, t0)
+                if victim == slot:
+                    break
+            if self._slot_states[slot] is None:
+                continue  # preempted itself
+            blk, off = self._table_state.write_coords(rid, p)
+            self._wblk[slot] = blk
+            self._woff[slot] = off
+            self._tables[slot] = self._table_state.table(rid)
+
+    def _prefill_dispatch(self, t0: float) -> None:
+        """Paged cross-request chunked prefill: ONE [slots, block_size] dispatch
+        packs up to `slots` block-aligned prompt chunks, taken FIFO across the
+        prefilling slots (a long prompt takes several consecutive rows — rows of
+        one dispatch see each other's K/V writes, so this is exact). Rows whose
+        chunk ends its prompt sample the request's first token on-device."""
+        import jax
+
+        jnp = self._jnp
+        R, C = self.slots, self.block_size
+        nb = self.num_blocks
+        rows: list[tuple[int, int, int, bool]] = []  # (slot, start, ntok, is_last)
+        for slot in self._prefilling_slots():
+            state = self._slot_states[slot]
+            wl = len(state.window)
+            pos = state.prefill_pos
+            while pos < wl and len(rows) < R:
+                ntok = min(C, wl - pos)
+                rows.append((slot, pos, ntok, pos + ntok >= wl))
+                pos += ntok
+            if len(rows) >= R:
+                break
+        if not rows:
+            return
+
+        toks = np.zeros((R, C), np.int32)
+        pos_a = np.zeros((R, C), np.int32)
+        tables = np.zeros((R, self.table_width), np.int32)
+        wblk = np.full((R, C), nb, np.int32)  # default: write nowhere
+        woff = np.zeros((R, C), np.int32)
+        last_idx = np.zeros((R,), np.int32)
+        keys = np.zeros((R, 2), np.uint32)
+        temps = np.zeros((R,), np.float32)
+        flags = np.zeros((R,), bool)
+        for r, (slot, start, ntok, is_last) in enumerate(rows):
+            state = self._slot_states[slot]
+            rid = state.request.rid
+            table = self._table_state.table(rid)
+            tables[r] = table
+            toks[r, :ntok] = state.window[start : start + ntok]
+            pos_a[r, :ntok] = np.arange(start, start + ntok)
+            for c in range(ntok):
+                wblk[r, c] = table[(start + c) // C]
+                woff[r, c] = (start + c) % C
+            last_idx[r] = ntok - 1
+            flags[r] = is_last
+            if is_last:
+                keys[r] = np.asarray(state.key)
+                temps[r] = state.temp
+
+        with span("serve/prefill"):
+            with self._rules_ctx():
+                self.cache, toks_d, keys_d = self._prefill_jit(
+                    self.params, self.cache,
+                    jnp.asarray(toks), jnp.asarray(pos_a), jnp.asarray(tables),
+                    jnp.asarray(wblk), jnp.asarray(woff), jnp.asarray(last_idx),
+                    jnp.asarray(keys), jnp.asarray(temps), jnp.asarray(flags),
+                )
+            out_toks, out_keys = jax.device_get((toks_d, keys_d))
+
+        now = self._now() - t0
+        for r, (slot, start, ntok, is_last) in enumerate(rows):
+            state = self._slot_states[slot]
+            state.prefill_pos = start + ntok
+            if not is_last:
+                continue
+            req, result = state.request, state.result
+            wl = len(state.window)
+            first_tok = int(out_toks[r])
+            result.first_token_s = now
+            if first_tok == self.eod_token_id:
+                self._finish(slot, "eod", now)
+                continue
+            self._emit_token(result, first_tok, now)
+            # budget clamped to the table ceiling: the last emitted token never
+            # needs a cache write, so max_len - wl + 1 tokens fit -> the stop is
+            # always "budget"/"eod", never "capacity"
+            allowed = min(req.max_new_tokens, self.max_len - wl + 1)
+            if allowed <= 1:
+                self._finish(slot, "budget", now)
+                continue
+            state.phase = "decode"
+            state.remaining = allowed - 1
+            self._tokens[slot, 0] = first_tok
+            self._positions[slot] = wl
+            self._keys[slot] = out_keys[r]
+            self._temps[slot] = state.temp
+            self._eods[slot] = self.eod_token_id
+            self._remaining[slot] = allowed - 1
 
     def _decode_dispatch(self, t0: float) -> None:
         """ONE compiled step for the whole batch, then host bookkeeping on the
@@ -402,23 +790,37 @@ class ServingEngine:
         import jax
 
         jnp = self._jnp
+        if self.kv_cache == "paged":
+            self._ensure_decode_blocks(t0)
+            if self._decoding_count() == 0:
+                return  # every decoder was preempted into the queue
         with span("serve/decode"):
             with self._rules_ctx():
-                self.cache, toks_d, keys_d, fin_d = self._decode_jit(
-                    self.params, self.cache,
-                    jnp.asarray(self._tokens), jnp.asarray(self._positions),
-                    jnp.asarray(self._keys), jnp.asarray(self._temps),
-                    jnp.asarray(self._eods), jnp.asarray(self._remaining),
-                )
+                if self.kv_cache == "paged":
+                    self.cache, toks_d, keys_d, fin_d = self._decode_jit(
+                        self.params, self.cache,
+                        jnp.asarray(self._tokens), jnp.asarray(self._positions),
+                        jnp.asarray(self._tables), jnp.asarray(self._wblk),
+                        jnp.asarray(self._woff),
+                        jnp.asarray(self._keys), jnp.asarray(self._temps),
+                        jnp.asarray(self._eods), jnp.asarray(self._remaining),
+                    )
+                else:
+                    self.cache, toks_d, keys_d, fin_d = self._decode_jit(
+                        self.params, self.cache,
+                        jnp.asarray(self._tokens), jnp.asarray(self._positions),
+                        jnp.asarray(self._keys), jnp.asarray(self._temps),
+                        jnp.asarray(self._eods), jnp.asarray(self._remaining),
+                    )
             toks, keys, finished = jax.device_get((toks_d, keys_d, fin_d))
         now = self._now() - t0
         self.decode_steps += 1
-        active = self._active_count()
+        active = self._decoding_count()
         self._occupancy_sum += active
         self.max_concurrent = max(self.max_concurrent, active)
         for slot in range(self.slots):
             state = self._slot_states[slot]
-            if state is None:
+            if state is None or state.phase != "decode":
                 continue
             self._positions[slot] += 1  # the fed token landed in the cache
             tok = int(toks[slot])
@@ -426,8 +828,7 @@ class ServingEngine:
             if tok == self.eod_token_id:
                 self._finish(slot, "eod", now)
                 continue
-            state.result.tokens.append(tok)
-            state.result.token_times_s.append(now)
+            self._emit_token(state.result, tok, now)
             self.decode_token_count += 1
             if finished[slot]:  # budget exhausted (eod handled above)
                 self._finish(slot, "budget", now)
@@ -435,26 +836,48 @@ class ServingEngine:
             state.remaining -= 1
             self._remaining[slot] = state.remaining
             self._tokens[slot, 0] = tok
-            if self._positions[slot] >= self.capacity:
+            if self.kv_cache == "ring" and self._positions[slot] >= self.capacity:
                 # ring full: the interactive path falls back to a sliding-window
                 # re-forward; the engine finishes the request instead (documented
-                # divergence — docs/components.md serving section)
+                # divergence — docs/components.md serving section). Paged mode
+                # never takes this exit: the admission budget clamp bounds
+                # positions below max_len
                 self._finish(slot, "capacity", now)
 
+    def step(self, t0: float) -> bool:
+        """One scheduler round: admit, (paged) prefill dispatch, decode
+        dispatch. Returns True if any device work was dispatched — the run loop
+        and the HTTP server's engine thread both drive this."""
+        self._admit(t0)
+        did = False
+        if self.kv_cache == "paged" and self._prefilling_slots():
+            self._prefill_dispatch(t0)
+            did = True
+        if self._decoding_count():
+            self._decode_dispatch(t0)
+            did = True
+        return did
+
     def run(self) -> dict[int, ServeResult]:
-        """Serve until queue and slots drain. Returns rid -> ServeResult."""
+        """Serve until queue and slots drain — or, when `stop_fn` trips, until
+        in-flight slots finish (graceful drain: no new admissions, queued
+        requests are left unserved). Returns rid -> ServeResult."""
         t0 = self._now()
-        while self._queue or self._active_count():
-            self._admit(t0)
-            if self._active_count() == 0:
-                if not self._queue:
+        while True:
+            stopping = self._stopping()
+            if stopping:
+                if self._active_count() == 0:
+                    break
+            elif not self._queue and self._active_count() == 0:
+                break
+            did = self.step(t0)
+            if not did:
+                if stopping or not self._queue:
                     break
                 # nothing running and the head hasn't arrived: wait for it
                 wait = self._queue[0].arrival_offset_s - (self._now() - t0)
                 if wait > 0:
                     time.sleep(min(wait, 0.05))
-                continue
-            self._decode_dispatch(t0)
         return self._results
 
     # -------------------------------------------------------------------- stats
@@ -464,7 +887,8 @@ class ServingEngine:
             if self.decode_steps
             else 0.0
         )
-        return {
+        out = {
+            "kv_cache": self.kv_cache,
             "decode_steps": self.decode_steps,
             "decode_tokens": self.decode_token_count,
             "slot_occupancy": occupancy,
@@ -473,17 +897,37 @@ class ServingEngine:
             "prefill_executables": self._prefill_traces,
             "slots": self.slots,
             "capacity": self.capacity,
+            "preemptions": self.preemptions,
+            "truncated_requests": self.truncated_requests,
         }
+        if self.kv_cache == "paged":
+            out.update(
+                max_len=self.max_len,
+                block_size=self.block_size,
+                num_blocks=self.num_blocks,
+                free_blocks=self._table_state.pool.free_count,
+            )
+        return out
 
     def decode_lowered_text(self) -> str:
         """Lowered HLO of the decode step with the CURRENT arg shardings — the
         sharding acceptance test greps this for mesh annotations."""
         jnp = self._jnp
         with self._rules_ctx():
-            lowered = self._decode_jit.lower(
-                self.params, self.cache,
-                jnp.asarray(self._tokens), jnp.asarray(self._positions),
-                jnp.asarray(self._keys), jnp.asarray(self._temps),
-                jnp.asarray(self._eods), jnp.asarray(self._remaining),
-            )
+            if self.kv_cache == "paged":
+                lowered = self._decode_jit.lower(
+                    self.params, self.cache,
+                    jnp.asarray(self._tokens), jnp.asarray(self._positions),
+                    jnp.asarray(self._tables), jnp.asarray(self._wblk),
+                    jnp.asarray(self._woff),
+                    jnp.asarray(self._keys), jnp.asarray(self._temps),
+                    jnp.asarray(self._eods), jnp.asarray(self._remaining),
+                )
+            else:
+                lowered = self._decode_jit.lower(
+                    self.params, self.cache,
+                    jnp.asarray(self._tokens), jnp.asarray(self._positions),
+                    jnp.asarray(self._keys), jnp.asarray(self._temps),
+                    jnp.asarray(self._eods), jnp.asarray(self._remaining),
+                )
         return lowered.as_text()

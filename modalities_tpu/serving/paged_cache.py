@@ -1,0 +1,178 @@
+"""Host-side block-table memory manager for the paged KV cache (serving v2).
+
+The device side is ONE static global pool per scanned layer
+(`[num_blocks, block_size, kv_heads, head_dim]`, models/gpt2/gpt2_model.py
+`init_paged_cache`/`prefill_paged`/`decode_paged`); everything here is plain
+Python bookkeeping that decides WHICH pool block each logical position of each
+request maps to. Block tables are handed to the jitted step as traced int32
+arrays, so allocation never triggers a recompile — the vLLM argument
+(block tables turn KV memory into paging, admission gates on free blocks
+instead of a per-slot ring capacity).
+
+Invariants (pinned by tests/serving/test_paged_cache.py and the scheduler
+property test):
+- a block is either on the free list or owned by exactly one request,
+- `free + sum(owned) == num_blocks` at all times (no leaks),
+- tables are position-ordered: table entry m holds logical positions
+  m*block_size .. (m+1)*block_size - 1, which is what keeps the gathered K/V
+  row position-ordered and the paged softmax bitwise equal to the ring row.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+def blocks_for_tokens(num_tokens: int, block_size: int) -> int:
+    """Pool blocks needed to hold `num_tokens` positions."""
+    return -(-max(int(num_tokens), 0) // int(block_size))
+
+
+class BlockPool:
+    """Free-list allocator over the global pool's block ids [0, num_blocks).
+
+    Block id `num_blocks` is the reserved WRITE-NOWHERE sentinel (the device
+    scatter runs with mode="drop"), so the pool itself never hands it out.
+    """
+
+    def __init__(self, num_blocks: int):
+        if int(num_blocks) < 1:
+            raise ValueError(f"BlockPool needs num_blocks >= 1, got {num_blocks}")
+        self.num_blocks = int(num_blocks)
+        # LIFO free list: freshly freed blocks are reused first (keeps the hot
+        # working set small; allocation order is irrelevant to correctness
+        # because tables, not block ids, carry position order)
+        self._free: list[int] = list(range(self.num_blocks - 1, -1, -1))
+        self._owner: dict[int, int] = {}  # block id -> rid
+
+    @property
+    def free_count(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_count(self) -> int:
+        return len(self._owner)
+
+    def allocate(self, rid: int) -> int | None:
+        """Pop a free block for `rid`; None when the pool is exhausted (the
+        scheduler preempts rather than corrupting a table)."""
+        if not self._free:
+            return None
+        block = self._free.pop()
+        self._owner[block] = int(rid)
+        return block
+
+    def free(self, block: int) -> None:
+        if block not in self._owner:
+            raise ValueError(f"double free / foreign block {block}")
+        del self._owner[block]
+        self._free.append(block)
+
+    def owner(self, block: int) -> int | None:
+        return self._owner.get(block)
+
+    def check(self) -> None:
+        """Leak/corruption audit: free + owned must tile [0, num_blocks)."""
+        ids = sorted(self._free) + sorted(self._owner)
+        if sorted(ids) != list(range(self.num_blocks)):
+            raise AssertionError(
+                f"block pool corrupt: free={sorted(self._free)} owned={sorted(self._owner)}"
+            )
+
+
+@dataclass
+class _RequestBlocks:
+    blocks: list[int] = field(default_factory=list)  # position-ordered
+
+
+class BlockTableState:
+    """Per-request block tables over one BlockPool.
+
+    `table_width` is the STATIC width of the traced table argument — it caps
+    request length at table_width * block_size and never changes after
+    construction (one decode executable)."""
+
+    def __init__(self, num_blocks: int, block_size: int, table_width: int):
+        if int(block_size) < 1:
+            raise ValueError(f"block_size must be >= 1, got {block_size}")
+        if int(table_width) < 1:
+            raise ValueError(f"table_width must be >= 1, got {table_width}")
+        self.pool = BlockPool(num_blocks)
+        self.block_size = int(block_size)
+        self.table_width = int(table_width)
+        self._requests: dict[int, _RequestBlocks] = {}
+
+    @property
+    def max_len(self) -> int:
+        """Per-request position ceiling imposed by the static table width."""
+        return self.table_width * self.block_size
+
+    def ensure(self, rid: int, num_tokens: int) -> bool:
+        """Grow `rid`'s table to cover positions [0, num_tokens). True on
+        success; False when the pool ran dry (NOTHING was allocated — the
+        caller preempts and retries, so partial growth must not leak)."""
+        state = self._requests.setdefault(int(rid), _RequestBlocks())
+        need = blocks_for_tokens(num_tokens, self.block_size) - len(state.blocks)
+        if need <= 0:
+            return True
+        if len(state.blocks) + need > self.table_width:
+            raise ValueError(
+                f"request {rid} needs {len(state.blocks) + need} blocks but the "
+                f"static table width is {self.table_width} "
+                f"(max_len {self.max_len}): admission should have clamped the budget"
+            )
+        if self.pool.free_count < need:
+            if not state.blocks:
+                del self._requests[int(rid)]
+            return False
+        for _ in range(need):
+            state.blocks.append(self.pool.allocate(int(rid)))
+        return True
+
+    def table(self, rid: int) -> list[int]:
+        """Static-width table row for the traced argument: owned blocks in
+        position order, padded with 0 (padded entries are masked by `pos`)."""
+        blocks = self._requests[int(rid)].blocks
+        return blocks + [0] * (self.table_width - len(blocks))
+
+    def write_coords(self, rid: int, position: int) -> tuple[int, int]:
+        """(physical block, offset) for writing logical `position`."""
+        blocks = self._requests[int(rid)].blocks
+        return blocks[position // self.block_size], position % self.block_size
+
+    def blocks_held(self, rid: int) -> int:
+        state = self._requests.get(int(rid))
+        return len(state.blocks) if state is not None else 0
+
+    def release(self, rid: int) -> int:
+        """Free every block `rid` owns (finish or preemption). Returns the
+        number freed; releasing an unknown rid is a no-op (0)."""
+        state = self._requests.pop(int(rid), None)
+        if state is None:
+            return 0
+        for block in state.blocks:
+            self.pool.free(block)
+        return len(state.blocks)
+
+    def active_requests(self) -> list[int]:
+        return sorted(self._requests)
+
+    def check(self) -> None:
+        """Audit: pool consistency + every owned block appears in exactly one
+        request table."""
+        self.pool.check()
+        seen: set[int] = set()
+        for rid, state in self._requests.items():
+            for block in state.blocks:
+                if block in seen:
+                    raise AssertionError(f"block {block} in two tables")
+                if self.pool.owner(block) != rid:
+                    raise AssertionError(
+                        f"block {block} table/owner mismatch: "
+                        f"table rid {rid}, pool owner {self.pool.owner(block)}"
+                    )
+                seen.add(block)
+        if len(seen) != self.pool.used_count:
+            raise AssertionError(
+                f"{self.pool.used_count} blocks allocated but {len(seen)} in tables"
+            )

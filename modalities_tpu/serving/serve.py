@@ -39,6 +39,12 @@ class ServingComponentConfig(BaseModel):
     seed: int = 0
     prompt_template: str = "{prompt}"
     eod_token: Optional[str] = "<eod>"
+    kv_cache: Optional[str] = None  # "ring" | "paged"; None = env/default ring
+    paged_block_size: int = 16
+    paged_num_blocks: Optional[int] = None  # None = slots * table width
+    paged_max_len: Optional[int] = None  # per-request ceiling; None = cache_capacity
+    http_host: str = "127.0.0.1"
+    http_port: Optional[int] = None  # set (0 = ephemeral) to start the HTTP front end
 
 
 class ServingComponent:
@@ -57,6 +63,12 @@ class ServingComponent:
         seed: int = 0,
         prompt_template: str = "{prompt}",
         eod_token: Optional[str] = "<eod>",
+        kv_cache: Optional[str] = None,
+        paged_block_size: int = 16,
+        paged_num_blocks: Optional[int] = None,
+        paged_max_len: Optional[int] = None,
+        http_host: str = "127.0.0.1",
+        http_port: Optional[int] = None,
         params=None,
     ):
         self.model = model
@@ -69,7 +81,14 @@ class ServingComponent:
         self.seed = seed
         self.prompt_template = prompt_template
         self.eod_token = eod_token
+        self.kv_cache = kv_cache
+        self.paged_block_size = paged_block_size
+        self.paged_num_blocks = paged_num_blocks
+        self.paged_max_len = paged_max_len
+        self.http_host = http_host
+        self.http_port = http_port
         self.params = params
+        self.stop_fn = None  # graceful drain: serve() wires the SIGTERM flag here
         self._engine = None
 
     def _eod_id(self) -> int:
@@ -91,6 +110,11 @@ class ServingComponent:
                 cache_capacity=self.cache_capacity,
                 eod_token_id=self._eod_id(),
                 default_temperature=self.temperature,
+                kv_cache=self.kv_cache,
+                paged_block_size=self.paged_block_size,
+                paged_num_blocks=self.paged_num_blocks,
+                paged_max_len=self.paged_max_len,
+                stop_fn=self.stop_fn,
                 mesh_handle=self.device_mesh,
             )
         return self._engine
@@ -113,7 +137,10 @@ class ServingComponent:
         results = engine.run()
         rows = []
         for rid, req in rid_to_req.items():
-            res = results[rid]
+            res = results.get(rid)
+            if res is None:  # graceful drain: admission stopped before this rid
+                logger.warning("serve: request %d left unserved by drain", rid)
+                continue
             rows.append(
                 {
                     "rid": rid,
@@ -121,11 +148,36 @@ class ServingComponent:
                     "completion": self.tokenizer.decode(res.tokens),
                     "tokens": res.tokens,
                     "finish_reason": res.finish_reason,
+                    "truncated": res.truncated,
                     "ttft_s": res.ttft_s,
                     "latency_s": res.finish_s - res.arrival_s,
                 }
             )
         return rows
+
+    def run_http(self) -> dict:
+        """Streaming HTTP front end (serving/server.py): blocks until drained
+        (SIGTERM/SIGINT via `stop_fn`, or server.stop()). Returns final stats."""
+        from modalities_tpu.serving.server import ServingHTTPServer
+
+        engine = self.build_engine()
+
+        def encode(prompt: str) -> list[int]:
+            text = self.prompt_template.format(prompt=prompt) if self.prompt_template else prompt
+            return list(self.tokenizer.tokenize(text))
+
+        server = ServingHTTPServer(
+            engine,
+            encode=encode,
+            decode=self.tokenizer.decode,
+            host=self.http_host,
+            port=self.http_port or 0,
+            default_max_new_tokens=self.max_new_tokens,
+        )
+        server.start()
+        logger.info("serving HTTP on %s:%d (POST /generate, GET /healthz, GET /stats)",
+                    self.http_host, server.port)
+        return server.serve_forever()
 
     def run(self) -> None:
         """Interactive loop (parity with TextInferenceComponent.run)."""
@@ -200,31 +252,51 @@ def serve(
     config_file_path: Path,
     requests_file_path: Optional[Path] = None,
     output_file_path: Optional[Path] = None,
+    http_port: Optional[int] = None,
 ) -> None:
-    """Entry point behind `python -m modalities_tpu serve`. With a JSONL requests
-    file: replay it and write result rows (stdout or --output_file_path). Without:
-    interactive prompt loop."""
+    """Entry point behind `python -m modalities_tpu serve`. With `http_port`
+    (flag or config knob): streaming HTTP front end until SIGTERM/SIGINT drains
+    it. With a JSONL requests file: replay it and write result rows (stdout or
+    --output_file_path). Without either: interactive prompt loop.
+
+    SIGTERM/SIGINT always drain gracefully (resilience flag-only handler):
+    admission stops, in-flight slots finish, the process exits 0 with final
+    stats."""
+    from modalities_tpu.resilience.preemption import PreemptionHandler
+
     config_dict = load_app_config_dict(config_file_path)
     components = build_serving_components(config_dict)
     component = components.serving_component
     _resolve_params(component, getattr(components.settings, "checkpoint_folder_path", None))
 
-    if requests_file_path is None:
-        component.run()
-        return
+    handler = PreemptionHandler().install()
+    component.stop_fn = handler.should_stop
+    try:
+        if http_port is not None:
+            component.http_port = int(http_port)
+        if component.http_port is not None:
+            stats = component.run_http()
+            logger.info("serve stats: %s", json.dumps(stats))
+            return
 
-    requests = []
-    with open(requests_file_path) as f:
-        for line in f:
-            line = line.strip()
-            if line:
-                requests.append(json.loads(line))
-    rows = component.run_requests(requests)
-    out_lines = [json.dumps(row) for row in rows]
-    if output_file_path is not None:
-        Path(output_file_path).write_text("\n".join(out_lines) + "\n")
-    else:
-        for line in out_lines:
-            print(line)
-    stats = component.build_engine().stats()
-    logger.info("serve stats: %s", json.dumps(stats))
+        if requests_file_path is None:
+            component.run()
+            return
+
+        requests = []
+        with open(requests_file_path) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    requests.append(json.loads(line))
+        rows = component.run_requests(requests)
+        out_lines = [json.dumps(row) for row in rows]
+        if output_file_path is not None:
+            Path(output_file_path).write_text("\n".join(out_lines) + "\n")
+        else:
+            for line in out_lines:
+                print(line)
+        stats = component.build_engine().stats()
+        logger.info("serve stats: %s", json.dumps(stats))
+    finally:
+        handler.uninstall()

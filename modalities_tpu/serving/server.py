@@ -1,0 +1,273 @@
+"""Streaming HTTP front end for the serving engine (serving v2).
+
+Stdlib-only (`ThreadingHTTPServer`): one HTTP thread per connection, ONE engine
+thread owning the model. The seam between them is thread-safe by construction:
+
+- handlers never touch the engine — a POST pushes (request, stream-queue) onto
+  `_pending` (queue.Queue) and then blocks reading its own stream queue;
+- the engine loop drains `_pending` at token boundaries (engine.submit stays
+  single-threaded), runs `engine.step`, and routes emitted tokens back through
+  the engine's `on_token`/`on_finish` callbacks into the per-request stream
+  queues.
+
+Endpoints:
+- `POST /generate` — body `{"prompt": str, "max_new_tokens": int,
+  "temperature": float|null, "seed": int}`; response is SSE
+  (`text/event-stream`): one `data: {"token_id", "text"}` event per token, a
+  final `data: {"done": true, "completion", "finish_reason", ...}` event, then
+  the connection closes. 503 while draining.
+- `GET /healthz` — `{"status": "ok"|"draining"}`.
+- `GET /stats` — engine stats + HTTP counters (advisory reads, no lock: every
+  field is a single GIL-atomic load).
+
+Graceful drain: `stop()` (or the engine's own `stop_fn`, e.g. the resilience
+SIGTERM flag) stops admission; in-flight slots finish and stream out; new
+POSTs get 503; `serve_forever` returns with the final stats dict.
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Optional
+
+from modalities_tpu.telemetry import span
+
+
+class ServingHTTPServer:
+    """Front end over a constructed ServingEngine.
+
+    `encode(prompt) -> list[int]` / `decode(token_ids) -> str` bridge HTTP text
+    to engine token ids (the serving component passes its tokenizer + prompt
+    template through these)."""
+
+    def __init__(
+        self,
+        engine,
+        encode: Callable[[str], list],
+        decode: Callable[[list], str],
+        *,
+        host: str = "127.0.0.1",
+        port: int = 0,  # 0 = ephemeral, resolved port on self.port after start()
+        default_max_new_tokens: int = 64,
+    ):
+        self.engine = engine
+        self._encode = encode
+        self._decode = decode
+        self._host = host
+        self._port_req = int(port)
+        self.port: Optional[int] = None
+        self.default_max_new_tokens = int(default_max_new_tokens)
+
+        self._pending: queue.Queue = queue.Queue()  # (body dict, stream queue)
+        self._streams: dict[int, queue.Queue] = {}  # rid -> stream (engine thread only)
+        self._shutdown = False
+        self._t0: Optional[float] = None
+        self.http_requests = 0
+        self.http_rejected = 0
+
+        # the engine streams through us; its own stop_fn (e.g. the resilience
+        # SIGTERM flag) still counts — we wrap it with the server's drain flag
+        engine._on_token = self._on_token
+        engine._on_finish = self._on_finish
+        prior_stop = engine._stop_fn
+        engine._stop_fn = lambda: self._shutdown or bool(prior_stop and prior_stop())
+
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._http_thread: Optional[threading.Thread] = None
+        self._engine_thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------- engine side
+    def _on_token(self, rid: int, tok: int) -> None:
+        stream = self._streams.get(rid)
+        if stream is not None:
+            stream.put(("token", int(tok)))
+
+    def _on_finish(self, rid: int, result) -> None:
+        stream = self._streams.pop(rid, None)
+        if stream is not None:
+            stream.put(("done", result))
+
+    def _drain_pending(self, t0: float) -> int:
+        drained = 0
+        while True:
+            try:
+                body, stream = self._pending.get_nowait()
+            except queue.Empty:
+                return drained
+            drained += 1
+            try:
+                prompt_tokens = self._encode(body["prompt"])
+                rid = self.engine.submit(
+                    prompt_tokens,
+                    int(body.get("max_new_tokens") or self.default_max_new_tokens),
+                    temperature=body.get("temperature"),
+                    seed=int(body.get("seed") or 0),
+                    arrival_offset_s=self.engine._now() - t0,
+                )
+                self._streams[rid] = stream
+                stream.put(("rid", rid))
+            except Exception as exc:  # bad prompt/params: surface on the stream
+                stream.put(("error", f"{type(exc).__name__}: {exc}"))
+
+    def _engine_loop(self) -> None:
+        engine = self.engine
+        t0 = engine._now()
+        self._t0 = t0
+        while True:
+            drained = self._drain_pending(t0)
+            stopping = engine._stopping()
+            if stopping and engine._active_count() == 0:
+                break
+            did = engine.step(t0)
+            if not did and not drained:
+                if stopping:
+                    break
+                time.sleep(0.002)  # idle: poll the submission queue
+        # anything still pending arrived after the drain decision: reject it
+        while True:
+            try:
+                _, stream = self._pending.get_nowait()
+            except queue.Empty:
+                break
+            self.http_rejected += 1
+            stream.put(("error", "server is draining"))
+
+    # --------------------------------------------------------------- HTTP side
+    @property
+    def draining(self) -> bool:
+        return self.engine._stopping()
+
+    def submit_stream(self, body: dict, stream: queue.Queue) -> None:
+        self._pending.put((body, stream))
+
+    def start(self) -> None:
+        front = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):  # stdlib default spams stderr per request
+                pass
+
+            def _json(self, code: int, payload: dict) -> None:
+                data = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):
+                if self.path == "/healthz":
+                    self._json(200, {"status": "draining" if front.draining else "ok"})
+                elif self.path == "/stats":
+                    stats = dict(front.engine.stats())
+                    stats["http_requests"] = front.http_requests
+                    stats["http_rejected"] = front.http_rejected
+                    stats["draining"] = front.draining
+                    self._json(200, stats)
+                else:
+                    self._json(404, {"error": f"unknown path {self.path}"})
+
+            def do_POST(self):
+                if self.path != "/generate":
+                    self._json(404, {"error": f"unknown path {self.path}"})
+                    return
+                with span("serve/http"):
+                    front.http_requests += 1
+                    try:
+                        length = int(self.headers.get("Content-Length") or 0)
+                        body = json.loads(self.rfile.read(length) or b"{}")
+                        prompt = body.get("prompt")
+                        if not isinstance(prompt, str) or not prompt:
+                            self._json(400, {"error": "body needs a non-empty 'prompt'"})
+                            return
+                    except (ValueError, json.JSONDecodeError) as exc:
+                        self._json(400, {"error": f"bad JSON body: {exc}"})
+                        return
+                    if front.draining:
+                        front.http_rejected += 1
+                        self._json(503, {"error": "server is draining"})
+                        return
+                    stream: queue.Queue = queue.Queue()
+                    front.submit_stream(body, stream)
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/event-stream")
+                    self.send_header("Cache-Control", "no-cache")
+                    self.send_header("Connection", "close")
+                    self.end_headers()
+                    self._stream_events(stream)
+
+            def _sse(self, payload: dict) -> None:
+                self.wfile.write(f"data: {json.dumps(payload)}\n\n".encode())
+                self.wfile.flush()
+
+            def _stream_events(self, stream: queue.Queue) -> None:
+                tokens: list[int] = []
+                try:
+                    while True:
+                        kind, value = stream.get()
+                        if kind == "rid":
+                            continue
+                        if kind == "token":
+                            tokens.append(value)
+                            self._sse(
+                                {"token_id": value, "text": front._decode([value])}
+                            )
+                        elif kind == "done":
+                            result = value
+                            self._sse(
+                                {
+                                    "done": True,
+                                    "completion": front._decode(result.tokens),
+                                    "token_ids": list(result.tokens),
+                                    "finish_reason": result.finish_reason,
+                                    "truncated": result.truncated,
+                                    "prompt_len": result.prompt_len,
+                                    "ttft_s": result.ttft_s,
+                                }
+                            )
+                            return
+                        else:  # "error"
+                            self._sse({"error": value})
+                            return
+                except (BrokenPipeError, ConnectionResetError):
+                    # client went away mid-stream; the engine finishes the
+                    # request anyway (no cancellation path) — tokens drop here
+                    return
+
+        self._httpd = ThreadingHTTPServer((self._host, self._port_req), _Handler)
+        self.port = self._httpd.server_address[1]
+        self._http_thread = threading.Thread(
+            target=self._httpd.serve_forever, name="serve-http", daemon=True
+        )
+        self._engine_thread = threading.Thread(
+            target=self._engine_loop, name="serve-engine", daemon=True
+        )
+        self._engine_thread.start()
+        self._http_thread.start()
+
+    def stop(self) -> None:
+        """Request graceful drain: stop admitting, let in-flight finish."""
+        self._shutdown = True
+
+    def serve_forever(self, poll_s: float = 0.1) -> dict:
+        """Block until the engine loop exits (stop()/stop_fn drain), then shut
+        the HTTP listener down and return final engine stats."""
+        try:
+            while self._engine_thread.is_alive():
+                self._engine_thread.join(poll_s)
+        finally:
+            self.close()
+        return self.engine.stats()
+
+    def close(self) -> None:
+        self._shutdown = True
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        if self._engine_thread is not None and self._engine_thread.is_alive():
+            self._engine_thread.join(5.0)

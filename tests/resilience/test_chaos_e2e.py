@@ -176,6 +176,8 @@ def test_nan_grads_skip_step_finishes_with_finite_loss(workdir):
     assert skipped[0]["in_window"] == 1 and skipped[0]["budget"] == 2
 
 
+@pytest.mark.slow  # ~20 s; the poison path stays pinned by the skip-step chaos
+# test above and the raise message by test_trainer_raises_on_nonfinite_grads
 def test_nan_grads_default_raise_policy_is_legacy_identical(workdir):
     """Under the default policy the same poison must still kill the run with the
     exact legacy message — resilience armed != behavior changed. The legacy

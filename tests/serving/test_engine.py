@@ -141,6 +141,26 @@ def test_ring_capacity_finishes_request(model, params):
     assert 0 < len(result.tokens) < 50
 
 
+def test_overlong_prompt_truncation_is_flagged_not_silent(model, params, ref):
+    """A prompt longer than the admission window is clipped to the last
+    capacity-1 tokens — and the clipping is RECORDED: `truncated` on the
+    result, engine counter, telemetry event (not silently dropped)."""
+    engine = ServingEngine(model, params, max_batch_slots=1, cache_capacity=8)
+    prompt = list(range(1, 13))  # 12 tokens > window of 7
+    rid = engine.submit(prompt, 3, temperature=0.0, seed=0)
+    result = engine.run()[rid]
+    assert result.truncated is True
+    assert result.prompt_len == 12  # original length, not the window
+    assert engine.stats()["truncated_requests"] == 1
+    # the served window IS the clipped tail: tokens match the reference fed it
+    expected = ref(prompt[-7:], 3, 0.0, 0)
+    assert result.tokens == expected[: len(result.tokens)]
+    # an in-window prompt stays unflagged
+    rid2 = engine.submit([1, 2, 3], 2, temperature=0.0, seed=1)
+    assert engine.run()[rid2].truncated is False
+    assert engine.stats()["truncated_requests"] == 1
+
+
 # ----------------------------------------------------- scheduler / admission
 
 

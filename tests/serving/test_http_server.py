@@ -1,0 +1,135 @@
+"""Streaming HTTP front end smoke (serving/server.py), in-process on an
+ephemeral port: one SSE round-trip through POST /generate must deliver
+token-for-token what the interactive path emits (the batch-invariance contract
+crosses the HTTP seam intact), /healthz and /stats answer, and stop() drains
+the engine loop and closes the listener.
+
+The full sequence runs in ONE test: the drain is terminal for the server, and
+a single module-scoped engine keeps the compile cost out of the tier-1 budget.
+"""
+
+import http.client
+import json
+
+import jax
+import pytest
+from flax.core import meta
+
+from modalities_tpu.inference.text.inference_component import TextInferenceComponent
+from modalities_tpu.serving.engine import ServingEngine
+from modalities_tpu.serving.server import ServingHTTPServer
+from tests.models.test_gpt2_model import tiny_gpt2
+from tests.serving.test_engine import _IdTok
+
+
+def _get(port: int, path: str, timeout: float = 30.0):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        conn.request("GET", path)
+        resp = conn.getresponse()
+        return resp.status, json.loads(resp.read())
+    finally:
+        conn.close()
+
+
+def _post_generate(port: int, body: dict, timeout: float = 120.0):
+    """POST /generate and parse the SSE stream into a list of event dicts."""
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        conn.request(
+            "POST", "/generate", body=json.dumps(body),
+            headers={"Content-Type": "application/json"},
+        )
+        resp = conn.getresponse()
+        if resp.status != 200:
+            return resp.status, resp.getheader("Content-Type"), json.loads(resp.read())
+        events, buf = [], b""
+        while True:
+            chunk = resp.read(1)
+            if not chunk:
+                break
+            buf += chunk
+            while b"\n\n" in buf:
+                raw, buf = buf.split(b"\n\n", 1)
+                assert raw.startswith(b"data: "), raw
+                events.append(json.loads(raw[len(b"data: "):]))
+        return resp.status, resp.getheader("Content-Type"), events
+    finally:
+        conn.close()
+
+
+def test_http_sse_round_trip_stats_and_drain():
+    model = tiny_gpt2("manual")
+    params = meta.unbox(model.init_params(jax.random.PRNGKey(0)))
+    engine = ServingEngine(
+        model, params, max_batch_slots=2, kv_cache="paged", paged_block_size=4
+    )
+    server = ServingHTTPServer(
+        engine,
+        encode=lambda s: [int(t) for t in s.split()],
+        decode=lambda ids: " ".join(str(i) for i in ids),
+        port=0,  # ephemeral
+    )
+    server.start()
+    try:
+        assert server.port > 0
+
+        status, health = _get(server.port, "/healthz")
+        assert (status, health["status"]) == (200, "ok")
+
+        # ---- one streamed round-trip: tokens arrive one SSE event at a time
+        status, ctype, events = _post_generate(
+            server.port,
+            {"prompt": "3 17 42 9", "max_new_tokens": 6, "temperature": 0.8, "seed": 1},
+        )
+        assert status == 200
+        assert ctype.startswith("text/event-stream")
+        streamed = [e["token_id"] for e in events if "token_id" in e]
+        done = [e for e in events if e.get("done")]
+        assert len(done) == 1
+        done = done[0]
+        assert streamed == done["token_ids"]  # per-token events == final list
+        assert len(streamed) == 6 and done["finish_reason"] == "budget"
+        assert done["truncated"] is False and done["prompt_len"] == 4
+        assert done["completion"] == " ".join(str(t) for t in streamed)
+        assert done["ttft_s"] >= 0.0
+
+        # the HTTP seam is invisible in the tokens: interactive path parity
+        comp = TextInferenceComponent(
+            model=model, params=params, tokenizer=_IdTok(),
+            prompt_template="{prompt}", sequence_length=32,
+            temperature=0.8, eod_token="<eod>",
+        )
+        assert streamed == comp.generate_tokens([3, 17, 42, 9], max_new_tokens=6, seed=1)
+
+        # ---- malformed bodies are a 400, not a wedged stream
+        status, _, err = _post_generate(server.port, {"prompt": ""})
+        assert status == 400 and "error" in err
+        status, _, err = _post_generate(server.port, {"max_new_tokens": 3})
+        assert status == 400 and "error" in err
+
+        status, stats = _get(server.port, "/stats")
+        assert status == 200
+        assert stats["http_requests"] == 3  # every POST /generate attempt counts
+        assert stats["http_rejected"] == 0  # 400s are errors, not drain rejects
+        assert stats["kv_cache"] == "paged"
+        assert stats["draining"] is False
+        assert stats["decode_executables"] == 1
+
+        # ---- drain: stop() flips healthz, rejects new work with 503, and
+        # serve_forever() returns the final stats once the engine loop exits
+        server.stop()
+        status, health = _get(server.port, "/healthz")
+        assert (status, health["status"]) == (200, "draining")
+        status, _, err = _post_generate(server.port, {"prompt": "1 2"})
+        assert status == 503 and "error" in err
+
+        final = server.serve_forever()
+        assert final["decode_executables"] == 1
+        assert final["free_blocks"] == final["num_blocks"]  # nothing leaked
+
+        # listener is closed: new connections must fail
+        with pytest.raises(OSError):
+            _get(server.port, "/healthz", timeout=3.0)
+    finally:
+        server.close()

@@ -1,0 +1,121 @@
+"""Block-table memory manager invariants (serving/paged_cache.py).
+
+Pure host-side tests — no JAX. The contract that keeps the paged attention
+bitwise equal to the ring row lives here: tables are position-ordered, a block
+is on the free list XOR owned by exactly one request, and ensure() is
+all-or-nothing so a mid-growth pool-dry never leaks.
+"""
+
+import numpy as np
+import pytest
+
+from modalities_tpu.serving.paged_cache import (
+    BlockPool,
+    BlockTableState,
+    blocks_for_tokens,
+)
+
+
+def test_blocks_for_tokens_ceil_division():
+    assert blocks_for_tokens(0, 4) == 0
+    assert blocks_for_tokens(1, 4) == 1
+    assert blocks_for_tokens(4, 4) == 1
+    assert blocks_for_tokens(5, 4) == 2
+    assert blocks_for_tokens(17, 16) == 2
+
+
+def test_pool_allocate_free_roundtrip():
+    pool = BlockPool(4)
+    assert pool.free_count == 4
+    blocks = [pool.allocate(rid=7) for _ in range(4)]
+    assert sorted(blocks) == [0, 1, 2, 3]
+    assert pool.allocate(rid=8) is None  # exhausted -> None, never an exception
+    assert pool.used_count == 4
+    for b in blocks:
+        assert pool.owner(b) == 7
+        pool.free(b)
+    assert pool.free_count == 4
+    pool.check()
+
+
+def test_pool_rejects_double_free_and_degenerate_size():
+    pool = BlockPool(2)
+    b = pool.allocate(rid=0)
+    pool.free(b)
+    with pytest.raises(ValueError, match="double free"):
+        pool.free(b)
+    with pytest.raises(ValueError, match="num_blocks"):
+        BlockPool(0)
+
+
+def test_lifo_reuse_keeps_working_set_hot():
+    pool = BlockPool(8)
+    first = pool.allocate(rid=0)
+    pool.free(first)
+    assert pool.allocate(rid=1) == first  # freshly freed block is reused first
+
+
+def test_table_growth_is_position_ordered_and_padded():
+    ts = BlockTableState(num_blocks=8, block_size=4, table_width=4)
+    assert ts.max_len == 16
+    assert ts.ensure(rid=5, num_tokens=9)  # 3 blocks
+    table = ts.table(5)
+    assert len(table) == 4  # static width, 0-padded
+    owned = table[:3]
+    assert len(set(owned)) == 3
+    # position -> (block, offset) walks the table in order
+    for pos in range(9):
+        blk, off = ts.write_coords(5, pos)
+        assert blk == owned[pos // 4]
+        assert off == pos % 4
+    ts.check()
+    assert ts.release(5) == 3
+    assert ts.pool.free_count == 8
+    assert ts.release(5) == 0  # unknown rid is a no-op
+
+
+def test_ensure_is_all_or_nothing_when_pool_dry():
+    ts = BlockTableState(num_blocks=3, block_size=2, table_width=3)
+    assert ts.ensure(rid=0, num_tokens=4)  # takes 2 of 3 blocks
+    # rid 1 needs 2 blocks but only 1 is free: nothing may be allocated
+    assert not ts.ensure(rid=1, num_tokens=4)
+    assert ts.pool.free_count == 1
+    assert ts.blocks_held(1) == 0
+    ts.check()
+    # growth past the static width is a scheduler bug, not a soft failure
+    with pytest.raises(ValueError, match="table width"):
+        ts.ensure(rid=0, num_tokens=7)
+
+
+def test_randomized_allocator_fuzz_never_leaks():
+    """Random ensure/release interleavings: the audit invariants hold at every
+    step and a full release returns the pool to pristine."""
+    rng = np.random.default_rng(0)
+    ts = BlockTableState(num_blocks=12, block_size=4, table_width=6)
+    live: dict[int, int] = {}  # rid -> tokens ensured so far
+    next_rid = 0
+    for _ in range(500):
+        if live and rng.random() < 0.35:
+            rid = int(rng.choice(list(live)))
+            ts.release(rid)
+            del live[rid]
+        elif live and rng.random() < 0.5:
+            rid = int(rng.choice(list(live)))
+            grown = min(live[rid] + int(rng.integers(1, 9)), ts.max_len)
+            if ts.ensure(rid, grown):
+                live[rid] = grown
+        else:
+            rid, next_rid = next_rid, next_rid + 1
+            want = int(rng.integers(1, ts.max_len + 1))
+            if ts.ensure(rid, want):
+                live[rid] = want
+        ts.check()
+        held = sum(ts.blocks_held(r) for r in live)
+        assert held + ts.pool.free_count == 12
+        for rid, tokens in live.items():
+            assert ts.blocks_held(rid) == blocks_for_tokens(tokens, 4)
+    for rid in list(live):
+        ts.release(rid)
+    ts.check()
+    assert ts.pool.free_count == 12
+    assert ts.active_requests() == []

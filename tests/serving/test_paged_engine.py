@@ -1,0 +1,357 @@
+"""Paged KV-cache serving acceptance (serving/engine.py kv_cache="paged").
+
+Three load-bearing contracts on top of the ring battery (test_engine.py):
+
+1. BATCH-INVARIANCE SURVIVES PAGING: the gathered K/V row is position-ordered
+   and masked garbage contributes exact zeros, so a paged slot emits
+   token-for-token what the interactive `_generate_cached` path emits — alone
+   or in a mixed batch — with ONE compiled decode step and ONE compiled
+   cross-request prefill step.
+2. THE LENGTH CEILING LIFTS: blocks are allocated on demand and the admission
+   budget clamp bounds positions below the table-width ceiling, so requests
+   finish "eod"/"budget", NEVER "capacity"; a request that overflows the ring
+   runs to completion under paged. Pool exhaustion preempts the youngest slot
+   (blocks freed, request requeued, identical tokens on re-admission).
+3. NO LEAKS: a randomized scheduler property (fake clock, random
+   arrivals/lengths/budgets, both cache modes) — every request finishes, slots
+   and blocks return to pristine, occupancy accounting matches dispatched
+   decode tokens, admission stays FIFO.
+"""
+
+import jax
+import numpy as np
+import pytest
+from flax.core import meta
+
+from modalities_tpu.inference.text.inference_component import TextInferenceComponent
+from modalities_tpu.serving.engine import ServingEngine, _kv_cache_from_env
+from tests.models.test_gpt2_model import tiny_gpt2
+from tests.serving.test_engine import _IdTok
+
+PROMPT = [3, 17, 42, 9, 77, 5, 23]
+
+
+@pytest.fixture(scope="module")
+def model():
+    return tiny_gpt2("manual")
+
+
+@pytest.fixture(scope="module")
+def params(model):
+    return meta.unbox(model.init_params(jax.random.PRNGKey(0)))
+
+
+@pytest.fixture(scope="module")
+def ref(model, params):
+    """Interactive-path reference (one component per temperature, as in
+    test_engine.py)."""
+    comps = {}
+
+    def generate(prompt, budget, temperature, seed, eod_id=-1):
+        t = 0.0 if temperature is None else float(temperature)
+        comp = comps.get(t)
+        if comp is None:
+            comp = TextInferenceComponent(
+                model=model, params=params, tokenizer=_IdTok(),
+                prompt_template="{prompt}", sequence_length=32,
+                temperature=t, eod_token="<eod>",
+            )
+            comps[t] = comp
+        comp.tokenizer.eod = eod_id
+        return comp.generate_tokens(prompt, max_new_tokens=budget, seed=seed)
+
+    return generate
+
+
+def paged_engine(model, params, **kwargs):
+    kwargs.setdefault("paged_block_size", 8)
+    return ServingEngine(model, params, kv_cache="paged", **kwargs)
+
+
+# ----------------------------------------------------------- batch invariance
+
+
+@pytest.mark.slow  # ~9 s; bitwise parity + decode_executables==1 stay pinned by
+# the mixed-batch test below (same references, more slots, same one executable)
+def test_paged_single_slot_matches_interactive_path_bitwise(model, params, ref):
+    """ISSUE acceptance: 1 paged slot == _generate_cached, token for token,
+    across greedy / sampled / temperature=None."""
+    engine = paged_engine(model, params, max_batch_slots=1)
+    for temperature, seed in [(0.0, 0), (0.8, 1), (None, 3)]:
+        rid = engine.submit(PROMPT, 10, temperature=temperature, seed=seed)
+        result = engine.run()[rid]
+        assert result.tokens == ref(PROMPT, 10, temperature, seed), (temperature, seed)
+        assert result.finish_reason == "budget"
+    assert engine.stats()["decode_executables"] == 1
+
+
+def test_paged_mixed_batch_matches_references_one_executable_each(model, params, ref):
+    """Mixed temperatures/seeds/budgets through 2 paged slots: bitwise equal to
+    the solo references, ONE decode executable, ONE cross-request prefill
+    executable (the fixed [slots, block_size] dispatch replaces the ring's
+    per-request ladder), and all pool blocks returned."""
+    engine = paged_engine(model, params, max_batch_slots=2)
+    reqs = [
+        (PROMPT, 10, 0.0, 0),
+        ([7, 7, 7], 4, 0.8, 1),
+        (list(range(1, 18)), 8, 0.0, 2),  # prompt spans 3 blocks -> 3 chunks
+        ([99, 3, 55, 8, 120], 6, 0.8, 3),
+        ([11] * 15, 12, 0.0, 4),
+        ([4, 2], 5, None, 5),  # default-temperature path rides along
+    ]
+    rids = [engine.submit(p, b, temperature=t, seed=s) for p, b, t, s in reqs]
+    results = engine.run()
+    for rid, (p, b, t, s) in zip(rids, reqs):
+        assert results[rid].tokens == ref(p, b, t, s), (rid, t, s)
+        assert results[rid].finish_reason == "budget"
+    stats = engine.stats()
+    assert stats["max_concurrent"] == 2
+    assert stats["decode_executables"] == 1
+    assert stats["prefill_executables"] == 1
+    assert stats["free_blocks"] == stats["num_blocks"]  # all blocks released
+
+
+# ------------------------------------------------------- length-ceiling lift
+
+
+def test_paged_lifts_the_ring_length_ceiling(model, params, ref):
+    """ISSUE acceptance: a (prompt, budget) that overflows the 32-token ring
+    runs to its full budget under paged with a lifted max_len — finish reasons
+    are "budget"/"eod", NEVER "capacity"."""
+    prompt = list(range(1, 21))  # 20 prompt tokens + 40 generated > 32
+    ring = ServingEngine(model, params, max_batch_slots=1)
+    rid = ring.submit(prompt, 40, temperature=0.0, seed=0)
+    ring_result = ring.run()[rid]
+    assert ring_result.finish_reason == "capacity"
+    assert len(ring_result.tokens) < 40
+
+    engine = paged_engine(model, params, max_batch_slots=1, paged_max_len=64)
+    rid = engine.submit(prompt, 40, temperature=0.0, seed=0)
+    result = engine.run()[rid]
+    assert result.finish_reason == "budget"
+    assert len(result.tokens) == 40
+    # the ring's shorter run is a prefix of the paged one (same trajectory)
+    assert result.tokens[: len(ring_result.tokens)] == ring_result.tokens
+
+
+def test_paged_budget_clamped_to_table_ceiling_never_capacity(model, params):
+    """A budget larger than the table can hold is clamped at admission: the
+    request still finishes "budget" (the last emitted token needs no cache
+    write, hence the +1)."""
+    engine = paged_engine(model, params, max_batch_slots=1, paged_max_len=16,
+                          paged_block_size=4)
+    rid = engine.submit([1, 2, 3, 4], 500, temperature=0.0, seed=0)
+    result = engine.run()[rid]
+    assert result.finish_reason == "budget"
+    assert len(result.tokens) == 16 - 4 + 1
+    assert engine.stats()["free_blocks"] == engine.stats()["num_blocks"]
+
+
+@pytest.mark.slow  # ~3 s; the truncated flag is pinned fast in test_engine.py
+# (ring) and the clamp formula by the budget-clamp test above
+def test_paged_overlong_prompt_truncated_and_clamped(model, params, ref):
+    """Truncation semantics carry over to paged mode: prompt clipped to the
+    last max_len-1 tokens, `truncated` flagged, budget clamped to the table
+    ceiling — finish is "budget", never "capacity"."""
+    engine = paged_engine(model, params, max_batch_slots=1, paged_block_size=4,
+                          paged_max_len=16)
+    prompt = list(range(1, 21))  # 20 tokens > window of 15
+    rid = engine.submit(prompt, 10, temperature=0.0, seed=0)
+    result = engine.run()[rid]
+    assert result.truncated is True
+    assert result.finish_reason == "budget"
+    assert len(result.tokens) == 16 - 15 + 1
+    assert result.tokens == ref(prompt[-15:], 2, 0.0, 0)
+    assert engine.stats()["truncated_requests"] == 1
+
+
+# ------------------------------------------------ exhaustion: preempt+requeue
+
+
+def test_pool_exhaustion_preempts_youngest_and_requeues(model, params, ref):
+    """ISSUE acceptance: with a pool too small for two long requests, the
+    youngest slot is preempted (blocks freed, request requeued) instead of
+    corrupting tables — and deterministic sampling reproduces the identical
+    completion on re-admission."""
+    # table_width = 24/4 = 6 blocks; a pool of 9 is one block short of the two
+    # requests' peak concurrent demand (6 + 4), so growth must preempt
+    engine = paged_engine(model, params, max_batch_slots=2, paged_block_size=4,
+                          paged_max_len=24, paged_num_blocks=9)
+    reqs = [(list(range(1, 9)), 15, 0.0, 0), ([5, 9, 2], 20, 0.8, 1)]
+    rids = [engine.submit(p, b, temperature=t, seed=s) for p, b, t, s in reqs]
+    results = engine.run()
+    for rid, (p, b, t, s) in zip(rids, reqs):
+        assert results[rid].tokens == ref(p, b, t, s), (rid, t, s)
+        assert results[rid].finish_reason == "budget"
+    stats = engine.stats()
+    assert stats["preemptions"] >= 1
+    assert stats["free_blocks"] == stats["num_blocks"]
+    engine._table_state.check()
+
+
+@pytest.mark.slow  # ~3 s; FIFO + no-leak gating legality stays pinned by the
+# tier-1 scheduler property cases below
+def test_admission_gates_on_free_blocks(model, params):
+    """Admission gates on the PROMPT's block demand: while the first request
+    holds the pool, a second whose prompt doesn't fit waits in the queue (no
+    concurrency) and is admitted FIFO once blocks free up."""
+    ticks = {"v": 0.0}
+
+    def clock():
+        ticks["v"] += 0.01
+        return ticks["v"]
+
+    engine = paged_engine(model, params, max_batch_slots=2, paged_block_size=4,
+                          paged_max_len=16, paged_num_blocks=4, time_fn=clock)
+    # first: prompt 2 blocks, grows to 3; second: prompt needs 3 blocks -> the
+    # single remaining free block can never admit it concurrently
+    first = engine.submit([1, 2, 3, 4, 5], 8, temperature=0.0, seed=0)
+    second = engine.submit([9, 8, 7, 6, 5, 4, 3, 2, 1], 8, temperature=0.0, seed=1)
+    results = engine.run()
+    assert results[first].finish_reason == "budget"
+    assert results[second].finish_reason == "budget"
+    assert results[first].first_token_s < results[second].first_token_s
+    stats = engine.stats()
+    assert stats["max_concurrent"] == 1  # never enough blocks for both
+    assert stats["preemptions"] == 0  # gating, not preemption, did the waiting
+
+
+# ------------------------------------------------------- construction / knobs
+
+
+def test_kv_cache_env_knob_validation(monkeypatch):
+    monkeypatch.setenv("MODALITIES_TPU_SERVE_KV_CACHE", "paged")
+    assert _kv_cache_from_env() == "paged"
+    monkeypatch.delenv("MODALITIES_TPU_SERVE_KV_CACHE")
+    assert _kv_cache_from_env() == "ring"
+    monkeypatch.setenv("MODALITIES_TPU_SERVE_KV_CACHE", "vllm")
+    with pytest.raises(ValueError, match="SERVE_KV_CACHE"):
+        _kv_cache_from_env()
+
+
+def test_paged_construction_guards(model, params):
+    # pool smaller than one max-length request would livelock preemption
+    with pytest.raises(ValueError, match="table width"):
+        paged_engine(model, params, paged_block_size=4, paged_max_len=32,
+                     paged_num_blocks=4)
+    with pytest.raises(ValueError, match="must be 'ring' or 'paged'"):
+        ServingEngine(model, params, kv_cache="flat")
+
+
+@pytest.mark.slow  # ~3 s ABSOLUTE model build for one constructor ValueError;
+# the other construction guards stay tier-1 above
+def test_paged_max_len_rejected_for_absolute_poe(params):
+    """The ceiling lift only exists for relative-position models: ABSOLUTE wpe
+    has no rows past the trained sequence length."""
+    abs_model = tiny_gpt2("manual", poe_type="ABSOLUTE")
+    abs_params = meta.unbox(abs_model.init_params(jax.random.PRNGKey(0)))
+    with pytest.raises(ValueError, match="ABSOLUTE"):
+        ServingEngine(abs_model, abs_params, kv_cache="paged", paged_max_len=64)
+
+
+# ------------------------------------------------- scheduler property (fuzz)
+
+
+@pytest.mark.parametrize(
+    "kv_cache,case_seed",
+    [
+        ("ring", 0),
+        # one seed per mode stays tier-1; the second seed of each mode (~3 s
+        # apiece) runs under -m slow only
+        pytest.param("ring", 1, marks=pytest.mark.slow),
+        pytest.param("paged", 0, marks=pytest.mark.slow),
+        ("paged", 1),  # seed 1 shrinks the pool to 8 blocks -> forces preemption
+    ],
+)
+def test_scheduler_property_randomized(model, params, kv_cache, case_seed):
+    """Randomized trace through a fake clock, both cache modes: every request
+    finishes with a legal reason, slots/blocks return to pristine, occupancy
+    accounting matches dispatched decode tokens, admission is FIFO."""
+    rng = np.random.default_rng(1000 + case_seed)
+    ticks = {"v": 0.0}
+
+    def clock():
+        ticks["v"] += 0.01
+        return ticks["v"]
+
+    slots = int(rng.integers(2, 4))
+    kwargs = dict(max_batch_slots=slots, time_fn=clock)
+    if kv_cache == "paged":
+        # seed 1 squeezes the pool to force preemptions mid-trace
+        kwargs.update(kv_cache="paged", paged_block_size=4, paged_max_len=24,
+                      paged_num_blocks=24 if case_seed == 0 else 8)
+    engine = ServingEngine(model, params, **kwargs)
+
+    t = 0.0
+    budgets = {}
+    for i in range(int(rng.integers(6, 11))):
+        t += float(rng.exponential(0.05))
+        plen = int(rng.integers(1, 13))
+        budget = int(rng.integers(1, 9))
+        rid = engine.submit(
+            [int(x) for x in rng.integers(0, 127, size=plen)],
+            budget,
+            temperature=float(rng.choice([0.0, 0.8])),
+            seed=i,
+            arrival_offset_s=t,
+        )
+        budgets[rid] = budget
+    results = engine.run()
+
+    legal = ("eod", "budget", "capacity") if kv_cache == "ring" else ("eod", "budget")
+    assert sorted(results) == sorted(budgets)
+    for rid, result in results.items():
+        assert result.finish_reason in legal, (rid, result.finish_reason)
+        assert len(result.tokens) <= budgets[rid]
+        assert len(result.token_times_s) == len(result.tokens)
+    # no slot leak; occupancy bookkeeping == dispatched decode tokens
+    assert all(s is None for s in engine._slot_states)
+    assert engine._occupancy_sum == engine.decode_token_count
+    stats = engine.stats()
+    assert 0.0 < stats["slot_occupancy"] <= 1.0
+    if kv_cache == "paged":
+        engine._table_state.check()  # block audit: free + owned tile the pool
+        assert stats["free_blocks"] == stats["num_blocks"]
+        assert engine._table_state.active_requests() == []
+    if stats["preemptions"] == 0:
+        # FIFO: earlier rids (arrivals are non-decreasing) start no later
+        firsts = [results[r].first_token_s for r in sorted(results)]
+        assert firsts == sorted(firsts)
+
+
+# ------------------------------------------------------------ mesh sharding
+
+
+def test_paged_mesh_decode_carries_named_shardings_and_matches(model, params, ref):
+    """ISSUE acceptance: under a dp_shard x tp mesh the paged pool leaves carry
+    mesh NamedShardings (blocks ride the dp axis, kv heads the tp axis), the
+    lowered decode HLO is annotated, and tokens stay bitwise equal."""
+    from jax.sharding import NamedSharding
+
+    from modalities_tpu.running_env.device_mesh import get_device_mesh
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs 4 virtual CPU devices")
+    handle = get_device_mesh(
+        device_type="cpu", data_parallel_shard_degree=2, tensor_parallel_degree=2,
+        world_size=4, devices=jax.devices()[:4],
+    )
+
+    with pytest.raises(ValueError, match="paged_num_blocks.*divisible"):
+        paged_engine(model, params, max_batch_slots=2, paged_num_blocks=9,
+                     mesh_handle=handle)
+
+    engine = paged_engine(model, params, max_batch_slots=2, mesh_handle=handle)
+    # scanned pool leaf: [layers, num_blocks, block_size, kv_heads, head_dim]
+    for leaf in jax.tree.leaves(engine.cache):
+        assert isinstance(leaf.sharding, NamedSharding)
+        spec = tuple(leaf.sharding.spec)
+        assert spec[1] in ("dp_shard", ("dp_shard",)), spec  # blocks on dp
+        assert spec[3] in ("tp", ("tp",)), spec  # kv heads on tp
+    rids = [engine.submit(PROMPT, 8, temperature=0.0, seed=0),
+            engine.submit([9, 8, 7, 6], 6, temperature=0.8, seed=5)]
+    results = engine.run()
+    assert results[rids[0]].tokens == ref(PROMPT, 8, 0.0, 0)
+    assert results[rids[1]].tokens == ref([9, 8, 7, 6], 6, 0.8, 5)
+    assert engine.stats()["decode_executables"] == 1
+    assert "sharding" in engine.decode_lowered_text()

@@ -65,3 +65,78 @@ def test_serve_cli_completions_decode_to_known_vocab(served_rows):
         assert len(row["tokens"]) <= {0: 6, 1: 4, 2: 3}[row["rid"]]
         for tok in row["completion"].split():
             assert tok.startswith("t") or tok == "<eod>", row["completion"]
+
+
+@pytest.mark.slow  # subprocess CLI + compile + real SIGTERM drain (~1-2 min CPU)
+def test_serve_cli_http_end_to_end_with_sigterm_drain(tmp_path):
+    """Full `python -m modalities_tpu serve --http_port` lifecycle: the server
+    comes up, streams one SSE generation, and a real SIGTERM drains it to
+    exit code 0 (the resilience flag-only handler, not a hard kill)."""
+    import http.client
+    import os
+    import signal
+    import socket
+    import subprocess
+    import sys
+    import time
+    from pathlib import Path
+
+    _byte_tokenizer_dir(tmp_path / "tokenizer")
+    cfg = yaml.safe_load(Path(CFG).read_text())
+    scfg = cfg["serving_component"]["config"]
+    scfg["tokenizer"]["config"]["pretrained_model_name_or_path"] = str(tmp_path / "tokenizer")
+    scfg["max_batch_slots"] = 2
+    scfg["model"]["config"]["n_layer"] = 1
+    scfg["kv_cache"] = "paged"  # serving v2 path end to end
+    cfg_path = tmp_path / "config_serve.yaml"
+    cfg_path.write_text(yaml.safe_dump(cfg))
+
+    with socket.socket() as s:  # free ephemeral port (benign bind race)
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "modalities_tpu", "serve",
+         "--config_file_path", str(cfg_path), "--http_port", str(port)],
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+    )
+    try:
+        deadline = time.monotonic() + 240
+        while True:  # healthz poll: imports + engine construction dominate
+            assert proc.poll() is None, proc.communicate()[1][-3000:]
+            try:
+                conn = http.client.HTTPConnection("127.0.0.1", port, timeout=5)
+                conn.request("GET", "/healthz")
+                up = conn.getresponse().status == 200
+                conn.close()
+                if up:
+                    break
+            except OSError:
+                time.sleep(1.0)
+            assert time.monotonic() < deadline, "serve --http_port never came up"
+
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=300)
+        conn.request(
+            "POST", "/generate",
+            body=json.dumps({"prompt": "t5 t6 t7", "max_new_tokens": 4}),
+            headers={"Content-Type": "application/json"},
+        )
+        resp = conn.getresponse()
+        assert resp.status == 200
+        assert resp.getheader("Content-Type").startswith("text/event-stream")
+        payload = resp.read().decode()  # Connection: close bounds the stream
+        conn.close()
+        events = [json.loads(b[len("data: "):]) for b in payload.split("\n\n")
+                  if b.startswith("data: ")]
+        done = [e for e in events if e.get("done")]
+        assert len(done) == 1
+        assert done[0]["finish_reason"] in ("eod", "budget")
+        assert [e["token_id"] for e in events if "token_id" in e] == done[0]["token_ids"]
+
+        proc.send_signal(signal.SIGTERM)
+        assert proc.wait(timeout=90) == 0  # graceful drain, not a crash
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
