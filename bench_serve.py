@@ -19,6 +19,12 @@ first so a mid-run kill still parses, and a budget-guard daemon thread
 (BENCH_SERVE_BUDGET_S, default 600 s; 0 disables) prints a final fallback line and
 exits 0 if the run outlives its budget.
 
+Server-side cross-check (PR 10): at end of run the engine's metrics registry is
+scraped (the same Prometheus text `GET /metrics` serves) and TTFT/TPOT
+percentiles estimated from the histogram buckets are reported as
+`server_*_ms` beside the exact client-side numbers; `latency_divergence` lists
+any pair differing by >10% (catches client-clock skew / queue-time blindness).
+
 Knobs: --slots N, --requests N, --rate R (Poisson arrivals/s; 0 = all at t=0),
 --max-new N, --seed S, --cache ring|paged (KV-cache layout; paged = PR-9 block
 pool), --long N (append N requests whose prompt+budget exceeds the ring
@@ -41,6 +47,11 @@ METRIC_KEYS = (
     "ttft_p99_ms",
     "tpot_p50_ms",
     "tpot_p99_ms",
+    "server_ttft_p50_ms",
+    "server_ttft_p99_ms",
+    "server_tpot_p50_ms",
+    "server_tpot_p99_ms",
+    "latency_divergence",
     "slot_occupancy",
     "capacity_finishes",
     "preemptions",
@@ -186,6 +197,11 @@ def main() -> int:
     from flax.core import meta
 
     from modalities_tpu.serving.engine import ServingEngine
+    from modalities_tpu.telemetry.metrics import (
+        MetricsRegistry,
+        histogram_quantile_from_parsed,
+        parse_prometheus_text,
+    )
 
     model = _tiny_model()
     params = meta.unbox(model.init_params(jax.random.PRNGKey(0)))
@@ -200,7 +216,12 @@ def main() -> int:
             # lift the per-request ceiling past the ring capacity so the --long
             # requests actually finish (NOPE+rotary model: no wpe table to outgrow)
             kwargs = {"kv_cache": "paged", "paged_max_len": max(need_len, capacity)}
-        return ServingEngine(model, params, max_batch_slots=slots, eod_token_id=-1, **kwargs)
+        # per-engine registry so the baseline's samples never mix into the
+        # measured engine's scrape
+        return ServingEngine(
+            model, params, max_batch_slots=slots, eod_token_id=-1,
+            metrics=MetricsRegistry(), **kwargs,
+        )
 
     def warmup(engine):
         # cover the prefill ladder (21 -> 16+4+1) and the decode step once, so
@@ -211,6 +232,7 @@ def main() -> int:
 
     engine = fresh_engine(args.slots)
     warmup(engine)
+    engine.metrics.reset()  # compile-window samples stay out of the scrape
     warm_tokens = engine.decode_token_count
     results, wall = _replay(engine, trace, arrivals=True)
     generated = sum(len(r.tokens) for r in results)
@@ -224,6 +246,34 @@ def main() -> int:
         tpots.extend(b - a for a, b in zip(ts, ts[1:]))
     ttft_p50, ttft_p99 = _percentiles_ms(ttfts)
     tpot_p50, tpot_p99 = _percentiles_ms(tpots)
+
+    # server-side percentiles: the SAME text /metrics would serve, estimated
+    # from histogram buckets — divergence from the exact client-side numbers
+    # flags client-clock skew or queue-time blindness (>10%)
+    parsed = parse_prometheus_text(engine.metrics.render())
+
+    def _server_pct(name: str, q: float):
+        v = histogram_quantile_from_parsed(parsed, name, q)
+        return v * 1000.0 if v is not None else None
+
+    server = {
+        "server_ttft_p50_ms": _server_pct("serve_ttft_seconds", 0.50),
+        "server_ttft_p99_ms": _server_pct("serve_ttft_seconds", 0.99),
+        "server_tpot_p50_ms": _server_pct("serve_tpot_seconds", 0.50),
+        "server_tpot_p99_ms": _server_pct("serve_tpot_seconds", 0.99),
+    }
+    divergence = []
+    for server_key, client_val in (
+        ("server_ttft_p50_ms", ttft_p50),
+        ("server_ttft_p99_ms", ttft_p99),
+        ("server_tpot_p50_ms", tpot_p50),
+        ("server_tpot_p99_ms", tpot_p99),
+    ):
+        server_val = server[server_key]
+        if server_val is None or client_val is None or client_val <= 0:
+            continue
+        if abs(server_val - client_val) / client_val > 0.10:
+            divergence.append(server_key.replace("server_", ""))
 
     stats = engine.stats()
     # occupancy over the measured window only (warmup steps excluded)
@@ -251,6 +301,8 @@ def main() -> int:
                 "ttft_p99_ms": ttft_p99,
                 "tpot_p50_ms": tpot_p50,
                 "tpot_p99_ms": tpot_p99,
+                **server,
+                "latency_divergence": divergence,
                 "slot_occupancy": stats["slot_occupancy"],
                 "capacity_finishes": sum(1 for r in results if r.finish_reason == "capacity"),
                 "preemptions": stats.get("preemptions", 0),

@@ -426,6 +426,30 @@ def entry_point_analyze_telemetry(sink_path: Path, as_json: bool) -> None:
         click.echo(format_goodput_table(summary))
 
 
+@data.command(name="analyze_serve")
+@click.option("--sink_path", type=click.Path(exists=True, path_type=Path), required=True,
+              help="A telemetry_rank_N.jsonl file, or the telemetry folder holding them "
+                   "(a serve run writes them when MODALITIES_TPU_SERVE_TELEMETRY_DIR is set).")
+@click.option("--as_json", is_flag=True, default=False, help="Emit the summary dict as JSON.")
+@_exception_handling
+def entry_point_analyze_serve(sink_path: Path, as_json: bool) -> None:
+    """Summarize a serve run's per-request trace records: p50/p95/p99 tables for
+    TTFT, end-to-end latency, queue wait, and mean TPOT; finish-reason
+    breakdown; preemption/truncation totals; and a slot-occupancy timeline
+    rebuilt from the admission intervals."""
+    from modalities_tpu.serving.analyze import (
+        format_serve_table,
+        load_serve_records,
+        summarize_serve,
+    )
+
+    summary = summarize_serve(load_serve_records(sink_path))
+    if as_json:
+        click.echo(json.dumps(summary))
+    else:
+        click.echo(format_serve_table(summary))
+
+
 @data.command(name="tune_kernels")
 @click.option("--out_dir", type=click.Path(path_type=Path), default=None,
               help="Where to write {device_kind}.json (default: $MODALITIES_TPU_TUNE_DIR, "

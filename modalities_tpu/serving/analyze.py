@@ -1,0 +1,173 @@
+"""Offline analysis of per-request serving trace records (`analyze_serve` CLI).
+
+Input: the per-rank telemetry JSONL sink(s) a serve run writes when
+`MODALITIES_TPU_SERVE_TELEMETRY_DIR` is set (or any folder/file holding
+`serve_request` records — tests point it at an engine-driven sink directly).
+Each record is one request's folded lifecycle: latency summary fields plus the
+raw monotonic event stream (enqueue/admit/prefill_chunk/first_token/preempt/
+requeue/finish).
+
+Output: p50/p95/p99 latency tables (TTFT, end-to-end, queue wait, mean TPOT),
+a finish-reason breakdown, token/preemption/truncation totals, and a coarse
+slot-occupancy timeline rebuilt from admit→(preempt|finish) intervals — the
+offline counterpart of the live `/metrics` histograms, but exact (per-request
+samples, not bucket interpolation).
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Iterable, Optional
+
+QUANTILES = (0.50, 0.95, 0.99)
+
+LATENCY_FIELDS = (
+    ("ttft_s", "time to first token"),
+    ("e2e_s", "end-to-end latency"),
+    ("queue_wait_s", "queue wait"),
+    ("tpot_mean_s", "mean time per output token"),
+)
+
+
+def load_serve_records(sink_path: Path) -> list[dict]:
+    """Read `serve_request` records from one `telemetry_rank_N.jsonl` file or
+    every such file in a folder. Non-serve events (spans, resilience, ...) are
+    skipped, and so is a torn tail line — a sink from a killed run may end
+    mid-write (same tolerance as `analyze_telemetry`)."""
+    sink_path = Path(sink_path)
+    if sink_path.is_dir():
+        files = sorted(sink_path.glob("telemetry_rank_*.jsonl"))
+        if not files:
+            raise FileNotFoundError(f"no telemetry_rank_*.jsonl under {sink_path}")
+    else:
+        files = [sink_path]
+    records: list[dict] = []
+    for path in files:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    event = json.loads(line)
+                except json.JSONDecodeError:
+                    continue  # torn tail line from a killed run
+                if event.get("event") == "serve_request":
+                    records.append(event)
+    return records
+
+
+def _quantile(sorted_values: list[float], q: float) -> float:
+    """Linear-interpolation quantile over EXACT per-request samples (matches
+    numpy's default method; avoids importing numpy for a CLI table)."""
+    n = len(sorted_values)
+    if n == 1:
+        return sorted_values[0]
+    pos = q * (n - 1)
+    lo = int(pos)
+    hi = min(lo + 1, n - 1)
+    frac = pos - lo
+    return sorted_values[lo] * (1.0 - frac) + sorted_values[hi] * frac
+
+
+def _occupancy_timeline(records: Iterable[dict], max_points: int = 40) -> list[dict]:
+    """Concurrent-requests-over-time rebuilt from each record's admit→exit
+    intervals (exit = the matching preempt or the finish). Returned as step
+    points (t, active), downsampled to at most `max_points` rows."""
+    deltas: list[tuple[float, int]] = []
+    for rec in records:
+        open_t: Optional[float] = None
+        for ev in rec.get("events", ()):
+            name, t = ev.get("name"), float(ev.get("t", 0.0))
+            if name == "admit":
+                open_t = t
+            elif name in ("preempt", "finish") and open_t is not None:
+                deltas.append((open_t, +1))
+                deltas.append((t, -1))
+                open_t = None
+    if not deltas:
+        return []
+    deltas.sort()
+    points: list[dict] = []
+    active = 0
+    for t, d in deltas:
+        active += d
+        if points and points[-1]["t"] == t:
+            points[-1]["active"] = active
+        else:
+            points.append({"t": round(t, 6), "active": active})
+    if len(points) > max_points:
+        stride = (len(points) + max_points - 1) // max_points
+        sampled = points[::stride]
+        if sampled[-1] is not points[-1]:
+            sampled.append(points[-1])
+        points = sampled
+    return points
+
+
+def summarize_serve(records: list[dict]) -> dict:
+    """Fold records into the summary dict `format_serve_table` renders (and
+    `--as_json` emits verbatim)."""
+    if not records:
+        return {"requests": 0}
+    reasons: dict[str, int] = {}
+    for rec in records:
+        reason = rec.get("finish_reason") or "?"
+        reasons[reason] = reasons.get(reason, 0) + 1
+    latency: dict[str, dict] = {}
+    for field, _ in LATENCY_FIELDS:
+        values = sorted(
+            float(rec[field]) for rec in records if rec.get(field) is not None
+        )
+        if not values:
+            continue
+        latency[field] = {
+            "n": len(values),
+            "mean": sum(values) / len(values),
+            **{f"p{int(q * 100)}": _quantile(values, q) for q in QUANTILES},
+        }
+    return {
+        "requests": len(records),
+        "finish_reasons": dict(sorted(reasons.items())),
+        "prompt_tokens": sum(int(rec.get("prompt_len") or 0) for rec in records),
+        "generated_tokens": sum(int(rec.get("tokens") or 0) for rec in records),
+        "preemptions": sum(int(rec.get("preemptions") or 0) for rec in records),
+        "truncated_requests": sum(1 for rec in records if rec.get("truncated")),
+        "latency": latency,
+        "occupancy_timeline": _occupancy_timeline(records),
+    }
+
+
+def format_serve_table(summary: dict) -> str:
+    if not summary.get("requests"):
+        return "no serve_request records found"
+    lines = [
+        f"requests: {summary['requests']}  "
+        f"prompt_tokens: {summary['prompt_tokens']}  "
+        f"generated_tokens: {summary['generated_tokens']}",
+        f"preemptions: {summary['preemptions']}  "
+        f"truncated: {summary['truncated_requests']}",
+        "",
+        "finish reasons:",
+    ]
+    for reason, count in summary["finish_reasons"].items():
+        lines.append(f"  {reason:<10} {count}")
+    lines += ["", f"{'latency':<14} {'n':>5} {'mean':>9} {'p50':>9} {'p95':>9} {'p99':>9}"]
+    for field, label in LATENCY_FIELDS:
+        row = summary["latency"].get(field)
+        if row is None:
+            continue
+        lines.append(
+            f"{field:<14} {row['n']:>5} "
+            f"{row['mean']:>9.4f} {row['p50']:>9.4f} {row['p95']:>9.4f} {row['p99']:>9.4f}"
+        )
+    timeline = summary.get("occupancy_timeline") or []
+    if timeline:
+        peak = max(p["active"] for p in timeline)
+        lines += ["", f"occupancy timeline (active requests over engine time, peak {peak}):"]
+        width = 40
+        for p in timeline:
+            bar = "#" * (p["active"] * width // max(peak, 1))
+            lines.append(f"  {p['t']:>9.3f}s {p['active']:>3} {bar}")
+    return "\n".join(lines)

@@ -46,6 +46,7 @@ zeros, so the softmax reduction matches the ring row bitwise.
 from __future__ import annotations
 
 import os
+import threading
 import time
 from collections import deque
 from dataclasses import dataclass, field
@@ -55,6 +56,7 @@ import numpy as np
 
 from modalities_tpu.serving.paged_cache import BlockTableState, blocks_for_tokens
 from modalities_tpu.telemetry import get_active_telemetry, span
+from modalities_tpu.telemetry.metrics import MetricsRegistry
 
 # mirror of TextInferenceComponent._PREFILL_CHUNKS: the same power-of-two ladder,
 # overridable via MODALITIES_TPU_SERVE_PREFILL_CHUNKS (comma list, descending,
@@ -154,6 +156,7 @@ class ServingEngine:
         on_finish: Optional[Callable[[int, ServeResult], None]] = None,
         mesh_handle=None,
         time_fn=None,
+        metrics: Optional[MetricsRegistry] = None,
     ):
         if not (hasattr(model, "init_slot_cache") and hasattr(model, "decode_slots")):
             raise ValueError(
@@ -277,6 +280,80 @@ class ServingEngine:
         self.max_concurrent = 0
         self.preemptions = 0
         self.truncated_requests = 0
+        # counters/gauges above mutate only under this lock, and stats() reads
+        # under it — /stats sees one consistent snapshot, never a mid-dispatch
+        # tear (decode_tokens without its decode_steps)
+        self._stats_lock = threading.Lock()
+
+        # request-lifecycle tracing (PR 10): per-rid monotonic event streams,
+        # flushed as one `serve_request` JSONL record at finish; a preempted
+        # request keeps its stream across requeue/replay
+        self._traces: dict[int, dict] = {}
+        self._dispatch_seq = 0  # watchdog heartbeat id for serve dispatches
+
+        self.metrics = metrics if metrics is not None else get_active_telemetry().metrics
+        reg = self.metrics
+        self._m_ttft = reg.histogram(
+            "serve_ttft_seconds", "Time from request arrival to its first token"
+        )
+        self._m_tpot = reg.histogram(
+            "serve_tpot_seconds", "Latency between consecutive generated tokens"
+        )
+        self._m_queue_wait = reg.histogram(
+            "serve_queue_wait_seconds", "Time from enqueue/requeue to slot admission"
+        )
+        self._m_e2e = reg.histogram(
+            "serve_e2e_latency_seconds", "Time from request arrival to finish"
+        )
+        self._m_submitted = reg.counter(
+            "serve_requests_submitted_total", "Requests accepted by submit()"
+        )
+        self._m_finished = reg.counter(
+            "serve_requests_finished_total", "Finished requests by finish reason"
+        )
+        self._m_tokens = reg.counter(
+            "serve_tokens_generated_total", "Generated tokens emitted to clients"
+        )
+        self._m_prompt_tokens = reg.counter(
+            "serve_prompt_tokens_total", "Prompt tokens accepted at submit()"
+        )
+        self._m_prefill_chunks = reg.counter(
+            "serve_prefill_chunks_total", "Prefill chunk dispatches (ring) / packed rows (paged)"
+        )
+        self._m_decode_steps = reg.counter(
+            "serve_decode_steps_total", "Batched decode dispatches"
+        )
+        self._m_preempt = reg.counter(
+            "serve_preemptions_total", "Slots preempted on paged pool exhaustion"
+        )
+        self._m_trunc = reg.counter(
+            "serve_truncated_requests_total", "Requests whose prompt was window-clipped"
+        )
+        # scheduler gauges are scrape-time callbacks: a GET /metrics racing the
+        # engine thread reads LIVE state, never a value one dispatch stale
+        reg.gauge("serve_active_slots", "Slots holding a live request").set_fn(
+            self._active_count
+        )
+        reg.gauge("serve_queue_depth", "Requests waiting in the FIFO queue").set_fn(
+            lambda: len(self._queue)
+        )
+        reg.gauge(
+            "serve_slot_occupancy_ratio", "Decoding slots over total slots, cumulative mean"
+        ).set_fn(self._occupancy_ratio)
+        reg.gauge("serve_slots_total", "Configured max_batch_slots").set(self.slots)
+        if self.kv_cache == "paged":
+            reg.gauge(
+                "serve_paged_free_blocks", "Free blocks in the paged KV pool"
+            ).set_fn(lambda: self._table_state.pool.free_count)
+            reg.gauge("serve_paged_total_blocks", "Configured paged KV pool size").set(
+                self.num_blocks
+            )
+
+        # a wedged serve dispatch dumps the same watchdog artifact as a wedged
+        # train step, with the engine's own stats in the `state` section
+        get_active_telemetry().register_watchdog_state_provider(
+            lambda: {"serving_engine": self.stats()}
+        )
 
         self._build_jits()
 
@@ -460,7 +537,67 @@ class ServingEngine:
                 arrival_offset_s=float(arrival_offset_s),
             )
         )
+        arrival = max(float(arrival_offset_s), 0.0)
+        self._traces[rid] = {"events": [], "preemptions": 0, "wait_from": arrival,
+                             "queue_wait_s": 0.0}
+        self._trace_event(rid, "enqueue", arrival)
+        self._m_submitted.inc()
+        self._m_prompt_tokens.inc(len(prompt_tokens))
         return rid
+
+    # ------------------------------------------------------------------ tracing
+    def _trace_event(self, rid: int, name: str, t: float, **fields) -> None:
+        trace = self._traces.get(rid)
+        if trace is not None:
+            trace["events"].append({"name": name, "t": round(float(t), 6), **fields})
+
+    def _trace_admit(self, rid: int, now: float) -> None:
+        """Admission: close the current queue-wait interval (enqueue or the last
+        requeue opened it) and observe it."""
+        self._trace_event(rid, "admit", now)
+        trace = self._traces.get(rid)
+        if trace is not None:
+            wait = max(0.0, now - trace["wait_from"])
+            trace["queue_wait_s"] += wait
+            self._m_queue_wait.observe(wait)
+
+    def _record_first_token(self, result: ServeResult, now: float) -> None:
+        """First token of an admission. TTFT is observed once per request — a
+        preempted request's replay re-fires the trace event (the timeline shows
+        both) but not the histogram sample (the client saw the FIRST one)."""
+        self._trace_event(result.rid, "first_token", now)
+        trace = self._traces.get(result.rid)
+        if trace is None or not trace.get("ttft_observed"):
+            if trace is not None:
+                trace["ttft_observed"] = True
+            self._m_ttft.observe(max(0.0, now - result.arrival_s))
+
+    def _flush_trace(self, result: ServeResult) -> None:
+        """Finish: fold the lifecycle stream into ONE JSONL record on the
+        per-rank telemetry sink (analyze_serve's input)."""
+        trace = self._traces.pop(result.rid, None)
+        if trace is None:
+            return
+        times = result.token_times_s
+        tpot_mean = (
+            (times[-1] - times[0]) / (len(times) - 1) if len(times) >= 2 else None
+        )
+        get_active_telemetry().emit_serve_trace(
+            {
+                "rid": result.rid,
+                "prompt_len": result.prompt_len,
+                "tokens": len(result.tokens),
+                "finish_reason": result.finish_reason,
+                "truncated": result.truncated,
+                "preemptions": trace["preemptions"],
+                "arrival_s": round(result.arrival_s, 6),
+                "queue_wait_s": round(trace["queue_wait_s"], 6),
+                "ttft_s": round(result.ttft_s, 6),
+                "e2e_s": round(result.finish_s - result.arrival_s, 6),
+                "tpot_mean_s": round(tpot_mean, 6) if tpot_mean is not None else None,
+                "events": trace["events"],
+            }
+        )
 
     def _stopping(self) -> bool:
         return self._stop_fn is not None and bool(self._stop_fn())
@@ -470,11 +607,14 @@ class ServingEngine:
         """Append + stream a token. `_streamed` survives preemption (the result
         list is reset but regenerated tokens are identical by determinism), so
         `on_token` fires exactly once per final token position."""
+        if result.token_times_s:
+            self._m_tpot.observe(max(0.0, now - result.token_times_s[-1]))
         result.tokens.append(tok)
         result.token_times_s.append(now)
         n = len(result.tokens)
         if n > self._streamed.get(result.rid, 0):
             self._streamed[result.rid] = n
+            self._m_tokens.inc()
             if self._on_token is not None:
                 self._on_token(result.rid, tok)
 
@@ -483,6 +623,13 @@ class ServingEngine:
         result.finish_s = now
         self._results[result.rid] = result
         self._streamed.pop(result.rid, None)
+        self._trace_event(
+            result.rid, "finish", now, reason=reason, tokens=len(result.tokens),
+            truncated=result.truncated,
+        )
+        self._m_finished.inc(reason=reason)
+        self._m_e2e.observe(max(0.0, now - result.arrival_s))
+        self._flush_trace(result)
         if self._on_finish is not None:
             self._on_finish(result.rid, result)
 
@@ -515,7 +662,9 @@ class ServingEngine:
             result.truncated = True
             if req.rid not in self._truncated_rids:  # once, even across preemption
                 self._truncated_rids.add(req.rid)
-                self.truncated_requests += 1
+                with self._stats_lock:
+                    self.truncated_requests += 1
+                self._m_trunc.inc()
                 get_active_telemetry().emit_event(
                     "serve/prompt_truncated",
                     {"rid": req.rid, "prompt_len": len(req.prompt_tokens), "window": len(window)},
@@ -552,13 +701,12 @@ class ServingEngine:
                     rid=req.rid, prompt_len=len(req.prompt_tokens),
                     arrival_s=max(req.arrival_offset_s, 0.0),
                 )
+                self._trace_admit(req.rid, now)
                 window = self._truncate_window(req, result)
                 if req.max_new_tokens <= 0:
-                    result.finish_reason = "budget"
                     now2 = self._now() - t0
                     result.first_token_s = now2
-                    result.finish_s = now2
-                    self._results[req.rid] = result
+                    self._finish_immediate(result, "budget", now2)
                     continue
                 key = jax.random.PRNGKey(req.seed)
                 pos = 0
@@ -573,10 +721,15 @@ class ServingEngine:
                                 np.int32(slot), np.int32(pos), key,
                                 np.float32(temp), np.bool_(is_last),
                             )
+                        self._m_prefill_chunks.inc()
+                        self._trace_event(
+                            req.rid, "prefill_chunk", self._now() - t0, start=pos, ntok=chunk
+                        )
                         pos += chunk
                 first_tok = int(tok)  # device sync: the request's TTFT point
                 now2 = self._now() - t0
                 result.first_token_s = now2
+                self._record_first_token(result, now2)
                 if first_tok == self.eod_token_id:
                     self._finish_immediate(result, "eod", now2)
                     continue
@@ -620,6 +773,7 @@ class ServingEngine:
                 if not self._table_state.ensure(req.rid, len(window)):
                     break  # head stays queued; decoders will free blocks
                 self._queue.popleft()
+                self._trace_admit(req.rid, now)
                 window = self._truncate_window(req, result)
                 if req.max_new_tokens <= 0:
                     self._table_state.release(req.rid)
@@ -656,7 +810,19 @@ class ServingEngine:
         state = self._slot_states[slot]
         rid = state.request.rid
         freed = self._table_state.release(rid)
-        self.preemptions += 1
+        with self._stats_lock:
+            self.preemptions += 1
+        self._m_preempt.inc()
+        now = self._now() - t0
+        self._trace_event(
+            rid, "preempt", now,
+            blocks_freed=freed, tokens_discarded=len(state.result.tokens),
+        )
+        self._trace_event(rid, "requeue", now)
+        trace = self._traces.get(rid)
+        if trace is not None:
+            trace["preemptions"] += 1
+            trace["wait_from"] = now  # re-admission closes a NEW queue-wait interval
         get_active_telemetry().emit_event(
             "serve/preempt",
             {"rid": rid, "blocks_freed": freed, "tokens_discarded": len(state.result.tokens)},
@@ -754,15 +920,20 @@ class ServingEngine:
             out_toks, out_keys = jax.device_get((toks_d, keys_d))
 
         now = self._now() - t0
+        self._m_prefill_chunks.inc(len(rows))
         for r, (slot, start, ntok, is_last) in enumerate(rows):
             state = self._slot_states[slot]
             state.prefill_pos = start + ntok
+            self._trace_event(
+                state.request.rid, "prefill_chunk", now, start=start, ntok=ntok
+            )
             if not is_last:
                 continue
             req, result = state.request, state.result
             wl = len(state.window)
             first_tok = int(out_toks[r])
             result.first_token_s = now
+            self._record_first_token(result, now)
             if first_tok == self.eod_token_id:
                 self._finish(slot, "eod", now)
                 continue
@@ -814,10 +985,8 @@ class ServingEngine:
                     )
             toks, keys, finished = jax.device_get((toks_d, keys_d, fin_d))
         now = self._now() - t0
-        self.decode_steps += 1
         active = self._decoding_count()
-        self._occupancy_sum += active
-        self.max_concurrent = max(self.max_concurrent, active)
+        emitted = 0
         for slot in range(self.slots):
             state = self._slot_states[slot]
             if state is None or state.phase != "decode":
@@ -829,7 +998,7 @@ class ServingEngine:
                 self._finish(slot, "eod", now)
                 continue
             self._emit_token(state.result, tok, now)
-            self.decode_token_count += 1
+            emitted += 1
             if finished[slot]:  # budget exhausted (eod handled above)
                 self._finish(slot, "budget", now)
                 continue
@@ -843,11 +1012,33 @@ class ServingEngine:
                 # never takes this exit: the admission budget clamp bounds
                 # positions below max_len
                 self._finish(slot, "capacity", now)
+        with self._stats_lock:
+            self.decode_steps += 1
+            self._occupancy_sum += active
+            self.max_concurrent = max(self.max_concurrent, active)
+            self.decode_token_count += emitted
+        self._m_decode_steps.inc()
+
+    def _occupancy_ratio(self) -> float:
+        with self._stats_lock:
+            if not self.decode_steps:
+                return 0.0
+            return self._occupancy_sum / (self.decode_steps * self.slots)
 
     def step(self, t0: float) -> bool:
         """One scheduler round: admit, (paged) prefill dispatch, decode
         dispatch. Returns True if any device work was dispatched — the run loop
-        and the HTTP server's engine thread both drive this."""
+        and the HTTP server's engine thread both drive this.
+
+        Watchdog: each round with pending work arms the hang watchdog (the same
+        one guarding Trainer steps), beating on a dispatched round and disarming
+        on an idle one — a wedged prefill/decode produces a `watchdog_dump_*`
+        artifact with the engine's stats in its state section."""
+        telemetry = get_active_telemetry()
+        armed = bool(self._queue) or self._active_count() > 0
+        if armed:
+            self._dispatch_seq += 1
+            telemetry.arm_watchdog(self._dispatch_seq, first_step=self._dispatch_seq == 1)
         self._admit(t0)
         did = False
         if self.kv_cache == "paged" and self._prefilling_slots():
@@ -856,6 +1047,11 @@ class ServingEngine:
         if self._decoding_count():
             self._decode_dispatch(t0)
             did = True
+        if armed:
+            if did:
+                telemetry.beat_watchdog(self._dispatch_seq)
+            else:
+                telemetry.disarm_watchdog()  # idle round: not wedged, just waiting
         return did
 
     def run(self) -> dict[int, ServeResult]:
@@ -863,42 +1059,53 @@ class ServingEngine:
         in-flight slots finish (graceful drain: no new admissions, queued
         requests are left unserved). Returns rid -> ServeResult."""
         t0 = self._now()
-        while True:
-            stopping = self._stopping()
-            if stopping:
-                if self._active_count() == 0:
+        try:
+            while True:
+                stopping = self._stopping()
+                if stopping:
+                    if self._active_count() == 0:
+                        break
+                elif not self._queue and self._active_count() == 0:
                     break
-            elif not self._queue and self._active_count() == 0:
-                break
-            did = self.step(t0)
-            if not did:
-                if stopping or not self._queue:
-                    break
-                # nothing running and the head hasn't arrived: wait for it
-                wait = self._queue[0].arrival_offset_s - (self._now() - t0)
-                if wait > 0:
-                    time.sleep(min(wait, 0.05))
+                did = self.step(t0)
+                if not did:
+                    if stopping or not self._queue:
+                        break
+                    # nothing running and the head hasn't arrived: wait for it
+                    wait = self._queue[0].arrival_offset_s - (self._now() - t0)
+                    if wait > 0:
+                        time.sleep(min(wait, 0.05))
+        finally:
+            get_active_telemetry().disarm_watchdog()
         return self._results
 
     # -------------------------------------------------------------------- stats
     def stats(self) -> dict:
-        occupancy = (
-            self._occupancy_sum / (self.decode_steps * self.slots)
-            if self.decode_steps
-            else 0.0
-        )
+        """One consistent snapshot: counters are read under the same lock their
+        dispatch-end updates hold, so a concurrent /stats never sees a
+        mid-dispatch tear (e.g. decode_tokens without its decode_steps)."""
+        with self._stats_lock:
+            decode_steps = self.decode_steps
+            decode_tokens = self.decode_token_count
+            occupancy_sum = self._occupancy_sum
+            max_concurrent = self.max_concurrent
+            preemptions = self.preemptions
+            truncated = self.truncated_requests
+        occupancy = occupancy_sum / (decode_steps * self.slots) if decode_steps else 0.0
         out = {
             "kv_cache": self.kv_cache,
-            "decode_steps": self.decode_steps,
-            "decode_tokens": self.decode_token_count,
+            "decode_steps": decode_steps,
+            "decode_tokens": decode_tokens,
             "slot_occupancy": occupancy,
-            "max_concurrent": self.max_concurrent,
+            "max_concurrent": max_concurrent,
             "decode_executables": self._decode_traces,
             "prefill_executables": self._prefill_traces,
             "slots": self.slots,
             "capacity": self.capacity,
-            "preemptions": self.preemptions,
-            "truncated_requests": self.truncated_requests,
+            "preemptions": preemptions,
+            "truncated_requests": truncated,
+            "queue_depth": len(self._queue),
+            "active_slots": self._active_count(),
         }
         if self.kv_cache == "paged":
             out.update(

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import json
 import logging
+import os
 from pathlib import Path
 from typing import Optional
 
@@ -175,8 +176,10 @@ class ServingComponent:
             default_max_new_tokens=self.max_new_tokens,
         )
         server.start()
-        logger.info("serving HTTP on %s:%d (POST /generate, GET /healthz, GET /stats)",
-                    self.http_host, server.port)
+        logger.info(
+            "serving HTTP on %s:%d (POST /generate, GET /healthz, GET /stats, GET /metrics)",
+            self.http_host, server.port,
+        )
         return server.serve_forever()
 
     def run(self) -> None:
@@ -261,8 +264,27 @@ def serve(
 
     SIGTERM/SIGINT always drain gracefully (resilience flag-only handler):
     admission stops, in-flight slots finish, the process exits 0 with final
-    stats."""
+    stats.
+
+    Observability (PR 10): `MODALITIES_TPU_SERVE_TELEMETRY_DIR=<folder>`
+    activates process telemetry for the serve run — per-request lifecycle
+    records land on the per-rank JSONL sink there (`data analyze_serve` reads
+    them) and a wedged dispatch dumps a watchdog artifact beside it.
+    `MODALITIES_TPU_SERVE_WATCHDOG_S` overrides the serve watchdog deadline
+    (default 300 s; 0 disables)."""
     from modalities_tpu.resilience.preemption import PreemptionHandler
+    from modalities_tpu.telemetry import Telemetry, set_active_telemetry
+
+    telemetry = None
+    prior_telemetry = None
+    telemetry_dir = os.environ.get("MODALITIES_TPU_SERVE_TELEMETRY_DIR")
+    if telemetry_dir:
+        watchdog_s = float(os.environ.get("MODALITIES_TPU_SERVE_WATCHDOG_S", "300"))
+        telemetry = Telemetry(
+            output_folder_path=telemetry_dir, watchdog_deadline_s=watchdog_s
+        )
+        prior_telemetry = set_active_telemetry(telemetry)
+        logger.info("serve telemetry: sink + watchdog artifacts in %s", telemetry_dir)
 
     config_dict = load_app_config_dict(config_file_path)
     components = build_serving_components(config_dict)
@@ -300,3 +322,6 @@ def serve(
         logger.info("serve stats: %s", json.dumps(stats))
     finally:
         handler.uninstall()
+        if telemetry is not None:
+            telemetry.close()
+            set_active_telemetry(prior_telemetry)

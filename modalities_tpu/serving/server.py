@@ -17,8 +17,12 @@ Endpoints:
   final `data: {"done": true, "completion", "finish_reason", ...}` event, then
   the connection closes. 503 while draining.
 - `GET /healthz` — `{"status": "ok"|"draining"}`.
-- `GET /stats` — engine stats + HTTP counters (advisory reads, no lock: every
-  field is a single GIL-atomic load).
+- `GET /stats` — one consistent engine-counter snapshot (taken under the
+  engine's stats lock) + HTTP counters + queue depth / active slots.
+- `GET /metrics` — Prometheus text exposition of the process metrics registry:
+  TTFT/TPOT/queue-wait/e2e histograms, slot-occupancy and paged-block-pool
+  gauges, preemption/truncation counters, tokens-served totals (and, when
+  training shares the process, the training_* goodput/memory gauges).
 
 Graceful drain: `stop()` (or the engine's own `stop_fn`, e.g. the resilience
 SIGTERM flag) stops admission; in-flight slots finish and stream out; new
@@ -34,7 +38,8 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Optional
 
-from modalities_tpu.telemetry import span
+from modalities_tpu.telemetry import get_active_telemetry, span
+from modalities_tpu.telemetry.metrics import CONTENT_TYPE_LATEST
 
 
 class ServingHTTPServer:
@@ -68,6 +73,12 @@ class ServingHTTPServer:
         self._t0: Optional[float] = None
         self.http_requests = 0
         self.http_rejected = 0
+        self._m_http = engine.metrics.counter(
+            "serve_http_requests_total", "POST /generate requests received"
+        )
+        self._m_http_rejected = engine.metrics.counter(
+            "serve_http_rejected_total", "Generate requests rejected while draining"
+        )
 
         # the engine streams through us; its own stop_fn (e.g. the resilience
         # SIGTERM flag) still counts — we wrap it with the server's drain flag
@@ -134,7 +145,9 @@ class ServingHTTPServer:
             except queue.Empty:
                 break
             self.http_rejected += 1
+            self._m_http_rejected.inc()
             stream.put(("error", "server is draining"))
+        get_active_telemetry().disarm_watchdog()  # loop exit: nothing in flight
 
     # --------------------------------------------------------------- HTTP side
     @property
@@ -168,6 +181,13 @@ class ServingHTTPServer:
                     stats["http_rejected"] = front.http_rejected
                     stats["draining"] = front.draining
                     self._json(200, stats)
+                elif self.path == "/metrics":
+                    data = front.engine.metrics.render().encode("utf-8")
+                    self.send_response(200)
+                    self.send_header("Content-Type", CONTENT_TYPE_LATEST)
+                    self.send_header("Content-Length", str(len(data)))
+                    self.end_headers()
+                    self.wfile.write(data)
                 else:
                     self._json(404, {"error": f"unknown path {self.path}"})
 
@@ -177,6 +197,7 @@ class ServingHTTPServer:
                     return
                 with span("serve/http"):
                     front.http_requests += 1
+                    front._m_http.inc()
                     try:
                         length = int(self.headers.get("Content-Length") or 0)
                         body = json.loads(self.rfile.read(length) or b"{}")
@@ -189,6 +210,7 @@ class ServingHTTPServer:
                         return
                     if front.draining:
                         front.http_rejected += 1
+                        front._m_http_rejected.inc()
                         self._json(503, {"error": "server is draining"})
                         return
                     stream: queue.Queue = queue.Queue()

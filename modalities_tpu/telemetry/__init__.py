@@ -22,6 +22,7 @@ from pathlib import Path
 from typing import Callable, Optional, Union
 
 from modalities_tpu.telemetry.goodput import BUCKETS, GoodputLedger
+from modalities_tpu.telemetry.metrics import MetricsRegistry
 from modalities_tpu.telemetry.sink import TelemetrySink
 from modalities_tpu.telemetry.spans import NULL_CONTEXT, SpanRecorder, step_trace_annotation
 from modalities_tpu.telemetry.watchdog import Watchdog
@@ -67,6 +68,10 @@ class Telemetry:
         self._watchdog: Optional[Watchdog] = None
         self._pending_state_providers: list[Callable[[], dict]] = []
         self._folder: Optional[Path] = None
+        # one scrape surface per process: the serving engine, HTTP front end, and
+        # training publish path all register into this registry (PR 10); present
+        # even when disabled so instrumented code never guards its metric calls
+        self.metrics = MetricsRegistry()
         if not enabled:
             self.global_rank = 0
             self._recorder = None
@@ -122,6 +127,14 @@ class Telemetry:
         if not self.enabled or self._sink is None:
             return
         self._sink.emit({"event": "resilience", "name": name, **(payload or {})})
+
+    def emit_serve_trace(self, record: dict) -> None:
+        """Write one per-request serving lifecycle record (`event:
+        "serve_request"`) to the JSONL sink — the `analyze_serve` CLI's input.
+        No-op when disabled or before the sink is open."""
+        if not self.enabled or self._sink is None:
+            return
+        self._sink.emit({"event": "serve_request", **record})
 
     # --------------------------------------------------------------- watchdog
 
@@ -182,7 +195,34 @@ class Telemetry:
         metrics = {"goodput [%]": summary["goodput_pct"]}
         for bucket in BUCKETS:
             metrics[f"goodput/{bucket} [s]"] = summary["buckets"][bucket]
+        # same numbers onto the Prometheus scrape surface: one job covers both
+        # training and serving workloads (PR 10)
+        self.metrics.gauge(
+            "training_goodput_ratio", "Fraction of wall time spent in train_step"
+        ).set(summary["goodput_pct"] / 100.0)
+        bucket_gauge = self.metrics.gauge(
+            "training_goodput_bucket_seconds",
+            "Cumulative wall seconds attributed to each goodput bucket",
+        )
+        for bucket in BUCKETS:
+            bucket_gauge.set(summary["buckets"][bucket], bucket=bucket)
         return metrics
+
+    def publish_resource_gauges(
+        self,
+        hbm_headroom_mb: Optional[float] = None,
+        peak_memory_mb: Optional[float] = None,
+    ) -> None:
+        """Device-memory gauges for the shared scrape surface; the trainer calls
+        this from its interval publish with the numbers it already computes."""
+        if hbm_headroom_mb is not None:
+            self.metrics.gauge(
+                "training_hbm_headroom_mbytes", "Min over devices of free HBM (MB)"
+            ).set(hbm_headroom_mb)
+        if peak_memory_mb is not None:
+            self.metrics.gauge(
+                "training_peak_memory_mbytes", "Max over devices of peak HBM in use (MB)"
+            ).set(peak_memory_mb)
 
     # -------------------------------------------------------------- lifecycle
 

@@ -503,6 +503,7 @@ class Trainer:
         headroom_mb = self._hbm_headroom_mb()
         if headroom_mb is not None:
             throughput["HBM headroom [MB]"] = ResultItem(headroom_mb, 1)
+        telemetry.publish_resource_gauges(hbm_headroom_mb=headroom_mb, peak_memory_mb=peak_mb)
         goodput_metrics = telemetry.throughput_metrics()
         if goodput_metrics:
             # cumulative since run start: goodput % plus per-bucket wall seconds

@@ -1,0 +1,356 @@
+"""Serving observability acceptance (PR 10): per-request lifecycle traces on the
+telemetry sink, the engine's Prometheus metrics, the `GET /metrics` endpoint
+(ring AND paged), the serve watchdog, and the `analyze_serve` CLI.
+
+All tests run on a FAKE model implementing the slot/paged decode API with
+one-hot "next token = (token + 1) mod V" logits — the observability layer is
+pure host-side bookkeeping, so these tests buy full lifecycle coverage
+(including a forced paged preemption + replay) for ~100 ms of jit compile
+instead of the tiny_gpt2 model's seconds.
+"""
+
+import http.client
+import json
+import time
+
+import pytest
+from click.testing import CliRunner
+
+from modalities_tpu.__main__ import main as cli_main
+from modalities_tpu.serving.analyze import load_serve_records, summarize_serve
+from modalities_tpu.serving.engine import ServingEngine
+from modalities_tpu.serving.server import ServingHTTPServer
+from modalities_tpu.telemetry import Telemetry, set_active_telemetry
+from modalities_tpu.telemetry.metrics import MetricsRegistry, parse_prometheus_text
+
+VOCAB = 32
+
+
+class _FakeSpec:
+    sequence_length = 64
+    poe_type = "NOPE"
+
+
+class FakeModel:
+    """Slot/paged decode API with deterministic next-token = (tok + 1) % V
+    logits. The KV cache is a dummy array: generation depends only on the fed
+    token, so preemption replay reproduces the same tokens by construction —
+    exactly the determinism contract the real engine relies on."""
+
+    config_spec = _FakeSpec()
+
+    def _logits(self, tokens):
+        import jax
+
+        return jax.nn.one_hot((tokens + 1) % VOCAB, VOCAB) * 100.0
+
+    def init_slot_cache(self, params, max_batch_slots, cache_capacity):
+        import jax.numpy as jnp
+
+        return {"kv": jnp.zeros((max_batch_slots, cache_capacity), jnp.float32)}
+
+    def prefill_slot(self, params, cache, tokens, slot, start_pos):
+        return self._logits(tokens), cache
+
+    def decode_slots(self, params, cache, tokens, positions):
+        return self._logits(tokens), cache
+
+    def init_paged_cache(self, params, num_blocks, block_size):
+        import jax.numpy as jnp
+
+        return {"kv": jnp.zeros((num_blocks, block_size), jnp.float32)}
+
+    def prefill_paged(self, params, cache, tokens, positions, tables, wblk, woff):
+        return self._logits(tokens), cache
+
+    def decode_paged(self, params, cache, tokens, positions, tables, wblk, woff):
+        return self._logits(tokens), cache
+
+
+def _tick_clock(dt: float = 0.01):
+    state = {"t": 0.0}
+
+    def clock():
+        state["t"] += dt
+        return state["t"]
+
+    return clock
+
+
+@pytest.fixture()
+def active_telemetry(tmp_path):
+    """Enabled telemetry (sink in tmp_path, watchdog off) installed as the
+    process-global instance for the duration of the test."""
+    telemetry = Telemetry(
+        output_folder_path=tmp_path, watchdog_deadline_s=0.0, use_jax_annotations=False
+    )
+    prior = set_active_telemetry(telemetry)
+    try:
+        yield telemetry, tmp_path
+    finally:
+        telemetry.close()
+        set_active_telemetry(prior)
+
+
+# ------------------------------------------------------------ lifecycle trace
+
+
+def test_ring_lifecycle_trace_and_metrics(active_telemetry):
+    telemetry, folder = active_telemetry
+    engine = ServingEngine(
+        FakeModel(), {}, max_batch_slots=2, eod_token_id=-1, time_fn=_tick_clock()
+    )
+    long_prompt = list(range(21))  # prefill ladder 21 -> 16 + 4 + 1
+    rid_long = engine.submit(long_prompt, 4, temperature=0.0, seed=0)
+    rid_short = engine.submit([3, 4], 3, temperature=0.0, seed=1)
+    results = engine.run()
+    assert results[rid_long].tokens == [(20 + i) % VOCAB for i in range(1, 5)]
+    telemetry.close()  # flush the sink before reading it back
+
+    records = {rec["rid"]: rec for rec in load_serve_records(folder)}
+    assert set(records) == {rid_long, rid_short}
+
+    rec = records[rid_long]
+    names = [e["name"] for e in rec["events"]]
+    assert names[:2] == ["enqueue", "admit"]
+    assert names.count("prefill_chunk") == 3  # 16 + 4 + 1
+    assert names[-1] == "finish"
+    assert names.index("first_token") < names.index("finish")
+    times = [e["t"] for e in rec["events"]]
+    assert times == sorted(times)  # monotonically consistent timestamps
+    assert rec["finish_reason"] == "budget" and rec["tokens"] == 4
+    assert rec["preemptions"] == 0 and rec["truncated"] is False
+    assert rec["queue_wait_s"] >= 0.0
+    assert rec["ttft_s"] > 0.0 and rec["e2e_s"] >= rec["ttft_s"]
+    assert rec["tpot_mean_s"] > 0.0
+
+    reg = telemetry.metrics  # the engine registered into the active registry
+    assert engine.metrics is reg
+    assert reg.counter("serve_requests_submitted_total").value() == 2
+    assert reg.counter("serve_requests_finished_total").value(reason="budget") == 2
+    assert reg.counter("serve_prefill_chunks_total").value() == 5  # 3 + (1+1)
+    assert reg.counter("serve_tokens_generated_total").value() == 7
+    assert reg.histogram("serve_ttft_seconds").count() == 2
+    assert reg.histogram("serve_e2e_latency_seconds").count() == 2
+    assert reg.histogram("serve_queue_wait_seconds").count() == 2
+    assert reg.histogram("serve_tpot_seconds").count() == 7 - 2  # deltas only
+    assert reg.gauge("serve_slots_total").value() == 2
+    assert reg.gauge("serve_active_slots").value() == 0
+    assert reg.gauge("serve_queue_depth").value() == 0
+
+
+def test_paged_preemption_trace_shows_requeue_and_replay(active_telemetry):
+    """ISSUE acceptance: a preempted request's trace record shows the
+    preempt -> requeue -> re-admit -> replayed first token sequence with
+    monotonically consistent timestamps, and TTFT is observed exactly once."""
+    telemetry, folder = active_telemetry
+    # table_width = 24/4 = 6; pool of 9 is one block short of both requests'
+    # peak concurrent demand, so growth preempts the youngest slot
+    engine = ServingEngine(
+        FakeModel(), {}, max_batch_slots=2, kv_cache="paged", paged_block_size=4,
+        paged_max_len=24, paged_num_blocks=9, eod_token_id=-1, time_fn=_tick_clock(),
+    )
+    rid_old = engine.submit(list(range(1, 9)), 15, temperature=0.0, seed=0)
+    rid_young = engine.submit([5, 9, 2], 20, temperature=0.0, seed=1)
+    results = engine.run()
+    # determinism across replay: tokens are (prev + 1) % V from the prompt tail
+    assert results[rid_old].tokens == [(8 + i) % VOCAB for i in range(1, 16)]
+    assert results[rid_young].tokens == [(2 + i) % VOCAB for i in range(1, 21)]
+    assert engine.stats()["preemptions"] >= 1
+    telemetry.close()
+
+    records = {rec["rid"]: rec for rec in load_serve_records(folder)}
+    preempted = [rec for rec in records.values() if rec["preemptions"] >= 1]
+    assert preempted, "pool exhaustion must have preempted one request"
+    rec = preempted[0]
+    assert rec["rid"] == rid_young  # youngest slot is the victim
+    names = [e["name"] for e in rec["events"]]
+    i_preempt = names.index("preempt")
+    assert names[i_preempt + 1] == "requeue"
+    assert "admit" in names[i_preempt + 2 :], "requeued request re-admitted"
+    assert names.count("admit") == 2 and names.count("first_token") == 2
+    times = [e["t"] for e in rec["events"]]
+    assert times == sorted(times)  # requeue + replay on ONE monotonic timeline
+    assert rec["finish_reason"] == "budget"
+
+    reg = telemetry.metrics
+    assert reg.counter("serve_preemptions_total").value() >= 1
+    # TTFT once per REQUEST (first admission), not once per admission
+    assert reg.histogram("serve_ttft_seconds").count() == 2
+    assert reg.histogram("serve_queue_wait_seconds").count() == 3  # 2 + requeue
+
+
+# ----------------------------------------------------------- GET /metrics
+
+
+def _get_raw(port: int, path: str, timeout: float = 30.0):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        conn.request("GET", path)
+        resp = conn.getresponse()
+        return resp.status, resp.getheader("Content-Type"), resp.read().decode()
+    finally:
+        conn.close()
+
+
+def _post_generate(port: int, body: dict, timeout: float = 60.0):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        conn.request(
+            "POST", "/generate", body=json.dumps(body),
+            headers={"Content-Type": "application/json"},
+        )
+        resp = conn.getresponse()
+        assert resp.status == 200
+        return resp.read()
+    finally:
+        conn.close()
+
+
+@pytest.mark.parametrize("kv_cache", ["ring", "paged"])
+def test_metrics_endpoint_serves_valid_exposition(kv_cache):
+    """ISSUE acceptance: GET /metrics returns valid Prometheus text exposition
+    with the latency histograms and slot/block-pool gauges, for BOTH cache
+    layouts."""
+    kwargs = {"paged_block_size": 4} if kv_cache == "paged" else {}
+    engine = ServingEngine(
+        FakeModel(), {}, max_batch_slots=2, kv_cache=kv_cache, eod_token_id=-1,
+        metrics=MetricsRegistry(), **kwargs,
+    )
+    server = ServingHTTPServer(
+        engine,
+        encode=lambda s: [int(t) % VOCAB for t in s.split()],
+        decode=lambda ids: " ".join(str(i) for i in ids),
+        port=0,
+    )
+    server.start()
+    try:
+        _post_generate(server.port, {"prompt": "3 17 4", "max_new_tokens": 5})
+
+        status, ctype, text = _get_raw(server.port, "/metrics")
+        assert status == 200
+        assert ctype == "text/plain; version=0.0.4; charset=utf-8"
+        parsed = parse_prometheus_text(text)  # raises on malformed exposition
+
+        for name in ("serve_ttft_seconds", "serve_tpot_seconds",
+                     "serve_queue_wait_seconds", "serve_e2e_latency_seconds"):
+            buckets = parsed[f"{name}_bucket"]
+            # cumulative le-buckets, +Inf present and monotone non-decreasing
+            rows = sorted(
+                (float("inf") if dict(k)["le"] == "+Inf" else float(dict(k)["le"]), v)
+                for k, v in buckets.items()
+            )
+            assert rows[-1][0] == float("inf")
+            values = [v for _, v in rows]
+            assert values == sorted(values)
+            assert parsed[f"{name}_count"][()] == rows[-1][1]
+        assert parsed["serve_ttft_seconds_count"][()] == 1
+        assert parsed["serve_tokens_generated_total"][()] == 5
+        assert parsed["serve_http_requests_total"][()] == 1
+        assert parsed["serve_requests_finished_total"][(("reason", "budget"),)] == 1
+        assert parsed["serve_slots_total"][()] == 2
+        assert 0.0 < parsed["serve_slot_occupancy_ratio"][()] <= 1.0
+        if kv_cache == "paged":
+            # idle again: every pool block is back
+            assert parsed["serve_paged_free_blocks"][()] == \
+                parsed["serve_paged_total_blocks"][()] > 0
+        else:
+            assert "serve_paged_free_blocks" not in parsed
+
+        # enriched /stats: consistent snapshot fields are present
+        status, _, body = _get_raw(server.port, "/stats")
+        stats = json.loads(body)
+        assert status == 200
+        assert stats["queue_depth"] == 0 and stats["active_slots"] == 0
+    finally:
+        server.stop()
+        server.close()
+
+
+# ---------------------------------------------------------------- watchdog
+
+
+def test_watchdog_dumps_artifact_on_wedged_decode(tmp_path):
+    """Satellite: a wedged decode dispatch produces the same watchdog_dump_*
+    artifact as a wedged train step, with engine stats in its state section."""
+    telemetry = Telemetry(
+        output_folder_path=tmp_path, watchdog_deadline_s=0.3,
+        watchdog_first_step_factor=1.0, use_jax_annotations=False,
+    )
+    prior = set_active_telemetry(telemetry)
+    try:
+        engine = ServingEngine(FakeModel(), {}, max_batch_slots=1, eod_token_id=-1)
+        original = engine._decode_jit
+        state = {"wedged": False}
+
+        def wedged_decode(*args, **kwargs):
+            if not state["wedged"]:
+                state["wedged"] = True
+                time.sleep(1.0)  # well past the 0.3 s deadline
+            return original(*args, **kwargs)
+
+        engine._decode_jit = wedged_decode
+        rid = engine.submit([1, 2, 3], 3, temperature=0.0, seed=0)
+        results = engine.run()
+        assert results[rid].finish_reason == "budget"  # run still completes
+
+        artifacts = telemetry.watchdog_artifacts
+        assert artifacts, "watchdog must have fired during the wedged dispatch"
+        dump = json.loads(artifacts[0].read_text())
+        assert dump["event"] == "watchdog_fired"
+        assert dump["thread_stacks"]  # all-thread stacks captured
+        assert dump["state"]["serving_engine"]["kv_cache"] == "ring"
+        assert artifacts[0].name.startswith("watchdog_dump_rank_0_step_")
+    finally:
+        telemetry.close()
+        set_active_telemetry(prior)
+
+
+# ------------------------------------------------------------- analyze CLI
+
+
+def test_analyze_serve_cli_renders_tables_and_json(active_telemetry):
+    telemetry, folder = active_telemetry
+    engine = ServingEngine(
+        FakeModel(), {}, max_batch_slots=2, eod_token_id=-1, time_fn=_tick_clock()
+    )
+    for seed in range(3):
+        engine.submit([1 + seed, 2, 3], 4, temperature=0.0, seed=seed)
+    engine.run()
+    telemetry.close()
+
+    result = CliRunner().invoke(
+        cli_main, ["data", "analyze_serve", "--sink_path", str(folder)]
+    )
+    assert result.exit_code == 0, result.output
+    assert "requests: 3" in result.output
+    assert "ttft_s" in result.output and "p95" in result.output
+    assert "budget" in result.output  # finish-reason breakdown
+    assert "occupancy timeline" in result.output
+
+    result = CliRunner().invoke(
+        cli_main, ["data", "analyze_serve", "--sink_path", str(folder), "--as_json"]
+    )
+    assert result.exit_code == 0, result.output
+    summary = json.loads(result.output)
+    assert summary["requests"] == 3
+    assert summary["generated_tokens"] == 12
+    assert summary["finish_reasons"] == {"budget": 3}
+    assert summary["latency"]["ttft_s"]["n"] == 3
+    assert summary["latency"]["ttft_s"]["p50"] <= summary["latency"]["ttft_s"]["p99"]
+    assert summary["occupancy_timeline"]
+    assert max(p["active"] for p in summary["occupancy_timeline"]) <= 2
+
+
+def test_analyze_serve_tolerates_torn_tail_and_empty_sink(tmp_path):
+    sink = tmp_path / "telemetry_rank_0.jsonl"
+    sink.write_text(
+        json.dumps({"event": "serve_request", "rid": 0, "prompt_len": 2, "tokens": 3,
+                    "finish_reason": "eod", "truncated": False, "preemptions": 0,
+                    "arrival_s": 0.0, "queue_wait_s": 0.01, "ttft_s": 0.02,
+                    "e2e_s": 0.05, "tpot_mean_s": 0.01, "events": []})
+        + "\n" + '{"event": "serve_requ'  # torn tail from a killed run
+    )
+    summary = summarize_serve(load_serve_records(sink))
+    assert summary["requests"] == 1 and summary["finish_reasons"] == {"eod": 1}
+    assert summarize_serve([]) == {"requests": 0}

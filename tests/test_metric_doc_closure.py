@@ -1,0 +1,41 @@
+"""Static closure: every metric name registered in code (`.counter(...)`,
+`.gauge(...)`, `.histogram(...)` with a string-literal name anywhere under
+modalities_tpu/) must appear in docs/components.md's metric reference table —
+same discipline as the env-var doc closure. An undocumented metric is a
+dashboard hazard: it shows up in a scrape with no runbook entry."""
+
+import re
+from pathlib import Path
+
+REPO = Path(__file__).parent.parent
+# matches reg.counter("name", ...) / self.metrics.gauge(\n    "name", ...) etc.;
+# \s* spans the line break of the multi-line registration style
+METRIC_REG = re.compile(
+    r"\.(?:counter|gauge|histogram)\(\s*[\"']([a-zA-Z_:][a-zA-Z0-9_:]*)[\"']"
+)
+
+
+def _metrics_in(text: str) -> set[str]:
+    return set(METRIC_REG.findall(text))
+
+
+def test_every_registered_metric_name_is_documented():
+    code_metrics: dict[str, str] = {}
+    for path in sorted((REPO / "modalities_tpu").rglob("*.py")):
+        for name in _metrics_in(path.read_text()):
+            code_metrics.setdefault(name, str(path.relative_to(REPO)))
+    assert code_metrics, "metric-name scan found nothing — repo layout changed?"
+    # the scan must at least see the serving engine's core metrics
+    assert "serve_ttft_seconds" in code_metrics
+    assert "training_goodput_ratio" in code_metrics
+
+    doc_text = (REPO / "docs" / "components.md").read_text()
+    doc_metrics = {
+        name for name in code_metrics
+        if f"`{name}`" in doc_text  # table cells render names in backticks
+    }
+    missing = {n: where for n, where in code_metrics.items() if n not in doc_metrics}
+    assert not missing, (
+        "metrics registered in code but absent from docs/components.md's "
+        f"metric reference table: {missing}"
+    )
