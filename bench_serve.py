@@ -30,6 +30,22 @@ Knobs: --slots N, --requests N, --rate R (Poisson arrivals/s; 0 = all at t=0),
 pool), --long N (append N requests whose prompt+budget exceeds the ring
 capacity — ring finishes them "capacity", paged completes them), --smoke
 (6 requests, 2 slots, no baseline — the tier-1 smoke test's fast path).
+
+Serving-v3 knobs (both imply --cache paged):
+  --shared_prefix_frac F   fixed-length prompts whose first F fraction is a
+                           COMMON system prefix (rest unique); reports
+                           `prefill_chunks` / `prefill_tokens_saved` /
+                           `prefill_chunks_skipped` so the slow oracle can pin
+                           prefill work dropping vs an F=0 run of the same shape
+  --spec K                 speculative decoding via the prompt-lookup n-gram
+                           drafter; the sequential baseline is replaced by a
+                           spec-OFF engine at the SAME slot count on the SAME
+                           trace (speedup = spec-on/spec-off tokens/s) and
+                           `spec_tokens_match` pins bitwise-identical output
+  --repetitive             all-greedy periodic prompts (acceptance-friendly:
+                           the n-gram drafter nails repetitive continuations)
+After every paged run the block-pool invariant audit runs (`pool_audit: "ok"`
+in the JSON line) — a leak or refcount tear fails the bench, not just a test.
 """
 
 import argparse
@@ -56,6 +72,18 @@ METRIC_KEYS = (
     "capacity_finishes",
     "preemptions",
     "truncated_requests",
+    # serving v3 (paged only; None on ring runs)
+    "prefill_chunks",
+    "prefill_tokens_saved",
+    "prefill_chunks_skipped",
+    "prefix_hit_requests",
+    "cow_copies",
+    "spec_k",
+    "spec_proposed",
+    "spec_accepted",
+    "spec_acceptance",
+    "spec_tokens_match",
+    "pool_audit",
 )
 
 
@@ -145,6 +173,63 @@ def _make_trace(n: int, rate: float, max_new: int, seed: int, long_n: int = 0, c
     return trace
 
 
+def _make_prefix_trace(n: int, rate: float, max_new: int, seed: int, frac: float,
+                       prompt_len: int):
+    """Shared-system-prompt mix: every prompt is exactly `prompt_len` tokens;
+    the first `frac * prompt_len` come from ONE seeded common prefix, the rest
+    are unique per request. frac=0 keeps the identical shape with fully unique
+    prompts — the apples-to-apples baseline for the prefill-chunks oracle."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    shared_len = int(round(frac * prompt_len))
+    shared = [int(x) for x in rng.integers(0, 127, size=shared_len)]
+    t = 0.0
+    trace = []
+    for i in range(n):
+        if rate > 0:
+            t += float(rng.exponential(1.0 / rate))
+        tail = [int(x) for x in rng.integers(0, 127, size=prompt_len - shared_len)]
+        trace.append(
+            {
+                "prompt": shared + tail,
+                "max_new_tokens": max_new,
+                "temperature": 0.0 if i % 2 == 0 else 0.8,
+                "seed": i,
+                "arrival_offset_s": t,
+            }
+        )
+    return trace
+
+
+def _make_repetitive_trace(n: int, rate: float, max_new: int, seed: int):
+    """Acceptance-friendly mix for the spec-decode oracle: each prompt repeats
+    its own short random pattern (periodic continuations the n-gram drafter
+    predicts), all greedy so every slot is a speculation candidate."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    t = 0.0
+    trace = []
+    for i in range(n):
+        if rate > 0:
+            t += float(rng.exponential(1.0 / rate))
+        period = int(rng.integers(2, 5))
+        pattern = [int(x) for x in rng.integers(0, 127, size=period)]
+        plen = int(rng.integers(16, 25))
+        prompt = (pattern * ((plen // period) + 1))[:plen]
+        trace.append(
+            {
+                "prompt": prompt,
+                "max_new_tokens": max_new,
+                "temperature": 0.0,
+                "seed": i,
+                "arrival_offset_s": t,
+            }
+        )
+    return trace
+
+
 def _replay(engine, trace, arrivals: bool):
     t0 = time.monotonic()
     rids = [
@@ -186,9 +271,35 @@ def main() -> int:
         help="append N requests whose prompt+budget exceeds the ring capacity",
     )
     parser.add_argument("--smoke", action="store_true", help="6 requests, 2 slots, no baseline")
+    parser.add_argument(
+        "--shared_prefix_frac",
+        type=float,
+        default=None,
+        help="fixed-length prompts sharing a common prefix of this fraction "
+        "(implies --cache paged; 0.0 = same shape, fully unique prompts)",
+    )
+    parser.add_argument(
+        "--prompt-len", type=int, default=64,
+        help="prompt length for the --shared_prefix_frac workload",
+    )
+    parser.add_argument(
+        "--spec", type=int, default=0,
+        help="speculative-decoding draft length k (implies --cache paged; "
+        "baseline becomes a spec-OFF engine at the same slot count)",
+    )
+    parser.add_argument(
+        "--repetitive", action="store_true",
+        help="all-greedy periodic prompts (acceptance-friendly spec workload)",
+    )
     args = parser.parse_args()
     if args.smoke:
         args.requests, args.slots, args.max_new = 6, 2, 6
+    if args.shared_prefix_frac is not None and not (0.0 <= args.shared_prefix_frac <= 1.0):
+        parser.error("--shared_prefix_frac must be in [0, 1]")
+    if args.spec < 0:
+        parser.error("--spec must be >= 0")
+    if args.shared_prefix_frac is not None or args.spec > 0:
+        args.cache = "paged"  # prefix sharing + spec decode live on the block pool
 
     print(_line({"provisional": True, "reason": "startup"}), flush=True)
     _arm_budget_guard()
@@ -207,15 +318,25 @@ def main() -> int:
     params = meta.unbox(model.init_params(jax.random.PRNGKey(0)))
 
     capacity = 64  # _tiny_model sequence_length == default ring cache_capacity
-    trace = _make_trace(args.requests, args.rate, args.max_new, args.seed, args.long, capacity)
+    if args.shared_prefix_frac is not None:
+        trace = _make_prefix_trace(
+            args.requests, args.rate, args.max_new, args.seed,
+            args.shared_prefix_frac, args.prompt_len,
+        )
+    elif args.repetitive:
+        trace = _make_repetitive_trace(args.requests, args.rate, args.max_new, args.seed)
+    else:
+        trace = _make_trace(args.requests, args.rate, args.max_new, args.seed, args.long, capacity)
     need_len = max(len(r["prompt"]) + r["max_new_tokens"] for r in trace)
 
-    def fresh_engine(slots: int) -> ServingEngine:
+    def fresh_engine(slots: int, spec_k: int = 0) -> ServingEngine:
         kwargs = {}
         if args.cache == "paged":
             # lift the per-request ceiling past the ring capacity so the --long
             # requests actually finish (NOPE+rotary model: no wpe table to outgrow)
             kwargs = {"kv_cache": "paged", "paged_max_len": max(need_len, capacity)}
+            if spec_k > 0:
+                kwargs["spec_decode"] = {"k": spec_k}
         # per-engine registry so the baseline's samples never mix into the
         # measured engine's scrape
         return ServingEngine(
@@ -228,9 +349,13 @@ def main() -> int:
         # compile time never lands in the measured latencies
         engine.submit(list(range(21)), 2, temperature=0.0, seed=0)
         engine.submit(list(range(5)), 2, temperature=0.8, seed=1)
+        if getattr(engine, "spec", None) is not None and engine.spec.enabled:
+            # a periodic greedy prompt makes the n-gram drafter fire, so the
+            # [slots, k+1] verify executable compiles here, not in the window
+            engine.submit([1, 2, 3] * 8, 6, temperature=0.0, seed=2)
         engine.run()
 
-    engine = fresh_engine(args.slots)
+    engine = fresh_engine(args.slots, spec_k=args.spec)
     warmup(engine)
     engine.metrics.reset()  # compile-window samples stay out of the scrape
     warm_tokens = engine.decode_token_count
@@ -279,9 +404,55 @@ def main() -> int:
     # occupancy over the measured window only (warmup steps excluded)
     _ = warm_tokens
 
+    # serving v3: prefill-work + spec accounting, then the pool invariant audit
+    # (an exception here fails the bench run itself, not just a test)
+    v3 = {}
+    if args.cache == "paged":
+        chunks = parsed.get("serve_prefill_chunks_total")
+        bs = stats["block_size"]
+
+        def chunks_of(ntok: int) -> int:
+            return -(-ntok // bs)  # ceil
+
+        # chunks each request would have dispatched without sharing, minus what
+        # it actually dispatched on its unmatched tail (full-match tail = 1 tok)
+        saved_chunks = sum(
+            chunks_of(len(t["prompt"])) - chunks_of(len(t["prompt"]) - r.prefix_hit_tokens)
+            for t, r in zip(trace, results)
+        )
+        proposed, accepted = stats["spec_proposed"], stats["spec_accepted"]
+        v3 = {
+            "prefill_chunks": next(iter(chunks.values())) if chunks else 0.0,
+            "prefill_tokens_saved": stats["prefix_hit_tokens"],
+            "prefill_chunks_skipped": saved_chunks,
+            "prefix_hit_requests": stats["prefix_hit_requests"],
+            "cow_copies": stats["cow_copies"],
+            "spec_k": stats["spec_k"],
+            "spec_proposed": proposed,
+            "spec_accepted": accepted,
+            "spec_acceptance": (accepted / proposed) if proposed else None,
+        }
+        engine._table_state.check()
+        assert stats["free_blocks"] == stats["num_blocks"], "blocks leaked"
+        v3["pool_audit"] = "ok"
+
     baseline_tokens_per_s = None
     speedup = None
-    if not args.smoke:
+    if args.spec > 0:
+        # spec oracle baseline: the SAME trace through a spec-OFF engine at the
+        # SAME slot count — speedup isolates speculation, and greedy output must
+        # stay bitwise identical whatever the drafter proposed
+        baseline = fresh_engine(args.slots, spec_k=0)
+        warmup(baseline)
+        base_results, base_wall = _replay(baseline, trace, arrivals=True)
+        base_generated = sum(len(r.tokens) for r in base_results)
+        baseline_tokens_per_s = base_generated / base_wall if base_wall > 0 else 0.0
+        if baseline_tokens_per_s:
+            speedup = tokens_per_s / baseline_tokens_per_s
+        v3["spec_tokens_match"] = all(
+            a.tokens == b.tokens for a, b in zip(results, base_results)
+        )
+    elif not args.smoke:
         baseline = fresh_engine(1)
         warmup(baseline)
         base_results, base_wall = _replay(baseline, trace, arrivals=False)
@@ -307,6 +478,7 @@ def main() -> int:
                 "capacity_finishes": sum(1 for r in results if r.finish_reason == "capacity"),
                 "preemptions": stats.get("preemptions", 0),
                 "truncated_requests": stats.get("truncated_requests", 0),
+                **v3,
                 "cache": args.cache,
                 "requests": args.requests,
                 "long_requests": args.long,

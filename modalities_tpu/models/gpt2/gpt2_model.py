@@ -593,6 +593,13 @@ class CausalSelfAttention(nn.Module):
             mask = key_pos[None, None, :] <= pos[:, :, None]  # [R, C, L]
         else:
             mask = key_pos[None, None, :] <= pos[:, None, None]  # [S, 1, L]
+        # recycled pool blocks hold whatever their previous owner wrote — and a
+        # masked logit drops out of the softmax, but 0-weight x NaN/inf V still
+        # poisons the output einsum. Zero every V row no query references, so a
+        # dirty recycled block behaves exactly like a fresh zeroed one (K needs
+        # no scrub: masked logits are replaced before the softmax).
+        valid = mask.any(axis=-2)  # [B, L] key rows referenced by any query
+        v_all = jnp.where(valid[:, :, None, None], v_all, 0.0)
         y = masked_attention(q, k_all, v_all, mask)
         return self._project_out(x, y)
 
@@ -1274,6 +1281,21 @@ class GPT2LLM(NNModel):
             {**params, "cache": cache}, tokens, None, pos_tree, mutable=["cache"]
         )
         return logits, mutated["cache"]
+
+    def verify_paged(self, params, cache, tokens, positions, tables, wblk, woff):
+        """Speculative-decoding verification forward (serving v3): row s of
+        `tokens` [S, k+1] is `[fed_token, draft_1 .. draft_k]` at absolute
+        positions `positions` [S, k+1]; ONE fixed-shape batched forward scores
+        every proposal column, and the engine folds the per-slot accept length
+        out of the returned logits with `jnp.where`/cumprod — no per-k shapes,
+        so the verify step compiles exactly once beside the 1-token decode.
+
+        The math is the packed-prefill contract verbatim (per-column causal
+        masking over the block tables, write coordinates wblk/woff [S, k+1]
+        with out-of-range = dropped), so this delegates to it: a draft column
+        attends exactly the K/V a sequential decode at that position would,
+        which is what makes greedy spec-decode bitwise equal to plain decode."""
+        return self.prefill_paged(params, cache, tokens, positions, tables, wblk, woff)
 
     # ------------------------------------------------------- scheduled pipelining
     def split_pp_params(self, params):

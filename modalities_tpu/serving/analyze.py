@@ -8,10 +8,12 @@ raw monotonic event stream (enqueue/admit/prefill_chunk/first_token/preempt/
 requeue/finish).
 
 Output: p50/p95/p99 latency tables (TTFT, end-to-end, queue wait, mean TPOT),
-a finish-reason breakdown, token/preemption/truncation totals, and a coarse
-slot-occupancy timeline rebuilt from admit→(preempt|finish) intervals — the
-offline counterpart of the live `/metrics` histograms, but exact (per-request
-samples, not bucket interpolation).
+a finish-reason breakdown, token/preemption/truncation totals, serving-v3
+prefix-sharing totals (`prefix_hit_tokens`) + the spec-decode acceptance
+ratio, and a coarse slot-occupancy timeline rebuilt from
+admit→(preempt|finish) intervals — the offline counterpart of the live
+`/metrics` histograms, but exact (per-request samples, not bucket
+interpolation).
 """
 
 from __future__ import annotations
@@ -127,6 +129,8 @@ def summarize_serve(records: list[dict]) -> dict:
             "mean": sum(values) / len(values),
             **{f"p{int(q * 100)}": _quantile(values, q) for q in QUANTILES},
         }
+    spec_proposed = sum(int(rec.get("spec_proposed") or 0) for rec in records)
+    spec_accepted = sum(int(rec.get("spec_accepted") or 0) for rec in records)
     return {
         "requests": len(records),
         "finish_reasons": dict(sorted(reasons.items())),
@@ -134,6 +138,14 @@ def summarize_serve(records: list[dict]) -> dict:
         "generated_tokens": sum(int(rec.get("tokens") or 0) for rec in records),
         "preemptions": sum(int(rec.get("preemptions") or 0) for rec in records),
         "truncated_requests": sum(1 for rec in records if rec.get("truncated")),
+        # serving v3: prompt tokens served from shared prefix blocks, and the
+        # spec-decode acceptance ratio (accepted drafts / proposed drafts)
+        "prefix_hit_tokens": sum(
+            int(rec.get("prefix_hit_tokens") or 0) for rec in records
+        ),
+        "spec_proposed": spec_proposed,
+        "spec_accepted": spec_accepted,
+        "spec_acceptance": (spec_accepted / spec_proposed) if spec_proposed else None,
         "latency": latency,
         "occupancy_timeline": _occupancy_timeline(records),
     }
@@ -147,10 +159,17 @@ def format_serve_table(summary: dict) -> str:
         f"prompt_tokens: {summary['prompt_tokens']}  "
         f"generated_tokens: {summary['generated_tokens']}",
         f"preemptions: {summary['preemptions']}  "
-        f"truncated: {summary['truncated_requests']}",
-        "",
-        "finish reasons:",
+        f"truncated: {summary['truncated_requests']}  "
+        f"prefix_hit_tokens: {summary.get('prefix_hit_tokens', 0)}",
     ]
+    acceptance = summary.get("spec_acceptance")
+    if acceptance is not None:
+        lines.append(
+            f"spec_decode: accepted {summary['spec_accepted']} / "
+            f"proposed {summary['spec_proposed']} "
+            f"(acceptance {acceptance:.3f})"
+        )
+    lines += ["", "finish reasons:"]
     for reason, count in summary["finish_reasons"].items():
         lines.append(f"  {reason:<10} {count}")
     lines += ["", f"{'latency':<14} {'n':>5} {'mean':>9} {'p50':>9} {'p95':>9} {'p99':>9}"]

@@ -34,13 +34,38 @@ Execution model:
   so under load the batch stays full instead of draining to the slowest
   request. `stop_fn` (graceful drain) stops admission; in-flight slots finish.
 
+Serving v3 (paged only) adds the two big tokens/s multipliers:
+
+- prefix sharing: admission looks the prompt window up in the block-table
+  state's prefix index; matched full blocks are FORKED into the new request's
+  table (refcount bump, no re-prefill) and the chunked prefill runs only on
+  the unmatched tail. A full-window match copy-on-writes the last shared
+  block (fresh block + one jitted device row-copy) and re-forwards just the
+  final prompt token to produce the first-token logits. Shared blocks are
+  never written (generated positions live in private blocks), `release` only
+  returns a block to the free list at refcount 0, and preempting a holder of
+  shared blocks can therefore free zero blocks without ever corrupting a
+  donor.
+- speculative decoding (`spec_decode` config block, k > 0): a zero-cost
+  prompt-lookup n-gram drafter proposes up to k tokens per greedy slot, and
+  ONE fixed-shape `[slots, k+1]` verify forward (model.verify_paged) scores
+  every proposal; accept lengths fold in via cumprod/`jnp.where`, so the
+  decode side stays exactly TWO executables (1-token decode + verify) no
+  matter what k accepts. Greedy emission takes the verify argmax row, which
+  IS the sequential greedy trajectory — bitwise identity with the
+  interactive path is proposal-independent by construction.
+
 Batch-invariance contract (pinned by tests/serving/test_engine.py and
 test_paged_engine.py): with exactly one active slot the engine emits
 token-for-token what the interactive `_generate_cached` path emits for the same
 (prompt, budget, temperature, seed) — same key-split sequence, same categorical
 shapes, bitwise-identical logits rows — in BOTH cache modes. For paged mode the
 gathered K/V row is position-ordered and garbage positions are masked to exact
-zeros, so the softmax reduction matches the ring row bitwise.
+zeros, so the softmax reduction matches the ring row bitwise. Prefix sharing
+and spec decode both preserve the contract: forked blocks hold bitwise the
+bytes the request's own prefill would have produced (chunk packing is
+bitwise-invariant, pinned since PR 9), and spec verify columns attend exactly
+the K/V a sequential decode would.
 """
 
 from __future__ import annotations
@@ -55,6 +80,7 @@ from typing import Callable, Optional
 import numpy as np
 
 from modalities_tpu.serving.paged_cache import BlockTableState, blocks_for_tokens
+from modalities_tpu.serving.spec_decode import propose_ngram, resolve_spec_config
 from modalities_tpu.telemetry import get_active_telemetry, span
 from modalities_tpu.telemetry.metrics import MetricsRegistry
 
@@ -77,6 +103,18 @@ def _prefill_chunks_from_env() -> tuple[int, ...]:
             "list ending in 1 (e.g. '64,16,4,1')"
         )
     return chunks
+
+
+def _prefix_sharing_from_env() -> bool:
+    raw = os.environ.get("MODALITIES_TPU_SERVE_PREFIX_SHARING", "1").strip().lower()
+    if raw in ("1", "true", "on", "yes"):
+        return True
+    if raw in ("0", "false", "off", "no"):
+        return False
+    raise ValueError(
+        f"MODALITIES_TPU_SERVE_PREFIX_SHARING={raw!r}: must be a boolean "
+        "(1/0/true/false/on/off)"
+    )
 
 
 def _kv_cache_from_env() -> str:
@@ -109,6 +147,7 @@ class ServeResult:
     finish_reason: str = ""  # "eod" | "budget" | "capacity"
     prompt_len: int = 0
     truncated: bool = False  # prompt window-clipped at admission
+    prefix_hit_tokens: int = 0  # prompt tokens served from shared blocks (v3)
     arrival_s: float = 0.0  # engine-clock arrival
     first_token_s: float = 0.0  # engine-clock time the first token was available
     finish_s: float = 0.0
@@ -151,6 +190,8 @@ class ServingEngine:
         paged_block_size: int = 16,
         paged_num_blocks: Optional[int] = None,
         paged_max_len: Optional[int] = None,
+        prefix_sharing: Optional[bool] = None,
+        spec_decode=None,
         stop_fn: Optional[Callable[[], bool]] = None,
         on_token: Optional[Callable[[int, int], None]] = None,
         on_finish: Optional[Callable[[int, ServeResult], None]] = None,
@@ -179,6 +220,19 @@ class ServingEngine:
         self.eod_token_id = int(eod_token_id)
         self.default_temperature = default_temperature
         self.prefill_chunks = tuple(prefill_chunks) if prefill_chunks else _prefill_chunks_from_env()
+        self.prefix_sharing = (
+            bool(prefix_sharing) if prefix_sharing is not None else _prefix_sharing_from_env()
+        )
+        self.spec = resolve_spec_config(spec_decode)
+        if self.kv_cache != "paged":
+            # both v3 multipliers ride the paged block tables; on the ring they
+            # silently degrade to the v1 path (sharing) or are rejected (spec)
+            self.prefix_sharing = False
+            if self.spec.enabled:
+                raise ValueError(
+                    "spec_decode.k > 0 requires kv_cache='paged': the verify "
+                    "forward runs through the paged block tables"
+                )
         self._now = time_fn if time_fn is not None else time.monotonic
         self._stop_fn = stop_fn
         self._on_token = on_token
@@ -271,15 +325,27 @@ class ServingEngine:
         self._truncated_rids: set[int] = set()  # count once even across preemption
 
         # trace counters: the traced fn bodies run once per COMPILATION, so these
-        # pin "one decode executable, bounded prefill ladder" in tests
+        # pin "one decode executable, bounded prefill ladder" in tests; serving
+        # v3 adds _verify_traces (must stay <= 1: the SECOND decode-side
+        # program) and _cow_traces (one jitted row-copy, traced src/dst)
         self._decode_traces = 0
         self._prefill_traces = 0
+        self._verify_traces = 0
+        self._cow_traces = 0
         self.decode_steps = 0
         self.decode_token_count = 0
         self._occupancy_sum = 0
         self.max_concurrent = 0
         self.preemptions = 0
         self.truncated_requests = 0
+        # serving v3 counters (all under _stats_lock)
+        self.prefix_hit_requests = 0
+        self.prefix_hit_blocks = 0
+        self.prefix_hit_tokens = 0
+        self.cow_copies = 0
+        self.verify_steps = 0
+        self.spec_proposed = 0
+        self.spec_accepted = 0
         # counters/gauges above mutate only under this lock, and stats() reads
         # under it — /stats sees one consistent snapshot, never a mid-dispatch
         # tear (decode_tokens without its decode_steps)
@@ -341,6 +407,21 @@ class ServingEngine:
             "serve_slot_occupancy_ratio", "Decoding slots over total slots, cumulative mean"
         ).set_fn(self._occupancy_ratio)
         reg.gauge("serve_slots_total", "Configured max_batch_slots").set(self.slots)
+        self._m_prefix_hit_blocks = reg.counter(
+            "serve_prefix_hit_blocks_total", "Prompt blocks served from the prefix index"
+        )
+        self._m_prefix_hit_requests = reg.counter(
+            "serve_prefix_hit_requests_total", "Admissions that forked shared prefix blocks"
+        )
+        self._m_cow = reg.counter(
+            "serve_cow_copies_total", "Copy-on-write block copies (shared block first write)"
+        )
+        self._m_spec_proposed = reg.counter(
+            "serve_spec_proposed_total", "Draft tokens proposed to the spec-decode verifier"
+        )
+        self._m_spec_accepted = reg.counter(
+            "serve_spec_accepted_total", "Draft tokens accepted by the spec-decode verifier"
+        )
         if self.kv_cache == "paged":
             reg.gauge(
                 "serve_paged_free_blocks", "Free blocks in the paged KV pool"
@@ -348,6 +429,9 @@ class ServingEngine:
             reg.gauge("serve_paged_total_blocks", "Configured paged KV pool size").set(
                 self.num_blocks
             )
+            reg.gauge(
+                "serve_shared_blocks", "Pool blocks referenced by more than one table"
+            ).set_fn(lambda: self._table_state.pool.shared_count)
 
         # a wedged serve dispatch dumps the same watchdog artifact as a wedged
         # train step, with the engine's own stats in the `state` section
@@ -506,9 +590,47 @@ class ServingEngine:
             finished = (toks == eods) | (remaining <= 1)
             return _constrain_cache(cache), toks, new_keys, finished
 
+        spec_k = self.spec.k
+
+        def spec_verify_fn(params, cache, tokens, positions, tables, wblk, woff, keys, temps, prop_len):
+            # the SECOND (and last) decode-side executable: ONE fixed
+            # [slots, k+1] verify forward scores every slot's proposals; the
+            # accept length folds in via cumprod so k acceptances never retrace
+            engine._verify_traces += 1
+            logits, cache = model.verify_paged(
+                params, cache, tokens, positions, tables, wblk, woff
+            )
+            g = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [S, k+1] greedy cont.
+            # column 0 through samp(): sampled slots draw their token (and
+            # advance their key) exactly like a plain decode step — greedy
+            # slots get argmax back and keep their key, bitwise as always
+            toks0, new_keys = jax.vmap(samp)(keys, logits[:, 0, :], temps)
+            # draft j (fed at column j) is accepted iff it equals the greedy
+            # continuation of column j-1 and every earlier draft was accepted
+            match = (tokens[:, 1:] == g[:, :-1]) & (
+                jnp.arange(spec_k)[None, :] < prop_len[:, None]
+            )
+            acc = jnp.cumprod(match.astype(jnp.int32), axis=1).sum(axis=1)  # [S]
+            return _constrain_cache(cache), g, toks0, new_keys, acc
+
+        def cow_fn(cache, src, dst):
+            # copy-on-write: duplicate pool row `src` into the freshly
+            # allocated `dst`. src/dst are traced int32 scalars, so every CoW
+            # reuses ONE executable
+            engine._cow_traces += 1
+
+            def copy_leaf(leaf):
+                axis = 1 if leaf.ndim == 5 else 0  # scanned [L, NB, ...] | unrolled
+                row = jax.lax.dynamic_index_in_dim(leaf, src, axis=axis, keepdims=False)
+                return jax.lax.dynamic_update_index_in_dim(leaf, row, dst, axis=axis)
+
+            return _constrain_cache(jax.tree.map(copy_leaf, cache))
+
         if self.kv_cache == "paged":
             self._prefill_jit = jax.jit(paged_prefill_fn, donate_argnums=(1,))
             self._decode_jit = jax.jit(paged_decode_fn, donate_argnums=(1,))
+            self._verify_jit = jax.jit(spec_verify_fn, donate_argnums=(1,))
+            self._cow_jit = jax.jit(cow_fn, donate_argnums=(0,))
         else:
             self._prefill_jit = jax.jit(prefill_fn, donate_argnums=(1,))
             self._decode_jit = jax.jit(decode_fn, donate_argnums=(1,))
@@ -589,6 +711,9 @@ class ServingEngine:
                 "tokens": len(result.tokens),
                 "finish_reason": result.finish_reason,
                 "truncated": result.truncated,
+                "prefix_hit_tokens": result.prefix_hit_tokens,
+                "spec_proposed": trace.get("spec_proposed", 0),
+                "spec_accepted": trace.get("spec_accepted", 0),
                 "preemptions": trace["preemptions"],
                 "arrival_s": round(result.arrival_s, 6),
                 "queue_wait_s": round(trace["queue_wait_s"], 6),
@@ -769,24 +894,71 @@ class ServingEngine:
                     arrival_s=max(req.arrival_offset_s, 0.0),
                 )
                 window = req.prompt_tokens[-(self.max_len - 1) :]
-                # admission gate: the whole prompt window must fit in free blocks
-                if not self._table_state.ensure(req.rid, len(window)):
+                ts = self._table_state
+                matched = ts.match_prefix(window) if self.prefix_sharing else []
+                # full-window match: every prompt position is already resident,
+                # but the LAST token must be re-forwarded to produce the
+                # first-token logits — its K/V write lands in the final shared
+                # block, so admission copy-on-writes that block (one fresh
+                # block + a jitted device row copy)
+                full_match = matched and len(matched) * self.block_size >= len(window)
+                # admission gate (BEFORE popleft): unmatched tail blocks + the
+                # CoW copy must fit in free blocks, or the head stays queued
+                need = (
+                    blocks_for_tokens(len(window), self.block_size)
+                    - len(matched)
+                    + (1 if full_match else 0)
+                )
+                if ts.pool.free_count < need:
                     break  # head stays queued; decoders will free blocks
                 self._queue.popleft()
                 self._trace_admit(req.rid, now)
                 window = self._truncate_window(req, result)
                 if req.max_new_tokens <= 0:
-                    self._table_state.release(req.rid)
                     now2 = self._now() - t0
                     result.first_token_s = now2
                     self._finish_immediate(result, "budget", now2)
                     continue
+                if matched:
+                    ts.fork_prefix(req.rid, matched)
+                if not ts.ensure(req.rid, len(window)):
+                    raise AssertionError("paged admission gate let a dry pool through")
+                tail_start = len(matched) * self.block_size
+                if full_match:
+                    tail_start = len(window) - 1
+                    cow = ts.ensure_writable(req.rid, tail_start)
+                    # matched blocks were just forked, so the write target is
+                    # shared by construction and CoW always triggers
+                    assert isinstance(cow, tuple), "full-match block unexpectedly private"
+                    self._cow_copy(*cow)
+                if matched:
+                    result.prefix_hit_tokens = tail_start
+                    with self._stats_lock:
+                        self.prefix_hit_requests += 1
+                        self.prefix_hit_blocks += len(matched)
+                        self.prefix_hit_tokens += tail_start
+                    self._m_prefix_hit_requests.inc()
+                    self._m_prefix_hit_blocks.inc(len(matched))
+                    self._trace_event(
+                        req.rid, "prefix_hit", now,
+                        blocks=len(matched), tokens=tail_start,
+                    )
                 self._slot_states[slot] = _SlotState(
                     request=req, result=result, remaining=0,
-                    phase="prefill", window=window, prefill_pos=0,
+                    phase="prefill", window=window, prefill_pos=tail_start,
                     key=jax.random.PRNGKey(req.seed), temp=temp, seq=self._admit_seq,
                 )
                 self._admit_seq += 1
+
+    def _cow_copy(self, src: int, dst: int) -> None:
+        """Device row copy backing a copy-on-write: pool block `src` -> `dst`
+        (one executable — src/dst are traced scalars)."""
+        with span("serve/cow"):
+            with self._rules_ctx():
+                self.cache = self._cow_jit(self.cache, np.int32(src), np.int32(dst))
+        with self._stats_lock:
+            self.cow_copies += 1
+        self._m_cow.inc()
 
     def _active_count(self) -> int:
         return sum(1 for s in self._slot_states if s is not None)
@@ -833,18 +1005,36 @@ class ServingEngine:
         self._queue.appendleft(state.request)
         self._clear_slot(slot)
 
-    def _ensure_decode_blocks(self, t0: float) -> None:
-        """Before a paged decode dispatch: every decoding slot needs the block
-        covering its write position. Allocation failure preempts the YOUNGEST
-        active slot (never an older one — FIFO fairness, no livelock: the
-        pool admits at least one max-length request by construction)."""
+    def _ensure_decode_blocks(self, t0: float, widths: Optional[dict] = None) -> None:
+        """Before a paged decode/verify dispatch: every decoding slot needs the
+        blocks covering its write range [p, p+w-1] (`widths` maps slot -> w;
+        default 1; w > 1 under spec decode), each exclusively owned — a shared
+        block is copy-on-written first. Allocation failure preempts the
+        YOUNGEST active slot (never an older one — FIFO fairness, no livelock:
+        the pool admits at least one max-length request by construction)."""
+        ts = self._table_state
         for slot in range(self.slots):
             state = self._slot_states[slot]
             if state is None or state.phase != "decode":
                 continue
             rid = state.request.rid
             p = int(self._positions[slot])
-            while not self._table_state.ensure(rid, p + 1):
+            w = int(widths.get(slot, 1)) if widths else 1
+            while True:
+                if ts.ensure(rid, p + w):
+                    # defensive CoW sweep: engine flows keep generated-region
+                    # blocks private (prompt sharing CoWs at admission), but a
+                    # shared write target here must still copy, never corrupt
+                    dry = False
+                    for bi in range(p // self.block_size, (p + w - 1) // self.block_size + 1):
+                        res = ts.ensure_writable(rid, bi * self.block_size)
+                        if res is False:
+                            dry = True  # pool ran dry mid-CoW: preempt + retry
+                            break
+                        if isinstance(res, tuple):
+                            self._cow_copy(*res)
+                    if not dry:
+                        break
                 victims = [
                     (s.seq, i) for i, s in enumerate(self._slot_states) if s is not None
                 ]
@@ -854,10 +1044,10 @@ class ServingEngine:
                     break
             if self._slot_states[slot] is None:
                 continue  # preempted itself
-            blk, off = self._table_state.write_coords(rid, p)
+            blk, off = ts.write_coords(rid, p)
             self._wblk[slot] = blk
             self._woff[slot] = off
-            self._tables[slot] = self._table_state.table(rid)
+            self._tables[slot] = ts.table(rid)
 
     def _prefill_dispatch(self, t0: float) -> None:
         """Paged cross-request chunked prefill: ONE [slots, block_size] dispatch
@@ -931,6 +1121,13 @@ class ServingEngine:
                 continue
             req, result = state.request, state.result
             wl = len(state.window)
+            if self.prefix_sharing:
+                # prompt fully resident: publish the full PROMPT blocks into
+                # the prefix index (first writer wins — forked/CoW duplicates
+                # stay out). Generated positions live past `wl` and are never
+                # registered, so indexed blocks are write-immutable for their
+                # owner and CoW-guarded for everyone else.
+                self._table_state.register_prefix(req.rid, state.window, upto=wl)
             first_tok = int(out_toks[r])
             result.first_token_s = now
             self._record_first_token(result, now)
@@ -962,9 +1159,27 @@ class ServingEngine:
 
         jnp = self._jnp
         if self.kv_cache == "paged":
-            self._ensure_decode_blocks(t0)
+            props = self._collect_proposals() if self.spec.enabled else {}
+            widths = {
+                slot: min(len(d) + 1, self._slot_states[slot].remaining)
+                for slot, d in props.items()
+            }
+            self._ensure_decode_blocks(t0, widths or None)
             if self._decoding_count() == 0:
                 return  # every decoder was preempted into the queue
+            props = {
+                slot: d
+                for slot, d in props.items()
+                if self._slot_states[slot] is not None
+                and self._slot_states[slot].phase == "decode"
+            }
+            if props:
+                # at least one slot has drafts to score: the round goes
+                # through the verify executable (slots without proposals ride
+                # along as plain 1-token columns). No proposals anywhere ->
+                # plain decode below, so BOTH decode-side programs stay warm
+                self._spec_verify_dispatch(t0, props)
+                return
         with span("serve/decode"):
             with self._rules_ctx():
                 if self.kv_cache == "paged":
@@ -1018,6 +1233,138 @@ class ServingEngine:
             self.max_concurrent = max(self.max_concurrent, active)
             self.decode_token_count += emitted
         self._m_decode_steps.inc()
+
+    def _collect_proposals(self) -> dict:
+        """Prompt-lookup drafts per decoding slot. Greedy slots only (sampled
+        slots have nothing to verify against — their token is a draw, not an
+        argmax), and only while >1 token of budget remains (the final token is
+        a plain decode either way). Deterministic: a pure function of the
+        request's own context, so preemption replay re-proposes identically."""
+        props: dict[int, list[int]] = {}
+        for slot in range(self.slots):
+            state = self._slot_states[slot]
+            if state is None or state.phase != "decode":
+                continue
+            if state.temp > 0.0 or state.remaining <= 1:
+                continue
+            drafts = propose_ngram(
+                state.window + state.result.tokens,
+                self.spec.k, self.spec.ngram_max, self.spec.ngram_min,
+            )
+            if drafts:
+                props[slot] = drafts
+        return props
+
+    def _spec_verify_dispatch(self, t0: float, props: dict) -> None:
+        """ONE [slots, k+1] verify forward for the whole batch: column 0 feeds
+        each slot's pending token (so a slot with no drafts behaves exactly
+        like a plain decode column — sampled slots draw via samp() on column
+        0), columns 1..n feed the drafts. The device returns the greedy
+        continuation per column + the folded accept length; the host replays
+        the sequential stopping rule over the accepted run, so eod/budget
+        semantics — and the emitted tokens — are bitwise the plain-decode
+        trajectory."""
+        import jax
+
+        jnp = self._jnp
+        S, K1 = self.slots, self.spec.k + 1
+        ts = self._table_state
+        toks = np.zeros((S, K1), np.int32)
+        pos_a = np.zeros((S, K1), np.int32)
+        wblk = np.full((S, K1), self.num_blocks, np.int32)  # default: write nowhere
+        woff = np.zeros((S, K1), np.int32)
+        prop_len = np.zeros((S,), np.int32)
+        for slot in range(S):
+            state = self._slot_states[slot]
+            if state is None or state.phase != "decode":
+                continue
+            p = int(self._positions[slot])
+            drafts = props.get(slot, [])
+            n = len(drafts)
+            toks[slot, 0] = self._tokens[slot, 0]
+            toks[slot, 1 : 1 + n] = drafts
+            pos_a[slot] = p + np.arange(K1)
+            prop_len[slot] = n
+            # write window: rejected-draft positions hold garbage afterwards,
+            # but the next dispatch's contiguous writes overwrite any garbage
+            # position before a query can attend it (key_pos <= pos masks the
+            # rest), and columns past the budget drop their writes entirely
+            w = min(n + 1, state.remaining)
+            rid = state.request.rid
+            for j in range(w):
+                blk, off = ts.write_coords(rid, p + j)
+                wblk[slot, j] = blk
+                woff[slot, j] = off
+        with span("serve/decode"):
+            with self._rules_ctx():
+                self.cache, g_d, toks0_d, keys_d, acc_d = self._verify_jit(
+                    self.params, self.cache,
+                    jnp.asarray(toks), jnp.asarray(pos_a), jnp.asarray(self._tables),
+                    jnp.asarray(wblk), jnp.asarray(woff),
+                    jnp.asarray(self._keys), jnp.asarray(self._temps),
+                    jnp.asarray(prop_len),
+                )
+            g, toks0, keys, acc = jax.device_get((g_d, toks0_d, keys_d, acc_d))
+        now = self._now() - t0
+        active = self._decoding_count()
+        emitted_total = 0
+        proposed_total = 0
+        accepted_total = 0
+        for slot in range(S):
+            state = self._slot_states[slot]
+            if state is None or state.phase != "decode":
+                continue
+            self._keys[slot] = keys[slot]
+            p = int(self._positions[slot])
+            drafts = props.get(slot, [])
+            if drafts:
+                L = int(acc[slot])
+                e = min(L + 1, state.remaining)  # emitted run, all valid columns
+                emitted_seq = [int(g[slot, j]) for j in range(e)]
+                used = min(L, e - 1)  # drafts that actually advanced the slot
+                proposed_total += len(drafts)
+                accepted_total += used
+                trace = self._traces.get(state.request.rid)
+                if trace is not None:
+                    trace["spec_proposed"] = trace.get("spec_proposed", 0) + len(drafts)
+                    trace["spec_accepted"] = trace.get("spec_accepted", 0) + used
+            else:
+                emitted_seq = [int(toks0[slot])]
+            # replay the sequential stopping rule over the accepted run
+            n_emit = 0
+            fin = None
+            rem = state.remaining
+            for tok in emitted_seq:
+                if tok == self.eod_token_id:
+                    fin = "eod"
+                    break
+                self._emit_token(state.result, tok, now)
+                n_emit += 1
+                if rem <= 1:
+                    fin = "budget"
+                    break
+                rem -= 1
+            emitted_total += n_emit
+            if fin is not None:
+                self._finish(slot, fin, now)
+                continue
+            state.remaining = rem
+            self._remaining[slot] = rem
+            self._positions[slot] = p + n_emit
+            self._tokens[slot, 0] = emitted_seq[-1]
+        with self._stats_lock:
+            self.decode_steps += 1
+            self.verify_steps += 1
+            self._occupancy_sum += active
+            self.max_concurrent = max(self.max_concurrent, active)
+            self.decode_token_count += emitted_total
+            self.spec_proposed += proposed_total
+            self.spec_accepted += accepted_total
+        self._m_decode_steps.inc()
+        if proposed_total:
+            self._m_spec_proposed.inc(proposed_total)
+        if accepted_total:
+            self._m_spec_accepted.inc(accepted_total)
 
     def _occupancy_ratio(self) -> float:
         with self._stats_lock:
@@ -1091,6 +1438,13 @@ class ServingEngine:
             max_concurrent = self.max_concurrent
             preemptions = self.preemptions
             truncated = self.truncated_requests
+            prefix_hit_requests = self.prefix_hit_requests
+            prefix_hit_blocks = self.prefix_hit_blocks
+            prefix_hit_tokens = self.prefix_hit_tokens
+            cow_copies = self.cow_copies
+            verify_steps = self.verify_steps
+            spec_proposed = self.spec_proposed
+            spec_accepted = self.spec_accepted
         occupancy = occupancy_sum / (decode_steps * self.slots) if decode_steps else 0.0
         out = {
             "kv_cache": self.kv_cache,
@@ -1113,6 +1467,19 @@ class ServingEngine:
                 block_size=self.block_size,
                 num_blocks=self.num_blocks,
                 free_blocks=self._table_state.pool.free_count,
+                prefix_sharing=self.prefix_sharing,
+                prefix_hit_requests=prefix_hit_requests,
+                prefix_hit_blocks=prefix_hit_blocks,
+                prefix_hit_tokens=prefix_hit_tokens,
+                cow_copies=cow_copies,
+                cow_executables=self._cow_traces,
+                shared_blocks=self._table_state.pool.shared_count,
+                prefix_index_size=self._table_state.prefix_index_size,
+                spec_k=self.spec.k,
+                verify_steps=verify_steps,
+                verify_executables=self._verify_traces,
+                spec_proposed=spec_proposed,
+                spec_accepted=spec_accepted,
             )
         return out
 

@@ -44,6 +44,8 @@ class ServingComponentConfig(BaseModel):
     paged_block_size: int = 16
     paged_num_blocks: Optional[int] = None  # None = slots * table width
     paged_max_len: Optional[int] = None  # per-request ceiling; None = cache_capacity
+    prefix_sharing: Optional[bool] = None  # paged CoW prefix reuse; None = env/on
+    spec_decode: Optional[dict] = None  # {"k": int, "drafter": "ngram", ...}; None = env/off
     http_host: str = "127.0.0.1"
     http_port: Optional[int] = None  # set (0 = ephemeral) to start the HTTP front end
 
@@ -68,6 +70,8 @@ class ServingComponent:
         paged_block_size: int = 16,
         paged_num_blocks: Optional[int] = None,
         paged_max_len: Optional[int] = None,
+        prefix_sharing: Optional[bool] = None,
+        spec_decode: Optional[dict] = None,
         http_host: str = "127.0.0.1",
         http_port: Optional[int] = None,
         params=None,
@@ -86,6 +90,8 @@ class ServingComponent:
         self.paged_block_size = paged_block_size
         self.paged_num_blocks = paged_num_blocks
         self.paged_max_len = paged_max_len
+        self.prefix_sharing = prefix_sharing
+        self.spec_decode = spec_decode
         self.http_host = http_host
         self.http_port = http_port
         self.params = params
@@ -115,6 +121,8 @@ class ServingComponent:
                 paged_block_size=self.paged_block_size,
                 paged_num_blocks=self.paged_num_blocks,
                 paged_max_len=self.paged_max_len,
+                prefix_sharing=self.prefix_sharing,
+                spec_decode=self.spec_decode,
                 stop_fn=self.stop_fn,
                 mesh_handle=self.device_mesh,
             )

@@ -74,6 +74,10 @@ def ref(model, params):
 # ----------------------------------------------------------- batch invariance
 
 
+@pytest.mark.slow  # ~7 s (three sequential solo engine runs); the fast tier-1
+# pin for engine-vs-interactive bitwise equality is
+# test_mixed_concurrent_batch_matches_sequential_references (every request is
+# checked against its solo reference, including the 1-active-slot tail rounds)
 def test_single_slot_matches_interactive_path_bitwise(model, params, ref):
     """ISSUE acceptance: 1 active slot == _generate_cached, token for token,
     across greedy / sampled / temperature=None."""
@@ -236,6 +240,10 @@ def test_prefill_chunk_ladder_env_knob(monkeypatch):
 # ------------------------------------------------------------ mesh sharding
 
 
+@pytest.mark.slow  # ~4 s; the fast tier-1 pin for mesh-annotated decode
+# (NamedSharding-carrying cache leaves + bitwise tokens under dp_shard x tp) is
+# test_paged_engine.py::test_paged_mesh_decode_carries_named_shardings_and_matches
+# on the newer pool layout — the engine-side mesh plumbing is shared
 def test_mesh_sharded_decode_carries_named_shardings_and_matches(model, params, ref):
     """ISSUE acceptance: under a dp_shard x tp mesh the decode step's params and
     KV cache carry mesh NamedShardings (slots ride the batch/dp axis, kv heads

@@ -85,6 +85,10 @@ def test_paged_single_slot_matches_interactive_path_bitwise(model, params, ref):
     assert engine.stats()["decode_executables"] == 1
 
 
+@pytest.mark.slow  # ~7 s; the fast tier-1 pin for paged mixed-batch bitwise +
+# one-executable-each + pool-drained is now
+# test_prefix_sharing.py::test_prefix_sharing_forks_cow_and_stays_bitwise
+# (4 mixed greedy/sampled requests through 2 paged slots with the same asserts)
 def test_paged_mixed_batch_matches_references_one_executable_each(model, params, ref):
     """Mixed temperatures/seeds/budgets through 2 paged slots: bitwise equal to
     the solo references, ONE decode executable, ONE cross-request prefill
@@ -114,6 +118,10 @@ def test_paged_mixed_batch_matches_references_one_executable_each(model, params,
 # ------------------------------------------------------- length-ceiling lift
 
 
+@pytest.mark.slow  # ~5 s (runs a ring engine just for contrast); the fast
+# tier-1 pin for long paged decode never finishing "capacity" is
+# test_paged_budget_clamped_to_table_ceiling_never_capacity, and the
+# ring-vs-paged overflow contrast is the slow bench_serve paged-vs-ring oracle
 def test_paged_lifts_the_ring_length_ceiling(model, params, ref):
     """ISSUE acceptance: a (prompt, budget) that overflows the 32-token ring
     runs to its full budget under paged with a lifted max_len — finish reasons
@@ -261,6 +269,10 @@ def test_paged_max_len_rejected_for_absolute_poe(params):
         pytest.param("ring", 1, marks=pytest.mark.slow),
         pytest.param("paged", 0, marks=pytest.mark.slow),
         ("paged", 1),  # seed 1 shrinks the pool to 8 blocks -> forces preemption
+        # seed 2 layers serving v3 onto the same invariants: half the prompts
+        # share an 8-token prefix (2 full blocks -> refcount forking) and the
+        # n-gram drafter speculates (k=2) over the mixed greedy/sampled trace
+        ("paged", 2),
     ],
 )
 def test_scheduler_property_randomized(model, params, kv_cache, case_seed):
@@ -277,19 +289,30 @@ def test_scheduler_property_randomized(model, params, kv_cache, case_seed):
     slots = int(rng.integers(2, 4))
     kwargs = dict(max_batch_slots=slots, time_fn=clock)
     if kv_cache == "paged":
-        # seed 1 squeezes the pool to force preemptions mid-trace
+        # seed 1 squeezes the pool to force preemptions mid-trace; seed 2 runs
+        # serving v3 (prefix forking + speculation) under a mid-size pool
         kwargs.update(kv_cache="paged", paged_block_size=4, paged_max_len=24,
                       paged_num_blocks=24 if case_seed == 0 else 8)
+        if case_seed == 2:
+            kwargs.update(paged_num_blocks=12, spec_decode={"k": 2})
     engine = ServingEngine(model, params, **kwargs)
 
+    shared = [int(x) for x in rng.integers(0, 127, size=8)]  # 2 full blocks
     t = 0.0
     budgets = {}
     for i in range(int(rng.integers(6, 11))):
-        t += float(rng.exponential(0.05))
+        # seed 2 packs arrivals tight so later sharers queue behind busy slots
+        # and admit AFTER the donor's registration (sharing is temporal)
+        t += float(rng.exponential(0.05 if case_seed != 2 else 0.005))
         plen = int(rng.integers(1, 13))
         budget = int(rng.integers(1, 9))
+        prompt = [int(x) for x in rng.integers(0, 127, size=plen)]
+        if case_seed == 2 and (i == 0 or rng.random() < 0.5):
+            prompt = shared + prompt[:4]  # candidate for a prefix-index hit
+            if i == 0:
+                budget = 12  # donor fills max_len: resident while sharers land
         rid = engine.submit(
-            [int(x) for x in rng.integers(0, 127, size=plen)],
+            prompt,
             budget,
             temperature=float(rng.choice([0.0, 0.8])),
             seed=i,
@@ -304,15 +327,25 @@ def test_scheduler_property_randomized(model, params, kv_cache, case_seed):
         assert result.finish_reason in legal, (rid, result.finish_reason)
         assert len(result.tokens) <= budgets[rid]
         assert len(result.token_times_s) == len(result.tokens)
-    # no slot leak; occupancy bookkeeping == dispatched decode tokens
+    # no slot leak; occupancy bookkeeping == dispatched decode tokens (a spec
+    # verify round can emit several accepted tokens per occupied slot, so the
+    # 1:1 equality only holds with speculation off)
     assert all(s is None for s in engine._slot_states)
-    assert engine._occupancy_sum == engine.decode_token_count
+    if not engine.spec.enabled:
+        assert engine._occupancy_sum == engine.decode_token_count
     stats = engine.stats()
     assert 0.0 < stats["slot_occupancy"] <= 1.0
     if kv_cache == "paged":
         engine._table_state.check()  # block audit: free + owned tile the pool
         assert stats["free_blocks"] == stats["num_blocks"]
         assert engine._table_state.active_requests() == []
+    if case_seed == 2 and kv_cache == "paged":
+        # the v3 machinery actually engaged on this trace (deterministic rng):
+        # forked admissions and scored proposals, with coherent counters
+        assert stats["prefix_hit_requests"] >= 1
+        assert stats["shared_blocks"] == 0 and stats["prefix_index_size"] == 0
+        assert 0 <= stats["spec_accepted"] <= stats["spec_proposed"]
+        assert stats["verify_executables"] <= 1
     if stats["preemptions"] == 0:
         # FIFO: earlier rids (arrivals are non-decreasing) start no later
         firsts = [results[r].first_token_s for r in sorted(results)]

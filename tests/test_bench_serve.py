@@ -53,6 +53,45 @@ def test_bench_serve_full_run_hits_speedup_oracle():
         assert isinstance(out[key], float), (key, out)
 
 
+@pytest.mark.slow  # two paged runs over the same 32-request trace (~2 min CPU)
+def test_bench_serve_prefix_sharing_oracle():
+    """ISSUE PR-11 acceptance: with half of every prompt shared (F=0.5), prefix
+    forking admits matched requests onto existing blocks and the chunked
+    prefill runs only on unmatched tails — >= 40% fewer prefill chunks than the
+    same trace with fully distinct prompts (F=0), with a clean pool audit.
+    Chunk counts are scheduling-deterministic at --rate 0 (no wall-clock
+    dependence), so no retry loop is needed."""
+    common = ("--requests", "32", "--slots", "2", "--rate", "0", "--max-new", "8")
+    f05 = _run(*common, "--shared_prefix_frac", "0.5", timeout=300)
+    f00 = _run(*common, "--shared_prefix_frac", "0.0", timeout=300)
+    assert f05["cache"] == "paged" and f00["cache"] == "paged"
+    assert f05["pool_audit"] == "ok" and f00["pool_audit"] == "ok"
+    assert f00["prefix_hit_requests"] == 0
+    assert f05["prefix_hit_requests"] > 0
+    assert f05["prefill_tokens_saved"] > 0
+    assert f05["prefill_chunks_skipped"] > 0
+    # the tentpole number: shared prefixes cut prefill work by >= 40%
+    assert f05["prefill_chunks"] <= 0.6 * f00["prefill_chunks"], (f05, f00)
+
+
+@pytest.mark.slow  # spec run + spec-off baseline on one trace (~2 min CPU)
+def test_bench_serve_spec_decode_oracle():
+    """ISSUE PR-11 acceptance: prompt-lookup speculation on a repetitive greedy
+    workload reaches >= 1.3x the spec-off tokens/s at the SAME slot count,
+    emitting bitwise-identical tokens (greedy spec decode is exact, never
+    lossy), with a clean pool audit."""
+    out = _run(
+        "--requests", "12", "--slots", "4", "--rate", "0", "--repetitive",
+        "--spec", "4", "--max-new", "24", timeout=420,
+    )
+    assert out["cache"] == "paged" and out["spec_k"] == 4
+    assert out["spec_tokens_match"] is True  # bitwise vs the spec-off engine
+    assert out["spec_proposed"] > 0
+    assert 0.0 < out["spec_acceptance"] <= 1.0
+    assert out["pool_audit"] == "ok"
+    assert out["speedup"] >= 1.3, out
+
+
 @pytest.mark.slow  # two full runs with baselines (four engines, ~3 min CPU)
 def test_bench_serve_paged_vs_ring_oracle():
     """ISSUE PR-9 acceptance: on the same trace with --long overflow requests,
