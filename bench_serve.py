@@ -46,6 +46,15 @@ Serving-v3 knobs (both imply --cache paged):
                            the n-gram drafter nails repetitive continuations)
 After every paged run the block-pool invariant audit runs (`pool_audit: "ok"`
 in the JSON line) — a leak or refcount tear fails the bench, not just a test.
+
+Fleet serving knob (PR 12):
+  --hot_swap_every N       hot-swap IDENTICAL weights (freshly copied device
+                           arrays) every N decode steps mid-flight, then replay
+                           the same trace swap-free and assert token-bitwise
+                           equality plus an unchanged decode executable count —
+                           the zero-drop/zero-recompile oracle. Reports
+                           `hot_swaps`, swap latency percentiles, and requests
+                           in flight during swaps.
 """
 
 import argparse
@@ -84,6 +93,12 @@ METRIC_KEYS = (
     "spec_acceptance",
     "spec_tokens_match",
     "pool_audit",
+    # hot weight swaps (--hot_swap_every; None otherwise)
+    "hot_swaps",
+    "swap_latency_ms_p50",
+    "swap_latency_ms_max",
+    "swap_in_flight_mean",
+    "swap_tokens_match",
 )
 
 
@@ -247,6 +262,42 @@ def _replay(engine, trace, arrivals: bool):
     return [results[r] for r in rids], wall
 
 
+def _replay_with_swaps(engine, trace, params, every: int):
+    """The --hot_swap_every driver: engine.run()'s loop inlined, with a hot
+    weight swap (identical values, freshly copied device arrays — a REAL
+    transfer, not an alias) installed every `every` decode steps, mid-flight.
+    The swap-free twin run must match this one token-bitwise: swapping changes
+    the plumbing, never the tokens."""
+    import jax
+
+    params_copy = jax.tree.map(lambda x: x.copy(), params)
+    t0 = engine._now()
+    rids = [
+        engine.submit(
+            r["prompt"], r["max_new_tokens"], temperature=r["temperature"],
+            seed=r["seed"], arrival_offset_s=r["arrival_offset_s"],
+        )
+        for r in trace
+    ]
+    swap_records = []
+    next_swap = engine.decode_steps + every  # decode_steps carries warmup steps
+    while True:
+        if not engine._queue and engine._active_count() == 0:
+            break
+        did = engine.step(t0)
+        if engine.decode_steps >= next_swap:
+            swap_records.append(engine.swap_weights(params_copy))
+            next_swap = engine.decode_steps + every
+        if not did:
+            if not engine._queue:
+                break
+            wait = engine._queue[0].arrival_offset_s - (engine._now() - t0)
+            if wait > 0:
+                time.sleep(min(wait, 0.05))
+    wall = engine._now() - t0
+    return [engine._results[r] for r in rids], wall, swap_records
+
+
 def _percentiles_ms(values):
     import numpy as np
 
@@ -291,7 +342,15 @@ def main() -> int:
         "--repetitive", action="store_true",
         help="all-greedy periodic prompts (acceptance-friendly spec workload)",
     )
+    parser.add_argument(
+        "--hot_swap_every", type=int, default=0,
+        help="hot-swap identical weights every N decode steps mid-flight and "
+        "oracle the output against a swap-free twin run (token-bitwise); "
+        "reports swap latency and requests in flight during swaps",
+    )
     args = parser.parse_args()
+    if args.hot_swap_every < 0:
+        parser.error("--hot_swap_every must be >= 0")
     if args.smoke:
         args.requests, args.slots, args.max_new = 6, 2, 6
     if args.shared_prefix_frac is not None and not (0.0 <= args.shared_prefix_frac <= 1.0):
@@ -359,7 +418,13 @@ def main() -> int:
     warmup(engine)
     engine.metrics.reset()  # compile-window samples stay out of the scrape
     warm_tokens = engine.decode_token_count
-    results, wall = _replay(engine, trace, arrivals=True)
+    swap_records = []
+    if args.hot_swap_every:
+        results, wall, swap_records = _replay_with_swaps(
+            engine, trace, params, args.hot_swap_every
+        )
+    else:
+        results, wall = _replay(engine, trace, arrivals=True)
     generated = sum(len(r.tokens) for r in results)
     # throughput counts ALL emitted tokens (prefill-sampled first tokens included)
     tokens_per_s = generated / wall if wall > 0 else 0.0
@@ -436,6 +501,31 @@ def main() -> int:
         assert stats["free_blocks"] == stats["num_blocks"], "blocks leaked"
         v3["pool_audit"] = "ok"
 
+    hot = {}
+    if args.hot_swap_every:
+        import numpy as np
+
+        # the oracle twin: identical trace, zero swaps — the tokens must match
+        # bitwise (the swap installs identical values, so any divergence is a
+        # swap-path bug, e.g. a recompile sampling down a different trace)
+        twin = fresh_engine(args.slots, spec_k=args.spec)
+        warmup(twin)
+        twin_results, _ = _replay(twin, trace, arrivals=True)
+        tokens_match = all(
+            a.tokens == b.tokens for a, b in zip(results, twin_results)
+        )
+        latencies_ms = [r["latency_s"] * 1000.0 for r in swap_records]
+        hot = {
+            "hot_swaps": len(swap_records),
+            "swap_latency_ms_p50": float(np.percentile(latencies_ms, 50)) if latencies_ms else None,
+            "swap_latency_ms_max": max(latencies_ms) if latencies_ms else None,
+            "swap_in_flight_mean": float(np.mean([r["in_flight"] for r in swap_records]))
+            if swap_records else None,
+            "swap_tokens_match": tokens_match,
+        }
+        assert tokens_match, "hot swap changed the tokens"
+        assert stats["decode_executables"] == 1, "hot swap recompiled the decode step"
+
     baseline_tokens_per_s = None
     speedup = None
     if args.spec > 0:
@@ -479,6 +569,7 @@ def main() -> int:
                 "preemptions": stats.get("preemptions", 0),
                 "truncated_requests": stats.get("truncated_requests", 0),
                 **v3,
+                **hot,
                 "cache": args.cache,
                 "requests": args.requests,
                 "long_requests": args.long,

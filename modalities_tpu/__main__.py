@@ -219,17 +219,30 @@ def entry_point_generate_text(config_file_path: Path) -> None:
     help="Start the streaming HTTP front end (SSE POST /generate, GET /healthz, GET /stats) "
     "on this port (0 = ephemeral) instead of replay/interactive; SIGTERM drains gracefully.",
 )
+@click.option(
+    "--fleet",
+    is_flag=True,
+    default=False,
+    help="Fleet mode (serving/fleet/): N engine workers behind a load-balancing router, "
+    "with checkpoint-watcher hot swaps and canary rollouts; the config's "
+    "serving_component.variant_key must be 'fleet' (configs/config_fleet.yaml). "
+    "--http_port sets the ROUTER port.",
+)
 @_exception_handling
 def entry_point_serve(
     config_file_path: Path,
     requests_file_path: Optional[Path],
     output_file_path: Optional[Path],
     http_port: Optional[int],
+    fleet: bool,
 ) -> None:
     """Continuous-batching text serving (serving/engine.py) from a sealed checkpoint."""
     from modalities_tpu.api import serve_text
 
-    serve_text(config_file_path, requests_file_path, output_file_path, http_port=http_port)
+    serve_text(
+        config_file_path, requests_file_path, output_file_path,
+        http_port=http_port, fleet=fleet,
+    )
 
 
 @main.command(name="convert_checkpoint_to_hf")

@@ -231,10 +231,12 @@ def serve_text(
     requests_file_path: Path | None = None,
     output_file_path: Path | None = None,
     http_port: int | None = None,
+    fleet: bool = False,
 ) -> None:
     """Config-driven continuous-batching serving (serving/serve.py): streaming
     HTTP front end (`http_port`, SSE /generate), replay of a JSONL request file,
-    or the interactive loop when neither is given."""
+    or the interactive loop when neither is given. `fleet=True` (with a
+    fleet-variant config) boots the router/worker/watcher tier instead."""
     from modalities_tpu.serving.serve import serve
 
     serve(
@@ -242,4 +244,5 @@ def serve_text(
         Path(requests_file_path) if requests_file_path else None,
         Path(output_file_path) if output_file_path else None,
         http_port=http_port,
+        fleet=fleet,
     )

@@ -131,6 +131,22 @@ def summarize_serve(records: list[dict]) -> dict:
         }
     spec_proposed = sum(int(rec.get("spec_proposed") or 0) for rec in records)
     spec_accepted = sum(int(rec.get("spec_accepted") or 0) for rec in records)
+    # fleet hot swaps: per-weights-generation breakdown (requests, errors,
+    # TTFT p50) — the offline view of a canary's probation window
+    generations: dict[int, dict] = {}
+    for rec in records:
+        gen = int(rec.get("weights_generation") or 0)
+        bucket = generations.setdefault(
+            gen, {"requests": 0, "errors": 0, "_ttfts": []}
+        )
+        bucket["requests"] += 1
+        if rec.get("finish_reason") == "error":
+            bucket["errors"] += 1
+        if rec.get("ttft_s") is not None:
+            bucket["_ttfts"].append(float(rec["ttft_s"]))
+    for bucket in generations.values():
+        ttfts = sorted(bucket.pop("_ttfts"))
+        bucket["ttft_p50_s"] = _quantile(ttfts, 0.5) if ttfts else None
     return {
         "requests": len(records),
         "finish_reasons": dict(sorted(reasons.items())),
@@ -146,6 +162,8 @@ def summarize_serve(records: list[dict]) -> dict:
         "spec_proposed": spec_proposed,
         "spec_accepted": spec_accepted,
         "spec_acceptance": (spec_accepted / spec_proposed) if spec_proposed else None,
+        # fleet hot swaps: which weights generation served each request
+        "generations": {gen: generations[gen] for gen in sorted(generations)},
         "latency": latency,
         "occupancy_timeline": _occupancy_timeline(records),
     }
@@ -172,6 +190,14 @@ def format_serve_table(summary: dict) -> str:
     lines += ["", "finish reasons:"]
     for reason, count in summary["finish_reasons"].items():
         lines.append(f"  {reason:<10} {count}")
+    generations = summary.get("generations") or {}
+    if len(generations) > 1 or any(int(g) != 0 for g in generations):
+        lines += ["", f"{'weights gen':<12} {'requests':>9} {'errors':>7} {'ttft_p50':>9}"]
+        for gen, row in generations.items():
+            ttft = f"{row['ttft_p50_s']:.4f}" if row.get("ttft_p50_s") is not None else "-"
+            lines.append(
+                f"{gen:<12} {row['requests']:>9} {row['errors']:>7} {ttft:>9}"
+            )
     lines += ["", f"{'latency':<14} {'n':>5} {'mean':>9} {'p50':>9} {'p95':>9} {'p99':>9}"]
     for field, label in LATENCY_FIELDS:
         row = summary["latency"].get(field)

@@ -144,8 +144,9 @@ class ServeRequest:
 class ServeResult:
     rid: int
     tokens: list[int] = field(default_factory=list)
-    finish_reason: str = ""  # "eod" | "budget" | "capacity"
+    finish_reason: str = ""  # "eod" | "budget" | "capacity" | "error"
     prompt_len: int = 0
+    weights_generation: int = 0  # generation serving when the request finished
     truncated: bool = False  # prompt window-clipped at admission
     prefix_hit_tokens: int = 0  # prompt tokens served from shared blocks (v3)
     arrival_s: float = 0.0  # engine-clock arrival
@@ -351,6 +352,16 @@ class ServingEngine:
         # tear (decode_tokens without its decode_steps)
         self._stats_lock = threading.Lock()
 
+        # fleet hot swap (PR 12): request_swap() queues new params from any
+        # thread; step() installs them at the next token boundary. Generation
+        # tags every finished result/trace; swap_history feeds the bench report.
+        self.weights_generation = 0
+        self.weight_swaps = 0
+        self.request_errors = 0  # finishes with reason "error" (non-finite logits)
+        self.swap_history: list[dict] = []
+        self._swap_lock = threading.Lock()
+        self._pending_swap: Optional[tuple] = None
+
         # request-lifecycle tracing (PR 10): per-rid monotonic event streams,
         # flushed as one `serve_request` JSONL record at finish; a preempted
         # request keeps its stream across requeue/replay
@@ -422,6 +433,17 @@ class ServingEngine:
         self._m_spec_accepted = reg.counter(
             "serve_spec_accepted_total", "Draft tokens accepted by the spec-decode verifier"
         )
+        self._m_swaps = reg.counter(
+            "serve_weight_swaps_total", "Hot weight swaps installed by the engine"
+        )
+        self._m_req_errors = reg.counter(
+            "serve_request_errors_total",
+            "Requests finished with reason=error (non-finite logits)",
+        )
+        self._m_generation = reg.gauge(
+            "serve_weights_generation", "Weights generation currently installed"
+        )
+        self._m_generation.set(0)
         if self.kv_cache == "paged":
             reg.gauge(
                 "serve_paged_free_blocks", "Free blocks in the paged KV pool"
@@ -511,6 +533,89 @@ class ServingEngine:
 
         return activation_rules(self._rules, self._mesh_handle.mesh)
 
+    # ------------------------------------------------------------------ hot swap
+    def request_swap(self, params, generation: Optional[int] = None) -> threading.Event:
+        """Queue a weight swap from ANY thread; the engine thread installs it at
+        the next step() boundary (between decode dispatches — never mid-token).
+        Returns an event set once the swap is installed. Only the latest pending
+        swap survives: a superseded one has its event set without installing."""
+        done = threading.Event()
+        with self._swap_lock:
+            if self._pending_swap is not None:
+                self._pending_swap[2].set()
+            self._pending_swap = (params, generation, done)
+        return done
+
+    def _maybe_apply_swap(self) -> None:
+        with self._swap_lock:
+            pending, self._pending_swap = self._pending_swap, None
+        if pending is None:
+            return
+        params, generation, done = pending
+        try:
+            self.swap_weights(params, generation)
+        finally:
+            done.set()
+
+    def swap_weights(self, params, generation: Optional[int] = None) -> dict:
+        """Install new params between decode steps — the hot half of the fleet
+        deployment loop (serving/fleet/). Zero dropped requests: slot state,
+        KV cache and queue are untouched, in-flight requests simply continue
+        under the new weights. Zero recompiles: every leaf is device_put onto
+        the OLD leaf's sharding after an aval check, so the pinned decode/
+        prefill/verify executables see identical (shape, dtype, sharding)
+        arguments. The prefix-sharing index is flushed — resident KV was
+        computed under the old weights and must not be forked into
+        new-generation requests (live holders keep their blocks).
+
+        `generation` may move backward (canary rollback re-installs the donor
+        generation). Call from the engine thread; other threads go through
+        request_swap()."""
+        import jax
+
+        start = self._now()
+        gen = int(generation) if generation is not None else self.weights_generation + 1
+        old_leaves, old_def = jax.tree.flatten(self.params)
+        new_leaves, new_def = jax.tree.flatten(params)
+        if old_def != new_def:
+            raise ValueError(
+                f"swap_weights: param tree changed ({new_def} != {old_def}) — a hot "
+                "swap must keep the architecture identical"
+            )
+        placed = []
+        for old, new in zip(old_leaves, new_leaves):
+            if (old.shape, old.dtype) != (new.shape, new.dtype):
+                raise ValueError(
+                    f"swap_weights: leaf {new.shape}/{new.dtype} does not match the "
+                    f"installed {old.shape}/{old.dtype} — identical avals are what "
+                    "keep the ONE decode executable warm"
+                )
+            sharding = getattr(old, "sharding", None)
+            placed.append(
+                jax.device_put(new, sharding) if sharding is not None else jax.device_put(new)
+            )
+        jax.block_until_ready(placed)
+        in_flight = self._active_count()
+        flushed = 0
+        if self._table_state is not None and self.prefix_sharing:
+            flushed = self._table_state.flush_prefix_index()
+        self.params = jax.tree.unflatten(old_def, placed)
+        self.weights_generation = gen
+        latency = self._now() - start
+        with self._stats_lock:
+            self.weight_swaps += 1
+        self._m_swaps.inc()
+        self._m_generation.set(gen)
+        record = {
+            "generation": gen,
+            "latency_s": latency,
+            "in_flight": in_flight,
+            "prefix_entries_flushed": flushed,
+        }
+        self.swap_history.append(record)
+        get_active_telemetry().emit_event("serve/weight_swap", dict(record))
+        return record
+
     # ---------------------------------------------------------------- jitted fns
     def _build_jits(self) -> None:
         import jax
@@ -552,7 +657,10 @@ class ServingEngine:
             # non-greedy) — exactly the interactive path's key-split discipline
             new_key = jnp.where(sample_flag & ~greedy, ks[0], key)
             tok = jnp.where(sample_flag, tok, jnp.int32(-1))
-            return _constrain_cache(cache), tok, new_key
+            # canary gating (PR 12): a non-finite logits row marks the request
+            # "error" on the host — NaN weights regress serve_request_errors_total
+            ok = jnp.isfinite(last).all()
+            return _constrain_cache(cache), tok, new_key, ok
 
         def decode_fn(params, cache, tokens, positions, keys, temps, eods, remaining):
             engine._decode_traces += 1  # must stay 1: ONE executable for the whole trace
@@ -562,7 +670,8 @@ class ServingEngine:
             # per-slot stopping folded into the step: eod never emits, budget
             # emits its last token then stops — the host only reads flags
             finished = (toks == eods) | (remaining <= 1)
-            return _constrain_cache(cache), toks, new_keys, finished
+            ok = jnp.isfinite(rows).all(axis=-1)
+            return _constrain_cache(cache), toks, new_keys, finished, ok
 
         def paged_prefill_fn(
             params, cache, tokens, pos, tables, wblk, woff, last_idx, keys, temps, flags
@@ -576,7 +685,8 @@ class ServingEngine:
             toks, new_keys = jax.vmap(samp)(keys, rows, temps)
             toks = jnp.where(flags, toks, jnp.int32(-1))
             new_keys = jnp.where(flags[:, None], new_keys, keys)
-            return _constrain_cache(cache), toks, new_keys
+            ok = jnp.isfinite(rows).all(axis=-1)
+            return _constrain_cache(cache), toks, new_keys, ok
 
         def paged_decode_fn(
             params, cache, tokens, positions, tables, wblk, woff, keys, temps, eods, remaining
@@ -588,7 +698,8 @@ class ServingEngine:
             rows = logits[:, 0, :]  # [slots, V]
             toks, new_keys = jax.vmap(samp)(keys, rows, temps)
             finished = (toks == eods) | (remaining <= 1)
-            return _constrain_cache(cache), toks, new_keys, finished
+            ok = jnp.isfinite(rows).all(axis=-1)
+            return _constrain_cache(cache), toks, new_keys, finished, ok
 
         spec_k = self.spec.k
 
@@ -611,7 +722,10 @@ class ServingEngine:
                 jnp.arange(spec_k)[None, :] < prop_len[:, None]
             )
             acc = jnp.cumprod(match.astype(jnp.int32), axis=1).sum(axis=1)  # [S]
-            return _constrain_cache(cache), g, toks0, new_keys, acc
+            # column 0 only: trailing columns past the valid window are fully
+            # masked and legitimately non-finite; NaN WEIGHTS poison column 0 too
+            ok = jnp.isfinite(logits[:, 0, :]).all(axis=-1)
+            return _constrain_cache(cache), g, toks0, new_keys, acc, ok
 
         def cow_fn(cache, src, dst):
             # copy-on-write: duplicate pool row `src` into the freshly
@@ -711,6 +825,7 @@ class ServingEngine:
                 "tokens": len(result.tokens),
                 "finish_reason": result.finish_reason,
                 "truncated": result.truncated,
+                "weights_generation": result.weights_generation,
                 "prefix_hit_tokens": result.prefix_hit_tokens,
                 "spec_proposed": trace.get("spec_proposed", 0),
                 "spec_accepted": trace.get("spec_accepted", 0),
@@ -746,6 +861,11 @@ class ServingEngine:
     def _record_result(self, result: ServeResult, reason: str, now: float) -> None:
         result.finish_reason = reason
         result.finish_s = now
+        result.weights_generation = self.weights_generation
+        if reason == "error":
+            with self._stats_lock:
+                self.request_errors += 1
+            self._m_req_errors.inc()
         self._results[result.rid] = result
         self._streamed.pop(result.rid, None)
         self._trace_event(
@@ -841,7 +961,7 @@ class ServingEngine:
                         toks = np.asarray([window[pos : pos + chunk]], dtype=np.int32)
                         is_last = pos + chunk >= len(window)
                         with self._rules_ctx():
-                            self.cache, tok, key = self._prefill_jit(
+                            self.cache, tok, key, ok = self._prefill_jit(
                                 self.params, self.cache, jnp.asarray(toks),
                                 np.int32(slot), np.int32(pos), key,
                                 np.float32(temp), np.bool_(is_last),
@@ -854,6 +974,9 @@ class ServingEngine:
                 first_tok = int(tok)  # device sync: the request's TTFT point
                 now2 = self._now() - t0
                 result.first_token_s = now2
+                if not bool(ok):  # non-finite logits: no token to trust
+                    self._finish_immediate(result, "error", now2)
+                    continue
                 self._record_first_token(result, now2)
                 if first_tok == self.eod_token_id:
                     self._finish_immediate(result, "eod", now2)
@@ -1101,13 +1224,13 @@ class ServingEngine:
 
         with span("serve/prefill"):
             with self._rules_ctx():
-                self.cache, toks_d, keys_d = self._prefill_jit(
+                self.cache, toks_d, keys_d, ok_d = self._prefill_jit(
                     self.params, self.cache,
                     jnp.asarray(toks), jnp.asarray(pos_a), jnp.asarray(tables),
                     jnp.asarray(wblk), jnp.asarray(woff), jnp.asarray(last_idx),
                     jnp.asarray(keys), jnp.asarray(temps), jnp.asarray(flags),
                 )
-            out_toks, out_keys = jax.device_get((toks_d, keys_d))
+            out_toks, out_keys, out_ok = jax.device_get((toks_d, keys_d, ok_d))
 
         now = self._now() - t0
         self._m_prefill_chunks.inc(len(rows))
@@ -1121,6 +1244,12 @@ class ServingEngine:
                 continue
             req, result = state.request, state.result
             wl = len(state.window)
+            if not bool(out_ok[r]):
+                # non-finite first-token row: finish "error" and NEVER publish
+                # this request's blocks into the prefix index
+                result.first_token_s = now
+                self._finish(slot, "error", now)
+                continue
             if self.prefix_sharing:
                 # prompt fully resident: publish the full PROMPT blocks into
                 # the prefix index (first writer wins — forked/CoW duplicates
@@ -1183,7 +1312,7 @@ class ServingEngine:
         with span("serve/decode"):
             with self._rules_ctx():
                 if self.kv_cache == "paged":
-                    self.cache, toks_d, keys_d, fin_d = self._decode_jit(
+                    self.cache, toks_d, keys_d, fin_d, ok_d = self._decode_jit(
                         self.params, self.cache,
                         jnp.asarray(self._tokens), jnp.asarray(self._positions),
                         jnp.asarray(self._tables), jnp.asarray(self._wblk),
@@ -1192,13 +1321,13 @@ class ServingEngine:
                         jnp.asarray(self._eods), jnp.asarray(self._remaining),
                     )
                 else:
-                    self.cache, toks_d, keys_d, fin_d = self._decode_jit(
+                    self.cache, toks_d, keys_d, fin_d, ok_d = self._decode_jit(
                         self.params, self.cache,
                         jnp.asarray(self._tokens), jnp.asarray(self._positions),
                         jnp.asarray(self._keys), jnp.asarray(self._temps),
                         jnp.asarray(self._eods), jnp.asarray(self._remaining),
                     )
-            toks, keys, finished = jax.device_get((toks_d, keys_d, fin_d))
+            toks, keys, finished, ok = jax.device_get((toks_d, keys_d, fin_d, ok_d))
         now = self._now() - t0
         active = self._decoding_count()
         emitted = 0
@@ -1209,6 +1338,9 @@ class ServingEngine:
             self._positions[slot] += 1  # the fed token landed in the cache
             tok = int(toks[slot])
             self._keys[slot] = keys[slot]
+            if not bool(ok[slot]):  # non-finite logits: the token is garbage
+                self._finish(slot, "error", now)
+                continue
             if tok == self.eod_token_id:
                 self._finish(slot, "eod", now)
                 continue
@@ -1297,14 +1429,14 @@ class ServingEngine:
                 woff[slot, j] = off
         with span("serve/decode"):
             with self._rules_ctx():
-                self.cache, g_d, toks0_d, keys_d, acc_d = self._verify_jit(
+                self.cache, g_d, toks0_d, keys_d, acc_d, ok_d = self._verify_jit(
                     self.params, self.cache,
                     jnp.asarray(toks), jnp.asarray(pos_a), jnp.asarray(self._tables),
                     jnp.asarray(wblk), jnp.asarray(woff),
                     jnp.asarray(self._keys), jnp.asarray(self._temps),
                     jnp.asarray(prop_len),
                 )
-            g, toks0, keys, acc = jax.device_get((g_d, toks0_d, keys_d, acc_d))
+            g, toks0, keys, acc, ok = jax.device_get((g_d, toks0_d, keys_d, acc_d, ok_d))
         now = self._now() - t0
         active = self._decoding_count()
         emitted_total = 0
@@ -1315,6 +1447,9 @@ class ServingEngine:
             if state is None or state.phase != "decode":
                 continue
             self._keys[slot] = keys[slot]
+            if not bool(ok[slot]):  # non-finite logits: nothing here is a token
+                self._finish(slot, "error", now)
+                continue
             p = int(self._positions[slot])
             drafts = props.get(slot, [])
             if drafts:
@@ -1382,6 +1517,7 @@ class ServingEngine:
         on an idle one — a wedged prefill/decode produces a `watchdog_dump_*`
         artifact with the engine's stats in its state section."""
         telemetry = get_active_telemetry()
+        self._maybe_apply_swap()  # token boundary: install any queued weight swap
         armed = bool(self._queue) or self._active_count() > 0
         if armed:
             self._dispatch_seq += 1
@@ -1445,6 +1581,8 @@ class ServingEngine:
             verify_steps = self.verify_steps
             spec_proposed = self.spec_proposed
             spec_accepted = self.spec_accepted
+            weight_swaps = self.weight_swaps
+            request_errors = self.request_errors
         occupancy = occupancy_sum / (decode_steps * self.slots) if decode_steps else 0.0
         out = {
             "kv_cache": self.kv_cache,
@@ -1460,6 +1598,9 @@ class ServingEngine:
             "truncated_requests": truncated,
             "queue_depth": len(self._queue),
             "active_slots": self._active_count(),
+            "weights_generation": self.weights_generation,
+            "weight_swaps": weight_swaps,
+            "request_errors": request_errors,
         }
         if self.kv_cache == "paged":
             out.update(

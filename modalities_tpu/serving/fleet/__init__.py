@@ -1,0 +1,27 @@
+"""Fleet serving: the train→serve continuous-deployment subsystem (PR 12).
+
+Three cooperating pieces close ROADMAP item 3's loop:
+
+- `watcher.CheckpointWatcher` — polls a training checkpoint ring for newly
+  SEALED checkpoints (manifest presence + clean verification), loads them via
+  the shared `load_serving_params` path, and hands params to a deploy callback.
+- `controller.RolloutController` + `controller.EngineWorker` — canary rollouts:
+  swap ONE worker to the next generation, watch its error/TTFT metrics against
+  the fleet for a probation window, then promote to every worker or roll the
+  canary back to the donor generation.
+- `router.FleetRouter` — asyncio HTTP front tier that load-balances
+  `POST /generate` across workers (least-loaded), health-checks them with
+  heartbeat deadlines, and retries a mid-stream dead worker on a peer.
+"""
+
+from modalities_tpu.serving.fleet.controller import EngineWorker, RolloutController
+from modalities_tpu.serving.fleet.router import FleetRouter, WorkerHandle
+from modalities_tpu.serving.fleet.watcher import CheckpointWatcher
+
+__all__ = [
+    "CheckpointWatcher",
+    "EngineWorker",
+    "FleetRouter",
+    "RolloutController",
+    "WorkerHandle",
+]

@@ -1,0 +1,221 @@
+"""`serve --fleet` glue: the DI component that boots the whole fleet — N
+in-process engine workers (each with its own MetricsRegistry and asyncio HTTP
+front end on a loopback port), the load-balancing router tier, the canary
+rollout controller, and (when a ring path is configured) the checkpoint
+watcher thread that closes the train→serve loop.
+
+Config surface is `configs/config_fleet.yaml`: the `inference_component.fleet`
+variant extends the `serve` variant's schema with the fleet knobs below; the
+time-window ones fall back to the ``MODALITIES_TPU_FLEET_POLL_S`` /
+``MODALITIES_TPU_FLEET_PROBATION_S`` / ``MODALITIES_TPU_FLEET_HEALTH_DEADLINE_S``
+environment variables (see watcher/controller/router modules)."""
+
+from __future__ import annotations
+
+import logging
+import time
+from pathlib import Path
+from typing import Optional
+
+from modalities_tpu.serving.serve import ServingComponent, ServingComponentConfig
+
+logger = logging.getLogger(__name__)
+
+
+class FleetComponentConfig(ServingComponentConfig):
+    """Schema of the `serving_component` node in configs/config_fleet.yaml."""
+
+    num_workers: int = 2
+    watch_ring_path: Optional[Path] = None  # training checkpoint ring to watch
+    watch_poll_s: Optional[float] = None  # None = MODALITIES_TPU_FLEET_POLL_S / 5s
+    probation_s: Optional[float] = None  # None = MODALITIES_TPU_FLEET_PROBATION_S / 30s
+    probation_tick_s: float = 0.25
+    max_error_delta: int = 0  # canary request_errors allowed during probation
+    ttft_regression_factor: float = 2.0  # canary mean TTFT ceiling vs fleet mean
+    health_interval_s: float = 0.5
+    heartbeat_deadline_s: Optional[float] = None  # None = ..._HEALTH_DEADLINE_S / 5s
+
+
+class FleetServingComponent(ServingComponent):
+    """ServingComponent whose run mode is a worker fleet behind a router."""
+
+    def __init__(
+        self,
+        *args,
+        num_workers: int = 2,
+        watch_ring_path: Optional[Path] = None,
+        watch_poll_s: Optional[float] = None,
+        probation_s: Optional[float] = None,
+        probation_tick_s: float = 0.25,
+        max_error_delta: int = 0,
+        ttft_regression_factor: float = 2.0,
+        health_interval_s: float = 0.5,
+        heartbeat_deadline_s: Optional[float] = None,
+        **kwargs,
+    ):
+        super().__init__(*args, **kwargs)
+        self.num_workers = int(num_workers)
+        self.watch_ring_path = Path(watch_ring_path) if watch_ring_path else None
+        self.watch_poll_s = watch_poll_s
+        self.probation_s = probation_s
+        self.probation_tick_s = probation_tick_s
+        self.max_error_delta = max_error_delta
+        self.ttft_regression_factor = ttft_regression_factor
+        self.health_interval_s = health_interval_s
+        self.heartbeat_deadline_s = heartbeat_deadline_s
+        self._boot_step = -1  # ring step the initial params came from
+
+    # ------------------------------------------------------------ param boot
+    def resolve_params(self, checkpoint_folder_path) -> None:
+        """Initial generation: explicit checkpoint > newest sealed ring folder
+        > fresh init. The ring bootstrap records its step so the watcher does
+        not immediately redeploy the weights it booted from."""
+        from modalities_tpu.resilience.manifest import _seen_steps_of
+        from modalities_tpu.serving.fleet.watcher import CheckpointWatcher
+        from modalities_tpu.serving.serve import _resolve_params, load_serving_params
+
+        if self.params is None and not checkpoint_folder_path and self.watch_ring_path:
+            scan = CheckpointWatcher(self.watch_ring_path, on_params=lambda *a: None)
+            folder = scan.scan_once()
+            if folder is not None:
+                logger.info("fleet: booting from ring checkpoint %s", folder)
+                self.params = load_serving_params(
+                    folder, mesh_handle=self.device_mesh, model=self.model
+                )
+                self._boot_step = _seen_steps_of(folder)
+                return
+        _resolve_params(self, checkpoint_folder_path)
+
+    # ------------------------------------------------------------- fleet run
+    def run_fleet(self) -> dict:
+        """Boot workers → router → controller → watcher; block until the stop
+        flag (SIGTERM) drains everything. Returns final per-worker stats plus
+        the router's fleet table."""
+        from modalities_tpu.serving.engine import ServingEngine
+        from modalities_tpu.serving.fleet.controller import EngineWorker, RolloutController
+        from modalities_tpu.serving.fleet.router import FleetRouter, WorkerHandle
+        from modalities_tpu.serving.fleet.watcher import CheckpointWatcher
+        from modalities_tpu.serving.serve import load_serving_params
+        from modalities_tpu.serving.server import ServingHTTPServer
+        from modalities_tpu.telemetry.metrics import MetricsRegistry
+
+        if self.params is None:
+            raise ValueError("params not resolved — serve() loads them first")
+
+        def encode(prompt: str) -> list[int]:
+            text = self.prompt_template.format(prompt=prompt) if self.prompt_template else prompt
+            return list(self.tokenizer.tokenize(text))
+
+        workers: list[EngineWorker] = []
+        for i in range(self.num_workers):
+            engine = ServingEngine(
+                self.model,
+                self.params,
+                max_batch_slots=self.max_batch_slots,
+                cache_capacity=self.cache_capacity,
+                eod_token_id=self._eod_id(),
+                default_temperature=self.temperature,
+                kv_cache=self.kv_cache,
+                paged_block_size=self.paged_block_size,
+                paged_num_blocks=self.paged_num_blocks,
+                paged_max_len=self.paged_max_len,
+                prefix_sharing=self.prefix_sharing,
+                spec_decode=self.spec_decode,
+                stop_fn=self.stop_fn,
+                mesh_handle=self.device_mesh,
+                metrics=MetricsRegistry(),  # per-worker: canary metrics stay isolated
+            )
+            server = ServingHTTPServer(
+                engine,
+                encode=encode,
+                decode=self.tokenizer.decode,
+                host=self.http_host,
+                port=0,  # loopback ephemeral: the router is the public face
+                default_max_new_tokens=self.max_new_tokens,
+            )
+            worker = EngineWorker(f"worker{i}", engine, server)
+            # POST /admin/swap on a worker: load the named sealed folder and
+            # hot-swap THAT worker (out-of-band of the canary flow)
+            server.swap_handler = self._swap_handler(worker, load_serving_params)
+            server.start()
+            workers.append(worker)
+
+        fleet_registry = MetricsRegistry()
+        controller = RolloutController(
+            workers,
+            metrics=fleet_registry,
+            probation_s=self.probation_s,
+            probation_tick_s=self.probation_tick_s,
+            max_error_delta=self.max_error_delta,
+            ttft_regression_factor=self.ttft_regression_factor,
+        )
+        handles = [
+            WorkerHandle(w.name, self.http_host, w.server.port) for w in workers
+        ]
+        router = FleetRouter(
+            handles,
+            host=self.http_host,
+            port=self.http_port or 0,
+            metrics=fleet_registry,
+            health_interval_s=self.health_interval_s,
+            heartbeat_deadline_s=self.heartbeat_deadline_s,
+        )
+        router.start()
+
+        watcher = None
+        if self.watch_ring_path is not None:
+            watcher = CheckpointWatcher(
+                self.watch_ring_path,
+                on_params=lambda params, step, folder: controller.deploy(
+                    params, step=step, folder=folder
+                ),
+                mesh_handle=self.device_mesh,
+                model=self.model,
+                poll_interval_s=self.watch_poll_s,
+            )
+            watcher.deployed_step = self._boot_step
+            watcher.start()
+
+        logger.info(
+            "fleet serving: %d workers behind router on %s:%d%s",
+            len(workers), self.http_host, router.port,
+            f", watching {self.watch_ring_path}" if watcher else "",
+        )
+        try:
+            while not (self.stop_fn is not None and self.stop_fn()):
+                time.sleep(0.2)
+        finally:
+            if watcher is not None:
+                watcher.stop()
+            router.stop()
+            for worker in workers:  # drain all workers concurrently...
+                worker.server.stop()
+            worker_stats = {}
+            for worker in workers:  # ...then reap each one
+                worker_stats[worker.name] = worker.server.serve_forever()
+            router.close()
+        return {
+            "fleet": router._fleet_table(),
+            "generation": controller.generation,
+            "workers": worker_stats,
+        }
+
+    @staticmethod
+    def _swap_handler(worker, load_fn):
+        def handler(body: dict) -> dict:
+            folder = body.get("checkpoint_folder")
+            if not folder:
+                raise ValueError("body needs a 'checkpoint_folder'")
+            params = load_fn(folder)
+            generation = body.get("generation")
+            done = worker.engine.request_swap(
+                params, int(generation) if generation is not None else None
+            )
+            if not done.wait(60.0):
+                raise TimeoutError("swap did not install within 60s")
+            return {
+                "worker": worker.name,
+                "weights_generation": worker.engine.weights_generation,
+            }
+
+        return handler

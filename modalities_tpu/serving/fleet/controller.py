@@ -1,0 +1,224 @@
+"""Rollout controller: canary deployments with metric-gated promote/rollback.
+
+`deploy(params, step)` never swaps the whole fleet at once. One worker — the
+least-loaded healthy one — becomes the canary for generation g+1. For a
+probation window its `serve_request_errors_total` delta and TTFT histogram are
+compared against the rest of the fleet; a regression rolls the canary back to
+the donor generation (whose params the controller kept a reference to — the
+engine's swap replaces the tree, it never mutates it), a clean window promotes
+g+1 to every worker. Either way the verdict is a telemetry event
+(``fleet/rollout`` / ``fleet/rollback``) and a counter
+(`fleet_rollouts_total` / `fleet_rollbacks_total`) on the fleet registry, so a
+bad checkpoint is visible in /metrics, not just absent from the fleet.
+
+Error deltas are checked every tick (a NaN-weights canary whose requests
+finish with reason "error" rolls back mid-window, fast); the TTFT comparison
+runs once at the end of the window where both sides have accumulated
+observations. Clock and sleep are injectable: unit tests drive probation with
+a fake clock, production uses wall time
+(``MODALITIES_TPU_FLEET_PROBATION_S`` sets the window, default 30 s).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Callable, Optional
+
+from modalities_tpu.resilience.events import record_event
+from modalities_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+def _default_probation_s() -> float:
+    return float(os.environ.get("MODALITIES_TPU_FLEET_PROBATION_S", "30.0"))
+
+
+class EngineWorker:
+    """One in-process serving worker: a ServingEngine plus (optionally) its
+    HTTP front end. Each worker owns its own MetricsRegistry, so error counts
+    and latency histograms are per-worker — that isolation is what makes the
+    canary comparison meaningful."""
+
+    def __init__(self, name: str, engine, server=None):
+        self.name = name
+        self.engine = engine
+        self.server = server  # ServingHTTPServer when fronted, None in units
+
+    @property
+    def url(self) -> Optional[str]:
+        if self.server is None or self.server.port is None:
+            return None
+        return f"http://127.0.0.1:{self.server.port}"
+
+    def healthy(self) -> bool:
+        return not self.engine._stopping()
+
+    def load(self) -> int:
+        """Live slots + queue depth: the least-loaded ranking key."""
+        return self.engine._active_count() + len(self.engine._queue)
+
+    def snapshot(self) -> dict:
+        """Consistent metric snapshot for probation baselines/deltas."""
+        stats = self.engine.stats()
+        ttft = self.engine.metrics.get("serve_ttft_seconds")
+        return {
+            "request_errors": stats["request_errors"],
+            "weights_generation": stats["weights_generation"],
+            "ttft_sum": ttft.sum() if ttft is not None else 0.0,
+            "ttft_count": ttft.count() if ttft is not None else 0.0,
+        }
+
+    def swap(self, params, generation: int, timeout_s: float = 60.0) -> bool:
+        """Install new weights on this worker. With a live engine loop (HTTP
+        front end running) the swap is queued onto the engine thread and lands
+        at the next token boundary; serverless workers (unit tests, batch mode)
+        swap synchronously."""
+        engine_thread = getattr(self.server, "_engine_thread", None)
+        if engine_thread is not None and engine_thread.is_alive():
+            done = self.engine.request_swap(params, generation)
+            return done.wait(timeout_s)
+        self.engine.swap_weights(params, generation)
+        return True
+
+
+class RolloutController:
+    """Canary rollout over a fixed worker set.
+
+    `metrics` is the FLEET registry (shared with the router, rendered on the
+    router's /metrics) — per-worker serve_* metrics live on each worker's own
+    registry."""
+
+    def __init__(
+        self,
+        workers: list[EngineWorker],
+        *,
+        metrics=None,
+        probation_s: Optional[float] = None,
+        probation_tick_s: float = 0.25,
+        max_error_delta: int = 0,
+        ttft_regression_factor: float = 2.0,
+        time_fn: Callable[[], float] = time.monotonic,
+        sleep_fn: Callable[[float], None] = time.sleep,
+    ):
+        if not workers:
+            raise ValueError("RolloutController needs at least one worker")
+        from modalities_tpu.telemetry.metrics import MetricsRegistry
+
+        self.workers = list(workers)
+        self.metrics = metrics if metrics is not None else MetricsRegistry()
+        self.probation_s = (
+            probation_s if probation_s is not None else _default_probation_s()
+        )
+        self.probation_tick_s = probation_tick_s
+        self.max_error_delta = int(max_error_delta)
+        self.ttft_regression_factor = float(ttft_regression_factor)
+        self._now = time_fn
+        self._sleep = sleep_fn
+        self.generation = max(w.engine.weights_generation for w in self.workers)
+        self._donor: Optional[tuple] = None  # (params, generation) last promoted over
+        self._m_rollouts = self.metrics.counter(
+            "fleet_rollouts_total", "Canary rollouts promoted to the full fleet"
+        )
+        self._m_rollbacks = self.metrics.counter(
+            "fleet_rollbacks_total", "Canary rollouts rolled back during probation"
+        )
+
+    # ----------------------------------------------------------------- deploy
+    def deploy(self, params, step: Optional[int] = None, folder=None) -> bool:
+        """Canary-roll `params` out as generation g+1. True on promotion;
+        False on rollback (the watcher burns the step)."""
+        gen = self.generation + 1
+        canary = self._pick_canary()
+        if canary is None:
+            record_event("fleet/rollback", stage="no_healthy_worker", generation=gen, step=step)
+            self._m_rollbacks.inc()
+            return False
+        # the donor tree: swap() replaces the engine's params reference, so
+        # holding the old reference here is all rollback needs
+        donor_params = canary.engine.params
+        donor_gen = canary.engine.weights_generation
+        baselines = {w.name: w.snapshot() for w in self.workers}
+        logger.info(
+            "fleet rollout: canary %s -> generation %d (step %s)", canary.name, gen, step
+        )
+        record_event("fleet/canary", worker=canary.name, generation=gen, step=step)
+        if not canary.swap(params, gen):
+            record_event(
+                "fleet/rollback", stage="canary_swap", worker=canary.name,
+                generation=gen, step=step,
+            )
+            self._m_rollbacks.inc()
+            return False
+        reason = self._probation(canary, baselines)
+        if reason is not None:
+            canary.swap(donor_params, donor_gen)
+            logger.warning(
+                "fleet rollback: generation %d off %s (%s) — donor generation %d keeps serving",
+                gen, canary.name, reason, donor_gen,
+            )
+            record_event(
+                "fleet/rollback", stage="probation", worker=canary.name,
+                generation=gen, step=step, reason=reason,
+            )
+            self._m_rollbacks.inc()
+            return False
+        for worker in self.workers:
+            if worker is not canary:
+                worker.swap(params, gen)
+        self.generation = gen
+        self._donor = (donor_params, donor_gen)
+        self._m_rollouts.inc()
+        logger.info("fleet rollout: generation %d promoted to %d workers", gen, len(self.workers))
+        record_event(
+            "fleet/rollout", generation=gen, step=step, workers=len(self.workers),
+            canary=canary.name,
+        )
+        return True
+
+    def _pick_canary(self) -> Optional[EngineWorker]:
+        healthy = [w for w in self.workers if w.healthy()]
+        if not healthy:
+            return None
+        return min(healthy, key=lambda w: w.load())
+
+    # -------------------------------------------------------------- probation
+    def _probation(self, canary: EngineWorker, baselines: dict) -> Optional[str]:
+        """Watch the canary for the probation window. None promotes; a reason
+        string rolls back."""
+        deadline = self._now() + self.probation_s
+        base = baselines[canary.name]
+        while True:
+            snap = canary.snapshot()
+            error_delta = snap["request_errors"] - base["request_errors"]
+            if error_delta > self.max_error_delta:
+                return (
+                    f"request_errors regressed by {error_delta} during probation "
+                    f"(allowed {self.max_error_delta})"
+                )
+            if self._now() >= deadline:
+                break
+            self._sleep(self.probation_tick_s)
+        # end-of-window TTFT check: canary mean vs the PEER fleet's mean over
+        # the same window (means from the histogram sum/count deltas — both
+        # sides need observations for the comparison to be meaningful)
+        snap = canary.snapshot()
+        canary_count = snap["ttft_count"] - base["ttft_count"]
+        peer_sum = peer_count = 0.0
+        for worker in self.workers:
+            if worker is canary:
+                continue
+            peer_snap = worker.snapshot()
+            peer_base = baselines[worker.name]
+            peer_sum += peer_snap["ttft_sum"] - peer_base["ttft_sum"]
+            peer_count += peer_snap["ttft_count"] - peer_base["ttft_count"]
+        if canary_count > 0 and peer_count > 0:
+            canary_mean = (snap["ttft_sum"] - base["ttft_sum"]) / canary_count
+            peer_mean = peer_sum / peer_count
+            if peer_mean > 0 and canary_mean > self.ttft_regression_factor * peer_mean:
+                return (
+                    f"ttft regressed: canary mean {canary_mean:.4f}s vs fleet mean "
+                    f"{peer_mean:.4f}s (factor {self.ttft_regression_factor:g})"
+                )
+        return None

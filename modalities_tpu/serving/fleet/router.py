@@ -1,0 +1,493 @@
+"""Fleet HTTP router: a load-balancing, failover-capable front tier over N
+engine workers, on the same stdlib-asyncio machinery as serving/server.py
+(whose wire helpers it reuses).
+
+Routing: `POST /generate` goes to the healthy worker with the lowest live load
+(active slots + queue depth, scraped by the health loop from each worker's
+`/stats`), ties broken by fewest picks. Health: a background task probes every
+worker's `/healthz` + `/stats` each interval; a worker is healthy while its
+last successful probe is within the heartbeat deadline
+(``MODALITIES_TPU_FLEET_HEALTH_DEADLINE_S``, default 5 s) and it is not
+draining. Transitions emit ``fleet/worker_unhealthy`` /
+``fleet/worker_recovered`` events and move the `fleet_workers_healthy` gauge.
+
+Failover: when a worker dies mid-stream (connection drops before its final
+SSE `done` event) the router marks it unhealthy, bumps
+`fleet_failovers_total`, emits ``fleet/failover``, and REPLAYS the request on
+a peer — forwarding only the token events past the count the client already
+received, so the client sees one seamless answer. That splice is exact when
+the peers are deterministic replicas (same weights generation, seeded
+sampling — the fleet deployment model); mid-rollout the canary may diverge,
+which is why the controller swaps the canary out of rotation-equality only
+for a probation window at a time.
+
+Endpoints: `POST /generate` (proxied SSE), `GET /healthz`, `GET /fleet`
+(per-worker table), `GET /metrics` (fleet registry exposition).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import threading
+import time
+from typing import Optional
+
+from modalities_tpu.resilience.events import record_event
+from modalities_tpu.serving.server import (
+    SSE_HEADER_BYTES,
+    json_response_bytes,
+    read_http_request,
+    response_bytes,
+    sse_event_bytes,
+)
+from modalities_tpu.telemetry.metrics import CONTENT_TYPE_LATEST
+from modalities_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+def _default_heartbeat_deadline_s() -> float:
+    return float(os.environ.get("MODALITIES_TPU_FLEET_HEALTH_DEADLINE_S", "5.0"))
+
+
+class _ClientGone(Exception):
+    """The downstream client hung up mid-stream: stop relaying, don't retry."""
+
+
+class WorkerHandle:
+    """Router-side view of one worker: address + live health/load state."""
+
+    def __init__(self, name: str, host: str, port: int):
+        self.name = name
+        self.host = host
+        self.port = int(port)
+        self.healthy = True  # optimistic until the first probe says otherwise
+        self.draining = False
+        self.last_heartbeat = time.monotonic()
+        self.load = 0  # active slots + queue depth, from the last /stats probe
+        self.weights_generation = 0
+        self.picks = 0  # least-loaded tiebreak: spread across idle workers
+
+    @property
+    def address(self) -> str:
+        return f"{self.host}:{self.port}"
+
+
+async def _read_response_head(reader: asyncio.StreamReader) -> tuple[int, dict]:
+    """Status code + headers of an upstream response; body stays on `reader`."""
+    status_line = await reader.readline()
+    if not status_line:
+        raise ConnectionError("upstream closed before the status line")
+    parts = status_line.decode("latin-1").split(None, 2)
+    if len(parts) < 2 or not parts[1].isdigit():
+        raise ConnectionError(f"malformed upstream status line: {status_line!r}")
+    headers: dict[str, str] = {}
+    while True:
+        line = await reader.readline()
+        if not line or line in (b"\r\n", b"\n"):
+            break
+        key, _, value = line.decode("latin-1").partition(":")
+        headers[key.strip().lower()] = value.strip()
+    return int(parts[1]), headers
+
+
+async def http_get_json(
+    host: str, port: int, path: str, timeout_s: float = 2.0
+) -> tuple[int, dict]:
+    """One GET round-trip against a worker (Connection: close framing)."""
+
+    async def _roundtrip():
+        reader, writer = await asyncio.open_connection(host, port)
+        try:
+            writer.write(
+                f"GET {path} HTTP/1.1\r\nHost: {host}\r\nConnection: close\r\n\r\n".encode()
+            )
+            await writer.drain()
+            status, header_map = await _read_response_head(reader)
+            length = header_map.get("content-length")
+            body = await (reader.readexactly(int(length)) if length else reader.read())
+            return status, json.loads(body or b"{}")
+        finally:
+            writer.close()
+            try:
+                await writer.wait_closed()
+            except (ConnectionError, OSError):
+                pass
+
+    return await asyncio.wait_for(_roundtrip(), timeout_s)
+
+
+class FleetRouter:
+    """Asyncio front tier over `WorkerHandle`s (lifecycle mirrors
+    ServingHTTPServer: start() binds, stop() drains, close() tears down)."""
+
+    def __init__(
+        self,
+        workers: list[WorkerHandle],
+        *,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        metrics=None,
+        health_interval_s: float = 0.5,
+        heartbeat_deadline_s: Optional[float] = None,
+        connect_timeout_s: float = 2.0,
+    ):
+        if not workers:
+            raise ValueError("FleetRouter needs at least one worker")
+        from modalities_tpu.telemetry.metrics import MetricsRegistry
+
+        self.workers = list(workers)
+        self._host = host
+        self._port_req = int(port)
+        self.port: Optional[int] = None
+        self.metrics = metrics if metrics is not None else MetricsRegistry()
+        self.health_interval_s = health_interval_s
+        self.heartbeat_deadline_s = (
+            heartbeat_deadline_s
+            if heartbeat_deadline_s is not None
+            else _default_heartbeat_deadline_s()
+        )
+        self.connect_timeout_s = connect_timeout_s
+        self.http_requests = 0
+        self.failovers = 0
+        self._shutdown = False
+        self._active_relays = 0
+        self._m_workers_healthy = self.metrics.gauge(
+            "fleet_workers_healthy", "Workers currently passing health checks"
+        )
+        self._m_workers_healthy.set(len(self.workers))
+        self._m_failovers = self.metrics.counter(
+            "fleet_failovers_total", "Generate requests re-routed off a dead worker"
+        )
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._aio_server = None
+        self._health_task: Optional[asyncio.Task] = None
+        self._loop_thread: Optional[threading.Thread] = None
+
+    # ---------------------------------------------------------------- health
+    async def _probe(self, worker: WorkerHandle) -> bool:
+        try:
+            status, health = await http_get_json(
+                worker.host, worker.port, "/healthz", self.connect_timeout_s
+            )
+            if status != 200:
+                return False
+            worker.draining = health.get("status") == "draining"
+            worker.weights_generation = int(health.get("weights_generation", 0))
+            status, stats = await http_get_json(
+                worker.host, worker.port, "/stats", self.connect_timeout_s
+            )
+            if status == 200:
+                worker.load = int(stats.get("active_slots", 0)) + int(
+                    stats.get("queue_depth", 0)
+                )
+            return True
+        except (OSError, ConnectionError, asyncio.TimeoutError, ValueError):
+            return False
+
+    async def _health_loop(self) -> None:
+        while True:
+            for worker in self.workers:
+                if await self._probe(worker):
+                    worker.last_heartbeat = time.monotonic()
+            now = time.monotonic()
+            for worker in self.workers:
+                was_healthy = worker.healthy
+                worker.healthy = (
+                    now - worker.last_heartbeat <= self.heartbeat_deadline_s
+                    and not worker.draining
+                )
+                if was_healthy and not worker.healthy:
+                    logger.warning("fleet router: worker %s unhealthy", worker.name)
+                    record_event(
+                        "fleet/worker_unhealthy", worker=worker.name,
+                        address=worker.address, draining=worker.draining,
+                    )
+                elif worker.healthy and not was_healthy:
+                    logger.info("fleet router: worker %s recovered", worker.name)
+                    record_event(
+                        "fleet/worker_recovered", worker=worker.name,
+                        address=worker.address,
+                    )
+            self._m_workers_healthy.set(sum(1 for w in self.workers if w.healthy))
+            await asyncio.sleep(self.health_interval_s)
+
+    def _pick(self, exclude: set) -> Optional[WorkerHandle]:
+        candidates = [
+            w for w in self.workers if w.healthy and w.name not in exclude
+        ]
+        if not candidates:
+            return None
+        worker = min(candidates, key=lambda w: (w.load, w.picks))
+        worker.picks += 1
+        return worker
+
+    # ----------------------------------------------------------------- proxy
+    async def _relay_from_worker(
+        self, worker: WorkerHandle, body_bytes: bytes, client_writer, state: dict
+    ) -> str:
+        """Stream one worker's answer through to the client. Returns "done"
+        (client got its final event) or "failover" (worker refused or died
+        before finishing — the caller retries a peer). Raises _ClientGone when
+        the CLIENT hangs up (no retry: nobody is listening)."""
+
+        async def send_client(data: bytes) -> None:
+            try:
+                client_writer.write(data)
+                await client_writer.drain()
+            except (ConnectionError, OSError) as exc:
+                raise _ClientGone() from exc
+
+        try:
+            reader, writer = await asyncio.wait_for(
+                asyncio.open_connection(worker.host, worker.port),
+                self.connect_timeout_s,
+            )
+        except (OSError, asyncio.TimeoutError):
+            return "failover"
+        try:
+            head = (
+                f"POST /generate HTTP/1.1\r\nHost: {worker.host}\r\n"
+                "Content-Type: application/json\r\n"
+                f"Content-Length: {len(body_bytes)}\r\nConnection: close\r\n\r\n"
+            )
+            writer.write(head.encode("latin-1") + body_bytes)
+            await writer.drain()
+            status, headers = await asyncio.wait_for(
+                _read_response_head(reader), self.connect_timeout_s
+            )
+            if status != 200:
+                length = headers.get("content-length")
+                body = await (
+                    reader.readexactly(int(length)) if length else reader.read()
+                )
+                if status == 503:  # draining worker: a peer can still serve it
+                    return "failover"
+                if state["headers_sent"]:  # mid-SSE: can't change the status now
+                    await send_client(
+                        sse_event_bytes({"error": body.decode("utf-8", "replace")})
+                    )
+                else:
+                    await send_client(
+                        response_bytes(
+                            status, headers.get("content-type", "application/json"), body
+                        )
+                    )
+                return "done"
+            if not state["headers_sent"]:
+                await send_client(SSE_HEADER_BYTES)
+                state["headers_sent"] = True
+            # relay the SSE stream, skipping token events the client already
+            # has from a previous worker (failover replay overlap)
+            buf = b""
+            seen_tokens = 0
+            skip = state["forwarded"]
+            while True:
+                chunk = await reader.read(4096)
+                if not chunk:
+                    return "failover"  # upstream died before its done event
+                buf += chunk
+                while b"\n\n" in buf:
+                    raw, buf = buf.split(b"\n\n", 1)
+                    if not raw.startswith(b"data: "):
+                        continue
+                    event = json.loads(raw[len(b"data: "):])
+                    if "token_id" in event:
+                        seen_tokens += 1
+                        if seen_tokens <= skip:
+                            continue
+                        state["forwarded"] += 1
+                        await send_client(raw + b"\n\n")
+                    else:
+                        # done / engine-side error: deterministic, never retried
+                        await send_client(raw + b"\n\n")
+                        return "done"
+        except (ConnectionError, asyncio.TimeoutError, asyncio.IncompleteReadError, OSError):
+            return "failover"
+        finally:
+            writer.close()
+            try:
+                await writer.wait_closed()
+            except (ConnectionError, OSError):
+                pass
+
+    async def _proxy_generate(self, body_bytes: bytes, client_writer) -> None:
+        self.http_requests += 1
+        if self._shutdown:
+            client_writer.write(json_response_bytes(503, {"error": "router is draining"}))
+            return
+        state = {"forwarded": 0, "headers_sent": False}
+        tried: set[str] = set()
+        self._active_relays += 1
+        try:
+            while True:
+                worker = self._pick(tried)
+                if worker is None:
+                    payload = {"error": "no healthy workers"}
+                    if state["headers_sent"]:
+                        client_writer.write(sse_event_bytes(payload))
+                    else:
+                        client_writer.write(json_response_bytes(503, payload))
+                    return
+                tried.add(worker.name)
+                outcome = await self._relay_from_worker(
+                    worker, body_bytes, client_writer, state
+                )
+                if outcome == "done":
+                    return
+                # the worker failed under us: out of rotation until a probe
+                # succeeds again, and the request moves to a peer. The
+                # heartbeat is invalidated too — a probe that completed just
+                # BEFORE we observed the death must not resurrect the worker
+                # in the health loop's evaluation phase.
+                worker.healthy = False
+                worker.last_heartbeat = float("-inf")
+                self.failovers += 1
+                self._m_failovers.inc()
+                self._m_workers_healthy.set(
+                    sum(1 for w in self.workers if w.healthy)
+                )
+                logger.warning(
+                    "fleet router: failover off %s after %d forwarded tokens",
+                    worker.name, state["forwarded"],
+                )
+                record_event(
+                    "fleet/failover", worker=worker.name,
+                    forwarded_tokens=state["forwarded"],
+                )
+        except _ClientGone:
+            return
+        finally:
+            self._active_relays -= 1
+
+    # -------------------------------------------------------------- endpoints
+    def _fleet_table(self) -> dict:
+        return {
+            "workers": [
+                {
+                    "name": w.name,
+                    "address": w.address,
+                    "healthy": w.healthy,
+                    "draining": w.draining,
+                    "load": w.load,
+                    "weights_generation": w.weights_generation,
+                    "picks": w.picks,
+                }
+                for w in self.workers
+            ],
+            "failovers": self.failovers,
+            "http_requests": self.http_requests,
+        }
+
+    async def _handle(self, reader, writer) -> None:
+        try:
+            req = await read_http_request(reader)
+            if req is None:
+                return
+            method, path, _headers, body_bytes = req
+            if method == "GET" and path == "/healthz":
+                healthy = sum(1 for w in self.workers if w.healthy)
+                writer.write(
+                    json_response_bytes(
+                        200,
+                        {
+                            "status": "draining" if self._shutdown else "ok",
+                            "workers_healthy": healthy,
+                            "workers_total": len(self.workers),
+                        },
+                    )
+                )
+            elif method == "GET" and path == "/fleet":
+                writer.write(json_response_bytes(200, self._fleet_table()))
+            elif method == "GET" and path == "/metrics":
+                data = self.metrics.render().encode("utf-8")
+                writer.write(response_bytes(200, CONTENT_TYPE_LATEST, data))
+            elif method == "POST" and path == "/generate":
+                await self._proxy_generate(body_bytes, writer)
+            else:
+                writer.write(json_response_bytes(404, {"error": f"unknown path {path}"}))
+            await writer.drain()
+        except (ConnectionError, BrokenPipeError, OSError):
+            pass
+        finally:
+            try:
+                writer.close()
+                await writer.wait_closed()
+            except (ConnectionError, OSError):
+                pass
+
+    # -------------------------------------------------------------- lifecycle
+    def _loop_main(self, started: threading.Event) -> None:
+        loop = asyncio.new_event_loop()
+        self._loop = loop
+        asyncio.set_event_loop(loop)
+
+        async def _bind():
+            self._aio_server = await asyncio.start_server(
+                self._handle, self._host, self._port_req
+            )
+            self.port = self._aio_server.sockets[0].getsockname()[1]
+            self._health_task = loop.create_task(self._health_loop())
+
+        try:
+            loop.run_until_complete(_bind())
+        finally:
+            started.set()
+        loop.run_forever()
+        tasks = asyncio.all_tasks(loop)
+        for task in tasks:
+            task.cancel()
+        if tasks:
+            loop.run_until_complete(asyncio.gather(*tasks, return_exceptions=True))
+        loop.close()
+
+    def start(self) -> "FleetRouter":
+        started = threading.Event()
+        self._loop_thread = threading.Thread(
+            target=self._loop_main, args=(started,), name="fleet-router", daemon=True
+        )
+        self._loop_thread.start()
+        started.wait(10.0)
+        if self.port is None:
+            raise RuntimeError(
+                f"fleet router failed to bind {self._host}:{self._port_req}"
+            )
+        return self
+
+    def stop(self) -> None:
+        """Drain: new generates get 503, in-flight relays finish."""
+        self._shutdown = True
+
+    def serve_forever(self, poll_s: float = 0.1) -> dict:
+        """Block until stop() and every in-flight relay finished, then close."""
+        try:
+            while not (self._shutdown and self._active_relays == 0):
+                time.sleep(poll_s)
+        finally:
+            self.close()
+        return self._fleet_table()
+
+    def close(self) -> None:
+        self._shutdown = True
+        loop = self._loop
+        if loop is not None and not loop.is_closed():
+
+            async def _close_listener():
+                if self._aio_server is not None:
+                    self._aio_server.close()
+                    await self._aio_server.wait_closed()
+
+            try:
+                asyncio.run_coroutine_threadsafe(_close_listener(), loop).result(5.0)
+            except Exception:
+                pass
+            try:
+                loop.call_soon_threadsafe(loop.stop)
+            except RuntimeError:
+                pass
+        if self._loop_thread is not None and self._loop_thread.is_alive():
+            self._loop_thread.join(5.0)
+        self._loop = None
+        self._aio_server = None

@@ -1,0 +1,172 @@
+"""Checkpoint watcher: the train→serve seam of the fleet subsystem.
+
+A poll loop over a training checkpoint ring (folders named
+``eid_*-seen_steps_*``, the PR-4 layout) that detects newly *sealed*
+checkpoints and hands verified, loaded params to a deploy callback — the
+rollout controller's `deploy` in production, a plain swap in single-engine
+mode.
+
+Sealing semantics are STRICTER than warmstart's `verify_manifest`: a folder
+without a ``manifest.json`` is not "legacy, accept unverified" — on the serve
+side it means the Orbax save is still in flight (the manifest is written only
+AFTER the commit) or died mid-save, so the watcher requires manifest PRESENCE
+*and* a clean verification. Torn/corrupt seals emit ``fleet/seal_rejected``
+and the scan walks back to the newest verifiable folder — the
+`resolve_resume_folder` ring-walk, re-pointed at deployment.
+
+A checkpoint that seals cleanly but fails to LOAD (the `checkpoint_io_error`
+fault point fires inside `load_serving_params`, storage died, tree mismatch)
+emits ``fleet/rollback`` and burns that step: the watcher never retries it and
+keeps serving the incumbent generation until a newer step appears. The deploy
+callback can burn a step the same way by returning False (canary probation
+rolled it back).
+
+Clocks and sleeps are injectable so the unit tests drive the loop with a fake
+clock; the default sleep waits on the stop event, so `stop()` interrupts a
+poll interval immediately.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from pathlib import Path
+from typing import Callable, Optional
+
+from modalities_tpu.resilience.events import record_event
+from modalities_tpu.resilience.manifest import (
+    MANIFEST_FILE_NAME,
+    _seen_steps_of,
+    verify_manifest,
+)
+from modalities_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+def _default_poll_s() -> float:
+    return float(os.environ.get("MODALITIES_TPU_FLEET_POLL_S", "5.0"))
+
+
+class CheckpointWatcher:
+    """Poll a checkpoint ring; deploy the newest sealed+verified checkpoint.
+
+    `on_params(params, step, folder)` is the deploy seam: return False to burn
+    the step (rollout rolled back), anything else marks it deployed. `load_fn`
+    defaults to the shared `load_serving_params` (serve.py), so startup and
+    watcher loads cannot drift."""
+
+    def __init__(
+        self,
+        ring_path,
+        on_params: Callable,
+        *,
+        mesh_handle=None,
+        model=None,
+        load_fn: Optional[Callable] = None,
+        poll_interval_s: Optional[float] = None,
+        sleep_fn: Optional[Callable[[float], None]] = None,
+    ):
+        self.ring_path = Path(ring_path)
+        self.on_params = on_params
+        self.mesh_handle = mesh_handle
+        self.model = model
+        if load_fn is None:
+            from modalities_tpu.serving.serve import load_serving_params
+
+            load_fn = load_serving_params
+        self._load_fn = load_fn
+        self.poll_interval_s = (
+            poll_interval_s if poll_interval_s is not None else _default_poll_s()
+        )
+        self._stop = threading.Event()
+        self._sleep_fn = sleep_fn if sleep_fn is not None else self._stop.wait
+        self._thread: Optional[threading.Thread] = None
+        self.deployed_step = -1  # newest step handed off successfully
+        self._rejected_steps: set[int] = set()  # load/deploy failures: burned
+        self._rejected_seen: set[str] = set()  # seal-reject events, deduped
+        self.polls = 0
+        self.deploys = 0
+
+    # ------------------------------------------------------------------- scan
+    def scan_once(self) -> Optional[Path]:
+        """Newest sealed AND verifiable ring folder strictly newer than the
+        deployed step (burned steps skipped). None when nothing new serves."""
+        candidates = sorted(
+            (p for p in self.ring_path.glob("eid_*-seen_steps_*") if p.is_dir()),
+            key=_seen_steps_of,
+            reverse=True,
+        )
+        for folder in candidates:
+            step = _seen_steps_of(folder)
+            if step <= self.deployed_step:
+                return None  # newest-first: everything below is already served
+            if step in self._rejected_steps:
+                continue
+            if not (folder / MANIFEST_FILE_NAME).is_file():
+                # torn seal: save in flight or crashed mid-save — never
+                # serveable as-is, but the manifest may still land, so the
+                # folder is re-checked next poll rather than burned
+                self._reject_seal(folder, "unsealed (no manifest)")
+                continue
+            verification = verify_manifest(folder)
+            if not verification.ok:
+                self._reject_seal(folder, verification.reason)
+                continue
+            return folder
+        return None
+
+    def _reject_seal(self, folder: Path, reason: str) -> None:
+        if folder.name in self._rejected_seen:
+            return  # one event per folder, not one per poll
+        self._rejected_seen.add(folder.name)
+        logger.warning("fleet watcher: rejecting seal of %s: %s", folder, reason)
+        record_event("fleet/seal_rejected", folder=str(folder), reason=reason)
+
+    # ------------------------------------------------------------------- poll
+    def poll_once(self) -> bool:
+        """One scan→load→deploy attempt; True when new params were deployed."""
+        self.polls += 1
+        folder = self.scan_once()
+        if folder is None:
+            return False
+        step = _seen_steps_of(folder)
+        try:
+            params = self._load_fn(folder, mesh_handle=self.mesh_handle, model=self.model)
+        except Exception as exc:
+            # sealed but unloadable (IO fault, storage death, tree mismatch):
+            # burn the step and keep serving the incumbent generation
+            logger.error(
+                "fleet watcher: loading %s failed (%r) — burning step %d", folder, exc, step
+            )
+            record_event(
+                "fleet/rollback", stage="load", folder=str(folder), step=step,
+                error=repr(exc),
+            )
+            self._rejected_steps.add(step)
+            return False
+        if self.on_params(params, step, folder) is False:
+            self._rejected_steps.add(step)  # rollout rolled back: never retry
+            return False
+        self.deployed_step = step
+        self.deploys += 1
+        return True
+
+    # -------------------------------------------------------------- lifecycle
+    def run(self, stop_fn: Optional[Callable[[], bool]] = None) -> None:
+        while not self._stop.is_set() and not (stop_fn is not None and stop_fn()):
+            self.poll_once()
+            self._sleep_fn(self.poll_interval_s)
+
+    def start(self) -> "CheckpointWatcher":
+        self._thread = threading.Thread(
+            target=self.run, name="fleet-watcher", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self, timeout_s: float = 10.0) -> None:
+        self._stop.set()
+        if self._thread is not None and self._thread.is_alive():
+            self._thread.join(timeout_s)

@@ -233,6 +233,17 @@ class BlockTableState:
             registered += 1
         return registered
 
+    def flush_prefix_index(self) -> int:
+        """Drop every prefix-index entry (hot weight swap: resident KV was
+        computed under the OLD weights, so forking it into a new-generation
+        request would splice stale activations into a fresh trajectory).
+        Live holders keep their blocks — only future admissions stop matching.
+        Returns how many entries were dropped."""
+        dropped = len(self._prefix_index)
+        self._prefix_index.clear()
+        self._block_key.clear()
+        return dropped
+
     def ensure_writable(self, rid: int, position: int):
         """Copy-on-write gate before writing logical `position` of `rid`.
 

@@ -218,17 +218,76 @@ def build_serving_components(config_dict: dict):
     from modalities_tpu.registry.components import COMPONENTS
     from modalities_tpu.registry.registry import ComponentEntity, Registry
 
+    from modalities_tpu.serving.fleet.component import (
+        FleetComponentConfig,
+        FleetServingComponent,
+    )
+
     registry = Registry(COMPONENTS)
     registry.add_entity(
         ComponentEntity("inference_component", "serve", ServingComponent, ServingComponentConfig)
     )
+    registry.add_entity(
+        ComponentEntity("inference_component", "fleet", FleetServingComponent, FleetComponentConfig)
+    )
     return ComponentFactory(registry).build_components(config_dict, ServeInstantiationModel)
 
 
+def load_serving_params(checkpoint_folder_path, mesh_handle=None, model=None):
+    """Sealed-checkpoint → serving params, shared by serve() startup and the
+    fleet checkpoint watcher so the two load paths cannot drift.
+
+    Manifest-verifies the folder first (refusing a corrupt seal beats serving
+    garbage), restores single-device under `retry_io` with the
+    `checkpoint_io_error` fault point armed-able at the read (same contract as
+    the training restore path), and extracts the params subtree from AppState
+    checkpoints. With both `mesh_handle` and `model`, the tree is placed onto
+    the serving mesh's NamedShardings — the PR-6 elastic contract: the restore
+    target comes from the *current* mesh, so a checkpoint sealed under any
+    training topology lands on any serving topology."""
+    from modalities_tpu.checkpointing.orbax.orbax_checkpoint_loading import (
+        restore_tree_single_device,
+    )
+    from modalities_tpu.resilience.faults import fire_io_error_if_armed
+    from modalities_tpu.resilience.manifest import verify_manifest
+    from modalities_tpu.resilience.retry import retry_io
+
+    folder = Path(checkpoint_folder_path)
+    verification = verify_manifest(folder)
+    if not verification.ok:
+        raise ValueError(
+            f"refusing to serve from {folder}: checkpoint failed manifest "
+            f"verification ({verification.reason})"
+        )
+
+    def _restore():
+        fire_io_error_if_armed()
+        return restore_tree_single_device(folder)
+
+    restored = retry_io(_restore, what=f"serving params from {folder.name}")
+    if isinstance(restored, dict) and "opt_state" in restored:
+        params = restored["params"]
+    else:
+        params = restored
+    if mesh_handle is not None and model is not None:
+        import jax
+
+        from modalities_tpu.parallel.sharding import (
+            default_logical_axis_rules,
+            params_shardings,
+        )
+
+        abstract = jax.eval_shape(lambda: model.init_params(jax.random.PRNGKey(0)))
+        rules = default_logical_axis_rules(mesh_handle)
+        params = jax.device_put(
+            params, params_shardings(abstract, rules, mesh_handle.mesh)
+        )
+    return params
+
+
 def _resolve_params(component, checkpoint_folder_path) -> None:
-    """Sealed-checkpoint param loading: manifest-verify the folder (refusing a
-    corrupt one beats serving garbage), restore single-device, extract the params
-    subtree from AppState checkpoints. No checkpoint -> fresh init (tests/demos)."""
+    """Startup param resolution: explicit params win, then a sealed checkpoint
+    via load_serving_params, else fresh init (tests/demos)."""
     import jax
 
     from flax.core import meta
@@ -236,24 +295,7 @@ def _resolve_params(component, checkpoint_folder_path) -> None:
     if component.params is not None:
         return
     if checkpoint_folder_path:
-        folder = Path(checkpoint_folder_path)
-        from modalities_tpu.resilience.manifest import verify_manifest
-
-        verification = verify_manifest(folder)
-        if not verification.ok:
-            raise ValueError(
-                f"refusing to serve from {folder}: checkpoint failed manifest "
-                f"verification ({verification.reason})"
-            )
-        from modalities_tpu.checkpointing.orbax.orbax_checkpoint_loading import (
-            restore_tree_single_device,
-        )
-
-        restored = restore_tree_single_device(folder)
-        if isinstance(restored, dict) and "opt_state" in restored:
-            component.params = restored["params"]
-        else:
-            component.params = restored
+        component.params = load_serving_params(checkpoint_folder_path)
     else:
         logger.warning("serve: no checkpoint_folder_path — serving fresh-init params")
         component.params = meta.unbox(component.model.init_params(jax.random.PRNGKey(0)))
@@ -264,6 +306,7 @@ def serve(
     requests_file_path: Optional[Path] = None,
     output_file_path: Optional[Path] = None,
     http_port: Optional[int] = None,
+    fleet: bool = False,
 ) -> None:
     """Entry point behind `python -m modalities_tpu serve`. With `http_port`
     (flag or config knob): streaming HTTP front end until SIGTERM/SIGINT drains
@@ -297,13 +340,26 @@ def serve(
     config_dict = load_app_config_dict(config_file_path)
     components = build_serving_components(config_dict)
     component = components.serving_component
-    _resolve_params(component, getattr(components.settings, "checkpoint_folder_path", None))
+    if fleet and not hasattr(component, "run_fleet"):
+        raise ValueError(
+            "--fleet needs the fleet serving component: set the config's "
+            "serving_component.variant_key to 'fleet' (see configs/config_fleet.yaml)"
+        )
+    checkpoint_folder_path = getattr(components.settings, "checkpoint_folder_path", None)
+    if hasattr(component, "resolve_params"):  # fleet: may bootstrap from the ring
+        component.resolve_params(checkpoint_folder_path)
+    else:
+        _resolve_params(component, checkpoint_folder_path)
 
     handler = PreemptionHandler().install()
     component.stop_fn = handler.should_stop
     try:
         if http_port is not None:
             component.http_port = int(http_port)
+        if hasattr(component, "run_fleet"):
+            stats = component.run_fleet()
+            logger.info("fleet stats: %s", json.dumps(stats))
+            return
         if component.http_port is not None:
             stats = component.run_http()
             logger.info("serve stats: %s", json.dumps(stats))

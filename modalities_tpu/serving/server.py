@@ -1,14 +1,21 @@
-"""Streaming HTTP front end for the serving engine (serving v2).
+"""Streaming HTTP front end for the serving engine (serving v2, asyncio v4).
 
-Stdlib-only (`ThreadingHTTPServer`): one HTTP thread per connection, ONE engine
-thread owning the model. The seam between them is thread-safe by construction:
+Stdlib-only: ONE asyncio event loop (own thread) multiplexes every connection,
+ONE engine thread owns the model. The seam between them is thread-safe by
+construction:
 
-- handlers never touch the engine — a POST pushes (request, stream-queue) onto
-  `_pending` (queue.Queue) and then blocks reading its own stream queue;
+- connection handlers never touch the engine — a POST pushes (request,
+  stream-queue) onto `_pending` (queue.Queue) and then relays its own stream
+  queue out as SSE;
 - the engine loop drains `_pending` at token boundaries (engine.submit stays
   single-threaded), runs `engine.step`, and routes emitted tokens back through
   the engine's `on_token`/`on_finish` callbacks into the per-request stream
   queues.
+
+The asyncio front replaces the PR-9 thread-per-connection ThreadingHTTPServer:
+same endpoints, same SSE framing, same drain contract, but idle connections
+cost a coroutine instead of a thread — and the fleet router (fleet/router.py)
+reuses the module-level HTTP helpers below for its own front end.
 
 Endpoints:
 - `POST /generate` — body `{"prompt": str, "max_new_tokens": int,
@@ -16,13 +23,13 @@ Endpoints:
   (`text/event-stream`): one `data: {"token_id", "text"}` event per token, a
   final `data: {"done": true, "completion", "finish_reason", ...}` event, then
   the connection closes. 503 while draining.
-- `GET /healthz` — `{"status": "ok"|"draining"}`.
+- `POST /admin/swap` — body `{"checkpoint_folder": str, "generation": int?}`;
+  forwarded to the wired `swap_handler` (fleet watcher path); 503 when no
+  handler is wired.
+- `GET /healthz` — `{"status": "ok"|"draining", "weights_generation": int}`.
 - `GET /stats` — one consistent engine-counter snapshot (taken under the
   engine's stats lock) + HTTP counters + queue depth / active slots.
-- `GET /metrics` — Prometheus text exposition of the process metrics registry:
-  TTFT/TPOT/queue-wait/e2e histograms, slot-occupancy and paged-block-pool
-  gauges, preemption/truncation counters, tokens-served totals (and, when
-  training shares the process, the training_* goodput/memory gauges).
+- `GET /metrics` — Prometheus text exposition of the process metrics registry.
 
 Graceful drain: `stop()` (or the engine's own `stop_fn`, e.g. the resilience
 SIGTERM flag) stops admission; in-flight slots finish and stream out; new
@@ -31,15 +38,79 @@ POSTs get 503; `serve_forever` returns with the final stats dict.
 
 from __future__ import annotations
 
+import asyncio
 import json
 import queue
 import threading
 import time
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from http import HTTPStatus
 from typing import Callable, Optional
 
 from modalities_tpu.telemetry import get_active_telemetry, span
 from modalities_tpu.telemetry.metrics import CONTENT_TYPE_LATEST
+
+# ---------------------------------------------------------------------------
+# HTTP/1.1 wire helpers, shared with the fleet router's asyncio front end.
+# ---------------------------------------------------------------------------
+
+_MAX_BODY_BYTES = 16 << 20  # refuse absurd Content-Length before readexactly
+
+
+async def read_http_request(
+    reader: asyncio.StreamReader,
+) -> Optional[tuple[str, str, dict, bytes]]:
+    """Parse one HTTP/1.1 request from a stream: (method, path, headers, body).
+    Returns None on EOF or a malformed request line (caller just closes)."""
+    try:
+        request_line = await reader.readline()
+        if not request_line:
+            return None
+        parts = request_line.decode("latin-1").strip().split()
+        if len(parts) != 3:
+            return None
+        method, path, _version = parts
+        headers: dict[str, str] = {}
+        while True:
+            line = await reader.readline()
+            if not line or line in (b"\r\n", b"\n"):
+                break
+            key, _, value = line.decode("latin-1").partition(":")
+            headers[key.strip().lower()] = value.strip()
+        length = int(headers.get("content-length") or 0)
+        if not 0 <= length <= _MAX_BODY_BYTES:
+            return None
+        body = await reader.readexactly(length) if length else b""
+        return method, path, headers, body
+    except (asyncio.IncompleteReadError, ConnectionError, ValueError):
+        return None
+
+
+def response_bytes(code: int, content_type: str, body: bytes) -> bytes:
+    """A complete fixed-length HTTP/1.1 response (connection closes after)."""
+    phrase = HTTPStatus(code).phrase
+    head = (
+        f"HTTP/1.1 {code} {phrase}\r\n"
+        f"Content-Type: {content_type}\r\n"
+        f"Content-Length: {len(body)}\r\n"
+        "Connection: close\r\n\r\n"
+    )
+    return head.encode("latin-1") + body
+
+
+def json_response_bytes(code: int, payload: dict) -> bytes:
+    return response_bytes(code, "application/json", json.dumps(payload).encode())
+
+
+SSE_HEADER_BYTES = (
+    b"HTTP/1.1 200 OK\r\n"
+    b"Content-Type: text/event-stream\r\n"
+    b"Cache-Control: no-cache\r\n"
+    b"Connection: close\r\n\r\n"
+)
+
+
+def sse_event_bytes(payload: dict) -> bytes:
+    return f"data: {json.dumps(payload)}\n\n".encode()
 
 
 class ServingHTTPServer:
@@ -58,6 +129,7 @@ class ServingHTTPServer:
         host: str = "127.0.0.1",
         port: int = 0,  # 0 = ephemeral, resolved port on self.port after start()
         default_max_new_tokens: int = 64,
+        swap_handler: Optional[Callable[[dict], dict]] = None,
     ):
         self.engine = engine
         self._encode = encode
@@ -66,10 +138,14 @@ class ServingHTTPServer:
         self._port_req = int(port)
         self.port: Optional[int] = None
         self.default_max_new_tokens = int(default_max_new_tokens)
+        # POST /admin/swap delegate: dict body -> dict result (fleet wires the
+        # watcher's load+swap path here; None keeps the endpoint disabled)
+        self.swap_handler = swap_handler
 
         self._pending: queue.Queue = queue.Queue()  # (body dict, stream queue)
         self._streams: dict[int, queue.Queue] = {}  # rid -> stream (engine thread only)
         self._shutdown = False
+        self._closing = False
         self._t0: Optional[float] = None
         self.http_requests = 0
         self.http_rejected = 0
@@ -87,8 +163,9 @@ class ServingHTTPServer:
         prior_stop = engine._stop_fn
         engine._stop_fn = lambda: self._shutdown or bool(prior_stop and prior_stop())
 
-        self._httpd: Optional[ThreadingHTTPServer] = None
-        self._http_thread: Optional[threading.Thread] = None
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._aio_server: Optional[asyncio.base_events.Server] = None
+        self._loop_thread: Optional[threading.Thread] = None
         self._engine_thread: Optional[threading.Thread] = None
 
     # ------------------------------------------------------------- engine side
@@ -157,119 +234,186 @@ class ServingHTTPServer:
     def submit_stream(self, body: dict, stream: queue.Queue) -> None:
         self._pending.put((body, stream))
 
-    def start(self) -> None:
-        front = self
+    async def _relay_stream(self, stream: queue.Queue, writer: asyncio.StreamWriter) -> None:
+        """Relay one request's engine stream out as SSE. The engine thread puts
+        into `stream`; we poll it at the engine's own idle cadence (2 ms) so the
+        event loop never blocks on a thread queue."""
+        writer.write(SSE_HEADER_BYTES)
+        try:
+            while True:
+                try:
+                    kind, value = stream.get_nowait()
+                except queue.Empty:
+                    if self._closing:
+                        return  # close() mid-stream: give the connection up
+                    await asyncio.sleep(0.002)
+                    continue
+                if kind == "rid":
+                    continue
+                if kind == "token":
+                    writer.write(
+                        sse_event_bytes(
+                            {"token_id": value, "text": self._decode([value])}
+                        )
+                    )
+                    await writer.drain()
+                elif kind == "done":
+                    result = value
+                    writer.write(
+                        sse_event_bytes(
+                            {
+                                "done": True,
+                                "completion": self._decode(result.tokens),
+                                "token_ids": list(result.tokens),
+                                "finish_reason": result.finish_reason,
+                                "truncated": result.truncated,
+                                "prompt_len": result.prompt_len,
+                                "ttft_s": result.ttft_s,
+                                "weights_generation": result.weights_generation,
+                            }
+                        )
+                    )
+                    await writer.drain()
+                    return
+                else:  # "error"
+                    writer.write(sse_event_bytes({"error": value}))
+                    await writer.drain()
+                    return
+        except (ConnectionError, BrokenPipeError):
+            # client went away mid-stream; the engine finishes the request
+            # anyway (no cancellation path) — tokens drop here
+            return
 
-        class _Handler(BaseHTTPRequestHandler):
-            def log_message(self, *args):  # stdlib default spams stderr per request
+    async def _handle_generate(self, body_bytes: bytes, writer: asyncio.StreamWriter) -> None:
+        with span("serve/http"):
+            self.http_requests += 1
+            self._m_http.inc()
+            try:
+                body = json.loads(body_bytes or b"{}")
+                prompt = body.get("prompt")
+                if not isinstance(prompt, str) or not prompt:
+                    writer.write(
+                        json_response_bytes(400, {"error": "body needs a non-empty 'prompt'"})
+                    )
+                    return
+            except (ValueError, json.JSONDecodeError) as exc:
+                writer.write(json_response_bytes(400, {"error": f"bad JSON body: {exc}"}))
+                return
+            if self.draining:
+                self.http_rejected += 1
+                self._m_http_rejected.inc()
+                writer.write(json_response_bytes(503, {"error": "server is draining"}))
+                return
+            stream: queue.Queue = queue.Queue()
+            self.submit_stream(body, stream)
+            await self._relay_stream(stream, writer)
+
+    async def _handle_admin_swap(self, body_bytes: bytes, writer: asyncio.StreamWriter) -> None:
+        if self.swap_handler is None:
+            writer.write(json_response_bytes(503, {"error": "no swap handler wired"}))
+            return
+        try:
+            body = json.loads(body_bytes or b"{}")
+        except (ValueError, json.JSONDecodeError) as exc:
+            writer.write(json_response_bytes(400, {"error": f"bad JSON body: {exc}"}))
+            return
+        loop = asyncio.get_running_loop()
+        try:
+            # checkpoint load + swap wait can take seconds: keep it off the loop
+            result = await loop.run_in_executor(None, self.swap_handler, body)
+            writer.write(json_response_bytes(200, {"ok": True, **(result or {})}))
+        except Exception as exc:
+            writer.write(
+                json_response_bytes(500, {"error": f"{type(exc).__name__}: {exc}"})
+            )
+
+    async def _handle(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter) -> None:
+        try:
+            req = await read_http_request(reader)
+            if req is None:
+                return
+            method, path, _headers, body_bytes = req
+            if method == "GET" and path == "/healthz":
+                writer.write(
+                    json_response_bytes(
+                        200,
+                        {
+                            "status": "draining" if self.draining else "ok",
+                            "weights_generation": getattr(
+                                self.engine, "weights_generation", 0
+                            ),
+                        },
+                    )
+                )
+            elif method == "GET" and path == "/stats":
+                stats = dict(self.engine.stats())
+                stats["http_requests"] = self.http_requests
+                stats["http_rejected"] = self.http_rejected
+                stats["draining"] = self.draining
+                writer.write(json_response_bytes(200, stats))
+            elif method == "GET" and path == "/metrics":
+                data = self.engine.metrics.render().encode("utf-8")
+                writer.write(response_bytes(200, CONTENT_TYPE_LATEST, data))
+            elif method == "POST" and path == "/generate":
+                await self._handle_generate(body_bytes, writer)
+            elif method == "POST" and path == "/admin/swap":
+                await self._handle_admin_swap(body_bytes, writer)
+            else:
+                writer.write(json_response_bytes(404, {"error": f"unknown path {path}"}))
+            await writer.drain()
+        except (ConnectionError, BrokenPipeError):
+            pass
+        finally:
+            try:
+                writer.close()
+                await writer.wait_closed()
+            except (ConnectionError, BrokenPipeError):
                 pass
 
-            def _json(self, code: int, payload: dict) -> None:
-                data = json.dumps(payload).encode()
-                self.send_response(code)
-                self.send_header("Content-Type", "application/json")
-                self.send_header("Content-Length", str(len(data)))
-                self.end_headers()
-                self.wfile.write(data)
+    # ------------------------------------------------------------- lifecycle
+    def _loop_main(self, started: threading.Event) -> None:
+        loop = asyncio.new_event_loop()
+        self._loop = loop
+        asyncio.set_event_loop(loop)
 
-            def do_GET(self):
-                if self.path == "/healthz":
-                    self._json(200, {"status": "draining" if front.draining else "ok"})
-                elif self.path == "/stats":
-                    stats = dict(front.engine.stats())
-                    stats["http_requests"] = front.http_requests
-                    stats["http_rejected"] = front.http_rejected
-                    stats["draining"] = front.draining
-                    self._json(200, stats)
-                elif self.path == "/metrics":
-                    data = front.engine.metrics.render().encode("utf-8")
-                    self.send_response(200)
-                    self.send_header("Content-Type", CONTENT_TYPE_LATEST)
-                    self.send_header("Content-Length", str(len(data)))
-                    self.end_headers()
-                    self.wfile.write(data)
-                else:
-                    self._json(404, {"error": f"unknown path {self.path}"})
+        async def _bind():
+            self._aio_server = await asyncio.start_server(
+                self._handle, self._host, self._port_req
+            )
+            self.port = self._aio_server.sockets[0].getsockname()[1]
 
-            def do_POST(self):
-                if self.path != "/generate":
-                    self._json(404, {"error": f"unknown path {self.path}"})
-                    return
-                with span("serve/http"):
-                    front.http_requests += 1
-                    front._m_http.inc()
-                    try:
-                        length = int(self.headers.get("Content-Length") or 0)
-                        body = json.loads(self.rfile.read(length) or b"{}")
-                        prompt = body.get("prompt")
-                        if not isinstance(prompt, str) or not prompt:
-                            self._json(400, {"error": "body needs a non-empty 'prompt'"})
-                            return
-                    except (ValueError, json.JSONDecodeError) as exc:
-                        self._json(400, {"error": f"bad JSON body: {exc}"})
-                        return
-                    if front.draining:
-                        front.http_rejected += 1
-                        front._m_http_rejected.inc()
-                        self._json(503, {"error": "server is draining"})
-                        return
-                    stream: queue.Queue = queue.Queue()
-                    front.submit_stream(body, stream)
-                    self.send_response(200)
-                    self.send_header("Content-Type", "text/event-stream")
-                    self.send_header("Cache-Control", "no-cache")
-                    self.send_header("Connection", "close")
-                    self.end_headers()
-                    self._stream_events(stream)
+        try:
+            loop.run_until_complete(_bind())
+        finally:
+            started.set()  # start() unblocks even when the bind failed
+        loop.run_forever()
+        # close() stopped the loop: cancel stragglers and shut down cleanly
+        tasks = asyncio.all_tasks(loop)
+        for task in tasks:
+            task.cancel()
+        if tasks:
+            loop.run_until_complete(asyncio.gather(*tasks, return_exceptions=True))
+        try:
+            loop.run_until_complete(
+                asyncio.wait_for(loop.shutdown_default_executor(), timeout=2.0)
+            )
+        except (asyncio.TimeoutError, RuntimeError):
+            pass
+        loop.close()
 
-            def _sse(self, payload: dict) -> None:
-                self.wfile.write(f"data: {json.dumps(payload)}\n\n".encode())
-                self.wfile.flush()
-
-            def _stream_events(self, stream: queue.Queue) -> None:
-                tokens: list[int] = []
-                try:
-                    while True:
-                        kind, value = stream.get()
-                        if kind == "rid":
-                            continue
-                        if kind == "token":
-                            tokens.append(value)
-                            self._sse(
-                                {"token_id": value, "text": front._decode([value])}
-                            )
-                        elif kind == "done":
-                            result = value
-                            self._sse(
-                                {
-                                    "done": True,
-                                    "completion": front._decode(result.tokens),
-                                    "token_ids": list(result.tokens),
-                                    "finish_reason": result.finish_reason,
-                                    "truncated": result.truncated,
-                                    "prompt_len": result.prompt_len,
-                                    "ttft_s": result.ttft_s,
-                                }
-                            )
-                            return
-                        else:  # "error"
-                            self._sse({"error": value})
-                            return
-                except (BrokenPipeError, ConnectionResetError):
-                    # client went away mid-stream; the engine finishes the
-                    # request anyway (no cancellation path) — tokens drop here
-                    return
-
-        self._httpd = ThreadingHTTPServer((self._host, self._port_req), _Handler)
-        self.port = self._httpd.server_address[1]
-        self._http_thread = threading.Thread(
-            target=self._httpd.serve_forever, name="serve-http", daemon=True
-        )
+    def start(self) -> None:
+        started = threading.Event()
         self._engine_thread = threading.Thread(
             target=self._engine_loop, name="serve-engine", daemon=True
         )
+        self._loop_thread = threading.Thread(
+            target=self._loop_main, args=(started,), name="serve-http", daemon=True
+        )
         self._engine_thread.start()
-        self._http_thread.start()
+        self._loop_thread.start()
+        started.wait(10.0)
+        if self.port is None:
+            raise RuntimeError(f"HTTP front end failed to bind {self._host}:{self._port_req}")
 
     def stop(self) -> None:
         """Request graceful drain: stop admitting, let in-flight finish."""
@@ -287,9 +431,26 @@ class ServingHTTPServer:
 
     def close(self) -> None:
         self._shutdown = True
-        if self._httpd is not None:
-            self._httpd.shutdown()
-            self._httpd.server_close()
-            self._httpd = None
+        self._closing = True
+        loop = self._loop
+        if loop is not None and not loop.is_closed():
+
+            async def _close_listener():
+                if self._aio_server is not None:
+                    self._aio_server.close()
+                    await self._aio_server.wait_closed()
+
+            try:
+                asyncio.run_coroutine_threadsafe(_close_listener(), loop).result(5.0)
+            except Exception:
+                pass
+            try:
+                loop.call_soon_threadsafe(loop.stop)
+            except RuntimeError:
+                pass
+        if self._loop_thread is not None and self._loop_thread.is_alive():
+            self._loop_thread.join(5.0)
+        self._loop = None
+        self._aio_server = None
         if self._engine_thread is not None and self._engine_thread.is_alive():
             self._engine_thread.join(5.0)

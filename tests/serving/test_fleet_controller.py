@@ -1,0 +1,168 @@
+"""Rollout controller units (serving/fleet/controller.py): canary selection,
+metric-gated promotion, and both rollback triggers, driven by fake engines and
+a fake clock — no model, no HTTP, so the whole probation state machine runs in
+milliseconds.
+
+The fake engine implements exactly the surface `EngineWorker` reads (stats,
+per-worker metrics registry, swap_weights) so the tests exercise the REAL
+worker/controller pair, not a mock of it.
+"""
+
+import pytest
+
+from modalities_tpu.resilience.events import counts_since, snapshot_counts
+from modalities_tpu.serving.fleet.controller import EngineWorker, RolloutController
+from modalities_tpu.telemetry.metrics import MetricsRegistry, parse_prometheus_text
+
+OLD, NEW = {"w": 1.0}, {"w": 2.0}
+
+
+class _FakeEngine:
+    """Minimal engine surface for EngineWorker: stats + TTFT histogram +
+    synchronous swap (server=None path)."""
+
+    def __init__(self, load=0):
+        self.params = OLD
+        self.weights_generation = 0
+        self.metrics = MetricsRegistry()
+        self._ttft = self.metrics.histogram("serve_ttft_seconds", "ttft")
+        self.request_errors = 0
+        self._load = load
+        self._queue = []
+        self.swaps = []  # (params, generation) in arrival order
+        self.stopping = False
+
+    def _stopping(self):
+        return self.stopping
+
+    def _active_count(self):
+        return self._load
+
+    def stats(self):
+        return {
+            "request_errors": self.request_errors,
+            "weights_generation": self.weights_generation,
+        }
+
+    def swap_weights(self, params, generation=None):
+        self.swaps.append((params, generation))
+        self.params = params
+        self.weights_generation = generation
+
+
+class _Clock:
+    """Fake monotonic clock; sleep advances it and fires per-tick callbacks —
+    how the tests inject 'traffic happened during probation'."""
+
+    def __init__(self):
+        self.t = 0.0
+        self.on_tick = None
+
+    def now(self):
+        return self.t
+
+    def sleep(self, dt):
+        self.t += dt
+        if self.on_tick is not None:
+            self.on_tick()
+
+
+def _fleet(n=3, loads=None):
+    workers = [
+        EngineWorker(f"w{i}", _FakeEngine(load=(loads or [0] * n)[i]))
+        for i in range(n)
+    ]
+    clock = _Clock()
+    registry = MetricsRegistry()
+    controller = RolloutController(
+        workers,
+        metrics=registry,
+        probation_s=1.0,
+        probation_tick_s=0.25,
+        time_fn=clock.now,
+        sleep_fn=clock.sleep,
+    )
+    return workers, controller, clock, registry
+
+
+def _counter(registry, name):
+    return parse_prometheus_text(registry.render()).get(name, {}).get((), 0.0)
+
+
+def test_clean_probation_promotes_to_every_worker():
+    workers, controller, clock, registry = _fleet(loads=[2, 0, 5])
+    before = snapshot_counts()
+    assert controller.deploy(NEW, step=7) is True
+    # least-loaded worker (w1) was the canary: it swapped first, during probation
+    assert workers[1].engine.swaps[0] == (NEW, 1)
+    assert all(w.engine.params is NEW for w in workers)
+    assert all(w.engine.weights_generation == 1 for w in workers)
+    assert controller.generation == 1
+    assert _counter(registry, "fleet_rollouts_total") == 1.0
+    assert counts_since(before).get("fleet", 0) == 2  # canary + rollout events
+
+    # the next deploy stacks on top: generation 2, donor kept as generation 1
+    assert controller.deploy({"w": 3.0}) is True
+    assert controller.generation == 2
+
+
+def test_error_regression_rolls_canary_back_mid_window():
+    workers, controller, clock, registry = _fleet()
+    canary = workers[0].engine  # equal loads: min() keeps the first worker
+
+    def bad_traffic():  # requests start erroring right after the swap
+        if canary.weights_generation == 1:
+            canary.request_errors += 1
+
+    clock.on_tick = bad_traffic
+    assert controller.deploy(NEW, step=7) is False
+    # rollback landed BEFORE the window ended (first tick, not after 1.0s)
+    assert clock.t < 1.0
+    # canary is back on the donor tree; peers never saw generation 1
+    assert canary.params is OLD and canary.weights_generation == 0
+    assert workers[1].engine.swaps == [] and workers[2].engine.swaps == []
+    assert controller.generation == 0
+    assert _counter(registry, "fleet_rollbacks_total") == 1.0
+
+    # the fleet keeps deploying: a good generation after the bad one promotes
+    clock.on_tick = None
+    assert controller.deploy({"w": 3.0}) is True
+    assert controller.generation == 1  # bad generation number was never taken
+
+
+def test_ttft_regression_rolls_back_at_window_end():
+    workers, controller, clock, _ = _fleet()
+    canary, peers = workers[0].engine, [w.engine for w in workers[1:]]
+
+    def slow_canary():  # canary answers, but 4x slower than the fleet
+        canary._ttft.observe(0.4)
+        for peer in peers:
+            peer._ttft.observe(0.1)
+
+    clock.on_tick = slow_canary
+    assert controller.deploy(NEW) is False
+    assert canary.params is OLD and canary.weights_generation == 0
+    assert clock.t >= 1.0  # TTFT verdict waits for the full window
+
+
+def test_quiet_window_promotes_despite_no_traffic():
+    """No observations on either side: the TTFT gate needs both sides to have
+    data, so an idle fleet promotes instead of flapping."""
+    workers, controller, _, _ = _fleet()
+    assert controller.deploy(NEW) is True
+
+
+def test_no_healthy_worker_is_a_rollback():
+    workers, controller, _, registry = _fleet()
+    for w in workers:
+        w.engine.stopping = True
+    before = snapshot_counts()
+    assert controller.deploy(NEW) is False
+    assert counts_since(before).get("fleet", 0) == 1
+    assert _counter(registry, "fleet_rollbacks_total") == 1.0
+    assert all(w.engine.swaps == [] for w in workers)
+
+
+def test_controller_requires_workers():
+    with pytest.raises(ValueError):
+        RolloutController([])

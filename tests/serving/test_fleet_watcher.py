@@ -1,0 +1,178 @@
+"""Checkpoint watcher units (serving/fleet/watcher.py) against a fake training
+ring on disk: real Orbax saves in `eid_*-seen_steps_*` folders, sealed with the
+PR-4 manifest machinery.
+
+The seam under test is the train→serve handoff's STRICTER sealing contract: a
+folder without a manifest is an in-flight (or died) save, not a legacy
+checkpoint — the watcher must walk back to the newest folder that is sealed
+AND verifies, and a seal that verifies but fails to LOAD (the
+`checkpoint_io_error` fault point) must burn the step and keep the incumbent
+generation serving, never half-swap.
+"""
+
+import json
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from modalities_tpu.resilience.events import counts_since, snapshot_counts
+from modalities_tpu.resilience.faults import arm_faults, clear_faults
+from modalities_tpu.resilience.manifest import MANIFEST_FILE_NAME, write_manifest
+from modalities_tpu.serving.fleet.watcher import CheckpointWatcher
+from modalities_tpu.telemetry import Telemetry, set_active_telemetry
+
+
+def _save_ring_step(ring, step, *, seal=True, scale=1.0):
+    """One training-ring folder: a real Orbax save, optionally sealed."""
+    import orbax.checkpoint as ocp
+
+    folder = ring / f"eid_0-seen_steps_{step}"
+    tree = {  # AppState layout: load_serving_params extracts the params subtree
+        "params": {"w": jnp.arange(4, dtype=jnp.float32) * scale},
+        "opt_state": {"m": jnp.zeros(4, dtype=jnp.float32)},
+        "step": jnp.asarray(step, dtype=jnp.int32),
+    }
+    checkpointer = ocp.StandardCheckpointer()
+    checkpointer.save(folder.absolute(), tree)
+    checkpointer.wait_until_finished()  # async commit: seal only AFTER it lands
+    if seal:
+        write_manifest(folder)
+    return folder
+
+
+@pytest.fixture()
+def fleet_events(tmp_path_factory):
+    """Active telemetry sink + a reader for the fleet/* events it captured."""
+    sink = tmp_path_factory.mktemp("telemetry")
+    telemetry = Telemetry(
+        output_folder_path=sink, watchdog_deadline_s=0.0, use_jax_annotations=False
+    )
+    prior = set_active_telemetry(telemetry)
+
+    def events(prefix="fleet/"):
+        telemetry.close()  # flush before reading back
+        out = []
+        for path in sorted(sink.glob("telemetry_rank_*.jsonl")):
+            for line in path.read_text().splitlines():
+                event = json.loads(line)
+                if event.get("name", "").startswith(prefix):
+                    out.append(event)
+        return out
+
+    try:
+        yield events
+    finally:
+        telemetry.close()
+        set_active_telemetry(prior)
+
+
+def _recording_watcher(ring, **kwargs):
+    deployed = []
+
+    def on_params(params, step, folder):
+        deployed.append((step, params))
+
+    return CheckpointWatcher(ring, on_params, **kwargs), deployed
+
+
+def test_torn_seal_rejected_newest_verifiable_wins(tmp_path, fleet_events):
+    """Unsealed newest folder (save in flight) is skipped — the older sealed
+    one deploys — and once its manifest lands a later poll picks it up."""
+    ring = tmp_path / "ring"
+    ring.mkdir()
+    _save_ring_step(ring, 100)
+    torn = _save_ring_step(ring, 200, seal=False, scale=2.0)
+
+    watcher, deployed = _recording_watcher(ring)
+    assert watcher.poll_once() is True
+    assert watcher.deployed_step == 100
+    assert [s for s, _ in deployed] == [100]
+    np.testing.assert_array_equal(deployed[0][1]["w"], np.arange(4, dtype=np.float32))
+
+    # the torn folder is re-checked, not burned: sealing it later deploys it
+    assert watcher.poll_once() is False  # still torn -> nothing new
+    write_manifest(torn)
+    assert watcher.poll_once() is True
+    assert watcher.deployed_step == 200
+
+    rejected = [e for e in fleet_events() if e["name"] == "fleet/seal_rejected"]
+    assert len(rejected) == 1  # deduped across the two polls that saw it torn
+    assert "unsealed" in rejected[0]["reason"]
+
+
+def test_corrupt_seal_rejected_with_reason(tmp_path, fleet_events):
+    """Digest mismatch (bit rot / torn upload after the manifest landed) walks
+    back to the older verified folder."""
+    ring = tmp_path / "ring"
+    ring.mkdir()
+    _save_ring_step(ring, 100)
+    corrupt = _save_ring_step(ring, 200, scale=2.0)
+    victim = max(
+        (p for p in corrupt.rglob("*") if p.is_file() and p.name != MANIFEST_FILE_NAME),
+        key=lambda p: p.stat().st_size,
+    )
+    victim.write_bytes(victim.read_bytes()[:-1] + b"\x00\x00")
+
+    watcher, deployed = _recording_watcher(ring)
+    assert watcher.poll_once() is True
+    assert watcher.deployed_step == 100
+    rejected = [e for e in fleet_events() if e["name"] == "fleet/seal_rejected"]
+    assert len(rejected) == 1
+    assert "mismatch" in rejected[0]["reason"]
+
+
+def test_load_failure_burns_step_and_rolls_back(tmp_path, monkeypatch, fleet_events):
+    """A seal that verifies but fails to LOAD (injected `checkpoint_io_error`
+    with retries exhausted) emits fleet/rollback, burns the step forever, and
+    the next poll serves the older verifiable step instead."""
+    monkeypatch.setenv("MODALITIES_TPU_IO_RETRY_ATTEMPTS", "1")  # no retry mask
+    ring = tmp_path / "ring"
+    ring.mkdir()
+    _save_ring_step(ring, 100)
+    _save_ring_step(ring, 200, scale=2.0)
+
+    watcher, deployed = _recording_watcher(ring)
+    clear_faults()
+    arm_faults("checkpoint_io_error:1")
+    try:
+        before = snapshot_counts()
+        assert watcher.poll_once() is False  # step 200 load fails -> no swap
+        assert watcher.deployed_step == -1 and deployed == []
+        assert counts_since(before).get("fleet", 0) == 1
+        rollbacks = [e for e in fleet_events() if e["name"] == "fleet/rollback"]
+        assert len(rollbacks) == 1
+        assert rollbacks[0]["stage"] == "load"
+        assert rollbacks[0]["step"] == 200
+
+        # fault exhausted: the ring walk lands on step 100, 200 stays burned
+        assert watcher.poll_once() is True
+        assert watcher.deployed_step == 100
+        assert [s for s, _ in deployed] == [100]
+        assert watcher.poll_once() is False  # 200 is never retried
+    finally:
+        clear_faults()
+
+
+def test_deploy_callback_false_burns_step(tmp_path):
+    """on_params returning False is the rollout-rolled-back signal: the step
+    burns (never retried) and the deployed generation does not advance."""
+    ring = tmp_path / "ring"
+    ring.mkdir()
+    _save_ring_step(ring, 100)
+    calls = []
+    watcher = CheckpointWatcher(ring, lambda p, s, f: calls.append(s) or False)
+    assert watcher.poll_once() is False
+    assert watcher.deployed_step == -1
+    assert watcher.poll_once() is False
+    assert calls == [100]  # not retried after the rollback
+
+
+def test_nothing_newer_than_deployed_is_a_noop(tmp_path):
+    ring = tmp_path / "ring"
+    ring.mkdir()
+    _save_ring_step(ring, 100)
+    watcher, deployed = _recording_watcher(ring)
+    watcher.deployed_step = 100  # e.g. the fleet booted from this ring folder
+    assert watcher.poll_once() is False
+    assert deployed == []
