@@ -343,6 +343,12 @@ def main() -> int:
         help="all-greedy periodic prompts (acceptance-friendly spec workload)",
     )
     parser.add_argument(
+        "--perfscope", type=str, default=None, metavar="PATH",
+        help="write the decode step's static HLO cost breakdown (perfscope "
+        "report JSON: FLOPs/bytes by op class) to PATH after warmup, so a "
+        "hardware round's throughput number ships with its attribution",
+    )
+    parser.add_argument(
         "--hot_swap_every", type=int, default=0,
         help="hot-swap identical weights every N decode steps mid-flight and "
         "oracle the output against a swap-free twin run (token-bitwise); "
@@ -416,6 +422,12 @@ def main() -> int:
 
     engine = fresh_engine(args.slots, spec_k=args.spec)
     warmup(engine)
+    if args.perfscope:
+        # after warmup the decode executable exists; the report is a static
+        # re-lowering walk, so it never perturbs the measured window below
+        from modalities_tpu.telemetry.perfscope import write_report
+
+        write_report(engine.perfscope_report(), args.perfscope)
     engine.metrics.reset()  # compile-window samples stay out of the scrape
     warm_tokens = engine.decode_token_count
     swap_records = []
@@ -571,6 +583,7 @@ def main() -> int:
                 **v3,
                 **hot,
                 "cache": args.cache,
+                "perfscope": args.perfscope,
                 "requests": args.requests,
                 "long_requests": args.long,
                 "slots": args.slots,

@@ -430,13 +430,22 @@ def entry_point_analyze_telemetry(sink_path: Path, as_json: bool) -> None:
     """Summarize a run's telemetry JSONL sink into a per-rank goodput table:
     every wall-clock second attributed to a bucket (init, compile, train_step,
     data_stall, eval, checkpoint, publish, other) plus goodput %."""
-    from modalities_tpu.telemetry.goodput import format_goodput_table, summarize_sink
+    from modalities_tpu.telemetry.goodput import (
+        format_goodput_table,
+        format_straggler_table,
+        straggler_summary,
+        summarize_sink,
+    )
 
     summary = summarize_sink(sink_path)
+    stragglers = straggler_summary(summary)
     if as_json:
-        click.echo(json.dumps(summary))
+        click.echo(json.dumps({**summary, "stragglers": stragglers}))
     else:
         click.echo(format_goodput_table(summary))
+        if len(summary.get("ranks", {})) > 1:
+            click.echo("\nstragglers (slowest rank per bucket):")
+            click.echo(format_straggler_table(stragglers))
 
 
 @data.command(name="analyze_serve")
@@ -461,6 +470,83 @@ def entry_point_analyze_serve(sink_path: Path, as_json: bool) -> None:
         click.echo(json.dumps(summary))
     else:
         click.echo(format_serve_table(summary))
+
+
+@data.command(name="analyze_perfscope")
+@click.option("--config_file_path", type=click.Path(exists=True, path_type=Path), required=True,
+              help="Training config; its jitted step is lowered + compiled on virtual "
+                   "CPU devices and the optimized HLO is cost-bucketed by op class.")
+@click.option("--report_path", type=click.Path(path_type=Path), default=None,
+              help="Also write the report JSON here (e.g. perfscope.json).")
+@click.option("--as_json", is_flag=True, default=False, help="Emit the report dict as JSON.")
+@_exception_handling
+def entry_point_analyze_perfscope(
+    config_file_path: Path, report_path: Optional[Path], as_json: bool
+) -> None:
+    """Static performance attribution: where the compiled train step's
+    FLOPs/bytes/estimated time go — matmul vs custom-call (flash/Pallas) vs
+    collectives per mesh axis vs host transfers vs elementwise. Per-bucket costs
+    sum to the module total by construction. Runs entirely on CPU."""
+    from modalities_tpu.telemetry.perfscope import (
+        format_perfscope_table,
+        run_perfscope_subprocess,
+        write_report,
+    )
+
+    report = run_perfscope_subprocess(config_file_path)
+    if report_path is not None:
+        write_report(report, report_path)
+    if as_json:
+        click.echo(json.dumps(report))
+    else:
+        click.echo(format_perfscope_table(report))
+
+
+@data.command(name="analyze_fleet")
+@click.option("--sink_path", "sink_paths", type=click.Path(exists=True, path_type=Path),
+              required=True, multiple=True,
+              help="Router and/or worker telemetry sinks (files or folders); repeatable "
+                   "— pass the router's sink AND each worker's to stitch the full tree.")
+@click.option("--as_json", is_flag=True, default=False, help="Emit stitched traces as JSON.")
+@_exception_handling
+def entry_point_analyze_fleet(sink_paths: tuple[Path, ...], as_json: bool) -> None:
+    """Stitch fleet-wide request traces: join the router's `fleet/request`
+    records with every worker's `serve_request` records on trace_id and render
+    one cross-tier span tree per request — a failover shows up as one trace
+    with two worker legs sharing the id."""
+    from modalities_tpu.serving.analyze import (
+        format_fleet_trace_tree,
+        load_fleet_records,
+        stitch_fleet_traces,
+    )
+
+    traces = stitch_fleet_traces(load_fleet_records(sink_paths))
+    if as_json:
+        click.echo(json.dumps(traces))
+    else:
+        click.echo(format_fleet_trace_tree(traces))
+
+
+@data.command(name="analyze_bench")
+@click.option("--artifacts_dir", type=click.Path(exists=True, path_type=Path), default=Path("."),
+              show_default=True,
+              help="Folder holding the driver's BENCH_r*.json / MULTICHIP_r*.json rounds.")
+@click.option("--as_json", is_flag=True, default=False, help="Emit the summary dict as JSON.")
+@_exception_handling
+def entry_point_analyze_bench(artifacts_dir: Path, as_json: bool) -> None:
+    """Benchmark-trajectory trend table over the per-round hardware artifacts:
+    MFU/tokens-per-sec per round with vs_baseline, wedged rounds (rc=124,
+    nothing parsed) and completed-but-metricless rounds flagged explicitly."""
+    from modalities_tpu.utils.benchmarking.trajectory import (
+        format_trajectory_table,
+        summarize_trajectory,
+    )
+
+    summary = summarize_trajectory(artifacts_dir)
+    if as_json:
+        click.echo(json.dumps(summary))
+    else:
+        click.echo(format_trajectory_table(summary))
 
 
 @data.command(name="tune_kernels")

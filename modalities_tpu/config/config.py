@@ -441,6 +441,11 @@ class TelemetryConfig(BaseModel):
     watchdog_deadline_s: Annotated[float, Field(ge=0)] = 1800.0
     watchdog_first_step_factor: Annotated[float, Field(ge=1)] = 4.0
     use_jax_annotations: bool = True
+    # step-time / goodput-bucket anomaly detection (PR 13): robust z-score
+    # threshold over a rolling window of per-step wall times; an anomalous step
+    # bumps training_step_time_anomaly_total and emits an anomaly/step_time event
+    anomaly_zscore: Annotated[float, Field(gt=0)] = 6.0
+    anomaly_window: Annotated[int, Field(ge=2)] = 64
 
 
 class ResilienceConfig(BaseModel):

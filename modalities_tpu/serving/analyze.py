@@ -216,3 +216,126 @@ def format_serve_table(summary: dict) -> str:
             bar = "#" * (p["active"] * width // max(peak, 1))
             lines.append(f"  {p['t']:>9.3f}s {p['active']:>3} {bar}")
     return "\n".join(lines)
+
+
+# ------------------------------------------------------------- fleet tracing
+# Cross-tier stitching (PR 13): the router's `fleet/request` record and every
+# worker's `serve_request` records share one trace_id — joining on it turns
+# "request latency spike" from a per-tier grep into ONE span tree per request.
+
+
+def _iter_jsonl(path: Path) -> Iterable[dict]:
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                yield json.loads(line)
+            except json.JSONDecodeError:
+                continue  # torn tail line from a killed run
+
+
+def load_fleet_records(sink_paths: Iterable[Path]) -> dict:
+    """Read router + worker sinks (files or folders of `telemetry_rank_*.jsonl`
+    / `*.jsonl`) into the three record streams the stitcher joins:
+    {"fleet_requests", "failovers", "serve_requests"}."""
+    files: list[Path] = []
+    for sink_path in sink_paths:
+        sink_path = Path(sink_path)
+        if sink_path.is_dir():
+            found = sorted(sink_path.glob("*.jsonl"))
+            if not found:
+                raise FileNotFoundError(f"no .jsonl sink files under {sink_path}")
+            files.extend(found)
+        else:
+            files.append(sink_path)
+    out = {"fleet_requests": [], "failovers": [], "serve_requests": []}
+    for path in files:
+        for event in _iter_jsonl(path):
+            kind = event.get("event")
+            if kind == "serve_request":
+                out["serve_requests"].append(event)
+            elif kind == "resilience" and event.get("name") == "fleet/request":
+                out["fleet_requests"].append(event)
+            elif kind == "resilience" and event.get("name") == "fleet/failover":
+                out["failovers"].append(event)
+    return out
+
+
+def stitch_fleet_traces(records: dict) -> list[dict]:
+    """Join the three streams on trace_id into one span tree per request:
+    {"trace_id", "router": fleet/request record or None, "failovers": [...],
+    "worker_legs": serve_request records sorted by hop}. Traces seen by only
+    one tier still appear (router-only: the worker sink wasn't collected;
+    worker-only: a direct client bypassed the router)."""
+    traces: dict[str, dict] = {}
+
+    def entry(trace_id: str) -> dict:
+        return traces.setdefault(
+            trace_id,
+            {"trace_id": trace_id, "router": None, "failovers": [], "worker_legs": []},
+        )
+
+    for rec in records.get("fleet_requests", ()):
+        tid = rec.get("trace_id")
+        if tid:
+            entry(tid)["router"] = rec
+    for rec in records.get("failovers", ()):
+        tid = rec.get("trace_id")
+        if tid:
+            entry(tid)["failovers"].append(rec)
+    for rec in records.get("serve_requests", ()):
+        tid = rec.get("trace_id")
+        if tid:
+            entry(tid)["worker_legs"].append(rec)
+    for trace in traces.values():
+        trace["worker_legs"].sort(key=lambda r: (int(r.get("hop") or 0), r.get("rid", 0)))
+    # stable order: router traces first, slowest e2e leading (the latency-spike
+    # triage order), then router-less traces by first worker arrival
+    def sort_key(trace: dict):
+        router = trace["router"]
+        if router is not None:
+            return (0, -float(router.get("e2e_s") or 0.0))
+        legs = trace["worker_legs"]
+        return (1, float(legs[0].get("arrival_s") or 0.0) if legs else 0.0)
+
+    return sorted(traces.values(), key=sort_key)
+
+
+def format_fleet_trace_tree(traces: list[dict]) -> str:
+    """Render stitched traces as one indented span tree per request."""
+    if not traces:
+        return "no fleet/request or serve_request records found"
+    lines: list[str] = []
+    for trace in traces:
+        router = trace["router"]
+        if router is not None:
+            lines.append(
+                f"trace {trace['trace_id']}  outcome={router.get('outcome')}  "
+                f"e2e={float(router.get('e2e_s') or 0.0):.4f}s  "
+                f"forwarded_tokens={router.get('forwarded_tokens')}"
+            )
+            for leg in router.get("legs") or ():
+                lines.append(
+                    f"  router leg hop={leg.get('hop')}  worker={leg.get('worker')}  "
+                    f"outcome={leg.get('outcome')}  "
+                    f"forwarded_tokens={leg.get('forwarded_tokens')}"
+                )
+        else:
+            lines.append(f"trace {trace['trace_id']}  (no router record)")
+        for rec in trace["worker_legs"]:
+            row = (
+                f"  worker leg hop={rec.get('hop')}  rid={rec.get('rid')}  "
+                f"finish={rec.get('finish_reason')}  tokens={rec.get('tokens')}"
+            )
+            if rec.get("ttft_s") is not None:
+                row += f"  ttft={float(rec['ttft_s']):.4f}s"
+            lines.append(row)
+        for rec in trace["failovers"]:
+            lines.append(
+                f"  failover off {rec.get('worker')} after "
+                f"{rec.get('forwarded_tokens')} forwarded tokens"
+            )
+        lines.append("")
+    return "\n".join(lines).rstrip()

@@ -73,6 +73,7 @@ from __future__ import annotations
 import os
 import threading
 import time
+import uuid
 from collections import deque
 from dataclasses import dataclass, field
 from typing import Callable, Optional
@@ -153,6 +154,10 @@ class ServeResult:
     first_token_s: float = 0.0  # engine-clock time the first token was available
     finish_s: float = 0.0
     token_times_s: list[float] = field(default_factory=list)
+    # fleet-wide request tracing (PR 13): ONE trace_id spans router -> every
+    # worker leg (a failover replay keeps the id, hop increments per leg)
+    trace_id: str = ""
+    trace_hop: int = 0
 
     @property
     def ttft_s(self) -> float:
@@ -757,6 +762,8 @@ class ServingEngine:
         temperature: Optional[float] = ...,
         seed: int = 0,
         arrival_offset_s: float = 0.0,
+        trace_id: Optional[str] = None,
+        trace_hop: int = 0,
     ) -> int:
         if not prompt_tokens:
             raise ValueError("empty prompt: the engine needs at least one prompt token")
@@ -774,8 +781,12 @@ class ServingEngine:
             )
         )
         arrival = max(float(arrival_offset_s), 0.0)
+        # fleet tracing: honor a propagated id (router/X-Trace-Id), mint otherwise
+        # — either way every record this request produces carries the same id
         self._traces[rid] = {"events": [], "preemptions": 0, "wait_from": arrival,
-                             "queue_wait_s": 0.0}
+                             "queue_wait_s": 0.0,
+                             "trace_id": trace_id or uuid.uuid4().hex[:16],
+                             "trace_hop": int(trace_hop)}
         self._trace_event(rid, "enqueue", arrival)
         self._m_submitted.inc()
         self._m_prompt_tokens.inc(len(prompt_tokens))
@@ -806,7 +817,10 @@ class ServingEngine:
         if trace is None or not trace.get("ttft_observed"):
             if trace is not None:
                 trace["ttft_observed"] = True
-            self._m_ttft.observe(max(0.0, now - result.arrival_s))
+            self._m_ttft.observe(
+                max(0.0, now - result.arrival_s),
+                exemplar=trace.get("trace_id") if trace is not None else None,
+            )
 
     def _flush_trace(self, result: ServeResult) -> None:
         """Finish: fold the lifecycle stream into ONE JSONL record on the
@@ -821,6 +835,8 @@ class ServingEngine:
         get_active_telemetry().emit_serve_trace(
             {
                 "rid": result.rid,
+                "trace_id": result.trace_id,
+                "hop": result.trace_hop,
                 "prompt_len": result.prompt_len,
                 "tokens": len(result.tokens),
                 "finish_reason": result.finish_reason,
@@ -862,6 +878,10 @@ class ServingEngine:
         result.finish_reason = reason
         result.finish_s = now
         result.weights_generation = self.weights_generation
+        trace = self._traces.get(result.rid)
+        if trace is not None:
+            result.trace_id = trace.get("trace_id", "")
+            result.trace_hop = int(trace.get("trace_hop", 0))
         if reason == "error":
             with self._stats_lock:
                 self.request_errors += 1
@@ -873,7 +893,9 @@ class ServingEngine:
             truncated=result.truncated,
         )
         self._m_finished.inc(reason=reason)
-        self._m_e2e.observe(max(0.0, now - result.arrival_s))
+        self._m_e2e.observe(
+            max(0.0, now - result.arrival_s), exemplar=result.trace_id or None
+        )
         self._flush_trace(result)
         if self._on_finish is not None:
             self._on_finish(result.rid, result)
@@ -1627,10 +1649,14 @@ class ServingEngine:
     def decode_lowered_text(self) -> str:
         """Lowered HLO of the decode step with the CURRENT arg shardings — the
         sharding acceptance test greps this for mesh annotations."""
+        return self._decode_lowered().as_text()
+
+    def _decode_lowered(self):
+        """The decode step's `jax.stages.Lowered` with the CURRENT arg shardings."""
         jnp = self._jnp
         with self._rules_ctx():
             if self.kv_cache == "paged":
-                lowered = self._decode_jit.lower(
+                return self._decode_jit.lower(
                     self.params, self.cache,
                     jnp.asarray(self._tokens), jnp.asarray(self._positions),
                     jnp.asarray(self._tables), jnp.asarray(self._wblk),
@@ -1638,11 +1664,25 @@ class ServingEngine:
                     jnp.asarray(self._keys), jnp.asarray(self._temps),
                     jnp.asarray(self._eods), jnp.asarray(self._remaining),
                 )
-            else:
-                lowered = self._decode_jit.lower(
-                    self.params, self.cache,
-                    jnp.asarray(self._tokens), jnp.asarray(self._positions),
-                    jnp.asarray(self._keys), jnp.asarray(self._temps),
-                    jnp.asarray(self._eods), jnp.asarray(self._remaining),
-                )
-        return lowered.as_text()
+            return self._decode_jit.lower(
+                self.params, self.cache,
+                jnp.asarray(self._tokens), jnp.asarray(self._positions),
+                jnp.asarray(self._keys), jnp.asarray(self._temps),
+                jnp.asarray(self._eods), jnp.asarray(self._remaining),
+            )
+
+    def perfscope_report(self, hw=None) -> dict:
+        """Compile the batched decode step and bucket its optimized-HLO cost by
+        op class (telemetry/perfscope.py) — the serving half of performance
+        attribution. Decode is the steady-state executable, so its matmul-vs-
+        bytes split IS the engine's roofline position."""
+        from modalities_tpu.telemetry.perfscope import perfscope_from_compiled
+
+        mesh_axis_sizes = None
+        if self._mesh_handle is not None:
+            mesh_axis_sizes = {
+                k: int(v) for k, v in self._mesh_handle.mesh.shape.items()
+            }
+        with self._rules_ctx():
+            compiled = self._decode_lowered().compile()
+        return perfscope_from_compiled(compiled, mesh_axis_sizes, hw)

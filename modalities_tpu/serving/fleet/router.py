@@ -32,6 +32,7 @@ import json
 import os
 import threading
 import time
+import uuid
 from typing import Optional
 
 from modalities_tpu.resilience.events import record_event
@@ -161,6 +162,17 @@ class FleetRouter:
         self._m_failovers = self.metrics.counter(
             "fleet_failovers_total", "Generate requests re-routed off a dead worker"
         )
+        # fleet tracing (PR 13): router-side end-to-end latency, exemplared with
+        # the trace_id so a histogram outlier leads straight to its span tree
+        self._m_e2e = self.metrics.histogram(
+            "fleet_request_e2e_seconds",
+            "Router-observed latency from generate arrival to the final SSE event",
+        )
+        from modalities_tpu.telemetry.metrics import register_process_metrics
+
+        from modalities_tpu import __version__
+
+        register_process_metrics(self.metrics, version=__version__)
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._aio_server = None
         self._health_task: Optional[asyncio.Task] = None
@@ -251,6 +263,11 @@ class FleetRouter:
             head = (
                 f"POST /generate HTTP/1.1\r\nHost: {worker.host}\r\n"
                 "Content-Type: application/json\r\n"
+                # fleet tracing: every leg of this request (failover replays
+                # included) carries the SAME trace_id; the hop counter tells the
+                # legs apart in the stitched span tree
+                f"X-Trace-Id: {state['trace_id']}\r\n"
+                f"X-Trace-Hop: {state['hop']}\r\n"
                 f"Content-Length: {len(body_bytes)}\r\nConnection: close\r\n\r\n"
             )
             writer.write(head.encode("latin-1") + body_bytes)
@@ -313,28 +330,44 @@ class FleetRouter:
             except (ConnectionError, OSError):
                 pass
 
-    async def _proxy_generate(self, body_bytes: bytes, client_writer) -> None:
+    async def _proxy_generate(
+        self, body_bytes: bytes, client_writer, headers: Optional[dict] = None
+    ) -> None:
         self.http_requests += 1
         if self._shutdown:
             client_writer.write(json_response_bytes(503, {"error": "router is draining"}))
             return
-        state = {"forwarded": 0, "headers_sent": False}
+        # mint the fleet-wide trace_id here (or honor one a client/upstream tier
+        # propagated): every worker leg, metric exemplar, and sink record of
+        # this request carries it — analyze_fleet stitches on it
+        trace_id = (headers or {}).get("x-trace-id") or uuid.uuid4().hex[:16]
+        state = {"forwarded": 0, "headers_sent": False, "trace_id": trace_id, "hop": 0}
+        legs: list[dict] = []
+        t_arrival = time.monotonic()
+        outcome = "client_gone"
         tried: set[str] = set()
         self._active_relays += 1
         try:
             while True:
                 worker = self._pick(tried)
                 if worker is None:
-                    payload = {"error": "no healthy workers"}
+                    payload = {"error": "no healthy workers", "trace_id": trace_id}
                     if state["headers_sent"]:
                         client_writer.write(sse_event_bytes(payload))
                     else:
                         client_writer.write(json_response_bytes(503, payload))
+                    outcome = "no_healthy_workers"
                     return
                 tried.add(worker.name)
+                leg = {"worker": worker.name, "hop": state["hop"], "t_start_s": round(
+                    time.monotonic() - t_arrival, 6)}
                 outcome = await self._relay_from_worker(
                     worker, body_bytes, client_writer, state
                 )
+                leg["outcome"] = outcome
+                leg["forwarded_tokens"] = state["forwarded"]
+                legs.append(leg)
+                state["hop"] += 1
                 if outcome == "done":
                     return
                 # the worker failed under us: out of rotation until a probe
@@ -355,12 +388,22 @@ class FleetRouter:
                 )
                 record_event(
                     "fleet/failover", worker=worker.name,
-                    forwarded_tokens=state["forwarded"],
+                    forwarded_tokens=state["forwarded"], trace_id=trace_id,
                 )
         except _ClientGone:
+            outcome = "client_gone"
             return
         finally:
             self._active_relays -= 1
+            e2e_s = time.monotonic() - t_arrival
+            self._m_e2e.observe(e2e_s, exemplar=trace_id)
+            # the router's half of the cross-tier span tree: one record per
+            # request, stitched against the workers' serve_request records
+            record_event(
+                "fleet/request", trace_id=trace_id, outcome=outcome,
+                forwarded_tokens=state["forwarded"], e2e_s=round(e2e_s, 6),
+                legs=legs,
+            )
 
     # -------------------------------------------------------------- endpoints
     def _fleet_table(self) -> dict:
@@ -386,7 +429,7 @@ class FleetRouter:
             req = await read_http_request(reader)
             if req is None:
                 return
-            method, path, _headers, body_bytes = req
+            method, path, headers, body_bytes = req
             if method == "GET" and path == "/healthz":
                 healthy = sum(1 for w in self.workers if w.healthy)
                 writer.write(
@@ -405,7 +448,7 @@ class FleetRouter:
                 data = self.metrics.render().encode("utf-8")
                 writer.write(response_bytes(200, CONTENT_TYPE_LATEST, data))
             elif method == "POST" and path == "/generate":
-                await self._proxy_generate(body_bytes, writer)
+                await self._proxy_generate(body_bytes, writer, headers)
             else:
                 writer.write(json_response_bytes(404, {"error": f"unknown path {path}"}))
             await writer.drain()

@@ -340,6 +340,19 @@ def serve(
     config_dict = load_app_config_dict(config_file_path)
     components = build_serving_components(config_dict)
     component = components.serving_component
+    # fleet-scrape identity (PR 13): every worker's /metrics carries a
+    # build_info gauge (version + config hash) and process uptime/RSS gauges.
+    # The engine's registry defaults to the active telemetry's, so registering
+    # there covers the HTTP front end's /metrics rendering.
+    from modalities_tpu import __version__
+    from modalities_tpu.telemetry import get_active_telemetry
+    from modalities_tpu.telemetry.metrics import config_hash_of, register_process_metrics
+
+    register_process_metrics(
+        get_active_telemetry().metrics,
+        version=__version__,
+        config_hash=config_hash_of(config_file_path),
+    )
     if fleet and not hasattr(component, "run_fleet"):
         raise ValueError(
             "--fleet needs the fleet serving component: set the config's "

@@ -195,6 +195,8 @@ class ServingHTTPServer:
                     temperature=body.get("temperature"),
                     seed=int(body.get("seed") or 0),
                     arrival_offset_s=self.engine._now() - t0,
+                    trace_id=body.get("trace_id") or None,
+                    trace_hop=int(body.get("trace_hop") or 0),
                 )
                 self._streams[rid] = stream
                 stream.put(("rid", rid))
@@ -270,6 +272,7 @@ class ServingHTTPServer:
                                 "prompt_len": result.prompt_len,
                                 "ttft_s": result.ttft_s,
                                 "weights_generation": result.weights_generation,
+                                "trace_id": result.trace_id,
                             }
                         )
                     )
@@ -284,12 +287,22 @@ class ServingHTTPServer:
             # anyway (no cancellation path) — tokens drop here
             return
 
-    async def _handle_generate(self, body_bytes: bytes, writer: asyncio.StreamWriter) -> None:
+    async def _handle_generate(
+        self,
+        body_bytes: bytes,
+        writer: asyncio.StreamWriter,
+        headers: Optional[dict] = None,
+    ) -> None:
         with span("serve/http"):
             self.http_requests += 1
             self._m_http.inc()
             try:
                 body = json.loads(body_bytes or b"{}")
+                # fleet tracing: the router's X-Trace-Id/X-Trace-Hop headers ride
+                # into the engine submit (body keys win when a client sets both)
+                if headers and headers.get("x-trace-id"):
+                    body.setdefault("trace_id", headers["x-trace-id"])
+                    body.setdefault("trace_hop", headers.get("x-trace-hop") or 0)
                 prompt = body.get("prompt")
                 if not isinstance(prompt, str) or not prompt:
                     writer.write(
@@ -332,7 +345,7 @@ class ServingHTTPServer:
             req = await read_http_request(reader)
             if req is None:
                 return
-            method, path, _headers, body_bytes = req
+            method, path, headers, body_bytes = req
             if method == "GET" and path == "/healthz":
                 writer.write(
                     json_response_bytes(
@@ -355,7 +368,7 @@ class ServingHTTPServer:
                 data = self.engine.metrics.render().encode("utf-8")
                 writer.write(response_bytes(200, CONTENT_TYPE_LATEST, data))
             elif method == "POST" and path == "/generate":
-                await self._handle_generate(body_bytes, writer)
+                await self._handle_generate(body_bytes, writer, headers)
             elif method == "POST" and path == "/admin/swap":
                 await self._handle_admin_swap(body_bytes, writer)
             else:

@@ -60,6 +60,8 @@ class Telemetry:
         watchdog_first_step_factor: float = 4.0,
         use_jax_annotations: bool = True,
         global_rank: Optional[int] = None,
+        anomaly_zscore: float = 6.0,
+        anomaly_window: int = 64,
     ):
         self.enabled = enabled
         self.watchdog_deadline_s = float(watchdog_deadline_s)
@@ -72,6 +74,13 @@ class Telemetry:
         # training publish path all register into this registry (PR 10); present
         # even when disabled so instrumented code never guards its metric calls
         self.metrics = MetricsRegistry()
+        # step-time / goodput-bucket anomaly detection (PR 13): lazily built
+        # robust-z detectors; inert when disabled
+        self.anomaly_zscore = float(anomaly_zscore)
+        self.anomaly_window = int(anomaly_window)
+        self._step_time_detector = None
+        self._bucket_detectors: dict[str, object] = {}
+        self._last_bucket_seconds: dict[str, float] = {}
         if not enabled:
             self.global_rank = 0
             self._recorder = None
@@ -147,6 +156,9 @@ class Telemetry:
                 deadline_s=self.watchdog_deadline_s,
                 artifact_dir=artifact_dir,
                 global_rank=self.global_rank,
+                # a hang artifact carries the live scrape surface too (PR 13):
+                # counters to correlate the wedged step against
+                metrics_provider=self.metrics.snapshot,
             )
             for provider in self._pending_state_providers:
                 self._watchdog.register_state_provider(provider)
@@ -206,7 +218,69 @@ class Telemetry:
         )
         for bucket in BUCKETS:
             bucket_gauge.set(summary["buckets"][bucket], bucket=bucket)
+        self._observe_bucket_deltas(summary["buckets"])
         return metrics
+
+    # ------------------------------------------------------- anomaly detection
+
+    def _detector(self):
+        from modalities_tpu.telemetry.perfscope import AnomalyDetector
+
+        return AnomalyDetector(
+            window=self.anomaly_window, zscore_threshold=self.anomaly_zscore
+        )
+
+    def observe_step_time(self, seconds: float, step_id: Optional[int] = None) -> None:
+        """Feed one step's wall time through the rolling robust-z detector
+        (PR 13). An anomalous step bumps `training_step_time_anomaly_total`,
+        the live z/EWMA land on gauges, and the sink gets an `anomaly/step_time`
+        event the analyze CLI can line up against the goodput buckets."""
+        if not self.enabled:
+            return
+        if self._step_time_detector is None:
+            self._step_time_detector = self._detector()
+        verdict = self._step_time_detector.observe(seconds)
+        z = verdict.zscore if verdict.zscore not in (float("inf"), float("-inf")) else 1e9
+        self.metrics.gauge(
+            "training_step_time_zscore", "Robust z-score of the latest step's wall time"
+        ).set(z)
+        self.metrics.gauge(
+            "training_step_time_ewma_seconds", "EWMA of per-step wall time"
+        ).set(verdict.ewma)
+        if verdict.is_anomaly:
+            self.metrics.counter(
+                "training_step_time_anomaly_total",
+                "Steps whose wall time scored over the anomaly z-score threshold",
+            ).inc()
+            self.emit_event(
+                "anomaly/step_time",
+                {"step_id": step_id, "seconds": round(seconds, 6),
+                 "zscore": round(z, 3), "ewma_s": round(verdict.ewma, 6)},
+            )
+
+    def _observe_bucket_deltas(self, bucket_seconds: dict) -> None:
+        """Per-publish goodput-bucket deltas through per-bucket detectors: a
+        publish interval that suddenly spends 10x its usual data_stall seconds
+        scores high on `training_goodput_bucket_zscore{bucket="data_stall"}`."""
+        zscore_gauge = self.metrics.gauge(
+            "training_goodput_bucket_zscore",
+            "Robust z-score of each goodput bucket's seconds over the last publish interval",
+        )
+        for bucket in BUCKETS:
+            total = float(bucket_seconds.get(bucket, 0.0))
+            delta = total - self._last_bucket_seconds.get(bucket, 0.0)
+            self._last_bucket_seconds[bucket] = total
+            detector = self._bucket_detectors.get(bucket)
+            if detector is None:
+                detector = self._bucket_detectors[bucket] = self._detector()
+            verdict = detector.observe(delta)
+            z = verdict.zscore if abs(verdict.zscore) != float("inf") else 1e9
+            zscore_gauge.set(z, bucket=bucket)
+            if verdict.is_anomaly:
+                self.emit_event(
+                    "anomaly/goodput_bucket",
+                    {"bucket": bucket, "delta_s": round(delta, 6), "zscore": round(z, 3)},
+                )
 
     def publish_resource_gauges(
         self,

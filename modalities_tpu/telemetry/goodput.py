@@ -198,6 +198,54 @@ def summarize_sink(path: Union[str, Path]) -> dict:
     return {"ranks": ranks, "combined": combined}
 
 
+def straggler_summary(summary: dict) -> dict:
+    """Cross-rank straggler attribution over a `summarize_sink` result (PR 13):
+    per goodput bucket, name the slowest rank and how far it sits above the
+    cross-rank median — a data_stall bucket where rank 3 spends 4x the median
+    IS the straggler the ROADMAP's multi-host rounds need named.
+
+    Returns {bucket: {"slowest_rank", "seconds", "median_s", "ratio_vs_median"}}
+    for buckets where any rank recorded time; single-rank sinks yield ratios of
+    1.0 (no peer to lag behind)."""
+    ranks = summary.get("ranks") or {}
+    if not ranks:
+        return {}
+    out: dict[str, dict] = {}
+    for bucket in BUCKETS:
+        per_rank = {
+            rank: float(s["buckets"].get(bucket, 0.0)) for rank, s in ranks.items()
+        }
+        worst_rank = max(per_rank, key=per_rank.get)
+        worst = per_rank[worst_rank]
+        if worst <= 0.0:
+            continue
+        values = sorted(per_rank.values())
+        n = len(values)
+        median = (
+            values[n // 2] if n % 2 else 0.5 * (values[n // 2 - 1] + values[n // 2])
+        )
+        out[bucket] = {
+            "slowest_rank": worst_rank,
+            "seconds": round(worst, 6),
+            "median_s": round(median, 6),
+            "ratio_vs_median": round(worst / median, 3) if median > 0 else None,
+        }
+    return out
+
+
+def format_straggler_table(stragglers: dict) -> str:
+    if not stragglers:
+        return "no per-rank bucket time recorded"
+    lines = [f"{'bucket':<20} {'slowest':>8} {'seconds':>11} {'median':>11} {'x median':>9}"]
+    for bucket, row in stragglers.items():
+        ratio = f"{row['ratio_vs_median']:.2f}" if row["ratio_vs_median"] is not None else "-"
+        lines.append(
+            f"{bucket:<20} {('rank ' + str(row['slowest_rank'])):>8} "
+            f"{row['seconds']:>10.3f}s {row['median_s']:>10.3f}s {ratio:>9}"
+        )
+    return "\n".join(lines)
+
+
 def format_goodput_table(summary: dict) -> str:
     """Render a summarize_sink() result as an aligned text table."""
     lines = []

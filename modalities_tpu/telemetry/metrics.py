@@ -40,6 +40,7 @@ from __future__ import annotations
 import math
 import re
 import threading
+import time
 from bisect import bisect_left
 from typing import Iterable, Optional, Sequence
 
@@ -208,6 +209,9 @@ class Histogram(_Metric):
             raise ValueError(f"histogram {name}: bucket bounds must be strictly increasing")
         self.bounds = bounds
         self._series: dict[tuple, list[float]] = {}  # [per-bucket.., +Inf, sum, count]
+        # fleet tracing (PR 13): last exemplar per label set, rendered as a
+        # comment line so `parse_prometheus_text` (which skips '#') stays valid
+        self._exemplars: dict[tuple, tuple[str, float]] = {}
 
     def _row(self, key: tuple) -> list[float]:
         row = self._series.get(key)
@@ -215,7 +219,7 @@ class Histogram(_Metric):
             row = self._series[key] = [0.0] * (len(self.bounds) + 3)
         return row
 
-    def observe(self, value: float, **labels) -> None:
+    def observe(self, value: float, exemplar: Optional[str] = None, **labels) -> None:
         idx = bisect_left(self.bounds, value)  # first bound >= value; == len -> +Inf
         key = _label_key(labels)
         with self._lock:
@@ -223,6 +227,12 @@ class Histogram(_Metric):
             row[idx] += 1
             row[-2] += value
             row[-1] += 1
+            if exemplar is not None:
+                self._exemplars[key] = (str(exemplar), float(value))
+
+    def exemplar(self, **labels) -> Optional[tuple[str, float]]:
+        """(trace_id, value) of the last exemplar-tagged observation, or None."""
+        return self._exemplars.get(_label_key(labels))
 
     def count(self, **labels) -> float:
         row = self._series.get(_label_key(labels))
@@ -249,10 +259,12 @@ class Histogram(_Metric):
     def reset(self) -> None:
         with self._lock:
             self._series.clear()
+            self._exemplars.clear()
 
     def render_lines(self):
         with self._lock:
             series = {k: list(v) for k, v in self._series.items()}
+            exemplars = dict(self._exemplars)
         if not series:
             series = {(): [0.0] * (len(self.bounds) + 3)}
         for key in sorted(series):
@@ -267,6 +279,12 @@ class Histogram(_Metric):
             yield f"{self.name}_bucket{_labels_text(inf_key)} {_fmt(cum)}"
             yield f"{self.name}_sum{_labels_text(key)} {_fmt(row[-2])}"
             yield f"{self.name}_count{_labels_text(key)} {_fmt(row[-1])}"
+            ex = exemplars.get(key)
+            if ex is not None:
+                # comment line by design: our exposition subset has no native
+                # OpenMetrics exemplar syntax, and '#' lines are parse-safe
+                yield (f"# EXEMPLAR {self.name}{_labels_text(key)} "
+                       f'trace_id="{_escape(ex[0])}" value={_fmt(ex[1])}')
 
 
 def _quantile_from_bucket_counts(
@@ -343,6 +361,90 @@ class MetricsRegistry:
             lines.append(f"# TYPE {metric.name} {metric.kind}")
             lines.extend(metric.render_lines())
         return "\n".join(lines) + "\n"
+
+    def snapshot(self) -> dict[str, dict]:
+        """JSON-safe point-in-time view of every registered series — what a
+        watchdog crash artifact embeds so a hang has counters to correlate
+        against, not just thread stacks. Histograms report sum/count (the
+        per-bucket rows stay on the scrape surface)."""
+        out: dict[str, dict] = {}
+        with self._lock:
+            metrics = {name: self._metrics[name] for name in sorted(self._metrics)}
+        for name, metric in metrics.items():
+            entry: dict = {"kind": metric.kind}
+            try:
+                if isinstance(metric, Histogram):
+                    with metric._lock:
+                        entry["series"] = {
+                            _labels_text(k) or "{}": {"sum": row[-2], "count": row[-1]}
+                            for k, row in metric._series.items()
+                        }
+                elif isinstance(metric, Gauge):
+                    with metric._lock:
+                        keys = set(metric._series) | set(metric._fns)
+                    entry["series"] = {
+                        _labels_text(k) or "{}": metric.value(**dict(k)) for k in keys
+                    }
+                else:
+                    with metric._lock:
+                        entry["series"] = {
+                            _labels_text(k) or "{}": v for k, v in metric._series.items()
+                        }
+            except Exception as e:  # a broken gauge callback must not sink the dump
+                entry["error"] = repr(e)
+            out[name] = entry
+        return out
+
+
+_PROCESS_START_S = time.monotonic()
+
+
+def _rss_bytes() -> float:
+    """Resident set size from /proc (Linux); ru_maxrss fallback elsewhere."""
+    try:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    return float(line.split()[1]) * 1024.0
+    except OSError:
+        pass
+    try:
+        import resource
+
+        return float(resource.getrusage(resource.RUSAGE_SELF).ru_maxrss) * 1024.0
+    except Exception:
+        return 0.0
+
+
+def register_process_metrics(
+    registry: "MetricsRegistry",
+    version: str = "",
+    config_hash: str = "",
+) -> None:
+    """Fleet-scrape identity + leak detection (PR 13): a constant-1
+    `modalities_tpu_build_info` gauge whose labels tell workers apart, plus
+    live process uptime/RSS gauges. Idempotent (get-or-create semantics)."""
+    registry.gauge(
+        "modalities_tpu_build_info",
+        "Constant 1; labels carry the package version and config hash",
+    ).set(1, version=version or "unknown", config_hash=config_hash or "unknown")
+    registry.gauge(
+        "process_uptime_seconds", "Seconds since this process registered metrics"
+    ).set_fn(lambda: time.monotonic() - _PROCESS_START_S)
+    registry.gauge(
+        "process_resident_memory_bytes", "Resident set size of this process"
+    ).set_fn(_rss_bytes)
+
+
+def config_hash_of(path) -> str:
+    """Short stable hash of a config file's bytes for the build_info label."""
+    import hashlib
+    from pathlib import Path as _Path
+
+    try:
+        return hashlib.sha256(_Path(path).read_bytes()).hexdigest()[:12]
+    except OSError:
+        return "unknown"
 
 
 CONTENT_TYPE_LATEST = "text/plain; version=0.0.4; charset=utf-8"

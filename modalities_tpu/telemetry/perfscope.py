@@ -1,0 +1,612 @@
+"""Performance-attribution scope (PR 13): static HLO cost breakdown, programmatic
+profiler capture windows, and step-time anomaly detection.
+
+Three pillars, all host-side observability (nothing here touches the jitted
+step's math — pinned by the bitwise profiler test):
+
+1. **HLO cost scope.** `analyze_hlo_text` walks an OPTIMIZED (post-SPMD) HLO
+   module — the text `jax.jit(...).lower(...).compile().as_text()` returns —
+   and buckets every instruction's FLOPs / bytes / roofline time estimate into
+   op classes: `matmul`, `custom_call` (Pallas kernels), `collective:<axis>`
+   (per mesh axis, matched by replica-group size), `host_transfer`,
+   `elementwise`, and `other`. The per-bucket totals sum to the module total
+   *by construction* (every instruction lands in exactly one bucket), so the
+   report's closure is a structural invariant, not a float coincidence — the
+   tier-1 test pins it. This is the GSPMD observation (arXiv 2105.04663) made
+   operational: the partitioned program statically names every collective and
+   matmul, so "where does the roofline say the MFU went" is answerable on a
+   CPU host without a single device second.
+2. **Profiler capture windows.** `ProfileWindow.from_env()` parses
+   `MODALITIES_TPU_PROFILE_AT_STEP=N[:K]` and arms `jax.profiler`
+   start/stop_trace around steps [N, N+K) — the trainer calls
+   `maybe_start`/`maybe_stop` unconditionally; both are no-ops outside the
+   window. Capture must never perturb results: the step fn is untouched, only
+   host-side trace collection toggles.
+3. **Anomaly detection.** `AnomalyDetector` keeps a rolling window and scores
+   each observation with a robust z (median/MAD, 0.6745 normalization) plus an
+   EWMA; the `Telemetry` facade feeds per-step wall time and per-goodput-bucket
+   deltas through detectors into the PR-10 metrics registry
+   (`training_step_time_anomaly_total`, `training_goodput_bucket_zscore`).
+
+The module doubles as a subprocess entry point (mirroring
+utils/recipe_validation.py): `python -m modalities_tpu.telemetry.perfscope
+<config.yaml>` builds the recipe's train step over a virtual CPU mesh of its
+world_size, lowers + compiles it, and prints the perfscope report JSON — the
+`data analyze_perfscope` CLI's engine.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import re
+import statistics
+from collections import deque
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Optional, Union
+
+from modalities_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+# ------------------------------------------------------------------ HLO parsing
+
+_DTYPE_BYTES = {
+    "pred": 1, "s2": 1, "s4": 1, "s8": 1, "u2": 1, "u4": 1, "u8": 1,
+    "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4, "f8e4m3fn": 1, "f8e5m2": 1,
+    "s64": 8, "u64": 8, "f64": 8, "c64": 8, "c128": 16,
+    "token": 0, "opaque": 0,
+}
+
+# one typed array literal inside an HLO instruction line: dtype[dims]{layout}?
+_SHAPE_RE = re.compile(r"\b([a-z][a-z0-9]*)\[([0-9,]*)\](?:\{[^}]*\})?")
+# instruction line: "  %name = <shapes> opcode(...), attrs" (ROOT optional)
+_INSTR_RE = re.compile(r"^\s*(?:ROOT\s+)?%?([\w.\-]+)\s*=\s*(.*)$")
+# first bare identifier followed by '(' after the output shape(s) is the opcode
+_OPCODE_RE = re.compile(r"\b([a-z][a-z0-9\-]*)\(")
+_COMP_START_RE = re.compile(r"^\s*(?:ENTRY\s+)?%?([\w.\-]+)\s+(?:\([^)]*\)\s*->|\{)")
+_CALLS_RE = re.compile(r"calls=%?([\w.\-]+)")
+_REPLICA_GROUPS_IOTA_RE = re.compile(r"replica_groups=\[(\d+),(\d+)\]")
+_REPLICA_GROUPS_LIT_RE = re.compile(r"replica_groups=\{\{([^}]*)\}")
+_CONTRACT_RE = re.compile(r"lhs_contracting_dims=\{([0-9,]*)\}")
+_CUSTOM_TARGET_RE = re.compile(r'custom_call_target="([^"]*)"')
+
+# instruction opcodes that are pure bookkeeping: no data moved, no flops
+_SKIP_OPS = frozenset(
+    ("parameter", "constant", "tuple", "get-tuple-element", "bitcast",
+     "after-all", "partition-id", "replica-id", "domain", "opt-barrier")
+)
+_COLLECTIVE_OPS = frozenset(
+    ("all-reduce", "all-gather", "reduce-scatter", "all-to-all",
+     "collective-permute", "collective-broadcast",
+     "all-reduce-start", "all-gather-start", "collective-permute-start")
+)
+# *-done halves complete an async pair whose cost the *-start already carries
+_COLLECTIVE_DONE_OPS = frozenset(
+    ("all-reduce-done", "all-gather-done", "collective-permute-done",
+     "async-done", "async-update")
+)
+_HOST_OPS = frozenset(("send", "recv", "send-done", "recv-done", "infeed", "outfeed"))
+_MATMUL_OPS = frozenset(("dot", "convolution"))
+# ops that do ~1 flop per output element (the elementwise/reduction family);
+# everything else with shapes is data movement -> "other"
+_ELEMENTWISE_OPS = frozenset(
+    ("add", "subtract", "multiply", "divide", "power", "maximum", "minimum",
+     "abs", "negate", "exponential", "exponential-minus-one", "log",
+     "log-plus-one", "logistic", "tanh", "sqrt", "rsqrt", "cbrt", "sign",
+     "sine", "cosine", "tan", "atan2", "erf", "floor", "ceil", "round",
+     "round-nearest-even", "compare", "select", "clamp", "and", "or", "xor",
+     "not", "shift-left", "shift-right-logical", "shift-right-arithmetic",
+     "remainder", "is-finite", "reduce", "reduce-window", "map",
+     "select-and-scatter", "sort", "rng", "rng-bit-generator", "iota",
+     "stochastic-convert", "convert", "reduce-precision", "exp")
+)
+
+# annotation-only custom calls the SPMD pipeline leaves behind — zero cost
+_ANNOTATION_CUSTOM_CALLS = frozenset(
+    ("Sharding", "SPMDFullToShardShape", "SPMDShardToFullShape",
+     "MoveToHost", "MoveToDevice", "AllocateBuffer")
+)
+
+
+@dataclass
+class HwSpec:
+    """Roofline constants for the time estimate. Defaults are TPU v5p-ish
+    (bf16 peak, HBM3 bandwidth, one ICI link); override per call or leave as-is
+    — bucket *shares* are what the report is for, not absolute seconds."""
+
+    peak_flops: float = 459e12  # bf16 FLOP/s
+    hbm_bw: float = 2.765e12  # bytes/s
+    collective_bw: float = 4.8e11  # bytes/s over ICI
+    collective_latency_s: float = 1e-6  # per-op launch/sync cost
+
+    def as_dict(self) -> dict:
+        return {
+            "peak_flops": self.peak_flops,
+            "hbm_bw": self.hbm_bw,
+            "collective_bw": self.collective_bw,
+            "collective_latency_s": self.collective_latency_s,
+        }
+
+
+def _shape_bytes(dtype: str, dims: str) -> tuple[int, int]:
+    """(element_count, bytes) for one dtype[dims] literal."""
+    n = 1
+    for d in dims.split(","):
+        if d:
+            n *= int(d)
+    return n, n * _DTYPE_BYTES.get(dtype, 4)
+
+
+def _line_shapes(text: str) -> list[tuple[int, int, int]]:
+    """Every (position, elements, bytes) shape literal in an instruction line."""
+    out = []
+    for m in _SHAPE_RE.finditer(text):
+        if m.group(1) not in _DTYPE_BYTES and not m.group(2):
+            continue
+        elems, nbytes = _shape_bytes(m.group(1), m.group(2))
+        out.append((m.start(), elems, nbytes))
+    return out
+
+
+def _collective_axis(line: str, mesh_axis_sizes: Optional[dict[str, int]]) -> str:
+    """Name the mesh axis a collective runs over by matching its replica-group
+    size against the mesh axis sizes; unmatched sizes keep a `size<g>` tag so
+    the bucket is still stable and greppable."""
+    group_size = None
+    m = _REPLICA_GROUPS_IOTA_RE.search(line)
+    if m:  # iota format [groups,size]<=[n]
+        group_size = int(m.group(2))
+    else:
+        m = _REPLICA_GROUPS_LIT_RE.search(line)
+        if m:  # literal format {{0,1},{2,3}}: size of the first group
+            group_size = len([t for t in m.group(1).split(",") if t.strip()])
+    if group_size is None or group_size <= 1:
+        return "all"
+    for axis, size in sorted((mesh_axis_sizes or {}).items()):
+        if int(size) == group_size:
+            return axis
+    return f"size{group_size}"
+
+
+def _instruction_cost(opcode: str, line: str, rhs: str, opcode_pos: int) -> tuple[int, int]:
+    """(flops, bytes) for one instruction line. Output shapes precede the
+    opcode; operand shapes follow it. Bytes = operands read + outputs written
+    (the HBM traffic a roofline charges); flops are per-op-family estimates."""
+    shapes = _line_shapes(rhs)
+    out_elems = sum(e for pos, e, _ in shapes if pos < opcode_pos)
+    out_bytes = sum(b for pos, _, b in shapes if pos < opcode_pos)
+    in_bytes = sum(b for pos, _, b in shapes if pos > opcode_pos)
+    nbytes = out_bytes + in_bytes
+
+    if opcode in _MATMUL_OPS:
+        contract = 1
+        m = _CONTRACT_RE.search(line)
+        if m and opcode == "dot":
+            # contracting size = product of the lhs dims named in the attr;
+            # the lhs shape is the first operand literal after the opcode
+            operand_shapes = [
+                (pos, _SHAPE_RE.match(rhs, pos)) for pos, _, _ in shapes if pos > opcode_pos
+            ]
+            if operand_shapes:
+                lhs = operand_shapes[0][1]
+                dims = [int(d) for d in lhs.group(2).split(",") if d]
+                for idx in (int(i) for i in m.group(1).split(",") if i):
+                    if 0 <= idx < len(dims):
+                        contract *= dims[idx]
+        flops = 2 * out_elems * max(contract, 1)
+        return flops, nbytes
+    if opcode in _ELEMENTWISE_OPS:
+        return out_elems, nbytes
+    return 0, nbytes
+
+
+def analyze_hlo_text(
+    hlo_text: str,
+    mesh_axis_sizes: Optional[dict[str, int]] = None,
+    hw: Optional[HwSpec] = None,
+    top_ops: int = 5,
+) -> dict:
+    """Bucket one optimized HLO module's instructions into op-class costs.
+
+    Fusion double-count rule: a `fusion` instruction carries the HBM traffic
+    (its operand/output shapes ARE what the fused kernel reads/writes) but no
+    flops; the instructions inside the fused computation carry their flops but
+    no bytes (their intermediates live in registers/VMEM). Every instruction
+    therefore contributes to exactly one bucket once, and the report total is
+    the sum of the buckets by construction.
+    """
+    hw = hw or HwSpec()
+    # computations referenced by fusion instructions: inner ops = flops only
+    fused_comps = set(_CALLS_RE.findall(hlo_text))
+    module_name = ""
+    m = re.search(r"HloModule\s+([\w.\-]+)", hlo_text)
+    if m:
+        module_name = m.group(1)
+
+    buckets: dict[str, dict] = {}
+
+    def _bucket(name: str) -> dict:
+        b = buckets.get(name)
+        if b is None:
+            b = buckets[name] = {"ops": 0, "flops": 0, "bytes": 0, "est_time_s": 0.0, "top_ops": []}
+        return b
+
+    current_comp = None
+    for raw_line in hlo_text.splitlines():
+        comp_m = _COMP_START_RE.match(raw_line)
+        if comp_m and ("{" in raw_line or "->" in raw_line) and "=" not in raw_line.split("{")[0]:
+            current_comp = comp_m.group(1)
+            continue
+        instr = _INSTR_RE.match(raw_line)
+        if instr is None:
+            continue
+        rhs = instr.group(2)
+        op_m = _OPCODE_RE.search(rhs)
+        if op_m is None:
+            continue
+        opcode = op_m.group(1)
+        if opcode in _SKIP_OPS:
+            continue
+        in_fusion = current_comp in fused_comps
+
+        flops, nbytes = _instruction_cost(opcode, raw_line, rhs, op_m.start())
+        if opcode == "fusion":
+            flops = 0  # inner ops carry the flops
+        elif in_fusion:
+            nbytes = 0  # the fusion instruction carries the traffic
+
+        if opcode in _COLLECTIVE_DONE_OPS:
+            continue  # cost carried by the matching *-start
+        if opcode in _COLLECTIVE_OPS:
+            bucket_name = f"collective:{_collective_axis(raw_line, mesh_axis_sizes)}"
+            est = nbytes / hw.collective_bw + hw.collective_latency_s
+        elif opcode in _HOST_OPS:
+            bucket_name = "host_transfer"
+            est = nbytes / hw.hbm_bw
+        elif opcode in _MATMUL_OPS:
+            bucket_name = "matmul"
+            est = max(flops / hw.peak_flops, nbytes / hw.hbm_bw)
+        elif opcode == "custom-call":
+            target_m = _CUSTOM_TARGET_RE.search(raw_line)
+            target = target_m.group(1) if target_m else ""
+            if target in _ANNOTATION_CUSTOM_CALLS:
+                continue  # SPMD annotation, not a kernel
+            if "gemm" in target.lower() or "dot" in target.lower():
+                bucket_name = "matmul"
+            else:
+                bucket_name = "custom_call"
+            est = max(flops / hw.peak_flops, nbytes / hw.hbm_bw)
+        elif opcode in _ELEMENTWISE_OPS or opcode == "fusion":
+            bucket_name = "elementwise"
+            est = max(flops / hw.peak_flops, nbytes / hw.hbm_bw)
+        else:
+            bucket_name = "other"
+            est = nbytes / hw.hbm_bw
+
+        b = _bucket(bucket_name)
+        b["ops"] += 1
+        b["flops"] += flops
+        b["bytes"] += nbytes
+        b["est_time_s"] += est
+        b["top_ops"].append(
+            {"op": f"{opcode} %{instr.group(1)}", "flops": flops, "bytes": nbytes,
+             "est_time_s": est}
+        )
+
+    for b in buckets.values():
+        b["top_ops"] = sorted(b["top_ops"], key=lambda o: -o["est_time_s"])[:top_ops]
+        b["est_time_s"] = round(b["est_time_s"], 12)
+        for o in b["top_ops"]:
+            o["est_time_s"] = round(o["est_time_s"], 12)
+
+    # module total = sum of buckets, BY CONSTRUCTION (the closure the tier-1
+    # test pins): every counted instruction incremented exactly one bucket
+    total = {
+        "ops": sum(b["ops"] for b in buckets.values()),
+        "flops": sum(b["flops"] for b in buckets.values()),
+        "bytes": sum(b["bytes"] for b in buckets.values()),
+        "est_time_s": round(sum(b["est_time_s"] for b in buckets.values()), 12),
+    }
+    return {
+        "module": module_name,
+        "mesh_axes": dict(mesh_axis_sizes or {}),
+        "hw": hw.as_dict(),
+        "buckets": {k: buckets[k] for k in sorted(buckets)},
+        "total": total,
+    }
+
+
+def perfscope_from_compiled(
+    compiled, mesh_axis_sizes: Optional[dict[str, int]] = None,
+    hw: Optional[HwSpec] = None,
+) -> dict:
+    """Report for one `jax.stages.Compiled` executable: the optimized-HLO walk
+    plus XLA's own cost analysis as an independent cross-check column."""
+    report = analyze_hlo_text(compiled.as_text(), mesh_axis_sizes, hw)
+    try:
+        cost = compiled.cost_analysis()
+        if isinstance(cost, (list, tuple)):  # some jaxlibs return one dict per device
+            cost = cost[0] if cost else {}
+        report["xla_cost_analysis"] = {
+            k: float(v) for k, v in cost.items()
+            if k in ("flops", "bytes accessed", "optimal_seconds")
+        }
+    except Exception as e:  # cost analysis is a bonus column, never a failure
+        report["xla_cost_analysis"] = {"error": repr(e)}
+    return report
+
+
+def write_report(report: dict, path: Union[str, Path]) -> Path:
+    path = Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    tmp = path.with_suffix(path.suffix + ".tmp")
+    with open(tmp, "w") as f:
+        json.dump(report, f, indent=1)
+    tmp.rename(path)
+    return path
+
+
+def format_perfscope_table(report: dict) -> str:
+    """Aligned text table for one or many module reports ({"executables": ...}
+    or a single analyze_hlo_text result)."""
+    modules = report.get("executables") or {report.get("module") or "module": report}
+    lines = []
+    for name, mod in modules.items():
+        total = mod["total"]
+        lines.append(
+            f"{name}: {total['ops']} ops, {total['flops'] / 1e9:.3f} GFLOP, "
+            f"{total['bytes'] / 1e6:.3f} MB, est {total['est_time_s'] * 1e3:.4f} ms"
+        )
+        lines.append(f"  {'bucket':<24} {'ops':>6} {'GFLOP':>10} {'MB':>10} {'est ms':>10} {'share':>7}")
+        for bucket, b in sorted(
+            mod["buckets"].items(), key=lambda kv: -kv[1]["est_time_s"]
+        ):
+            share = b["est_time_s"] / total["est_time_s"] if total["est_time_s"] else 0.0
+            lines.append(
+                f"  {bucket:<24} {b['ops']:>6} {b['flops'] / 1e9:>10.3f} "
+                f"{b['bytes'] / 1e6:>10.3f} {b['est_time_s'] * 1e3:>10.4f} {share:>6.1%}"
+            )
+        xla = mod.get("xla_cost_analysis") or {}
+        if "flops" in xla:
+            lines.append(
+                f"  xla cost_analysis cross-check: {xla['flops'] / 1e9:.3f} GFLOP, "
+                f"{xla.get('bytes accessed', 0.0) / 1e6:.3f} MB"
+            )
+        lines.append("")
+    return "\n".join(lines).rstrip()
+
+
+# --------------------------------------------------- train-step report (config)
+
+
+def perfscope_for_config(
+    config_file_path: Union[str, Path],
+    warmstart_checkpoint_folder: Optional[str] = None,
+    hw: Optional[HwSpec] = None,
+) -> dict:
+    """Build the recipe's train step over its real mesh (virtual CPU devices
+    suffice), lower + compile it, and return the perfscope report. Requires
+    jax.device_count() >= the config's world_size — same contract as
+    utils/recipe_validation.validate_recipe, and the same build path."""
+    from modalities_tpu.utils.recipe_validation import build_lowered_train_step
+
+    built = build_lowered_train_step(
+        Path(config_file_path), warmstart_checkpoint_folder=warmstart_checkpoint_folder
+    )
+    mesh_axis_sizes = {k: int(v) for k, v in built.mesh_handle.mesh.shape.items()}
+    report = perfscope_from_compiled(built.lowered.compile(), mesh_axis_sizes, hw)
+    return {
+        "config": str(config_file_path),
+        "world_size": built.world_size,
+        "executables": {"train_step": report},
+    }
+
+
+def run_perfscope_subprocess(
+    config_file_path: Union[str, Path],
+    warmstart_checkpoint_folder: Optional[str] = None,
+) -> dict:
+    """Re-exec `python -m modalities_tpu.telemetry.perfscope` with the CPU
+    backend forced and world_size virtual devices — works from any ambient
+    environment (one whose JAX already claimed a TPU, or has too few devices)."""
+    import subprocess
+    import sys
+
+    import yaml
+
+    config_file_path = Path(config_file_path)
+    with open(config_file_path) as f:
+        raw = yaml.safe_load(f)
+    try:
+        world_size = int(raw["device_mesh"]["config"]["world_size"])
+    except (KeyError, TypeError, ValueError) as e:
+        raise ValueError(
+            f"{config_file_path}: could not read a literal device_mesh.config."
+            "world_size — perfscope needs it to size the virtual device pool"
+        ) from e
+
+    env = os.environ.copy()
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "", env.get("XLA_FLAGS", ""))
+    env["XLA_FLAGS"] = (flags + f" --xla_force_host_platform_device_count={world_size}").strip()
+
+    cmd = [sys.executable, "-m", "modalities_tpu.telemetry.perfscope", str(config_file_path)]
+    if warmstart_checkpoint_folder:
+        cmd += ["--warmstart_checkpoint_folder", warmstart_checkpoint_folder]
+    proc = subprocess.run(cmd, env=env, capture_output=True, text=True)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"perfscope failed for {config_file_path} (exit {proc.returncode}):\n"
+            f"{proc.stdout[-2000:]}\n{proc.stderr[-4000:]}"
+        )
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+# ------------------------------------------------------------- profiler windows
+
+
+class ProfileWindow:
+    """Programmatic `jax.profiler` capture armed by env var: start an xplane
+    trace right before step N and stop it after K steps, no code edits.
+
+    `MODALITIES_TPU_PROFILE_AT_STEP=N` (one step) or `N:K` (K steps);
+    `MODALITIES_TPU_PROFILE_DIR` overrides the output folder (default: the
+    `fallback_dir` the trainer passes, its telemetry folder). Both hooks are
+    cheap no-ops outside the window, and a profiler failure is logged, never
+    raised — observability must not take a run down."""
+
+    def __init__(self, start_step: int, num_steps: int = 1, out_dir: Optional[Path] = None):
+        if num_steps < 1:
+            raise ValueError(f"profile window needs num_steps >= 1, got {num_steps}")
+        self.start_step = int(start_step)
+        self.num_steps = int(num_steps)
+        self.out_dir = Path(out_dir) if out_dir is not None else None
+        self.active = False
+        self.completed = False
+
+    @classmethod
+    def from_env(cls, fallback_dir: Optional[Path] = None) -> Optional["ProfileWindow"]:
+        raw = os.environ.get("MODALITIES_TPU_PROFILE_AT_STEP", "").strip()
+        if not raw:
+            return None
+        try:
+            if ":" in raw:
+                start_s, num_s = raw.split(":", 1)
+                start, num = int(start_s), int(num_s)
+            else:
+                start, num = int(raw), 1
+        except ValueError as e:
+            raise ValueError(
+                f"MODALITIES_TPU_PROFILE_AT_STEP={raw!r}: expected N or N:K "
+                "(capture K steps starting at step N)"
+            ) from e
+        out = os.environ.get("MODALITIES_TPU_PROFILE_DIR")
+        out_dir = Path(out) if out else fallback_dir
+        return cls(start, num, out_dir)
+
+    def maybe_start(self, step_id: int) -> bool:
+        """Call before dispatching `step_id`; starts the trace on the window's
+        first step. Returns True if capture is running."""
+        if self.active:
+            return True
+        if self.completed or step_id != self.start_step:
+            return False
+        try:
+            import jax
+
+            out_dir = self.out_dir or Path(os.getcwd()) / "profile"
+            out_dir.mkdir(parents=True, exist_ok=True)
+            jax.profiler.start_trace(str(out_dir))
+            self.active = True
+            logger.info(
+                "perfscope: profiler capture started at step %d for %d step(s) -> %s",
+                step_id, self.num_steps, out_dir,
+            )
+        except Exception:
+            logger.exception("perfscope: profiler start failed; window disabled")
+            self.completed = True
+        return self.active
+
+    def maybe_stop(self, step_id: int, block_on=None) -> bool:
+        """Call after `step_id` completed; stops the trace once the window's
+        last step is done. Returns True if capture stopped on this call.
+
+        `block_on`: optional pytree of arrays to `block_until_ready` before
+        stopping, so the async-dispatched device work of the captured steps is
+        actually in the trace (dispatch returns long before execution)."""
+        if not self.active or step_id < self.start_step + self.num_steps - 1:
+            return False
+        try:
+            import jax
+
+            if block_on is not None:
+                jax.block_until_ready(block_on)
+            jax.profiler.stop_trace()
+            logger.info("perfscope: profiler capture stopped after step %d", step_id)
+        except Exception:
+            logger.exception("perfscope: profiler stop failed")
+        self.active = False
+        self.completed = True
+        return True
+
+
+# ----------------------------------------------------------- anomaly detection
+
+
+@dataclass
+class Anomaly:
+    value: float
+    zscore: float
+    ewma: float
+    is_anomaly: bool
+
+
+class AnomalyDetector:
+    """Rolling robust z-score + EWMA over a univariate stream (per-step wall
+    time, per-bucket goodput seconds). Robust z = 0.6745 * (v - median) / MAD —
+    outliers in the window don't inflate their own yardstick the way a plain
+    stdev z does. No verdicts until `min_history` observations; a zero MAD
+    (constant window) scores any deviation as `inf`."""
+
+    def __init__(
+        self,
+        window: int = 64,
+        zscore_threshold: float = 6.0,
+        min_history: int = 8,
+        ewma_alpha: float = 0.2,
+    ):
+        if window < 2:
+            raise ValueError(f"anomaly window must be >= 2, got {window}")
+        self.window: deque[float] = deque(maxlen=int(window))
+        self.zscore_threshold = float(zscore_threshold)
+        self.min_history = max(2, int(min_history))
+        self.ewma_alpha = float(ewma_alpha)
+        self.ewma: Optional[float] = None
+        self.anomalies = 0
+
+    def observe(self, value: float) -> Anomaly:
+        value = float(value)
+        self.ewma = (
+            value if self.ewma is None
+            else self.ewma_alpha * value + (1.0 - self.ewma_alpha) * self.ewma
+        )
+        z = 0.0
+        if len(self.window) >= self.min_history:
+            med = statistics.median(self.window)
+            mad = statistics.median(abs(v - med) for v in self.window)
+            dev = value - med
+            if mad > 0.0:
+                z = 0.6745 * dev / mad
+            elif dev != 0.0:
+                z = math.copysign(math.inf, dev)
+        is_anomaly = z > self.zscore_threshold  # one-sided: slow is the anomaly
+        if is_anomaly:
+            self.anomalies += 1
+        self.window.append(value)
+        return Anomaly(value=value, zscore=z, ewma=self.ewma, is_anomaly=is_anomaly)
+
+
+# ---------------------------------------------------------- subprocess entry
+
+
+def _main() -> None:
+    import argparse
+
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("config_file_path", type=Path)
+    parser.add_argument("--warmstart_checkpoint_folder", default=None)
+    args = parser.parse_args()
+    report = perfscope_for_config(
+        args.config_file_path,
+        warmstart_checkpoint_folder=args.warmstart_checkpoint_folder,
+    )
+    print(json.dumps(report))
+
+
+if __name__ == "__main__":
+    _main()

@@ -61,6 +61,7 @@ class Watchdog:
         artifact_dir: Path,
         global_rank: int = 0,
         poll_interval_s: float = 0.05,
+        metrics_provider: Optional[Callable[[], dict]] = None,
     ):
         if deadline_s <= 0:
             raise ValueError(f"watchdog deadline_s must be > 0, got {deadline_s}")
@@ -68,6 +69,10 @@ class Watchdog:
         self.artifact_dir = Path(artifact_dir)
         self.global_rank = global_rank
         self._poll_interval_s = poll_interval_s
+        # PR 13: snapshot of the process's metrics registry folded into the
+        # artifact — a hang dump without counters can't be correlated against
+        # the scrape history
+        self._metrics_provider = metrics_provider
         self._lock = threading.Lock()
         self._stop_event = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -157,6 +162,12 @@ class Watchdog:
                 state.update(provider())
             except Exception as e:
                 state[f"provider_error_{len(state)}"] = repr(e)
+        metrics_snapshot = None
+        if self._metrics_provider is not None:
+            try:
+                metrics_snapshot = self._metrics_provider()
+            except Exception as e:
+                metrics_snapshot = {"error": repr(e)}
         artifact = {
             "event": "watchdog_fired",
             "rank": self.global_rank,
@@ -167,6 +178,13 @@ class Watchdog:
             "thread_stacks": collect_thread_stacks(),
             "device_memory": _collect_device_memory(),
             "state": state,
+            "metrics": metrics_snapshot,
+            # serving hangs: which weights generation was live when the step
+            # wedged — lifted from the engine's state provider for triage
+            "weights_generation": (
+                state.get("serving_engine", {}).get("weights_generation")
+                if isinstance(state.get("serving_engine"), dict) else None
+            ),
         }
         self.artifact_dir.mkdir(parents=True, exist_ok=True)
         path = self.artifact_dir / f"watchdog_dump_rank_{self.global_rank}_step_{armed_step}.json"

@@ -60,6 +60,7 @@ from modalities_tpu.resilience.faults import (
     peer_hang_if_armed,
 )
 from modalities_tpu.telemetry import Telemetry, get_active_telemetry
+from modalities_tpu.telemetry.perfscope import ProfileWindow
 from modalities_tpu.training.train_step import StepFunctions
 from modalities_tpu.training.training_progress import TrainingProgress
 from modalities_tpu.utils.logging import get_logger
@@ -169,6 +170,12 @@ class Trainer:
         first_step_id = step_id
         # first deadline is stretched: the first step legitimately traces + compiles
         telemetry.arm_watchdog(step_id + 1, first_step=True)
+        # env-armed programmatic profiler capture (MODALITIES_TPU_PROFILE_AT_STEP=N[:K]):
+        # purely observational — the capture window must never change step outputs
+        # (pinned bitwise by tests/telemetry/test_perfscope.py)
+        profile_window = ProfileWindow.from_env(
+            fallback_dir=telemetry.sink_path.parent if telemetry.sink_path is not None else None
+        )
         profiler_cm = self.profiler
         if profiler_cm is not None:
             profiler_cm.__enter__()
@@ -204,9 +211,17 @@ class Trainer:
                         )
                     device_batch = dict(device_batch)
                     device_batch[BALLOT_KEY] = make_ballot(local_vote, mesh_handle)
+                if profile_window is not None:
+                    profile_window.maybe_start(step_id + 1)
+                step_t0 = time.perf_counter()
                 with telemetry.step_annotation(step_id + 1):
                     with telemetry.span("first_step" if step_id == first_step_id else "train_step"):
                         state, metrics = step_fn(state, device_batch)
+                # host-side dispatch time: in steady state the dispatch queue's
+                # backpressure makes this track device step time — feed the rolling
+                # anomaly detector (compile-dominated first step excluded)
+                if step_id != first_step_id:
+                    telemetry.observe_step_time(time.perf_counter() - step_t0, step_id=step_id + 1)
                 debug_grads = metrics.pop("grads", None)  # exposed only when debugging
                 decided = VOTE_CONTINUE
                 if consensus:
@@ -289,6 +304,10 @@ class Trainer:
 
                 if profiler_cm is not None:
                     profiler_cm.step()
+                if profile_window is not None:
+                    # block on this step's metrics so the captured device work has
+                    # actually executed before the trace closes
+                    profile_window.maybe_stop(step_id, block_on=metrics)
 
                 # step completed end-to-end (callbacks included): re-arm the hang
                 # deadline for the next one
@@ -357,6 +376,10 @@ class Trainer:
             # post-loop drain work (publish flush, checkpoint drain) is not a hang
             telemetry.disarm_watchdog()
             feed.close()
+            if profile_window is not None and profile_window.active:
+                # the loop exited mid-window (crash, preemption, exhausted loader):
+                # close the trace so the partial capture is still readable
+                profile_window.maybe_stop(profile_window.start_step + profile_window.num_steps)
             if profiler_cm is not None:
                 profiler_cm.__exit__(None, None, None)
             if self.gc_frequency > 0:

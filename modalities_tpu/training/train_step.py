@@ -84,6 +84,26 @@ class StepFunctions:
     # mesh is attached, and the only executable surface in materialize=False mode
     lower_train_step: Optional[Callable[[Any], Any]] = None
 
+    def perfscope_report(self, batch_abstract, hw=None) -> dict:
+        """Lower + compile the sharded step and bucket its optimized-HLO cost by
+        op class (telemetry/perfscope.py) — the static half of performance
+        attribution: where the step's FLOPs/bytes go before a profiler ever runs."""
+        if self.lower_train_step is None:
+            raise ValueError(
+                "perfscope_report needs the AOT lowering surface; this StepFunctions "
+                "was built without lower_train_step"
+            )
+        from modalities_tpu.telemetry.perfscope import perfscope_from_compiled
+
+        mesh_axis_sizes = (
+            {k: int(v) for k, v in self.mesh_handle.mesh.shape.items()}
+            if self.mesh_handle is not None
+            else None
+        )
+        return perfscope_from_compiled(
+            self.lower_train_step(batch_abstract).compile(), mesh_axis_sizes, hw
+        )
+
 
 class TrainStepBuilder:
     """Assembles model + loss + optimizer + schedule + mesh into jitted step functions.

@@ -143,16 +143,31 @@ def _estimate_activation_bytes(model, mesh_handle, step_profile) -> dict:
     }
 
 
-def validate_recipe(
+class BuiltTrainStep:
+    """Everything `validate_recipe` and `telemetry.perfscope` need from one
+    declarative component build: the abstract-state step functions, the live
+    components, the mesh, the abstract batch, and the lowering outcome."""
+
+    def __init__(self, fns, components, mesh_handle, batch_abstract, world_size,
+                 lowered, lowering: str):
+        self.fns = fns
+        self.components = components
+        self.mesh_handle = mesh_handle
+        self.batch_abstract = batch_abstract
+        self.world_size = world_size
+        self.lowered = lowered  # None when lowering failed
+        self.lowering = lowering  # "ok" | "failed: ..."
+
+
+def build_lowered_train_step(
     config_file_path: Path,
-    hbm_budget_bytes: int = V5P_HBM_BUDGET_BYTES,
     warmstart_checkpoint_folder: Optional[str] = None,
-    compile_memory_check: bool = False,
-) -> dict:
-    """Build the recipe's train step over its real mesh, lower it, and report the
-    per-chip memory budget. Requires jax.device_count() >= the config's world_size
-    (use XLA_FLAGS=--xla_force_host_platform_device_count=N JAX_PLATFORMS=cpu, or let
-    the `benchmark validate_recipe` CLI re-exec with them set)."""
+    raise_on_lowering_failure: bool = True,
+) -> BuiltTrainStep:
+    """Build the recipe's full sharded train step over its real mesh (abstract
+    state, no parameter buffers) and lower it. The shared front half of
+    `validate_recipe` and `telemetry.perfscope.perfscope_for_config`. Requires
+    jax.device_count() >= the config's world_size."""
     import jax
 
     from modalities_tpu.config.instantiation_models import RecipeValidationInstantiationModel
@@ -215,13 +230,46 @@ def validate_recipe(
         "targets": {components.loss_fn.target_key: tok},
     }
 
-    xla_memory = None
     lowered = None
     try:
         lowered = fns.lower_train_step(batch_abstract)
         lowering = "ok"
     except Exception as e:  # report the partitioning/tracing failure, don't crash
+        if raise_on_lowering_failure:
+            raise
         lowering = f"failed: {type(e).__name__}: {str(e)[:500]}"
+    return BuiltTrainStep(
+        fns, components, mesh_handle, batch_abstract, world_size, lowered, lowering
+    )
+
+
+def validate_recipe(
+    config_file_path: Path,
+    hbm_budget_bytes: int = V5P_HBM_BUDGET_BYTES,
+    warmstart_checkpoint_folder: Optional[str] = None,
+    compile_memory_check: bool = False,
+) -> dict:
+    """Build the recipe's train step over its real mesh, lower it, and report the
+    per-chip memory budget. Requires jax.device_count() >= the config's world_size
+    (use XLA_FLAGS=--xla_force_host_platform_device_count=N JAX_PLATFORMS=cpu, or let
+    the `benchmark validate_recipe` CLI re-exec with them set)."""
+    import jax
+
+    config_file_path = Path(config_file_path)
+    built = build_lowered_train_step(
+        config_file_path,
+        warmstart_checkpoint_folder=warmstart_checkpoint_folder,
+        raise_on_lowering_failure=False,
+    )
+    components = built.components
+    mesh_handle = built.mesh_handle
+    world_size = built.world_size
+    step_profile = components.settings.step_profile
+    fns = built.fns
+    model = fns.app_state_handle.model
+    lowered, lowering = built.lowered, built.lowering
+
+    xla_memory = None
     if compile_memory_check and lowered is not None:
         # VERDICT r4 #7: back the activation FORMULA with the compiler's own
         # per-device accounting. The virtual-mesh CPU compile runs the same

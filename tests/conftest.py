@@ -19,6 +19,39 @@ jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
+# --- slowest-test artifact (PR 13) ---------------------------------------------
+# Past slow-marking rebalances (PRs 8/9/11) eyeballed `--durations` output from a
+# scrollback; this hook writes the top N call-phase durations to a JSONL artifact
+# at session end so the next rebalance is data-driven. Path override:
+# MODALITIES_TPU_TEST_DURATIONS_PATH ("" disables). Workers under pytest-xdist
+# skip the write (each would clobber the file with a partial view).
+
+_DURATIONS_TOP_N = 15
+_durations: dict = {}
+
+
+def pytest_runtest_logreport(report):
+    if report.when == "call":
+        _durations[report.nodeid] = report.duration
+
+
+def pytest_sessionfinish(session, exitstatus):
+    if hasattr(session.config, "workerinput"):  # xdist worker: partial view
+        return
+    raw = os.environ.get("MODALITIES_TPU_TEST_DURATIONS_PATH")
+    if raw == "":
+        return
+    path = raw or str(session.config.rootpath / "test_durations.jsonl")
+    try:
+        import json
+
+        slowest = sorted(_durations.items(), key=lambda kv: kv[1], reverse=True)
+        with open(path, "w") as f:
+            for nodeid, duration in slowest[:_DURATIONS_TOP_N]:
+                f.write(json.dumps({"nodeid": nodeid, "duration_s": round(duration, 3)}) + "\n")
+    except OSError:
+        pass  # an unwritable artifact path must never fail the suite
+
 
 @pytest.fixture
 def tmp_experiment_dir(tmp_path):

@@ -1,0 +1,102 @@
+"""`data analyze_fleet` CLI: router + worker JSONL sinks stitch into one
+cross-tier span tree per trace_id (table and JSON), torn tails tolerated;
+plus `data analyze_perfscope` argument validation (the heavy subprocess path
+is exercised by tests/telemetry/test_perfscope.py in-process)."""
+
+import json
+
+from click.testing import CliRunner
+
+from modalities_tpu.__main__ import main as cli_main
+
+TID_A = "aaaa000011112222"
+TID_B = "bbbb000011112222"
+
+
+def _write_jsonl(path, records):
+    with open(path, "w") as f:
+        for rec in records:
+            f.write(json.dumps(rec) + "\n")
+
+
+def _seed_sinks(tmp_path):
+    router_dir = tmp_path / "router"
+    worker_dir = tmp_path / "worker0"
+    router_dir.mkdir()
+    worker_dir.mkdir()
+    _write_jsonl(router_dir / "telemetry_rank_0.jsonl", [
+        {"event": "resilience", "name": "fleet/request", "rank": 0, "trace_id": TID_A,
+         "outcome": "done", "forwarded_tokens": 5, "e2e_s": 0.8,
+         "legs": [
+             {"worker": "w0", "hop": 0, "t_start_s": 0.0, "outcome": "failover",
+              "forwarded_tokens": 2},
+             {"worker": "w1", "hop": 1, "t_start_s": 0.3, "outcome": "done",
+              "forwarded_tokens": 5},
+         ]},
+        {"event": "resilience", "name": "fleet/failover", "rank": 0, "trace_id": TID_A,
+         "worker": "w0", "forwarded_tokens": 2},
+        {"event": "resilience", "name": "fleet/request", "rank": 0, "trace_id": TID_B,
+         "outcome": "done", "forwarded_tokens": 3, "e2e_s": 0.1,
+         "legs": [{"worker": "w1", "hop": 0, "t_start_s": 0.0, "outcome": "done",
+                   "forwarded_tokens": 3}]},
+    ])
+    _write_jsonl(worker_dir / "telemetry_rank_0.jsonl", [
+        {"event": "serve_request", "rank": 0, "rid": 7, "trace_id": TID_A, "hop": 1,
+         "tokens": 5, "finish_reason": "budget", "arrival_s": 0.31, "ttft_s": 0.02},
+        {"event": "serve_request", "rank": 0, "rid": 8, "trace_id": TID_B, "hop": 0,
+         "tokens": 3, "finish_reason": "eod", "arrival_s": 0.01, "ttft_s": 0.01},
+    ])
+    return router_dir, worker_dir
+
+
+def test_analyze_fleet_table_stitches_traces(tmp_path):
+    router_dir, worker_dir = _seed_sinks(tmp_path)
+    result = CliRunner().invoke(cli_main, [
+        "data", "analyze_fleet",
+        "--sink_path", str(router_dir), "--sink_path", str(worker_dir),
+    ])
+    assert result.exit_code == 0, result.output
+    # both traces render; the failover trace leads (router traces sort by e2e)
+    assert result.output.index(TID_A) < result.output.index(TID_B)
+    assert "failover off w0 after 2 forwarded tokens" in result.output
+    assert "worker leg hop=1  rid=7" in result.output
+
+
+def test_analyze_fleet_json_shape(tmp_path):
+    router_dir, worker_dir = _seed_sinks(tmp_path)
+    result = CliRunner().invoke(cli_main, [
+        "data", "analyze_fleet", "--sink_path", str(router_dir),
+        "--sink_path", str(worker_dir), "--as_json",
+    ])
+    assert result.exit_code == 0, result.output
+    traces = {t["trace_id"]: t for t in json.loads(result.output)}
+    assert set(traces) == {TID_A, TID_B}
+    assert len(traces[TID_A]["worker_legs"]) == 1
+    assert traces[TID_A]["failovers"][0]["worker"] == "w0"
+    assert traces[TID_B]["failovers"] == []
+
+
+def test_analyze_fleet_tolerates_torn_tail_and_rejects_empty_folder(tmp_path):
+    router_dir, worker_dir = _seed_sinks(tmp_path)
+    with open(router_dir / "telemetry_rank_0.jsonl", "a") as f:
+        f.write('{"event": "resilience", "name": "fleet/req')  # torn write
+    result = CliRunner().invoke(
+        cli_main, ["data", "analyze_fleet", "--sink_path", str(router_dir)]
+    )
+    assert result.exit_code == 0, result.output
+    assert TID_A in result.output
+
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    result = CliRunner().invoke(
+        cli_main, ["data", "analyze_fleet", "--sink_path", str(empty)]
+    )
+    assert result.exit_code != 0  # an empty folder is a user error, not silence
+
+
+def test_analyze_perfscope_requires_an_existing_config(tmp_path):
+    result = CliRunner().invoke(cli_main, [
+        "data", "analyze_perfscope", "--config_file_path", str(tmp_path / "no.yaml"),
+    ])
+    assert result.exit_code != 0
+    assert "does not exist" in result.output

@@ -280,3 +280,19 @@ def test_mesh_sharded_decode_carries_named_shardings_and_matches(model, params, 
     assert results[rids[1]].tokens == ref([9, 8, 7, 6], 6, 0.8, 5)
     assert engine.stats()["decode_executables"] == 1
     assert "sharding" in engine.decode_lowered_text()
+
+
+# ------------------------------------------------------- performance scope
+
+
+def test_perfscope_report_closure_on_the_decode_step(model, params):
+    """Serving half of the PR-13 perfscope: the batched decode step compiles
+    and its per-bucket costs sum exactly to the module total, with the
+    matmul work (the qkv/attn/mlp dots) visible as its own bucket."""
+    engine = ServingEngine(model, params, max_batch_slots=2, eod_token_id=-1)
+    report = engine.perfscope_report()
+    total = report["total"]
+    for key in ("ops", "flops", "bytes"):
+        assert sum(b[key] for b in report["buckets"].values()) == total[key], key
+    assert total["flops"] > 0
+    assert "matmul" in report["buckets"]

@@ -40,20 +40,34 @@ class _ScriptedWorker:
     dying after `abort_after` token events when set (no done event, connection
     cut: the failover trigger)."""
 
-    def __init__(self, tokens, abort_after=None, load=0):
+    def __init__(self, tokens, abort_after=None, load=0, sink_path=None):
         self.tokens = tokens
         self.abort_after = abort_after
         self.load = load
         self.generates = 0
+        self.generate_headers = []  # headers of every /generate received
+        self.sink_path = sink_path  # write a serve_request record here (like a real worker)
         self.port = None
         self._loop = None
         self._started = threading.Event()
+
+    def _record_leg(self, headers, emitted):
+        if self.sink_path is None:
+            return
+        record = {
+            "event": "serve_request", "rank": 0, "rid": self.generates,
+            "trace_id": headers.get("x-trace-id", ""),
+            "hop": int(headers.get("x-trace-hop") or 0),
+            "tokens": emitted, "finish_reason": "budget", "arrival_s": 0.0,
+        }
+        with open(self.sink_path, "a") as f:
+            f.write(json.dumps(record) + "\n")
 
     async def _handle(self, reader, writer):
         req = await read_http_request(reader)
         if req is None:
             return
-        method, path, _headers, _body = req
+        method, path, headers, _body = req
         try:
             if method == "GET" and path == "/healthz":
                 writer.write(json_response_bytes(200, {"status": "ok"}))
@@ -63,10 +77,14 @@ class _ScriptedWorker:
                 )
             elif method == "POST" and path == "/generate":
                 self.generates += 1
+                self.generate_headers.append(dict(headers))
                 writer.write(SSE_HEADER_BYTES)
                 for i, token in enumerate(self.tokens):
                     if self.abort_after is not None and i >= self.abort_after:
-                        return  # mid-stream death: close without a done event
+                        # mid-stream death: close without a done event; a real
+                        # worker's engine still finishes and records the request
+                        self._record_leg(headers, i)
+                        return
                     writer.write(sse_event_bytes({"token_id": token, "token": str(token)}))
                     await writer.drain()
                 writer.write(
@@ -74,6 +92,7 @@ class _ScriptedWorker:
                         {"done": True, "token_ids": self.tokens, "finish_reason": "budget"}
                     )
                 )
+                self._record_leg(headers, len(self.tokens))
             await writer.drain()
         finally:
             writer.close()
@@ -307,6 +326,99 @@ def test_admin_swap_endpoint_on_live_worker():
         assert [e["token_id"] for e in events if "token_id" in e] == [5 % VOCAB, 6, 7]
     finally:
         server.close()
+
+
+def test_failover_one_trace_id_across_router_workers_and_stitched_tree(tmp_path):
+    """The PR-13 tracing acceptance pin: a mid-stream failover carries ONE
+    trace_id end to end — the router's `fleet/request` record, BOTH worker legs
+    (the dying scripted worker's record from the propagated X-Trace-Id header,
+    and the real server→engine path on the replay leg), and the stitched
+    `analyze_fleet` span tree."""
+    from modalities_tpu.serving.analyze import (
+        format_fleet_trace_tree,
+        load_fleet_records,
+        stitch_fleet_traces,
+    )
+    from modalities_tpu.telemetry import Telemetry, set_active_telemetry
+
+    telemetry = Telemetry(
+        output_folder_path=tmp_path, watchdog_deadline_s=0.0, use_jax_annotations=False
+    )
+    prior = set_active_telemetry(telemetry)
+    dying = _ScriptedWorker(
+        ANSWER, abort_after=2, sink_path=tmp_path / "scripted_worker.jsonl"
+    ).start()
+    # the replay leg is a REAL worker: ServingHTTPServer + engine, so the
+    # header→body→engine.submit→serve_request propagation is the actual code path
+    engine = ServingEngine(FakeModel(), {}, max_batch_slots=2, eod_token_id=-1)
+    backup = ServingHTTPServer(
+        engine,
+        encode=lambda s: [int(t) for t in s.split()],
+        decode=lambda ids: " ".join(str(i) for i in ids),
+        port=0,
+    )
+    backup.start()
+    router = FleetRouter(
+        [
+            WorkerHandle("dying", "127.0.0.1", dying.port),
+            WorkerHandle("backup", "127.0.0.1", backup.port),
+        ],
+        health_interval_s=30.0,
+    )
+    router.start()
+    try:
+        deadline = time.monotonic() + 5.0
+        hb0 = {w.name: w.last_heartbeat for w in router.workers}
+        while time.monotonic() < deadline:
+            if all(w.last_heartbeat > hb0[w.name] for w in router.workers):
+                break
+            time.sleep(0.01)
+        else:
+            pytest.fail("first health sweep never completed")
+        time.sleep(0.05)
+
+        status, events = _post_generate(
+            router.port, {"prompt": "3 4", "max_new_tokens": 5}
+        )
+        assert status == 200
+        done = [e for e in events if e.get("done")]
+        assert len(done) == 1
+        trace_id = done[0]["trace_id"]
+        assert trace_id  # the SSE done event carries the trace back to the client
+
+        # the router SENT the trace headers to the first (dying) worker
+        assert dying.generate_headers[0]["x-trace-id"] == trace_id
+        assert dying.generate_headers[0]["x-trace-hop"] == "0"
+    finally:
+        router.close()
+        dying.stop()
+        backup.close()
+        telemetry.close()
+        set_active_telemetry(prior)
+
+    records = load_fleet_records([tmp_path])
+    # router's half: one fleet/request record naming both legs + one failover
+    assert len(records["fleet_requests"]) == 1
+    req = records["fleet_requests"][0]
+    assert req["trace_id"] == trace_id and req["outcome"] == "done"
+    assert [(leg["worker"], leg["hop"]) for leg in req["legs"]] == [
+        ("dying", 0), ("backup", 1)
+    ]
+    assert [f["trace_id"] for f in records["failovers"]] == [trace_id]
+    # worker legs: the scripted hop-0 record and the real engine's hop-1 record
+    # share the ONE trace_id
+    legs = {(r["trace_id"], r["hop"]) for r in records["serve_requests"]}
+    assert legs == {(trace_id, 0), (trace_id, 1)}
+
+    traces = stitch_fleet_traces(records)
+    assert [t["trace_id"] for t in traces] == [trace_id]
+    trace = traces[0]
+    assert trace["router"] is req
+    assert [leg["hop"] for leg in trace["worker_legs"]] == [0, 1]
+    assert len(trace["failovers"]) == 1
+    tree = format_fleet_trace_tree(traces)
+    assert tree.count(trace_id) == 1  # one request, one tree
+    assert "failover off dying" in tree
 
 
 def test_admin_swap_without_handler_is_503():

@@ -182,3 +182,87 @@ def test_concurrent_updates_lose_nothing():
     parsed = parse_prometheus_text(reg.render())
     assert parsed["conc_seconds_bucket"][(("le", "+Inf"),)] == total
     assert not math.isnan(parsed["conc_seconds_sum"][()])
+
+
+# --------------------------------------------- PR 13: exemplars + identity
+
+
+def test_histogram_exemplar_stored_rendered_and_parse_safe():
+    reg = MetricsRegistry()
+    h = reg.histogram("ex_seconds", buckets=(0.5, 1.5))
+    h.observe(0.25)  # no exemplar
+    assert h.exemplar() is None
+    h.observe(1.0, exemplar="abc123def4567890")
+    assert h.exemplar() == ("abc123def4567890", 1.0)
+    h.observe(0.75, exemplar="fedcba9876543210")  # last one wins
+    assert h.exemplar() == ("fedcba9876543210", 0.75)
+
+    text = reg.render()
+    assert "# EXEMPLAR ex_seconds" in text and "fedcba9876543210" in text
+    # the comment line never breaks the exposition parser or the samples
+    parsed = parse_prometheus_text(text)
+    assert parsed["ex_seconds_count"][()] == 3.0
+
+    h.reset()
+    assert h.exemplar() is None  # reset drops exemplars with the series
+
+
+def test_histogram_exemplar_is_per_label_set():
+    reg = MetricsRegistry()
+    h = reg.histogram("exl_seconds", buckets=(1.0,))
+    h.observe(0.5, exemplar="trace-a", worker="w0")
+    h.observe(0.7, exemplar="trace-b", worker="w1")
+    assert h.exemplar(worker="w0") == ("trace-a", 0.5)
+    assert h.exemplar(worker="w1") == ("trace-b", 0.7)
+    assert h.exemplar(worker="w2") is None
+
+
+def test_register_process_metrics_build_info_and_gauges():
+    from modalities_tpu.telemetry.metrics import register_process_metrics
+
+    reg = MetricsRegistry()
+    register_process_metrics(reg, version="0.1.0", config_hash="cafe01234567")
+    register_process_metrics(reg, version="0.1.0", config_hash="cafe01234567")  # idempotent
+
+    parsed = parse_prometheus_text(reg.render())
+    key = (("config_hash", "cafe01234567"), ("version", "0.1.0"))
+    assert parsed["modalities_tpu_build_info"][key] == 1.0
+    assert parsed["process_uptime_seconds"][()] >= 0.0
+    # RSS of a live python process with jax imported is comfortably > 10 MiB
+    assert parsed["process_resident_memory_bytes"][()] > 10 * 1024 * 1024
+    # unset labels fall back to "unknown", never empty strings
+    reg2 = MetricsRegistry()
+    register_process_metrics(reg2)
+    parsed2 = parse_prometheus_text(reg2.render())
+    assert (("config_hash", "unknown"), ("version", "unknown")) in parsed2[
+        "modalities_tpu_build_info"
+    ]
+
+
+def test_config_hash_of_is_stable_and_tolerant(tmp_path):
+    from modalities_tpu.telemetry.metrics import config_hash_of
+
+    cfg = tmp_path / "c.yaml"
+    cfg.write_text("a: 1\n")
+    h1 = config_hash_of(cfg)
+    assert len(h1) == 12 and h1 == config_hash_of(cfg)
+    cfg.write_text("a: 2\n")
+    assert config_hash_of(cfg) != h1
+    assert config_hash_of(tmp_path / "missing.yaml") == "unknown"
+
+
+def test_registry_snapshot_covers_all_kinds_and_survives_broken_callbacks():
+    reg = MetricsRegistry()
+    reg.counter("snap_total", "c").inc(reason="x")
+    reg.gauge("snap_gauge", "g").set(7.0)
+    reg.histogram("snap_seconds", buckets=(1.0,)).observe(0.5)
+    reg.gauge("snap_broken", "b").set_fn(lambda: 1 / 0)
+
+    snap = reg.snapshot()
+    assert snap["snap_total"]["series"]['{reason="x"}'] == 1.0
+    assert snap["snap_gauge"]["series"]["{}"] == 7.0
+    assert snap["snap_seconds"]["series"]["{}"] == {"sum": 0.5, "count": 1}
+    assert "error" in snap["snap_broken"]  # broken callback never sinks the dump
+    import json
+
+    json.dumps(snap)  # the whole snapshot is JSON-safe (watchdog embeds it)

@@ -147,3 +147,86 @@ def test_span_overhead_is_small():
             pass
     on_per_span = (time.perf_counter() - t0) / n
     assert on_per_span < 5e-5
+
+
+# ------------------------------------- PR 13: stragglers + step-time anomalies
+
+
+def _summary_with(rank_buckets: dict) -> dict:
+    return {
+        "ranks": {
+            rank: {"buckets": dict(buckets)} for rank, buckets in rank_buckets.items()
+        }
+    }
+
+
+def test_straggler_summary_names_slowest_rank_per_bucket():
+    from modalities_tpu.telemetry.goodput import format_straggler_table, straggler_summary
+
+    summary = _summary_with({
+        0: {"train_step": 8.0, "data_stall": 1.0},
+        1: {"train_step": 8.1, "data_stall": 0.9},
+        2: {"train_step": 8.0, "data_stall": 4.0},  # the data straggler
+    })
+    stragglers = straggler_summary(summary)
+    assert stragglers["data_stall"]["slowest_rank"] == 2
+    assert stragglers["data_stall"]["seconds"] == 4.0
+    assert stragglers["data_stall"]["median_s"] == 1.0
+    assert stragglers["data_stall"]["ratio_vs_median"] == 4.0
+    assert stragglers["train_step"]["slowest_rank"] == 1
+    assert "checkpoint" not in stragglers  # no rank recorded any: dropped
+    table = format_straggler_table(stragglers)
+    assert "rank 2" in table and "data_stall" in table
+
+
+def test_straggler_summary_single_rank_and_empty():
+    from modalities_tpu.telemetry.goodput import format_straggler_table, straggler_summary
+
+    single = straggler_summary(_summary_with({0: {"train_step": 5.0}}))
+    assert single["train_step"]["ratio_vs_median"] == 1.0  # no peer to lag behind
+    assert straggler_summary({"ranks": {}}) == {}
+    assert "no per-rank" in format_straggler_table({})
+
+
+def test_observe_step_time_feeds_gauges_counter_and_sink(tmp_path):
+    telemetry = Telemetry(
+        output_folder_path=tmp_path, watchdog_deadline_s=0,
+        anomaly_zscore=6.0, anomaly_window=32,
+    )
+    for step in range(12):
+        telemetry.observe_step_time(1.0 + 0.001 * (step % 3), step_id=step)
+    reg = telemetry.metrics
+    assert reg.counter("training_step_time_anomaly_total").value() == 0
+    assert reg.gauge("training_step_time_ewma_seconds").value() == pytest.approx(1.0, abs=0.01)
+
+    telemetry.observe_step_time(5.0, step_id=12)  # a 5x excursion
+    assert reg.counter("training_step_time_anomaly_total").value() == 1
+    assert reg.gauge("training_step_time_zscore").value() > 6.0
+    telemetry.close()
+    events = [json.loads(ln) for ln in telemetry.sink_path.read_text().splitlines()]
+    anomalies = [e for e in events if e.get("name") == "anomaly/step_time"]
+    assert len(anomalies) == 1 and anomalies[0]["step_id"] == 12
+    assert anomalies[0]["seconds"] == 5.0
+
+
+def test_bucket_delta_zscore_localizes_the_anomalous_phase(tmp_path):
+    telemetry = Telemetry(
+        output_folder_path=tmp_path, watchdog_deadline_s=0, anomaly_window=16,
+    )
+    try:
+        # steady publishes: every interval adds ~1s train_step, ~0.1s data_stall
+        totals = {"train_step": 0.0, "data_stall": 0.0}
+        for i in range(10):
+            totals["train_step"] += 1.0
+            totals["data_stall"] += 0.1
+            telemetry._observe_bucket_deltas(dict(totals))
+        gauge = telemetry.metrics.gauge("training_goodput_bucket_zscore")
+        assert abs(gauge.value(bucket="data_stall")) < 6.0
+        # one interval suddenly stalls 3s on data: only that bucket's z spikes
+        totals["train_step"] += 1.0
+        totals["data_stall"] += 3.0
+        telemetry._observe_bucket_deltas(dict(totals))
+        assert gauge.value(bucket="data_stall") > 6.0
+        assert abs(gauge.value(bucket="train_step")) < 6.0
+    finally:
+        telemetry.close()

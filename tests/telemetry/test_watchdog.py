@@ -136,3 +136,60 @@ def test_collect_thread_stacks_names_every_live_thread():
 def test_zero_deadline_rejected(tmp_path):
     with pytest.raises(ValueError, match="deadline_s"):
         Watchdog(deadline_s=0.0, artifact_dir=tmp_path)
+
+
+def test_dump_embeds_metrics_snapshot_and_weights_generation(tmp_path):
+    """PR 13: a hang artifact carries the registry's counters (not just thread
+    stacks) and, when a serving engine registered state, its live
+    weights_generation — the two correlates an on-call actually needs."""
+    from modalities_tpu.telemetry.metrics import MetricsRegistry
+
+    reg = MetricsRegistry()
+    reg.counter("serve_decode_steps_total", "d").inc()
+    reg.counter("serve_decode_steps_total", "d").inc()
+    watchdog = Watchdog(
+        deadline_s=0.05, artifact_dir=tmp_path, poll_interval_s=0.01,
+        metrics_provider=reg.snapshot,
+    )
+    watchdog.register_state_provider(
+        lambda: {"serving_engine": {"weights_generation": 4, "active": 1}}
+    )
+    watchdog.start()
+    watchdog.arm(step_id=3)
+    try:
+        assert _wait_for(lambda: watchdog.fired_artifacts)
+    finally:
+        watchdog.stop()
+    artifact = json.loads(watchdog.fired_artifacts[0].read_text())
+    assert artifact["metrics"]["serve_decode_steps_total"]["series"]["{}"] == 2.0
+    assert artifact["weights_generation"] == 4
+
+
+def test_dump_metrics_provider_failure_never_sinks_the_artifact(tmp_path):
+    watchdog = Watchdog(
+        deadline_s=0.05, artifact_dir=tmp_path, poll_interval_s=0.01,
+        metrics_provider=lambda: 1 / 0,
+    )
+    watchdog.start()
+    watchdog.arm(step_id=1)
+    try:
+        assert _wait_for(lambda: watchdog.fired_artifacts)
+    finally:
+        watchdog.stop()
+    artifact = json.loads(watchdog.fired_artifacts[0].read_text())
+    assert "error" in artifact["metrics"]
+    assert artifact["thread_stacks"]  # the stacks still landed
+
+
+def test_telemetry_watchdog_wires_its_own_registry_snapshot(tmp_path):
+    """The Telemetry-owned watchdog dumps the Telemetry-owned registry."""
+    telemetry = Telemetry(output_folder_path=tmp_path, watchdog_deadline_s=0.05)
+    telemetry.metrics.counter("training_step_time_anomaly_total", "a").inc()
+    telemetry.arm_watchdog(step_id=1)
+    try:
+        assert _wait_for(lambda: telemetry.watchdog_artifacts)
+    finally:
+        telemetry.close()
+    artifact = json.loads(telemetry.watchdog_artifacts[0].read_text())
+    assert artifact["metrics"]["training_step_time_anomaly_total"]["series"]["{}"] == 1.0
+    assert artifact["weights_generation"] is None  # not serving: explicit null

@@ -1,0 +1,56 @@
+"""The tier-1 slowest-test artifact hook (tests/conftest.py): session end writes
+the top-N call-phase durations as JSONL so slow-marking rebalances read data
+instead of scrollback. Exercised by driving the hook functions directly against
+a stub session — a real nested pytest run would cost more than the hook saves."""
+
+import json
+import types
+
+import tests.conftest as harness
+
+
+def _stub_session(rootpath):
+    config = types.SimpleNamespace(rootpath=rootpath)  # no workerinput attr
+    return types.SimpleNamespace(config=config)
+
+
+def _stub_report(nodeid, when, duration):
+    return types.SimpleNamespace(nodeid=nodeid, when=when, duration=duration)
+
+
+def test_durations_artifact_keeps_slowest_call_phases(tmp_path, monkeypatch):
+    monkeypatch.setattr(harness, "_durations", {})
+    monkeypatch.setattr(harness, "_DURATIONS_TOP_N", 2)
+    monkeypatch.setenv(
+        "MODALITIES_TPU_TEST_DURATIONS_PATH", str(tmp_path / "durations.jsonl")
+    )
+    harness.pytest_runtest_logreport(_stub_report("t/a.py::fast", "call", 0.01))
+    harness.pytest_runtest_logreport(_stub_report("t/a.py::slow", "call", 3.5))
+    harness.pytest_runtest_logreport(_stub_report("t/a.py::mid", "call", 1.25))
+    # setup/teardown phases never count toward the wall-time budget
+    harness.pytest_runtest_logreport(_stub_report("t/a.py::slow", "setup", 99.0))
+
+    harness.pytest_sessionfinish(_stub_session(tmp_path), exitstatus=0)
+    rows = [
+        json.loads(line)
+        for line in (tmp_path / "durations.jsonl").read_text().splitlines()
+    ]
+    assert [r["nodeid"] for r in rows] == ["t/a.py::slow", "t/a.py::mid"]
+    assert rows[0]["duration_s"] == 3.5
+
+
+def test_durations_artifact_disable_and_xdist_worker_skip(tmp_path, monkeypatch):
+    monkeypatch.setattr(harness, "_durations", {"t::x": 1.0})
+    monkeypatch.setenv("MODALITIES_TPU_TEST_DURATIONS_PATH", "")  # "" disables
+    harness.pytest_sessionfinish(_stub_session(tmp_path), exitstatus=0)
+    assert list(tmp_path.iterdir()) == []
+
+    monkeypatch.delenv("MODALITIES_TPU_TEST_DURATIONS_PATH")
+    worker = _stub_session(tmp_path)
+    worker.config.workerinput = {"workerid": "gw0"}  # xdist worker: partial view
+    harness.pytest_sessionfinish(worker, exitstatus=0)
+    assert list(tmp_path.iterdir()) == []
+
+    # default path lands at <rootdir>/test_durations.jsonl
+    harness.pytest_sessionfinish(_stub_session(tmp_path), exitstatus=0)
+    assert (tmp_path / "test_durations.jsonl").exists()

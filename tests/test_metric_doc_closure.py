@@ -9,14 +9,37 @@ from pathlib import Path
 
 REPO = Path(__file__).parent.parent
 # matches reg.counter("name", ...) / self.metrics.gauge(\n    "name", ...) etc.;
-# \s* spans the line break of the multi-line registration style
+# \s* spans the line break of the multi-line registration style; group 1 is the
+# metric kind so the lint below can apply kind-specific naming rules
 METRIC_REG = re.compile(
-    r"\.(?:counter|gauge|histogram)\(\s*[\"']([a-zA-Z_:][a-zA-Z0-9_:]*)[\"']"
+    r"\.(counter|gauge|histogram)\(\s*[\"']([a-zA-Z_:][a-zA-Z0-9_:]*)[\"']"
 )
 
 
 def _metrics_in(text: str) -> set[str]:
-    return set(METRIC_REG.findall(text))
+    return {name for _, name in METRIC_REG.findall(text)}
+
+
+def _registrations_in_repo() -> dict[str, str]:
+    """name -> kind for every string-literal registration under modalities_tpu/."""
+    regs: dict[str, str] = {}
+    for path in sorted((REPO / "modalities_tpu").rglob("*.py")):
+        for kind, name in METRIC_REG.findall(path.read_text()):
+            regs.setdefault(name, kind)
+    return regs
+
+
+def test_metric_names_follow_prometheus_conventions():
+    """Static lint: snake_case names, counters end in `_total` (the exposition
+    renderer appends no suffix — a counter without it graphs as a gauge and
+    breaks rate() muscle memory on every dashboard)."""
+    regs = _registrations_in_repo()
+    assert regs, "metric-name scan found nothing — repo layout changed?"
+    snake = re.compile(r"[a-z][a-z0-9_]*")
+    bad_case = {n for n in regs if not snake.fullmatch(n)}
+    assert not bad_case, f"metric names must be snake_case ([a-z][a-z0-9_]*): {bad_case}"
+    bad_counters = {n for n, kind in regs.items() if kind == "counter" and not n.endswith("_total")}
+    assert not bad_counters, f"counter names must end in _total: {bad_counters}"
 
 
 def test_every_registered_metric_name_is_documented():

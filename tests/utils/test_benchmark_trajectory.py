@@ -1,0 +1,92 @@
+"""Benchmark-trajectory analysis (`utils/benchmarking/trajectory.py` + the
+`data analyze_bench` CLI): the driver's BENCH_r*/MULTICHIP_r* round artifacts
+fold into one classified trend table, with wedged rounds (rc=124, parsed null)
+flagged explicitly — the PR-13 satellite that makes round 4–5's silent wedge a
+one-glance read."""
+
+import json
+
+from click.testing import CliRunner
+
+from modalities_tpu.__main__ import main as cli_main
+from modalities_tpu.utils.benchmarking.trajectory import (
+    format_trajectory_table,
+    load_round_artifacts,
+    summarize_trajectory,
+)
+
+
+def _write(folder, name, payload):
+    (folder / name).write_text(json.dumps(payload))
+
+
+def _seed_rounds(folder):
+    """A trajectory shaped like the real repo's: ok rounds, a wedged pair, a
+    failed round, plus multichip history with one wedge."""
+    _write(folder, "BENCH_r1.json", {"n": 1, "rc": 1, "tail": "boom", "parsed": None})
+    _write(folder, "BENCH_r2.json", {
+        "n": 2, "rc": 0, "tail": "",
+        "parsed": {"metric": "mfu", "value": 0.382, "unit": "ratio", "vs_baseline": 0.556,
+                   "detail": {"config": "680m_flash", "tokens_per_sec": 2244.2, "device": "v5p"}},
+    })
+    _write(folder, "BENCH_r3.json", {"n": 3, "rc": 0, "tail": "", "parsed": None})
+    _write(folder, "BENCH_r4.json", {"n": 4, "rc": 124, "tail": "", "parsed": None})
+    _write(folder, "MULTICHIP_r1.json", {"n_devices": 8, "rc": 124, "ok": False, "skipped": False, "tail": ""})
+    _write(folder, "MULTICHIP_r2.json", {"n_devices": 8, "rc": 0, "ok": True, "skipped": False, "tail": ""})
+    _write(folder, "MULTICHIP_r3.json", {"n_devices": 0, "rc": 0, "ok": False, "skipped": True, "tail": ""})
+
+
+def test_round_loading_sorts_by_round_and_keeps_torn_artifacts(tmp_path):
+    _seed_rounds(tmp_path)
+    (tmp_path / "BENCH_r10.json").write_text('{"torn')  # crashed mid-write
+    rounds = load_round_artifacts(tmp_path, "BENCH")
+    assert [r["round"] for r in rounds] == [1, 2, 3, 4, 10]
+    assert rounds[-1]["data"] is None  # torn artifact is itself a signal
+
+
+def test_summarize_classifies_every_flavor_and_flags_non_ok(tmp_path):
+    _seed_rounds(tmp_path)
+    summary = summarize_trajectory(tmp_path)
+    by_round = {r["round"]: r for r in summary["bench"]}
+    assert by_round[1]["status"] == "failed"
+    assert by_round[2]["status"] == "ok" and by_round[2]["value"] == 0.382
+    assert by_round[2]["tokens_per_sec"] == 2244.2
+    assert by_round[3]["status"] == "no_metric"  # rc=0 but nothing measured
+    assert by_round[4]["status"] == "wedged"  # the timeout's rc
+    mc = {r["round"]: r["status"] for r in summary["multichip"]}
+    assert mc == {1: "wedged", 2: "ok", 3: "skipped"}
+    assert summary["best_bench_value"] == 0.382
+    # every non-ok bench round + non-ok/skipped multichip round is named
+    assert sorted(summary["flags"]) == [
+        "BENCH r1: failed (rc=1)",
+        "BENCH r3: no_metric (rc=0)",
+        "BENCH r4: wedged (rc=124)",
+        "MULTICHIP r1: wedged (rc=124)",
+    ]
+
+
+def test_format_table_renders_rows_and_flags(tmp_path):
+    _seed_rounds(tmp_path)
+    table = format_trajectory_table(summarize_trajectory(tmp_path))
+    assert "wedged" in table and "0.382" in table and "680m_flash" in table
+    assert "flagged rounds:" in table
+    assert format_trajectory_table(summarize_trajectory(tmp_path / "empty")) == (
+        "no BENCH_r*/MULTICHIP_r* artifacts found"
+    )
+
+
+def test_analyze_bench_cli_table_and_json(tmp_path):
+    _seed_rounds(tmp_path)
+    result = CliRunner().invoke(
+        cli_main, ["data", "analyze_bench", "--artifacts_dir", str(tmp_path)]
+    )
+    assert result.exit_code == 0, result.output
+    assert "BENCH r4: wedged" in result.output
+
+    result = CliRunner().invoke(
+        cli_main, ["data", "analyze_bench", "--artifacts_dir", str(tmp_path), "--as_json"]
+    )
+    assert result.exit_code == 0, result.output
+    summary = json.loads(result.output)
+    assert summary["best_bench_value"] == 0.382
+    assert len(summary["bench"]) == 4 and len(summary["multichip"]) == 3
