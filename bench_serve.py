@@ -55,6 +55,19 @@ Fleet serving knob (PR 12):
                            the zero-drop/zero-recompile oracle. Reports
                            `hot_swaps`, swap latency percentiles, and requests
                            in flight during swaps.
+
+Quantized serving knobs (PR 14; --quant-kv implies --cache paged):
+  --quant-weights M        int8 | fp8 weight-only serving (params quantized
+                           once up front, dequant-on-the-fly matmul)
+  --quant-kv M             int8 paged KV pool with per-(block,row,head)
+                           float32 scales
+  --kv-pool-bytes N        size the paged pool from a NOMINAL-bf16 K/V data
+                           byte budget instead of slots*table-width — int8
+                           fits 2x the blocks of bf16 at the same budget, so
+                           the half-budget int8 oracle pins capacity parity
+Quantized runs are excluded from the bitwise parity pins; instead the logit
+oracle (quant/oracle.py) runs on the same model/params and reports
+`quant_logit_max_err` / `quant_token_match` in the JSON line.
 """
 
 import argparse
@@ -99,6 +112,14 @@ METRIC_KEYS = (
     "swap_latency_ms_max",
     "swap_in_flight_mean",
     "swap_tokens_match",
+    # quantized serving (--quant-weights / --quant-kv; None otherwise)
+    "quant_weights",
+    "quant_kv",
+    "pool_blocks",
+    "kv_pool_bytes",
+    "quant_bytes_saved",
+    "quant_logit_max_err",
+    "quant_token_match",
 )
 
 
@@ -349,6 +370,19 @@ def main() -> int:
         "hardware round's throughput number ships with its attribution",
     )
     parser.add_argument(
+        "--quant-weights", choices=("none", "int8", "fp8"), default="none",
+        help="weight-only quantized serving mode",
+    )
+    parser.add_argument(
+        "--quant-kv", choices=("none", "int8"), default="none",
+        help="quantized paged KV pool mode (implies --cache paged)",
+    )
+    parser.add_argument(
+        "--kv-pool-bytes", type=int, default=None,
+        help="size the paged pool from this NOMINAL-bf16 K/V data byte budget "
+        "(int8 pools fit 2x the blocks at the same budget)",
+    )
+    parser.add_argument(
         "--hot_swap_every", type=int, default=0,
         help="hot-swap identical weights every N decode steps mid-flight and "
         "oracle the output against a swap-free twin run (token-bitwise); "
@@ -365,6 +399,8 @@ def main() -> int:
         parser.error("--spec must be >= 0")
     if args.shared_prefix_frac is not None or args.spec > 0:
         args.cache = "paged"  # prefix sharing + spec decode live on the block pool
+    if args.quant_kv != "none" or args.kv_pool_bytes is not None:
+        args.cache = "paged"  # quantized KV blocks live on the block pool
 
     print(_line({"provisional": True, "reason": "startup"}), flush=True)
     _arm_budget_guard()
@@ -394,18 +430,33 @@ def main() -> int:
         trace = _make_trace(args.requests, args.rate, args.max_new, args.seed, args.long, capacity)
     need_len = max(len(r["prompt"]) + r["max_new_tokens"] for r in trace)
 
+    pool_blocks = None
+    if args.kv_pool_bytes is not None:
+        # pool sized from the byte budget instead of slots * table width: the
+        # half-budget int8 capacity oracle compares this count across modes
+        from modalities_tpu.quant.kv import kv_blocks_for_budget
+
+        spec = model.config_spec
+        pool_blocks = kv_blocks_for_budget(
+            args.kv_pool_bytes, 16, spec.n_head_kv,
+            spec.n_embd // spec.n_head_q, mode=args.quant_kv,
+        )
+
     def fresh_engine(slots: int, spec_k: int = 0) -> ServingEngine:
         kwargs = {}
         if args.cache == "paged":
             # lift the per-request ceiling past the ring capacity so the --long
             # requests actually finish (NOPE+rotary model: no wpe table to outgrow)
             kwargs = {"kv_cache": "paged", "paged_max_len": max(need_len, capacity)}
+            if pool_blocks is not None:
+                kwargs["paged_num_blocks"] = pool_blocks
             if spec_k > 0:
                 kwargs["spec_decode"] = {"k": spec_k}
         # per-engine registry so the baseline's samples never mix into the
         # measured engine's scrape
         return ServingEngine(
             model, params, max_batch_slots=slots, eod_token_id=-1,
+            quant_weights=args.quant_weights, quant_kv=args.quant_kv,
             metrics=MetricsRegistry(), **kwargs,
         )
 
@@ -538,6 +589,28 @@ def main() -> int:
         assert tokens_match, "hot swap changed the tokens"
         assert stats["decode_executables"] == 1, "hot swap recompiled the decode step"
 
+    quant = {
+        "quant_weights": stats["quant_weights"],
+        "quant_kv": stats["quant_kv"],
+        "kv_pool_bytes": stats["kv_pool_bytes"],
+        "quant_bytes_saved": stats["quant_bytes_saved"],
+    }
+    if args.cache == "paged":
+        quant["pool_blocks"] = stats["num_blocks"]
+    if args.quant_weights != "none" or args.quant_kv != "none":
+        # the parity gate for quantized modes: bitwise pins don't apply, the
+        # teacher-forced logit oracle does (quant/oracle.py)
+        from modalities_tpu.quant.oracle import run_oracle
+
+        n_oracle, n_new = (2, 4) if args.smoke else (3, 6)
+        report = run_oracle(
+            model, params, [t["prompt"][:12] for t in trace[:n_oracle]],
+            quant_weights=args.quant_weights, quant_kv=args.quant_kv,
+            max_new_tokens=n_new,
+        )
+        quant["quant_logit_max_err"] = report.max_abs_err
+        quant["quant_token_match"] = report.token_match
+
     baseline_tokens_per_s = None
     speedup = None
     if args.spec > 0:
@@ -582,6 +655,7 @@ def main() -> int:
                 "truncated_requests": stats.get("truncated_requests", 0),
                 **v3,
                 **hot,
+                **quant,
                 "cache": args.cache,
                 "perfscope": args.perfscope,
                 "requests": args.requests,

@@ -190,6 +190,9 @@ class SlotDecodeSpec:
     kind: str = "ring"  # "ring" | "paged"
     num_blocks: int = 0  # paged only: global pool blocks per layer
     block_size: int = 0  # paged only: tokens per block
+    # paged only: "none" | "int8" — int8 pools store quantized K/V rows plus a
+    # float32 scale per (block, row, kv_head) alongside (quant/kv.py)
+    kv_quant: str = "none"
 
 
 @dataclass(frozen=True)
@@ -239,6 +242,11 @@ class GPT2ModelSpec:
     # into the forward (model_debugging_hook.print_forward_hook; the jit-native
     # analogue of the reference's eager print hook, debug_components.py:50-70)
     debug_print_activations: Optional[str] = None
+    # weight-only quantized serving (quant/weights.py): "none" | "int8" | "fp8".
+    # Non-"none" swaps every dense layer for QuantDenseGeneral (kernel stored
+    # quantized + float32 per-output-channel scale, dequant fused into the
+    # matmul). Serving-only — the train step never sets this.
+    quant_weights: str = "none"
 
     @property
     def head_dim(self) -> int:
@@ -280,6 +288,7 @@ class GPT2ModelSpec:
                 self.param_dtype,
                 self.compute_dtype,
                 self.debug_print_activations,
+                self.quant_weights,
             )
         )
 
@@ -383,8 +392,81 @@ def flash_attention(q, k, v):
     return flash_attention_or_fallback(q, k, v, causal=True)
 
 
+class QuantDenseGeneral(nn.Module):
+    """DenseGeneral over a weight-only-quantized kernel (quant/weights.py layout).
+
+    Params: `kernel` in the quantized storage dtype with the SAME shape and
+    logical axes as the bf16 layer it replaces, plus a float32 `scale` shaped
+    like the output feature dims (one symmetric per-output-channel scale),
+    plus the usual float32 bias. The tree therefore matches what
+    `quantize_params` produces from a restored checkpoint — load/swap install
+    quantized params straight into a model whose spec selects this layer.
+
+    The matmul runs through `quant_matmul_or_fallback` (ops/quant_matmul.py):
+    the quantized kernel is widened in VMEM inside the fused Pallas kernel on
+    TPU, and the bitwise-identical pure-jnp dequant expression elsewhere.
+    `n_contract` input dims are flattened into one contraction (always the
+    LEADING kernel dims — matches every use site: axis=-1 projections and the
+    attention c_proj's axis=(-2, -1))."""
+
+    features: tuple  # output feature dims
+    kernel_axes: tuple
+    mode: str  # "int8" | "fp8"
+    n_contract: int = 1  # leading kernel dims that contract (trailing x dims)
+    use_bias: bool = False
+    param_dtype: str = "float32"  # bias storage dtype (kernel/scale are fixed)
+
+    @nn.compact
+    def __call__(self, x):
+        from modalities_tpu.ops.quant_matmul import quant_matmul_or_fallback
+        from modalities_tpu.quant.weights import quant_storage_dtype
+
+        feats = tuple(int(f) for f in self.features)
+        in_shape = tuple(int(d) for d in x.shape[x.ndim - self.n_contract :])
+        storage = quant_storage_dtype(self.mode)
+        kernel = self.param(
+            "kernel",
+            nn.with_logical_partitioning(nn.initializers.zeros, self.kernel_axes),
+            in_shape + feats,
+            storage,
+        )
+        scale_axes = self.kernel_axes[self.n_contract :]
+        scale = self.param(
+            "scale",
+            nn.with_logical_partitioning(nn.initializers.ones, scale_axes),
+            feats,
+            jnp.float32,
+        )
+        k_flat = math.prod(in_shape)
+        n_flat = math.prod(feats)
+        batch_shape = x.shape[: x.ndim - self.n_contract]
+        y2 = quant_matmul_or_fallback(
+            x.reshape(-1, k_flat), kernel.reshape(k_flat, n_flat), scale.reshape(n_flat)
+        )
+        y = y2.reshape(batch_shape + feats)
+        if self.use_bias:
+            bias = self.param(
+                "bias",
+                nn.with_logical_partitioning(nn.initializers.zeros, scale_axes),
+                feats,
+                jnp.dtype(self.param_dtype),
+            )
+            y = y + bias.astype(y.dtype)
+        return y
+
+
 def _dense_general(spec, features, name, kernel_axes, dtype):
     bias_axes = kernel_axes[1:] if isinstance(features, tuple) else (kernel_axes[-1],)
+    if getattr(spec, "quant_weights", "none") != "none":
+        return QuantDenseGeneral(
+            features=features if isinstance(features, tuple) else (features,),
+            kernel_axes=tuple(kernel_axes),
+            mode=spec.quant_weights,
+            n_contract=1,
+            use_bias=spec.bias,
+            param_dtype=spec.param_dtype,
+            name=name,
+        )
     return nn.DenseGeneral(
         features=features,
         use_bias=spec.bias,
@@ -548,16 +630,30 @@ class CausalSelfAttention(nn.Module):
         ss = self.slot_spec
         head_dim = spec.head_dim
         nb, bs = ss.num_blocks, ss.block_size
+        kv_int8 = ss.kv_quant == "int8"
         pos = positions["pos"]
         tables = positions["tables"]
         wblk, woff = positions["wblk"], positions["woff"]
 
+        # int8 pools store quantized rows; a float32 scale per (block, row,
+        # kv_head) rides ALONGSIDE in the same cache tree (rows land at
+        # different steps, so the scale must be per written row, never per
+        # block). Zero-init scales dequantize untouched rows to exactly the
+        # bf16 path's zeros.
+        pool_dtype = jnp.int8 if kv_int8 else k.dtype
         cached_k = self.variable(
-            "cache", "cached_key", jnp.zeros, (nb, bs, spec.n_head_kv, head_dim), k.dtype
+            "cache", "cached_key", jnp.zeros, (nb, bs, spec.n_head_kv, head_dim), pool_dtype
         )
         cached_v = self.variable(
-            "cache", "cached_value", jnp.zeros, (nb, bs, spec.n_head_kv, head_dim), v.dtype
+            "cache", "cached_value", jnp.zeros, (nb, bs, spec.n_head_kv, head_dim), pool_dtype
         )
+        if kv_int8:
+            k_scale = self.variable(
+                "cache", "cached_key_scale", jnp.zeros, (nb, bs, spec.n_head_kv, 1), jnp.float32
+            )
+            v_scale = self.variable(
+                "cache", "cached_value_scale", jnp.zeros, (nb, bs, spec.n_head_kv, 1), jnp.float32
+            )
 
         if spec.use_rope:
             cos, sin = _rope_tables(head_dim, ss.capacity, spec.rope_base_freq, dtype=x.dtype)
@@ -570,24 +666,46 @@ class CausalSelfAttention(nn.Module):
             k = apply_rope(k, cos_i, sin_i)
 
         # scatter the incoming k/v into the pool at explicit (block, offset)
-        # coordinates; out-of-range blocks are dropped, never clamped
+        # coordinates; out-of-range blocks are dropped, never clamped.
+        # Quantize-on-write: int8 mode quantizes each incoming row (symmetric
+        # absmax over head_dim, one scale per kv-head) and scatters value and
+        # scale with the SAME coordinates — a dropped write drops both.
         k_flat = k.reshape(-1, spec.n_head_kv, head_dim)
         v_flat = v.reshape(-1, spec.n_head_kv, head_dim)
         blk, off = wblk.reshape(-1), woff.reshape(-1)
+        if kv_int8:
+            from modalities_tpu.quant.core import quantize_per_channel
+
+            k_flat, k_s = quantize_per_channel(k_flat, axis=-1)
+            v_flat, v_s = quantize_per_channel(v_flat, axis=-1)
+            ks_pool = k_scale.value.at[blk, off].set(k_s, mode="drop")
+            vs_pool = v_scale.value.at[blk, off].set(v_s, mode="drop")
         k_pool = cached_k.value.at[blk, off].set(k_flat, mode="drop")
         v_pool = cached_v.value.at[blk, off].set(v_flat, mode="drop")
         if not self.is_initializing():
             cached_k.value = k_pool
             cached_v.value = v_pool
+            if kv_int8:
+                k_scale.value = ks_pool
+                v_scale.value = vs_pool
 
         # gather each row's K/V tiles via its block table -> [B, MB*bs, Hkv, D];
-        # gathered index IS the logical position (tables are position-ordered)
+        # gathered index IS the logical position (tables are position-ordered).
+        # Dequant-at-gather: int8 mode gathers the quantized pool and its scale
+        # pool through the same tables and broadcasts the multiply back to
+        # x.dtype before the softmax.
         b_rows, mb = tables.shape
 
         def gather(pool):
-            return jnp.take(pool, tables, axis=0).reshape(b_rows, mb * bs, spec.n_head_kv, head_dim)
+            return jnp.take(pool, tables, axis=0).reshape(
+                b_rows, mb * bs, spec.n_head_kv, pool.shape[-1]
+            )
 
-        k_all, v_all = gather(k_pool), gather(v_pool)
+        if kv_int8:
+            k_all = (gather(k_pool).astype(jnp.float32) * gather(ks_pool)).astype(x.dtype)
+            v_all = (gather(v_pool).astype(jnp.float32) * gather(vs_pool)).astype(x.dtype)
+        else:
+            k_all, v_all = gather(k_pool), gather(v_pool)
         key_pos = jnp.arange(mb * bs)
         if ss.mode == "prefill":
             mask = key_pos[None, None, :] <= pos[:, :, None]  # [R, C, L]
@@ -673,18 +791,29 @@ class CausalSelfAttention(nn.Module):
         # the attention op (handled in __call__) and residuals after c_proj — never
         # the raw attention output (reference gpt2_model.py:676 resid_dropout(c_proj))
         spec = self.spec
-        out = nn.DenseGeneral(
-            features=spec.n_embd,
-            axis=(-2, -1),
-            use_bias=spec.bias,
-            name="c_proj",
-            kernel_init=nn.with_logical_partitioning(
-                nn.initializers.normal(0.02), ("heads", "head_dim", "embed")
-            ),
-            bias_init=nn.with_logical_partitioning(nn.initializers.zeros, ("embed",)),
-            dtype=x.dtype,
-            param_dtype=jnp.dtype(spec.param_dtype),
-        )(y)
+        if spec.quant_weights != "none":
+            out = QuantDenseGeneral(
+                features=(spec.n_embd,),
+                kernel_axes=("heads", "head_dim", "embed"),
+                mode=spec.quant_weights,
+                n_contract=2,  # kernel [H, D, E]: heads x head_dim contract
+                use_bias=spec.bias,
+                param_dtype=spec.param_dtype,
+                name="c_proj",
+            )(y)
+        else:
+            out = nn.DenseGeneral(
+                features=spec.n_embd,
+                axis=(-2, -1),
+                use_bias=spec.bias,
+                name="c_proj",
+                kernel_init=nn.with_logical_partitioning(
+                    nn.initializers.normal(0.02), ("heads", "head_dim", "embed")
+                ),
+                bias_init=nn.with_logical_partitioning(nn.initializers.zeros, ("embed",)),
+                dtype=x.dtype,
+                param_dtype=jnp.dtype(spec.param_dtype),
+            )(y)
         return nn.Dropout(rate=spec.dropout)(out, deterministic=self.deterministic or spec.dropout == 0.0)
 
 
@@ -777,7 +906,11 @@ def head_project(spec: "GPT2ModelSpec", inner_params, h):
     if spec.use_weight_tying:
         logits = jnp.einsum("bse,ve->bsv", h, inner_params["wte"].astype(jnp.float32))
     else:
-        logits = h @ inner_params["lm_head"]["kernel"].astype(jnp.float32)
+        head = inner_params["lm_head"]
+        kernel = head["kernel"].astype(jnp.float32)
+        if "scale" in head:  # weight-only quantized head: dequant per vocab column
+            kernel = kernel * head["scale"].astype(jnp.float32)
+        logits = h @ kernel
     return with_logical_constraint(logits, ("batch", "seq", "vocab_logits"))
 
 
@@ -967,6 +1100,15 @@ class GPT2Module(nn.Module):
             return x
         if spec.use_weight_tying:
             logits = jnp.einsum("bse,ve->bsv", x.astype(jnp.float32), wte.astype(jnp.float32))
+        elif spec.quant_weights != "none":
+            logits = QuantDenseGeneral(
+                features=(spec.vocab_size,),
+                kernel_axes=("embed", "vocab"),
+                mode=spec.quant_weights,
+                use_bias=False,
+                param_dtype=spec.param_dtype,
+                name="lm_head",
+            )(x.astype(jnp.float32))
         else:
             logits = nn.Dense(
                 spec.vocab_size,
@@ -1121,7 +1263,10 @@ class GPT2LLM(NNModel):
         inner = params["params"]
         if self.config_spec.use_weight_tying:
             return inner["wte"]
-        return inner["lm_head"]["kernel"].T
+        head = inner["lm_head"]
+        if "scale" in head:  # weight-only quantized head: dequant per vocab column
+            return (head["kernel"].astype(jnp.float32) * head["scale"].astype(jnp.float32)).T
+        return head["kernel"].T
 
     # ----------------------------------------------------------- KV-cache decoding
     def init_decode_cache(self, params, batch_size: int):
@@ -1227,13 +1372,29 @@ class GPT2LLM(NNModel):
                 return int(leaf.shape[0]), int(leaf.shape[1])
         raise ValueError("not a paged KV cache: no [.., blocks, block_size, heads, head_dim] leaf")
 
-    def init_paged_cache(self, params, num_blocks: int, block_size: int):
+    @staticmethod
+    def _paged_cache_quant(cache) -> str:
+        """KV quant mode read off the cache leaves: an int8 pool leaf means the
+        cache was built with kv_quant="int8" — recovered statically so the
+        prefill/decode surfaces never grow a mode argument."""
+        for leaf in jax.tree.leaves(cache):
+            if jnp.dtype(leaf.dtype) == jnp.int8:
+                return "int8"
+        return "none"
+
+    def init_paged_cache(self, params, num_blocks: int, block_size: int, kv_quant: str = "none"):
         """Zeroed global block pool ([num_blocks, block_size, Hkv, D] per layer,
-        leading layers axis added by the scan). Shapes via abstract init."""
+        leading layers axis added by the scan). Shapes via abstract init.
+        kv_quant="int8" stores int8 pools plus float32 scale pools
+        ([num_blocks, block_size, Hkv, 1]) alongside in the same tree."""
         nb, bs = int(num_blocks), int(block_size)
         if nb < 1 or bs < 1:
             raise ValueError(f"paged cache needs num_blocks >= 1 and block_size >= 1, got {nb}/{bs}")
-        sspec = SlotDecodeSpec("decode", 1, bs, kind="paged", num_blocks=nb, block_size=bs)
+        if kv_quant not in ("none", "int8"):
+            raise ValueError(f"unknown kv_quant {kv_quant!r} (expected none|int8)")
+        sspec = SlotDecodeSpec(
+            "decode", 1, bs, kind="paged", num_blocks=nb, block_size=bs, kv_quant=kv_quant
+        )
         module = GPT2Module(self.config_spec, deterministic=True, slot_spec=sspec)
         tokens = jnp.zeros((1, 1), dtype=jnp.int32)
         positions = {
@@ -1257,6 +1418,7 @@ class GPT2LLM(NNModel):
         sspec = SlotDecodeSpec(
             "prefill", int(tokens.shape[0]), int(tables.shape[1]) * bs,
             kind="paged", num_blocks=nb, block_size=bs,
+            kv_quant=self._paged_cache_quant(cache),
         )
         module = GPT2Module(self.config_spec, deterministic=True, slot_spec=sspec)
         pos_tree = {"pos": positions, "tables": tables, "wblk": wblk, "woff": woff}
@@ -1274,6 +1436,7 @@ class GPT2LLM(NNModel):
         sspec = SlotDecodeSpec(
             "decode", int(tokens.shape[0]), int(tables.shape[1]) * bs,
             kind="paged", num_blocks=nb, block_size=bs,
+            kv_quant=self._paged_cache_quant(cache),
         )
         module = GPT2Module(self.config_spec, deterministic=True, slot_spec=sspec)
         pos_tree = {"pos": positions, "tables": tables, "wblk": wblk, "woff": woff}

@@ -191,7 +191,7 @@ def tune_kernels(
     recorder=None,
     smoke: bool = False,
 ) -> Dict[str, Any]:
-    """Timed block-size sweep for the three Pallas kernels; persists the winners.
+    """Timed block-size sweep for the dispatchable Pallas kernels; persists the winners.
 
     ``recorder`` is an optional telemetry SpanRecorder — each candidate timing
     runs inside a ``tune/{kernel}/{label}`` span so sweeps publish through the
@@ -297,6 +297,31 @@ def tune_kernels(
         f"e{shape_bucket(n_embd)}",
         [{"block_rows": bn} for bn in row_blocks],
         make_rms,
+    )
+
+    # ---- quant matmul: block_m x block_n over the rows bucket (serving's
+    # fused dequant-matmul; ops/quant_matmul.py looks winners up by row count)
+    from modalities_tpu.ops.pallas.quant_matmul import quant_matmul
+
+    wq = jax.random.randint(rng, (n_embd, 4 * n_embd), -127, 128, dtype=jnp.int8)
+    wscale = jnp.full((4 * n_embd,), 0.01, dtype=jnp.float32)
+    xq = jax.random.normal(rng, (rows, n_embd), dtype=jdtype)
+
+    def make_quant_mm(block_m, block_n):
+        f = jax.jit(
+            lambda x, w, s: quant_matmul(
+                x, w, s, block_m=block_m, block_n=block_n, interpret=interpret
+            )
+        )
+        return lambda: jax.block_until_ready(f(xq, wq, wscale))
+
+    mm_m_blocks = sorted({b for b in (64, 128, 256) if b <= rows} or {min(rows, 64)})
+    mm_n_blocks = sorted({b for b in (128, 256, 512) if b <= 4 * n_embd} or {128})
+    sweep(
+        "quant_matmul",
+        f"m{shape_bucket(rows)}",
+        [{"block_m": bm, "block_n": bn} for bm in mm_m_blocks for bn in mm_n_blocks],
+        make_quant_mm,
     )
 
     summary: Dict[str, Any] = {

@@ -1,0 +1,104 @@
+"""Pallas TPU fused dequant-matmul for weight-only quantized serving.
+
+`y = (x @ wq) * scale` with `x [M, K]` (f32/bf16), `wq [K, N]` a quantized
+kernel (int8, or an fp8/emulated-fp8 grid), and `scale [N]` float32 per output
+channel. The fusion point is the whole argument: the quantized kernel is read
+from HBM in its 1-byte form and widened IN VMEM, so the weight's HBM traffic
+is half/quarter of the bf16/f32 path — dequantizing outside the matmul would
+materialize the full-width weight and give the bytes right back.
+
+Math per (bm, bn) grid tile: widen the weight tile to x's dtype, one MXU dot
+with fp32 accumulation (`preferred_element_type`), multiply the fp32
+accumulator by the channel scales, cast to x's dtype. The pure-jnp fallback in
+ops/quant_matmul.py runs the IDENTICAL expression on the full arrays, so
+interpret-mode parity off-TPU is bitwise (the K contraction is never split).
+
+`interpret=True` runs the kernel under the Pallas CPU emulator — same
+discipline as flash_attention.py / fused_rmsnorm.py, pinned by
+tests/ops/test_kernel_dispatch_closure.py.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+DEFAULT_BLOCK_M = 128
+DEFAULT_BLOCK_N = 128
+
+
+def _kernel(x_ref, w_ref, s_ref, y_ref):
+    x = x_ref[...]  # [bm, K]
+    w = w_ref[...].astype(x.dtype)  # [K, bn] widened in VMEM, not HBM
+    acc = jnp.dot(x, w, preferred_element_type=jnp.float32)  # [bm, bn] fp32
+    y_ref[...] = (acc * s_ref[...].astype(jnp.float32)).astype(y_ref.dtype)
+
+
+def _block(n: int, preferred: int) -> int:
+    return max(8, min(preferred, 1 << max(0, int(n) - 1).bit_length()))
+
+
+def quant_matmul(
+    x,
+    wq,
+    scale,
+    *,
+    block_m: int = DEFAULT_BLOCK_M,
+    block_n: int = DEFAULT_BLOCK_N,
+    interpret: bool = False,
+):
+    """Fused dequant-matmul: x [M, K] @ wq [K, N] (quantized) * scale [N].
+
+    Returns [M, N] in x's dtype with fp32 accumulation. K is contracted whole
+    per tile (serving matmuls have K = n_embd/ffn sizes that fit VMEM beside a
+    128-wide tile); M and N are padded up to the block grid and cropped after.
+    """
+    m, k = x.shape
+    kw, n = wq.shape
+    if kw != k:
+        raise ValueError(f"quant_matmul: x [{m},{k}] vs wq [{kw},{n}] contraction mismatch")
+    if scale.shape != (n,):
+        raise ValueError(f"quant_matmul: scale shape {scale.shape} != ({n},)")
+
+    bm, bn = _block(m, block_m), _block(n, block_n)
+    m_pad, n_pad = -m % bm, -n % bn
+    if m_pad:
+        x = jnp.pad(x, ((0, m_pad), (0, 0)))
+    if n_pad:
+        wq = jnp.pad(wq, ((0, 0), (0, n_pad)))
+        scale = jnp.pad(scale, (0, n_pad))
+    mp, np_ = m + m_pad, n + n_pad
+
+    y = pl.pallas_call(
+        _kernel,
+        grid=(mp // bm, np_ // bn),
+        in_specs=[
+            pl.BlockSpec((bm, k), lambda i, j: (i, 0)),
+            pl.BlockSpec((k, bn), lambda i, j: (0, j)),
+            pl.BlockSpec((1, bn), lambda i, j: (0, j)),
+        ],
+        out_specs=pl.BlockSpec((bm, bn), lambda i, j: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((mp, np_), x.dtype),
+        interpret=interpret,
+    )(x, wq, scale.reshape(1, np_))
+    if m_pad or n_pad:
+        y = y[:m, :n]
+    return y
+
+
+def flops_and_bytes(m: int, k: int, n: int, x_bytes: int, w_bytes: int) -> dict:
+    """Static cost of one call — the autotune sweep's ranking metric and the
+    perfscope cross-check that quantized weights actually halve the weight
+    traffic."""
+    return {
+        "flops": 2.0 * m * k * n,
+        "bytes": float(m * k * x_bytes + k * n * w_bytes + m * n * x_bytes + 4 * n),
+    }
+
+
+def reference_quant_matmul(x, wq, scale):
+    """The fallback tier and parity oracle: the SAME widen-dot-scale expression
+    on unblocked arrays (K is never split in the kernel, so this is bitwise)."""
+    acc = jnp.dot(x, wq.astype(x.dtype), preferred_element_type=jnp.float32)
+    return (acc * scale.astype(jnp.float32)).astype(x.dtype)

@@ -1,0 +1,147 @@
+"""Core quantization primitives: symmetric int8 (per-channel / per-block) and
+fp8 e4m3, with explicit scale layouts.
+
+Conventions (every consumer — quant/weights.py, the paged KV pool, the Pallas
+dequant-matmul — relies on these, and tests/quant/test_quant_core.py pins them):
+
+- int8 is SYMMETRIC absmax: `scale = absmax / 127`, `q = round(x / scale)` in
+  [-127, 127] (-128 is never produced, so dequant is sign-symmetric), and the
+  round-trip error is bounded by `scale / 2` per element — exactly, not
+  approximately, which is what makes the bound a usable test oracle.
+- scales are float32 and keep the reduced axis as size 1 (`keepdims=True`), so
+  `dequantize(q, scale)` is always a plain broadcast multiply. A scale layout
+  is therefore readable off the array shape: per-channel over axis=-1 of a
+  [T, H, D] tensor gives scale [T, H, 1].
+- fp8 uses `float8_e4m3fn` when this jaxlib materializes it, otherwise an
+  emulated e4m3 grid (4-bit mantissa rounding, clamp at ±448) stored in
+  float32 — same representable values, so numerics do not depend on the
+  jaxlib. `quantize_fp8` also absmax-prescales (scale = absmax / 448) so the
+  full e4m3 range is used regardless of the input magnitude.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+INT8_QMAX = 127.0
+FP8_E4M3_MAX = 448.0  # largest finite e4m3fn value
+# smallest e4m3 EXPONENT used by the emulation grid: e4m3fn normals go down to
+# 2^-6; below that the grid steps stay at the subnormal spacing 2^-9
+_E4M3_MIN_EXP = -6
+_E4M3_MANT_BITS = 3
+
+
+def fp8_dtype():
+    """The native float8_e4m3 dtype, or None when this jaxlib cannot hold it
+    as an array dtype (the emulated grid is used instead)."""
+    dt = getattr(jnp, "float8_e4m3fn", None)
+    if dt is None:
+        return None
+    try:  # some jaxlibs export the name but cannot materialize arrays of it
+        jnp.zeros((1,), dt)
+    except Exception:
+        return None
+    return dt
+
+
+def _safe_scale(absmax, qmax: float):
+    # a zero row must not divide by zero; scale 0 would also break dequant, so
+    # clamp to the smallest positive normal — q rounds to 0 there anyway
+    return jnp.maximum(absmax / qmax, jnp.finfo(jnp.float32).tiny).astype(jnp.float32)
+
+
+def quantize_per_channel(x, axis: int = -1):
+    """Symmetric int8 quantization with one scale per slice along `axis`.
+
+    Returns (q int8, scale float32) where scale keeps `axis` as size 1, so
+    `dequantize(q, scale)` broadcasts. Round-trip bound: |dq - x| <= scale/2.
+    """
+    x32 = jnp.asarray(x).astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(x32), axis=axis, keepdims=True)
+    scale = _safe_scale(absmax, INT8_QMAX)
+    q = jnp.clip(jnp.round(x32 / scale), -INT8_QMAX, INT8_QMAX).astype(jnp.int8)
+    return q, scale
+
+
+def quantize_per_block(x, block: int, axis: int = -1):
+    """Symmetric int8 quantization with one scale per contiguous `block`-sized
+    group along `axis` (the KV-pool layout: finer than per-channel, coarser
+    than per-element). `axis`'s extent must divide by `block`.
+
+    Returns (q int8 with x's shape, scale float32 with axis extent
+    `x.shape[axis] // block` — one entry per block, NOT keepdims-style).
+    """
+    x32 = jnp.asarray(x).astype(jnp.float32)
+    axis = axis % x32.ndim
+    n = x32.shape[axis]
+    if n % int(block) != 0:
+        raise ValueError(f"axis extent {n} not divisible by block {block}")
+    split = x32.shape[:axis] + (n // int(block), int(block)) + x32.shape[axis + 1 :]
+    xb = x32.reshape(split)
+    absmax = jnp.max(jnp.abs(xb), axis=axis + 1, keepdims=True)
+    scale = _safe_scale(absmax, INT8_QMAX)
+    q = jnp.clip(jnp.round(xb / scale), -INT8_QMAX, INT8_QMAX).astype(jnp.int8)
+    return q.reshape(x32.shape), jnp.squeeze(scale, axis=axis + 1)
+
+
+def dequantize(q, scale, dtype=jnp.float32):
+    """Broadcast-multiply dequantization; the inverse of the quantizers above.
+    For per-block scales pass the same `block`/`axis` via `dequantize_block`."""
+    return (q.astype(jnp.float32) * scale.astype(jnp.float32)).astype(dtype)
+
+
+def dequantize_block(q, scale, block: int, axis: int = -1, dtype=jnp.float32):
+    """Dequantize a `quantize_per_block` pair (scale has one entry per block)."""
+    axis = axis % q.ndim
+    split = q.shape[:axis] + (q.shape[axis] // int(block), int(block)) + q.shape[axis + 1 :]
+    qb = q.astype(jnp.float32).reshape(split)
+    out = qb * jnp.expand_dims(scale.astype(jnp.float32), axis + 1)
+    return out.reshape(q.shape).astype(dtype)
+
+
+def round_to_e4m3_grid(x):
+    """Round float values onto the e4m3fn representable grid WITHOUT changing
+    dtype — the emulation path for jaxlibs with no native float8, and the
+    numerics oracle for the native one (same grid by construction).
+
+    Grid: 3 mantissa bits (spacing 2^(e-3) at exponent e), normals down to
+    2^-6, subnormal spacing 2^-9, clamp at ±448 (e4m3fn has no inf).
+    """
+    x32 = jnp.asarray(x).astype(jnp.float32)
+    ax = jnp.abs(x32)
+    # floor(log2 |x|), with zeros mapped harmlessly onto the minimum exponent
+    exp = jnp.floor(jnp.log2(jnp.where(ax > 0, ax, 1.0)))
+    exp = jnp.clip(exp, _E4M3_MIN_EXP, None)
+    step = jnp.exp2(exp - _E4M3_MANT_BITS)
+    snapped = jnp.round(x32 / step) * step
+    return jnp.clip(snapped, -FP8_E4M3_MAX, FP8_E4M3_MAX).astype(jnp.float32)
+
+
+def quantize_fp8(x):
+    """Absmax-prescaled fp8 e4m3 quantization.
+
+    Returns (q, scale) with scale float32 `[..., 1]` over the last axis
+    (`absmax / 448` — the tensor's largest value lands on the largest finite
+    e4m3 value). `q` is native float8_e4m3fn when the jaxlib supports it,
+    otherwise the emulated grid in float32; either way
+    `dequantize(q, scale, dtype)` reverses it.
+    """
+    x32 = jnp.asarray(x).astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(x32), axis=-1, keepdims=True)
+    scale = _safe_scale(absmax, FP8_E4M3_MAX)
+    scaled = x32 / scale
+    native = fp8_dtype()
+    if native is not None:
+        q = jnp.clip(scaled, -FP8_E4M3_MAX, FP8_E4M3_MAX).astype(native)
+    else:
+        q = round_to_e4m3_grid(scaled)
+    return q, scale
+
+
+def tree_bytes(tree) -> int:
+    """Total leaf bytes of a pytree (the before/after of
+    `serve_quant_weights_bytes_saved`)."""
+    return int(
+        sum(leaf.size * jnp.dtype(leaf.dtype).itemsize for leaf in jax.tree.leaves(tree))
+    )

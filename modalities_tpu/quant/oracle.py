@@ -1,0 +1,130 @@
+"""Logit-error oracle for quantized serving modes.
+
+Quantized modes are *excluded* from the bitwise interactive-parity pins — int8
+kernels cannot be bitwise-equal to bf16 and pretending otherwise would pin
+noise. This module is the acceptance gate that replaces those pins: a
+teacher-forced greedy comparison between a quantized variant and the bf16
+reference over a CPU prompt corpus, reporting
+
+- ``max_abs_err``     — max |quant_logits - ref_logits| over every scored
+                        position (prefill's last column plus every decode step),
+- ``token_match``     — fraction of positions where the quantized argmax equals
+                        the reference argmax (the greedy token-match rate).
+
+Teacher forcing is what makes the numbers meaningful: BOTH variants are fed the
+reference's greedy tokens, so position t compares the same conditional
+distribution instead of diverging transcripts. Both variants run the PAGED
+prefill/decode path with identity block tables — the exact executables serving
+uses — so KV-quant error (which only exists in the paged pool) is measured, not
+just weight error.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass
+class OracleReport:
+    """Comparison of one quantized variant against the bf16 reference."""
+
+    max_abs_err: float
+    token_match: float
+    positions: int
+    ref_tokens: list
+    quant_tokens: list
+
+    def as_dict(self) -> dict:
+        return {
+            "quant_logit_max_err": self.max_abs_err,
+            "quant_token_match": self.token_match,
+            "oracle_positions": self.positions,
+        }
+
+
+def _greedy_paged_run(model, params, prompt, n_new, kv_quant, teacher_tokens=None):
+    """One single-slot greedy generation through the paged path with an
+    identity block table. Returns (per-position logits [n_new, V] float32,
+    greedy tokens [n_new]). With `teacher_tokens`, those are fed instead of the
+    run's own argmax (the transcript is forced; the argmax is still recorded)."""
+    block_size = 4
+    total = len(prompt) + n_new
+    mb = -(-total // block_size)  # ceil: identity table covers the whole run
+    cache = model.init_paged_cache(params, mb, block_size, kv_quant=kv_quant)
+    tables = jnp.arange(mb, dtype=jnp.int32)[None, :]
+
+    t = len(prompt)
+    positions = jnp.arange(t, dtype=jnp.int32)[None, :]
+    tokens = jnp.asarray(prompt, dtype=jnp.int32)[None, :]
+    logits, cache = model.prefill_paged(
+        params, cache, tokens, positions,
+        tables, positions[0] // block_size, positions[0] % block_size,
+    )
+    step_logits = [jnp.asarray(logits[0, -1], jnp.float32)]
+    out_tokens = [int(jnp.argmax(step_logits[-1]))]
+
+    for i in range(n_new - 1):
+        fed = teacher_tokens[i] if teacher_tokens is not None else out_tokens[-1]
+        pos = t + i
+        logits, cache = model.decode_paged(
+            params, cache,
+            jnp.asarray([[fed]], jnp.int32), jnp.asarray([pos], jnp.int32),
+            tables,
+            jnp.asarray([pos // block_size], jnp.int32),
+            jnp.asarray([pos % block_size], jnp.int32),
+        )
+        step_logits.append(jnp.asarray(logits[0, 0], jnp.float32))
+        out_tokens.append(int(jnp.argmax(step_logits[-1])))
+
+    return jnp.stack(step_logits), out_tokens
+
+
+def run_oracle(
+    model,
+    params,
+    prompts,
+    *,
+    quant_weights: str = "none",
+    quant_kv: str = "none",
+    max_new_tokens: int = 8,
+) -> OracleReport:
+    """Gate a quantized configuration against the bf16 reference.
+
+    `params` is the UNQUANTIZED tree; the quantized variant is derived here via
+    the same `quantized_model`/`quantize_params` pair the serving load seam
+    uses, so the oracle measures exactly what the engine would serve."""
+    from modalities_tpu.quant.weights import quantize_params, quantized_model
+
+    if quant_weights == "none" and quant_kv == "none":
+        raise ValueError("oracle needs at least one quantized mode to compare")
+
+    q_model = quantized_model(model, quant_weights)
+    q_params = quantize_params(params, quant_weights) if quant_weights != "none" else params
+
+    max_err = 0.0
+    matches = 0
+    positions = 0
+    all_ref, all_quant = [], []
+    for prompt in prompts:
+        ref_logits, ref_tokens = _greedy_paged_run(
+            model, params, prompt, max_new_tokens, "none"
+        )
+        q_logits, q_tokens = _greedy_paged_run(
+            q_model, q_params, prompt, max_new_tokens, quant_kv,
+            teacher_tokens=ref_tokens,
+        )
+        max_err = max(max_err, float(jnp.max(jnp.abs(q_logits - ref_logits))))
+        matches += sum(int(a == b) for a, b in zip(ref_tokens, q_tokens))
+        positions += len(ref_tokens)
+        all_ref.append(ref_tokens)
+        all_quant.append(q_tokens)
+
+    return OracleReport(
+        max_abs_err=max_err,
+        token_match=matches / max(1, positions),
+        positions=positions,
+        ref_tokens=all_ref,
+        quant_tokens=all_quant,
+    )

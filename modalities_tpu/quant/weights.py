@@ -1,0 +1,213 @@
+"""Weight-only quantization for serving: quantize params ONCE at load time,
+dequantize on the fly inside the matmul.
+
+The single quantization seam is `load_serving_params` (serving/serve.py):
+startup, the fleet `CheckpointWatcher`, and `/admin/swap` all load through it,
+so every generation a fleet ever installs is quantized identically — and
+`infer_quant_mode` lets `swap_weights` reject a generation whose mode differs
+from the incumbent's before any leaf is compared.
+
+Layout contract (pinned by tests/quant/test_quant_weights.py and relied on by
+the model's QuantDenseGeneral): a quantized dense node is the original node
+with `kernel` re-stored in the quantized dtype plus a float32 `scale` sibling
+shaped like the kernel's OUTPUT feature dims (one symmetric absmax scale per
+output channel, reduced over the input dims). Bias and every non-dense param
+(embeddings, norm scales) are untouched. `quantize_params` is idempotent — a
+node that already has a `scale` sibling passes through unchanged, so the
+engine can re-quantize defensively without double-scaling.
+
+Input-dims rule (matches how `_dense_general` builds kernels in the GPT-2
+model): 2-D kernels contract 1 leading dim ([K, N]); 3-D q/k/v projection
+kernels contract 1 ([E, H, D]); 3-D attention output projections (`c_proj`)
+contract 2 ([H, D, E]). Anything else is an error, not a guess.
+"""
+
+from __future__ import annotations
+
+import copy
+import dataclasses
+import os
+from typing import Mapping
+
+import jax.numpy as jnp
+
+from modalities_tpu.quant.core import (
+    FP8_E4M3_MAX,
+    INT8_QMAX,
+    _safe_scale,
+    fp8_dtype,
+    round_to_e4m3_grid,
+)
+
+WEIGHT_MODES = ("none", "int8", "fp8")
+_ENV_VAR = "MODALITIES_TPU_QUANT_WEIGHTS"
+
+# 3-D kernel names whose FIRST dim is the contraction ([E, H, D]); the
+# attention output projection contracts its first TWO dims ([H, D, E]).
+_QKV_NAMES = ("q_attn", "k_attn", "v_attn")
+
+
+def resolve_quant_weights_mode(setting=None) -> str:
+    """Env > config > "none". Malformed values raise naming the source —
+    a typo'd quant mode must never silently serve bf16."""
+    env = os.environ.get(_ENV_VAR)
+    if env is not None:
+        source, value = f"env {_ENV_VAR}", env
+    else:
+        source, value = "config quant.weights", setting
+    if value is None:
+        return "none"
+    v = str(value).strip().lower()
+    if v in ("", "none", "off", "0", "no", "false"):
+        return "none"
+    if v in WEIGHT_MODES:
+        return v
+    raise ValueError(f"{source}: invalid weight quant mode {value!r} (expected none|int8|fp8)")
+
+
+def quant_storage_dtype(mode: str):
+    """The array dtype quantized kernels are stored in. fp8 uses the native
+    float8_e4m3fn when this jaxlib has it; otherwise the emulated e4m3 grid is
+    stored in bfloat16 (every e4m3 value is exactly representable there — 8
+    significand bits vs e4m3's 3 — so numerics are identical and the kernel
+    still shrinks 2x vs float32)."""
+    if mode == "int8":
+        return jnp.int8
+    if mode == "fp8":
+        return fp8_dtype() or jnp.bfloat16
+    raise ValueError(f"no storage dtype for quant mode {mode!r}")
+
+
+def _kernel_dims(path: tuple, kernel) -> tuple[int, int]:
+    """(n_batch, n_in) for a kernel at `path`: scan-stacked kernels (under the
+    "blocks" scan collection) carry one leading layers axis that is a BATCH
+    dim (each layer quantized independently); the remaining logical kernel
+    follows the 2-D / q-k-v / attention-c_proj rules."""
+    name = path[-1]
+    n_batch = 1 if "blocks" in path else 0  # nn.scan's stacked layers axis
+    nd = kernel.ndim - n_batch
+    if nd == 2:
+        return n_batch, 1
+    if nd == 3 and name in _QKV_NAMES:
+        return n_batch, 1
+    if nd == 3 and name == "c_proj" and "attn" in path:
+        return n_batch, 2
+    raise ValueError(
+        f"quantize_params: no input-dims rule for kernel at {'/'.join(path)} "
+        f"with shape {tuple(kernel.shape)}"
+    )
+
+
+def _quantize_kernel(kernel, mode: str, n_batch: int, n_in: int):
+    """Symmetric per-output-channel quantization: absmax over the input dims
+    (axes n_batch..n_batch+n_in), scale shaped [*batch_dims, *output_dims]."""
+    k32 = jnp.asarray(kernel).astype(jnp.float32)
+    axes = tuple(range(n_batch, n_batch + n_in))
+    absmax = jnp.max(jnp.abs(k32), axis=axes, keepdims=True)
+    if mode == "int8":
+        scale = _safe_scale(absmax, INT8_QMAX)
+        q = jnp.clip(jnp.round(k32 / scale), -INT8_QMAX, INT8_QMAX).astype(jnp.int8)
+    elif mode == "fp8":
+        scale = _safe_scale(absmax, FP8_E4M3_MAX)
+        scaled = k32 / scale
+        native = fp8_dtype()
+        if native is not None:
+            q = jnp.clip(scaled, -FP8_E4M3_MAX, FP8_E4M3_MAX).astype(native)
+        else:
+            q = round_to_e4m3_grid(scaled).astype(jnp.bfloat16)
+    else:
+        raise ValueError(f"unknown quant mode {mode!r}")
+    for _ in axes:  # store the scale without the reduced input dims
+        scale = jnp.squeeze(scale, axis=n_batch)
+    return q, scale
+
+
+def quantize_params(params, mode: str):
+    """Quantize every dense kernel in an (unboxed) param tree; returns a new
+    tree, never mutates. Idempotent: nodes that already carry a `scale`
+    sibling pass through, so load/swap paths can always call this."""
+    if mode == "none":
+        return params
+    if mode not in WEIGHT_MODES:
+        raise ValueError(f"unknown quant mode {mode!r} (expected none|int8|fp8)")
+
+    def walk(node, path):
+        if isinstance(node, Mapping):
+            kernel = node.get("kernel")
+            if kernel is not None and getattr(kernel, "ndim", 0) >= 2:
+                if "scale" in node:  # already quantized — idempotent
+                    return dict(node)
+                q, scale = _quantize_kernel(kernel, mode, *_kernel_dims(path, kernel))
+                out = dict(node)
+                out["kernel"] = q
+                out["scale"] = scale
+                return out
+            return {k: walk(v, path + (k,)) for k, v in node.items()}
+        return node
+
+    return walk(params, ("",))
+
+
+def infer_quant_mode(params) -> str:
+    """Read the quantization mode off a param tree: "none" when no dense node
+    carries a scale sibling, "int8"/"fp8" when they all agree, "mixed" when
+    they do not (a mixed tree is exactly what the swap drift check must
+    reject)."""
+    modes = set()
+    quantized = [0]
+    total = [0]
+
+    def walk(node):
+        if not isinstance(node, Mapping):
+            return
+        kernel = node.get("kernel")
+        if kernel is not None and getattr(kernel, "ndim", 0) >= 2:
+            total[0] += 1
+            if "scale" in node:
+                quantized[0] += 1
+                modes.add("int8" if jnp.dtype(kernel.dtype) == jnp.int8 else "fp8")
+            return
+        for v in node.values():
+            walk(v)
+
+    walk(params)
+    if not modes:
+        return "none"
+    if len(modes) > 1 or quantized[0] != total[0]:
+        return "mixed"
+    return modes.pop()
+
+
+def weights_bytes_saved(params, param_dtype="float32") -> int:
+    """Bytes a quantized tree saves vs storing every quantized kernel in
+    `param_dtype`, NET of the added scale arrays — the value behind
+    `serve_quant_weights_bytes_saved`. Computed from the quantized tree alone
+    so it is correct whether the engine quantized the params itself or they
+    arrived pre-quantized through load_serving_params."""
+    full = jnp.dtype(param_dtype).itemsize
+    saved = [0]
+
+    def walk(node):
+        if not isinstance(node, Mapping):
+            return
+        kernel = node.get("kernel")
+        if kernel is not None and "scale" in node and getattr(kernel, "ndim", 0) >= 2:
+            saved[0] += kernel.size * (full - jnp.dtype(kernel.dtype).itemsize)
+            saved[0] -= node["scale"].size * 4
+            return
+        for v in node.values():
+            walk(v)
+
+    walk(params)
+    return int(saved[0])
+
+
+def quantized_model(model, mode: str):
+    """A COPY of `model` whose spec selects quantized dense layers — the
+    in-place `with_spec_updates` would mutate a model shared across tests and
+    fleet workers, so this never touches the original."""
+    if mode == "none":
+        return model
+    m = copy.copy(model)
+    m.config_spec = dataclasses.replace(model.config_spec, quant_weights=mode)
+    return m

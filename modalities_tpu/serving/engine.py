@@ -198,6 +198,8 @@ class ServingEngine:
         paged_max_len: Optional[int] = None,
         prefix_sharing: Optional[bool] = None,
         spec_decode=None,
+        quant_weights: Optional[str] = None,
+        quant_kv: Optional[str] = None,
         stop_fn: Optional[Callable[[], bool]] = None,
         on_token: Optional[Callable[[int, int], None]] = None,
         on_finish: Optional[Callable[[int, ServeResult], None]] = None,
@@ -218,6 +220,40 @@ class ServingEngine:
                 f"{type(model).__name__} does not expose the paged decode API "
                 "(init_paged_cache/prefill_paged/decode_paged)"
             )
+        # quantized inference (quant/): weight-only quantization swaps the model
+        # for its QuantDenseGeneral variant and (idempotently) quantizes the
+        # params — a tree already quantized by load_serving_params passes
+        # through unchanged, so every entry path yields the same generation.
+        from modalities_tpu.quant.kv import resolve_quant_kv_mode
+        from modalities_tpu.quant.weights import (
+            infer_quant_mode,
+            quantize_params,
+            quantized_model,
+            resolve_quant_weights_mode,
+            weights_bytes_saved,
+        )
+
+        self.quant_weights = resolve_quant_weights_mode(quant_weights)
+        self.quant_kv = resolve_quant_kv_mode(quant_kv)
+        if self.quant_kv != "none" and self.kv_cache != "paged":
+            raise ValueError(
+                f"quant_kv={self.quant_kv!r} requires kv_cache='paged': only the "
+                "block pool stores per-block scales alongside the K/V data"
+            )
+        pre_mode = infer_quant_mode(params)
+        if pre_mode not in ("none", self.quant_weights):
+            raise ValueError(
+                f"params arrive quantized as {pre_mode!r} but the engine is "
+                f"configured for quant_weights={self.quant_weights!r} — quantize "
+                "every generation through the same load_serving_params seam"
+            )
+        self._quant_bytes_saved = 0
+        if self.quant_weights != "none":
+            model = quantized_model(model, self.quant_weights)
+            params = quantize_params(params, self.quant_weights)
+            self._quant_bytes_saved = weights_bytes_saved(params)
+        self._infer_quant_mode = infer_quant_mode  # swap drift check reuses it
+
         spec_len = int(model.config_spec.sequence_length)
         self.model = model
         self.params = params
@@ -299,7 +335,9 @@ class ServingEngine:
 
         self._jnp = jnp
         if self.kv_cache == "paged":
-            self.cache = model.init_paged_cache(params, self.num_blocks, self.block_size)
+            self.cache = model.init_paged_cache(
+                params, self.num_blocks, self.block_size, kv_quant=self.quant_kv
+            )
             self._table_state = BlockTableState(
                 self.num_blocks, self.block_size, self.table_width
             )
@@ -449,6 +487,24 @@ class ServingEngine:
             "serve_weights_generation", "Weights generation currently installed"
         )
         self._m_generation.set(0)
+        # quantized inference (quant/): pool/weight byte accounting + the mode
+        # info gauge (value always 1; the modes ride the labels, Prometheus
+        # *_info convention)
+        from modalities_tpu.quant.core import tree_bytes
+
+        self.kv_pool_bytes = tree_bytes(self.cache)
+        reg.gauge(
+            "serve_kv_pool_bytes",
+            "Device bytes held by the serving KV cache (pools + quant scales)",
+        ).set(self.kv_pool_bytes)
+        reg.gauge(
+            "serve_quant_weights_bytes_saved",
+            "Param bytes saved by weight-only quantization (net of scale arrays)",
+        ).set(self._quant_bytes_saved)
+        reg.gauge(
+            "serve_quant_mode_info",
+            "Active quantization modes as labels (weights=, kv=); value is always 1",
+        ).set(1.0, weights=self.quant_weights, kv=self.quant_kv)
         if self.kv_cache == "paged":
             reg.gauge(
                 "serve_paged_free_blocks", "Free blocks in the paged KV pool"
@@ -513,7 +569,7 @@ class ServingEngine:
         if self.kv_cache == "paged":
             abstract_cache = jax.eval_shape(
                 lambda: self.model.init_paged_cache(
-                    self.params, self.num_blocks, self.block_size
+                    self.params, self.num_blocks, self.block_size, kv_quant=self.quant_kv
                 )
             )
         else:
@@ -580,6 +636,27 @@ class ServingEngine:
 
         start = self._now()
         gen = int(generation) if generation is not None else self.weights_generation + 1
+        # quantization-mode drift gate (before any leaf comparison): a fleet
+        # rollout must never install a generation quantized differently from
+        # the incumbent — mixed bf16/int8 leaves would either fail the aval
+        # check leaf-by-leaf with a misleading message or, worse, silently
+        # change serving numerics mid-fleet
+        new_mode = self._infer_quant_mode(params)
+        if new_mode != self.quant_weights:
+            from modalities_tpu.resilience.events import record_event
+
+            record_event(
+                "fleet/rollback",
+                stage="quant",
+                installed=self.quant_weights,
+                offered=new_mode,
+                generation=gen,
+            )
+            raise ValueError(
+                f"swap_weights: quantization mode drift (installed "
+                f"{self.quant_weights!r}, offered {new_mode!r}) — every generation "
+                "must be quantized through the same load_serving_params seam"
+            )
         old_leaves, old_def = jax.tree.flatten(self.params)
         new_leaves, new_def = jax.tree.flatten(params)
         if old_def != new_def:
@@ -1623,6 +1700,10 @@ class ServingEngine:
             "weights_generation": self.weights_generation,
             "weight_swaps": weight_swaps,
             "request_errors": request_errors,
+            "quant_weights": self.quant_weights,
+            "quant_kv": self.quant_kv,
+            "kv_pool_bytes": self.kv_pool_bytes,
+            "quant_bytes_saved": self._quant_bytes_saved,
         }
         if self.kv_cache == "paged":
             out.update(

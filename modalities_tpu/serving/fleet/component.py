@@ -80,7 +80,10 @@ class FleetServingComponent(ServingComponent):
             if folder is not None:
                 logger.info("fleet: booting from ring checkpoint %s", folder)
                 self.params = load_serving_params(
-                    folder, mesh_handle=self.device_mesh, model=self.model
+                    folder,
+                    mesh_handle=self.device_mesh,
+                    model=self.model,
+                    quant_weights=self.quant_weights_setting,
                 )
                 self._boot_step = _seen_steps_of(folder)
                 return
@@ -102,6 +105,15 @@ class FleetServingComponent(ServingComponent):
         if self.params is None:
             raise ValueError("params not resolved — serve() loads them first")
 
+        # ONE load seam for every generation the fleet ever installs: boot,
+        # watcher rollouts, and /admin/swap all quantize through this partial,
+        # so swap_weights' quant-drift gate only fires on true config skew.
+        import functools
+
+        load_quantized = functools.partial(
+            load_serving_params, quant_weights=self.quant_weights_setting
+        )
+
         def encode(prompt: str) -> list[int]:
             text = self.prompt_template.format(prompt=prompt) if self.prompt_template else prompt
             return list(self.tokenizer.tokenize(text))
@@ -121,6 +133,8 @@ class FleetServingComponent(ServingComponent):
                 paged_max_len=self.paged_max_len,
                 prefix_sharing=self.prefix_sharing,
                 spec_decode=self.spec_decode,
+                quant_weights=self.quant_weights_setting,
+                quant_kv=self.quant_kv_setting,
                 stop_fn=self.stop_fn,
                 mesh_handle=self.device_mesh,
                 metrics=MetricsRegistry(),  # per-worker: canary metrics stay isolated
@@ -136,7 +150,7 @@ class FleetServingComponent(ServingComponent):
             worker = EngineWorker(f"worker{i}", engine, server)
             # POST /admin/swap on a worker: load the named sealed folder and
             # hot-swap THAT worker (out-of-band of the canary flow)
-            server.swap_handler = self._swap_handler(worker, load_serving_params)
+            server.swap_handler = self._swap_handler(worker, load_quantized)
             server.start()
             workers.append(worker)
 
@@ -171,6 +185,7 @@ class FleetServingComponent(ServingComponent):
                 ),
                 mesh_handle=self.device_mesh,
                 model=self.model,
+                load_fn=load_quantized,
                 poll_interval_s=self.watch_poll_s,
             )
             watcher.deployed_step = self._boot_step

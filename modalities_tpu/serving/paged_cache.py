@@ -59,6 +59,16 @@ class BlockPool:
         # because tables, not block ids, carry position order)
         self._free: list[int] = list(range(self.num_blocks - 1, -1, -1))
         self._refcount: dict[int, int] = {}  # block id -> references >= 1
+        # observers see block LIVENESS transitions (0 -> 1 ref on allocate,
+        # last ref -> 0 on free; fork/partial-free are invisible) — the
+        # quantized pool's scale mirror (quant/kv.py KVScaleMirror) rides these
+        # so scale-slot allocation tracks block allocation exactly
+        self._observers: list = []
+
+    def add_observer(self, observer) -> None:
+        """Register an object with `on_allocate(block)` / `on_free(block)`
+        callbacks, fired on liveness transitions only."""
+        self._observers.append(observer)
 
     @property
     def free_count(self) -> int:
@@ -81,6 +91,8 @@ class BlockPool:
             return None
         block = self._free.pop()
         self._refcount[block] = 1
+        for obs in self._observers:
+            obs.on_allocate(block)
         return block
 
     def fork(self, block: int) -> None:
@@ -101,10 +113,17 @@ class BlockPool:
             return False
         del self._refcount[block]
         self._free.append(block)
+        for obs in self._observers:
+            obs.on_free(block)
         return True
 
     def refcount(self, block: int) -> int:
         return self._refcount.get(block, 0)
+
+    def allocated_blocks(self) -> list[int]:
+        """Sorted ids of currently-allocated blocks (audit surface for the
+        scale mirror's check)."""
+        return sorted(self._refcount)
 
     def check(self) -> None:
         """Leak/corruption audit: free + refcounted must tile [0, num_blocks)

@@ -46,6 +46,7 @@ class ServingComponentConfig(BaseModel):
     paged_max_len: Optional[int] = None  # per-request ceiling; None = cache_capacity
     prefix_sharing: Optional[bool] = None  # paged CoW prefix reuse; None = env/on
     spec_decode: Optional[dict] = None  # {"k": int, "drafter": "ngram", ...}; None = env/off
+    quant: Optional[dict] = None  # {"weights": none|int8|fp8, "kv": none|int8}; None = env/off
     http_host: str = "127.0.0.1"
     http_port: Optional[int] = None  # set (0 = ephemeral) to start the HTTP front end
 
@@ -72,6 +73,7 @@ class ServingComponent:
         paged_max_len: Optional[int] = None,
         prefix_sharing: Optional[bool] = None,
         spec_decode: Optional[dict] = None,
+        quant: Optional[dict] = None,
         http_host: str = "127.0.0.1",
         http_port: Optional[int] = None,
         params=None,
@@ -92,6 +94,11 @@ class ServingComponent:
         self.paged_max_len = paged_max_len
         self.prefix_sharing = prefix_sharing
         self.spec_decode = spec_decode
+        self.quant = quant or {}
+        # The config settings, not resolved modes: the engine resolves env >
+        # config itself so a bench override via env wins consistently.
+        self.quant_weights_setting = self.quant.get("weights")
+        self.quant_kv_setting = self.quant.get("kv")
         self.http_host = http_host
         self.http_port = http_port
         self.params = params
@@ -123,6 +130,8 @@ class ServingComponent:
                 paged_max_len=self.paged_max_len,
                 prefix_sharing=self.prefix_sharing,
                 spec_decode=self.spec_decode,
+                quant_weights=self.quant_weights_setting,
+                quant_kv=self.quant_kv_setting,
                 stop_fn=self.stop_fn,
                 mesh_handle=self.device_mesh,
             )
@@ -233,7 +242,9 @@ def build_serving_components(config_dict: dict):
     return ComponentFactory(registry).build_components(config_dict, ServeInstantiationModel)
 
 
-def load_serving_params(checkpoint_folder_path, mesh_handle=None, model=None):
+def load_serving_params(
+    checkpoint_folder_path, mesh_handle=None, model=None, quant_weights=None
+):
     """Sealed-checkpoint → serving params, shared by serve() startup and the
     fleet checkpoint watcher so the two load paths cannot drift.
 
@@ -244,7 +255,13 @@ def load_serving_params(checkpoint_folder_path, mesh_handle=None, model=None):
     checkpoints. With both `mesh_handle` and `model`, the tree is placed onto
     the serving mesh's NamedShardings — the PR-6 elastic contract: the restore
     target comes from the *current* mesh, so a checkpoint sealed under any
-    training topology lands on any serving topology."""
+    training topology lands on any serving topology.
+
+    `quant_weights` ("int8"/"fp8", resolved against MODALITIES_TPU_QUANT_WEIGHTS)
+    quantizes the tree HERE, inside the single shared seam: startup, the fleet
+    CheckpointWatcher, and /admin/swap all produce identically-quantized
+    generations, so `swap_weights`'s quant-drift gate never fires on a
+    same-config rollout."""
     from modalities_tpu.checkpointing.orbax.orbax_checkpoint_loading import (
         restore_tree_single_device,
     )
@@ -269,6 +286,15 @@ def load_serving_params(checkpoint_folder_path, mesh_handle=None, model=None):
         params = restored["params"]
     else:
         params = restored
+    from modalities_tpu.quant.weights import (
+        quantize_params,
+        quantized_model,
+        resolve_quant_weights_mode,
+    )
+
+    quant_mode = resolve_quant_weights_mode(quant_weights)
+    if quant_mode != "none":
+        params = quantize_params(params, quant_mode)
     if mesh_handle is not None and model is not None:
         import jax
 
@@ -277,7 +303,11 @@ def load_serving_params(checkpoint_folder_path, mesh_handle=None, model=None):
             params_shardings,
         )
 
-        abstract = jax.eval_shape(lambda: model.init_params(jax.random.PRNGKey(0)))
+        # The sharding target must match the tree being placed: a quantized
+        # tree has int8/fp8 kernels plus scale siblings, so the abstract init
+        # comes from the quantized model variant.
+        shard_model = quantized_model(model, quant_mode)
+        abstract = jax.eval_shape(lambda: shard_model.init_params(jax.random.PRNGKey(0)))
         rules = default_logical_axis_rules(mesh_handle)
         params = jax.device_put(
             params, params_shardings(abstract, rules, mesh_handle.mesh)
@@ -295,7 +325,10 @@ def _resolve_params(component, checkpoint_folder_path) -> None:
     if component.params is not None:
         return
     if checkpoint_folder_path:
-        component.params = load_serving_params(checkpoint_folder_path)
+        component.params = load_serving_params(
+            checkpoint_folder_path,
+            quant_weights=getattr(component, "quant_weights_setting", None),
+        )
     else:
         logger.warning("serve: no checkpoint_folder_path — serving fresh-init params")
         component.params = meta.unbox(component.model.init_params(jax.random.PRNGKey(0)))

@@ -169,7 +169,7 @@ def test_smoke_sweep_round_trips_and_publishes_spans(tmp_path, monkeypatch):
     summary = autotune.tune_kernels(tmp_path, iters=1, recorder=recorder, smoke=True)
 
     assert summary["interpret"] is True  # CPU host => interpret sweep
-    for kernel in ("flash_attention", "fused_ce", "fused_rmsnorm"):
+    for kernel in ("flash_attention", "fused_ce", "fused_rmsnorm", "quant_matmul"):
         assert any(k.startswith(f"{kernel}|") for k in summary["entries"]), kernel
         assert any(name.startswith(f"tune/{kernel}/") for name in seen), kernel
 

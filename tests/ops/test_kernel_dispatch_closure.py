@@ -47,9 +47,11 @@ def test_dispatch_entry_points_expose_interpret():
     from modalities_tpu.ops.pallas.flash_attention import pallas_flash_attention
     from modalities_tpu.ops.pallas.fused_ce import fused_ce_sum_and_count
     from modalities_tpu.ops.pallas.fused_rmsnorm import fused_rms_norm
+    from modalities_tpu.ops.pallas.quant_matmul import quant_matmul
+    from modalities_tpu.ops.quant_matmul import quant_matmul_or_fallback
     from modalities_tpu.ops.rmsnorm import rms_norm_or_fallback
 
-    for fn in (pallas_flash_attention, fused_ce_sum_and_count, fused_rms_norm, ce_dispatch, rms_norm_or_fallback):
+    for fn in (pallas_flash_attention, fused_ce_sum_and_count, fused_rms_norm, ce_dispatch, rms_norm_or_fallback, quant_matmul, quant_matmul_or_fallback):
         params = inspect.signature(fn).parameters
         assert "interpret" in params, f"{fn.__module__}.{fn.__name__} lacks an interpret path"
         assert params["interpret"].default is False, fn.__name__

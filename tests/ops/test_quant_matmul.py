@@ -1,0 +1,101 @@
+"""Parity + dispatch pins for the fused dequant-matmul (ops/quant_matmul.py,
+ops/pallas/quant_matmul.py): interpret-mode kernel output is BITWISE equal to
+the pure-jnp reference (K is never split, so the contraction order matches),
+and the tier/block resolution follows env > autotune > defaults."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from modalities_tpu.ops.pallas.quant_matmul import (
+    flops_and_bytes,
+    quant_matmul,
+    reference_quant_matmul,
+)
+from modalities_tpu.ops.quant_matmul import (
+    quant_matmul_or_fallback,
+    quant_matmul_tier,
+    resolve_quant_matmul_blocks,
+)
+from modalities_tpu.quant.core import quantize_per_channel
+
+
+def _case(m, k, n, seed=0, dtype=jnp.float32):
+    kx, kw = jax.random.split(jax.random.PRNGKey(seed))
+    x = jax.random.normal(kx, (m, k), dtype=dtype)
+    w = jax.random.normal(kw, (n, k))
+    wq_t, scale = quantize_per_channel(w, axis=-1)  # [N, K] rows -> per-N scales
+    return x, wq_t.T, jnp.squeeze(scale, -1)  # wq [K, N], scale [N]
+
+
+@pytest.mark.parametrize(
+    "m,k,n,bm,bn",
+    [
+        (8, 16, 24, 8, 8),  # multi-tile both ways
+        (5, 16, 9, 8, 8),  # ragged M and N (padding path)
+        (16, 32, 16, 16, 16),  # exact tiles
+    ],
+)
+def test_interpret_kernel_bitwise_matches_reference(m, k, n, bm, bn):
+    x, wq, scale = _case(m, k, n)
+    got = quant_matmul(x, wq, scale, block_m=bm, block_n=bn, interpret=True)
+    want = reference_quant_matmul(x, wq, scale)
+    assert got.shape == (m, n) and got.dtype == want.dtype
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_bf16_inputs_round_trip():
+    x, wq, scale = _case(4, 16, 8, dtype=jnp.bfloat16)
+    got = quant_matmul(x, wq, scale, block_m=4, block_n=8, interpret=True)
+    assert got.dtype == jnp.bfloat16
+    np.testing.assert_array_equal(
+        np.asarray(got.astype(jnp.float32)),
+        np.asarray(reference_quant_matmul(x, wq, scale).astype(jnp.float32)),
+    )
+
+
+def test_reference_dequant_is_exactly_scaled_int_matmul():
+    x, wq, scale = _case(4, 8, 6)
+    want = (x @ wq.astype(x.dtype)) * scale
+    np.testing.assert_allclose(
+        np.asarray(reference_quant_matmul(x, wq, scale)), np.asarray(want), rtol=1e-6
+    )
+
+
+def test_tier_resolution_and_fallback(monkeypatch):
+    monkeypatch.delenv("MODALITIES_TPU_QUANT_MATMUL", raising=False)
+    assert not quant_matmul_tier().enabled  # auto off-TPU = fallback tier
+    monkeypatch.setenv("MODALITIES_TPU_QUANT_MATMUL", "on")
+    assert quant_matmul_tier().enabled
+    monkeypatch.setenv("MODALITIES_TPU_QUANT_MATMUL", "off")
+    tier = quant_matmul_tier()
+    assert not tier.enabled
+    x, wq, scale = _case(4, 8, 6)
+    # off tier returns the pure-jnp fallback; interpret still drives the kernel
+    off = quant_matmul_or_fallback(x, wq, scale, tier=tier)
+    np.testing.assert_array_equal(np.asarray(off), np.asarray(reference_quant_matmul(x, wq, scale)))
+    kern = quant_matmul_or_fallback(x, wq, scale, tier=tier, interpret=True)
+    np.testing.assert_array_equal(np.asarray(kern), np.asarray(off))
+    monkeypatch.setenv("MODALITIES_TPU_QUANT_MATMUL", "sideways")
+    with pytest.raises(ValueError, match="MODALITIES_TPU_QUANT_MATMUL"):
+        quant_matmul_tier()
+
+
+def test_block_env_overrides_beat_autotune(monkeypatch):
+    monkeypatch.setenv("MODALITIES_TPU_QUANT_MM_BLOCK_M", "32")
+    monkeypatch.setenv("MODALITIES_TPU_QUANT_MM_BLOCK_N", "64")
+    assert resolve_quant_matmul_blocks(4096, jnp.bfloat16) == (32, 64)
+    monkeypatch.delenv("MODALITIES_TPU_QUANT_MM_BLOCK_N")
+    assert resolve_quant_matmul_blocks(4096, jnp.bfloat16)[0] == 32
+    monkeypatch.setenv("MODALITIES_TPU_QUANT_MM_BLOCK_M", "notanint")
+    with pytest.raises(ValueError):
+        resolve_quant_matmul_blocks(4096, jnp.bfloat16)
+
+
+def test_flops_and_bytes_accounting():
+    cost = flops_and_bytes(8, 16, 24, x_bytes=4, w_bytes=1)
+    assert cost["flops"] == 2 * 8 * 16 * 24
+    assert cost["bytes"] == 8 * 16 * 4 + 16 * 24 * 1 + 8 * 24 * 4 + 4 * 24
+    # int8 weights move 4x less weight traffic than f32 at the same shape
+    assert cost["bytes"] < flops_and_bytes(8, 16, 24, x_bytes=4, w_bytes=4)["bytes"]

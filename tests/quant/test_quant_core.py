@@ -1,0 +1,96 @@
+"""Numerics pins for quant/core.py: the EXACT round-trip bounds and scale
+layouts every consumer (weights, KV pool, dequant-matmul) builds on."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from modalities_tpu.quant.core import (
+    FP8_E4M3_MAX,
+    INT8_QMAX,
+    dequantize,
+    dequantize_block,
+    fp8_dtype,
+    quantize_fp8,
+    quantize_per_block,
+    quantize_per_channel,
+    round_to_e4m3_grid,
+    tree_bytes,
+)
+
+
+def test_per_channel_round_trip_bound_is_exact():
+    """|dequant - x| <= scale/2 elementwise — symmetric absmax with round-to-
+    nearest cannot do worse, and the test uses the bound as an exact oracle."""
+    x = jax.random.normal(jax.random.PRNGKey(0), (5, 7, 33)) * 3.0
+    q, scale = quantize_per_channel(x, axis=-1)
+    assert q.dtype == jnp.int8
+    assert scale.shape == (5, 7, 1) and scale.dtype == jnp.float32
+    err = jnp.abs(dequantize(q, scale) - x)
+    assert bool(jnp.all(err <= scale / 2.0 + 1e-7))
+    # absmax itself survives the round trip exactly (it maps onto q = +-127)
+    assert int(jnp.max(jnp.abs(q.astype(jnp.int32)))) == 127
+
+
+def test_per_channel_other_axis_and_zero_rows():
+    x = jnp.zeros((4, 6))
+    q, scale = quantize_per_channel(x, axis=0)
+    assert scale.shape == (1, 6)
+    # zero rows: safe scale (no div-by-zero), dequant gives EXACT zeros
+    assert bool(jnp.all(scale > 0))
+    assert bool(jnp.all(dequantize(q, scale) == 0.0))
+
+
+def test_per_block_layout_and_bound():
+    x = jax.random.normal(jax.random.PRNGKey(1), (3, 32)) * 0.5
+    q, scale = quantize_per_block(x, block=8, axis=-1)
+    assert q.shape == x.shape and q.dtype == jnp.int8
+    assert scale.shape == (3, 4)  # one scale per 8-wide block
+    dq = dequantize_block(q, scale, block=8, axis=-1)
+    # per-element bound: each element's block scale
+    per_elem_scale = jnp.repeat(scale, 8, axis=-1)
+    assert bool(jnp.all(jnp.abs(dq - x) <= per_elem_scale / 2.0 + 1e-7))
+
+
+def test_per_block_rejects_non_divisible_extent():
+    with pytest.raises(ValueError, match="not divisible"):
+        quantize_per_block(jnp.ones((2, 10)), block=4)
+
+
+def test_e4m3_grid_fixed_points_and_clamp():
+    """Exactly-representable e4m3 values are fixed points; everything clamps
+    at +-448 (e4m3fn has no inf to overflow into)."""
+    exact = jnp.asarray([0.0, 0.0625, 1.0, 1.125, -2.25, 448.0, -448.0])
+    assert bool(jnp.all(round_to_e4m3_grid(exact) == exact))
+    assert float(round_to_e4m3_grid(jnp.asarray(10000.0))) == FP8_E4M3_MAX
+    assert float(round_to_e4m3_grid(jnp.asarray(-10000.0))) == -FP8_E4M3_MAX
+    # relative rounding error of a normal value is bounded by half a mantissa step
+    x = jnp.asarray([3.3, 7.7, 0.123, -5.5])
+    err = jnp.abs(round_to_e4m3_grid(x) - x)
+    assert bool(jnp.all(err <= jnp.abs(x) * (2.0 ** (-3)) / 2 + 1e-7))
+
+
+def test_native_fp8_matches_emulated_grid_when_available():
+    """When this jaxlib has float8_e4m3fn, casting must land on the same grid
+    the emulation computes — one numerics oracle for both storage paths."""
+    native = fp8_dtype()
+    if native is None:
+        pytest.skip("no native float8_e4m3fn in this jaxlib")
+    x = jax.random.normal(jax.random.PRNGKey(2), (128,)) * 100.0
+    casted = jnp.clip(x, -FP8_E4M3_MAX, FP8_E4M3_MAX).astype(native).astype(jnp.float32)
+    np.testing.assert_array_equal(np.asarray(casted), np.asarray(round_to_e4m3_grid(x)))
+
+
+def test_quantize_fp8_round_trip():
+    x = jax.random.normal(jax.random.PRNGKey(3), (6, 50)) * 4.0
+    q, scale = quantize_fp8(x)
+    assert scale.shape == (6, 1)
+    dq = dequantize(q, scale)
+    # e4m3 keeps ~2 decimal digits; prescaling makes the bound relative to absmax
+    assert float(jnp.max(jnp.abs(dq - x))) <= float(jnp.max(scale)) * FP8_E4M3_MAX * (2.0 ** (-4))
+
+
+def test_tree_bytes_counts_leaf_storage():
+    tree = {"a": jnp.zeros((4, 4), jnp.float32), "b": {"c": jnp.zeros((8,), jnp.int8)}}
+    assert tree_bytes(tree) == 4 * 4 * 4 + 8

@@ -1,0 +1,136 @@
+"""Pins for quant/weights.py: the quantized-tree layout contract (treedef AND
+avals must match what the quantized model variant initializes — the engine
+relies on this to jit the quantized forward against loaded-then-quantized
+params), idempotency, mode inference, and byte accounting."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+from flax.core import meta
+
+from modalities_tpu.quant.weights import (
+    infer_quant_mode,
+    quant_storage_dtype,
+    quantize_params,
+    quantized_model,
+    resolve_quant_weights_mode,
+    weights_bytes_saved,
+)
+from tests.models.test_gpt2_model import tiny_gpt2
+
+
+@pytest.fixture(scope="module")
+def model():
+    return tiny_gpt2("manual")
+
+
+@pytest.fixture(scope="module")
+def params(model):
+    return meta.unbox(model.init_params(jax.random.PRNGKey(0)))
+
+
+def test_resolve_mode_env_beats_config(monkeypatch):
+    assert resolve_quant_weights_mode(None) == "none"
+    assert resolve_quant_weights_mode("int8") == "int8"
+    assert resolve_quant_weights_mode("off") == "none"
+    monkeypatch.setenv("MODALITIES_TPU_QUANT_WEIGHTS", "fp8")
+    assert resolve_quant_weights_mode("int8") == "fp8"
+    monkeypatch.setenv("MODALITIES_TPU_QUANT_WEIGHTS", "int4")
+    with pytest.raises(ValueError, match="MODALITIES_TPU_QUANT_WEIGHTS"):
+        resolve_quant_weights_mode(None)
+
+
+def test_resolve_mode_malformed_config_names_source():
+    with pytest.raises(ValueError, match="config quant.weights"):
+        resolve_quant_weights_mode("int3")
+
+
+@pytest.mark.parametrize("mode", ["int8", "fp8"])
+def test_quantized_tree_matches_quantized_model_init(model, params, mode):
+    """THE layout contract: quantize_params output must have the exact treedef
+    and leaf avals of the quantized model variant's own init — this is what
+    lets the engine swap loaded-then-quantized params into the quantized
+    forward without retracing surprises."""
+    qp = quantize_params(params, mode)
+    q_model = quantized_model(model, mode)
+    abstract = jax.eval_shape(
+        lambda: meta.unbox(q_model.init_params(jax.random.PRNGKey(0)))
+    )
+    got_leaves, got_def = jax.tree.flatten(qp)
+    want_leaves, want_def = jax.tree.flatten(abstract)
+    assert got_def == want_def
+    for got, want in zip(got_leaves, want_leaves):
+        assert got.shape == want.shape
+        assert jnp.dtype(got.dtype) == jnp.dtype(want.dtype)
+
+
+def test_quantize_is_idempotent_and_pure(model, params):
+    qp = quantize_params(params, "int8")
+    again = quantize_params(qp, "int8")
+    assert jax.tree.structure(again) == jax.tree.structure(qp)
+    for a, b in zip(jax.tree.leaves(again), jax.tree.leaves(qp)):
+        assert a is b or bool(jnp.all(a == b))
+    # the source tree is untouched (no scale siblings appeared)
+    assert infer_quant_mode(params) == "none"
+
+
+def test_quantized_model_never_mutates_the_original(model):
+    q = quantized_model(model, "int8")
+    assert q is not model
+    assert q.config_spec.quant_weights == "int8"
+    assert model.config_spec.quant_weights == "none"
+    assert quantized_model(model, "none") is model
+
+
+def test_infer_mode_none_int8_fp8_and_mixed(params):
+    assert infer_quant_mode(params) == "none"
+    assert infer_quant_mode(quantize_params(params, "int8")) == "int8"
+    assert infer_quant_mode(quantize_params(params, "fp8")) == "fp8"
+
+    # hand-build a mixed tree: one dense node quantized, one not
+    mixed = {
+        "a": {"kernel": jnp.zeros((4, 4), jnp.int8), "scale": jnp.ones((4,))},
+        "b": {"kernel": jnp.zeros((4, 4), jnp.float32)},
+    }
+    assert infer_quant_mode(mixed) == "mixed"
+
+
+def test_scale_shapes_follow_output_feature_dims(params):
+    qp = quantize_params(params, "int8")
+    blocks = qp["params"]["blocks"]["block"]
+    # scanned q_attn kernel [L, E, H, D] -> scale [L, H, D] (layers axis is batch)
+    attn = blocks["attn"]
+    assert attn["q_attn"]["scale"].shape == attn["q_attn"]["kernel"].shape[:1] + attn["q_attn"]["kernel"].shape[2:]
+    # scanned attention c_proj [L, H, D, E] contracts two dims -> scale [L, E]
+    cp = attn["c_proj"]
+    assert cp["scale"].shape == (cp["kernel"].shape[0], cp["kernel"].shape[-1])
+    assert cp["kernel"].dtype == jnp.int8
+    assert cp["scale"].dtype == jnp.float32
+
+
+def test_bytes_saved_accounts_for_scales(params):
+    qp = quantize_params(params, "int8")
+    saved = weights_bytes_saved(qp)
+    assert saved > 0
+    # recompute independently: 3 bytes/elem saved per kernel, minus 4/scale elem
+    expect = 0
+
+    def walk(node):
+        nonlocal expect
+        if not isinstance(node, dict):
+            return
+        if "kernel" in node and "scale" in node:
+            expect += node["kernel"].size * 3 - node["scale"].size * 4
+            return
+        for v in node.values():
+            walk(v)
+
+    walk(qp)
+    assert saved == expect
+
+
+def test_storage_dtype_shrinks_fp8(params):
+    assert quant_storage_dtype("int8") == jnp.int8
+    assert jnp.dtype(quant_storage_dtype("fp8")).itemsize <= 2
+    with pytest.raises(ValueError):
+        quant_storage_dtype("none")

@@ -55,7 +55,7 @@ class FakeModel:
     def decode_slots(self, params, cache, tokens, positions):
         return self._logits(tokens), cache
 
-    def init_paged_cache(self, params, num_blocks, block_size):
+    def init_paged_cache(self, params, num_blocks, block_size, kv_quant="none"):
         import jax.numpy as jnp
 
         return {"kv": jnp.zeros((num_blocks, block_size), jnp.float32)}

@@ -57,6 +57,39 @@ def test_lifo_reuse_keeps_working_set_hot():
     assert pool.allocate() == first  # freshly freed block is reused first
 
 
+def test_observer_sees_true_allocations_only():
+    """Observer hooks (the quantized pool's scale mirror rides these): fire on
+    0->1 allocate and last-ref free ONLY — fork and partial free of a shared
+    block are refcount moves, not allocation events."""
+    events = []
+
+    class Recorder:
+        def on_allocate(self, block):
+            events.append(("alloc", block))
+
+        def on_free(self, block):
+            events.append(("free", block))
+
+    pool = BlockPool(4)
+    pool.add_observer(Recorder())
+    b = pool.allocate()
+    pool.fork(b)  # refcount 2: invisible to the observer
+    assert events == [("alloc", b)]
+    assert not pool.free(b)  # drops to refcount 1: still invisible
+    assert events == [("alloc", b)]
+    assert pool.free(b)  # last reference: NOW the free fires
+    assert events == [("alloc", b), ("free", b)]
+    assert pool.allocated_blocks() == []
+
+
+def test_allocated_blocks_is_sorted_refcounted_set():
+    pool = BlockPool(5)
+    blocks = [pool.allocate() for _ in range(3)]
+    assert pool.allocated_blocks() == sorted(blocks)
+    pool.free(blocks[1])
+    assert pool.allocated_blocks() == sorted(b for b in blocks if b != blocks[1])
+
+
 def test_pool_refcount_fork_lifecycle():
     pool = BlockPool(4)
     b = pool.allocate()
@@ -195,8 +228,15 @@ def test_randomized_allocator_fuzz_never_leaks():
     audit invariants hold at every step — refcounts match table references, no
     block leaks, prefix-index entries never outlive their block — and a full
     release returns the pool to pristine."""
+    from modalities_tpu.quant.kv import KVScaleMirror
+
     rng = np.random.default_rng(0)
     ts = BlockTableState(num_blocks=12, block_size=4, table_width=6)
+    # quantized-pool shadow: the scale mirror rides the SAME fuzz via the
+    # pool's observer hooks; scale-slot allocation must track block allocation
+    # exactly through every fork/CoW/preempt interleaving
+    mirror = KVScaleMirror(12)
+    ts.pool.add_observer(mirror)
     live: dict[int, int] = {}  # rid -> tokens ensured so far
     prompts: dict[int, list[int]] = {}  # rid -> token ids backing its prefix
     next_rid = 0
@@ -232,6 +272,7 @@ def test_randomized_allocator_fuzz_never_leaks():
                 if rng.random() < 0.7:
                     ts.register_prefix(rid, prompt, upto=len(prompt))
         ts.check()
+        mirror.check(ts.pool)
         # distinct blocks held across tables + free == num_blocks (shared
         # blocks count once) — the serving-v3 leak invariant
         distinct = set()
@@ -243,6 +284,9 @@ def test_randomized_allocator_fuzz_never_leaks():
     for rid in list(live):
         ts.release(rid)
     ts.check()
+    mirror.check(ts.pool)
     assert ts.pool.free_count == 12
+    assert mirror.live == set()  # zero scale-slot leaks after full release
+    assert mirror.allocs == mirror.frees > 0
     assert ts.active_requests() == []
     assert ts.prefix_index_size == 0

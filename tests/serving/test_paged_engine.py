@@ -273,6 +273,10 @@ def test_paged_max_len_rejected_for_absolute_poe(params):
         # share an 8-token prefix (2 full blocks -> refcount forking) and the
         # n-gram drafter speculates (k=2) over the mixed greedy/sampled trace
         ("paged", 2),
+        # seed 3 runs the QUANTIZED pool (int8 blocks + scale arrays) under the
+        # seed-1 squeeze: preemptions and replay must hold with scale pools in
+        # the cache tree, and the pool/scale audit stays clean
+        ("paged", 3),
     ],
 )
 def test_scheduler_property_randomized(model, params, kv_cache, case_seed):
@@ -295,6 +299,8 @@ def test_scheduler_property_randomized(model, params, kv_cache, case_seed):
                       paged_num_blocks=24 if case_seed == 0 else 8)
         if case_seed == 2:
             kwargs.update(paged_num_blocks=12, spec_decode={"k": 2})
+        if case_seed == 3:
+            kwargs.update(quant_kv="int8")  # tight pool, quantized blocks
     engine = ServingEngine(model, params, **kwargs)
 
     shared = [int(x) for x in rng.integers(0, 127, size=8)]  # 2 full blocks
@@ -339,6 +345,13 @@ def test_scheduler_property_randomized(model, params, kv_cache, case_seed):
         engine._table_state.check()  # block audit: free + owned tile the pool
         assert stats["free_blocks"] == stats["num_blocks"]
         assert engine._table_state.active_requests() == []
+    if case_seed == 3 and kv_cache == "paged":
+        # quantized pool actually engaged: int8 data + scale leaves in the tree
+        assert stats["quant_kv"] == "int8"
+        import jax.numpy as jnp
+
+        dtypes = {jnp.dtype(leaf.dtype) for leaf in jax.tree.leaves(engine.cache)}
+        assert jnp.dtype(jnp.int8) in dtypes and jnp.dtype(jnp.float32) in dtypes
     if case_seed == 2 and kv_cache == "paged":
         # the v3 machinery actually engaged on this trace (deterministic rng):
         # forked admissions and scored proposals, with coherent counters

@@ -40,6 +40,46 @@ def test_bench_serve_smoke_emits_parseable_json_line():
     assert out["requests"] == 6
 
 
+def test_bench_serve_quant_smoke_runs_oracle_and_audits_pool():
+    """Fast tier-1 pin for the quantized path: the int8/int8 smoke completes on
+    one decode executable with a clean pool audit, reports the quant schema
+    keys, and the inline logit oracle holds its gate."""
+    out = _run("--smoke", "--quant-weights", "int8", "--quant-kv", "int8", timeout=300)
+    assert out["quant_weights"] == "int8" and out["quant_kv"] == "int8"
+    assert out["cache"] == "paged" and out["pool_audit"] == "ok"
+    assert out["decode_executables"] == 1
+    assert out["quant_bytes_saved"] > 0
+    assert out["kv_pool_bytes"] > 0
+    assert out["pool_blocks"] > 0
+    assert out["quant_token_match"] >= 0.99, out
+    assert out["quant_logit_max_err"] <= 0.2, out
+
+
+@pytest.mark.slow  # int8 half-budget run + bf16 full-budget run (~2 min CPU)
+def test_bench_serve_quant_kv_half_budget_capacity_oracle():
+    """ISSUE PR-14 acceptance: an int8 KV pool sized from HALF the bf16 byte
+    budget holds >= the bf16 block count, finishes the 48-request run with ZERO
+    capacity finishes at >= 0.9x the bf16 tokens/s, and the logit oracle pins
+    >= 99% greedy token match with bounded max-abs error."""
+    common = ("--requests", "48", "--slots", "8", "--rate", "0")
+    budget = 65536
+    for attempt in range(2):
+        bf16 = _run(*common, "--cache", "paged", "--kv-pool-bytes", str(budget), timeout=540)
+        int8 = _run(
+            *common, "--kv-pool-bytes", str(budget // 2),
+            "--quant-kv", "int8", "--quant-weights", "int8", timeout=540,
+        )
+        assert bf16["capacity_finishes"] == 0 and int8["capacity_finishes"] == 0
+        assert int8["pool_blocks"] >= bf16["pool_blocks"], (int8, bf16)
+        assert int8["pool_audit"] == "ok" and int8["decode_executables"] == 1
+        assert int8["quant_token_match"] >= 0.99, int8
+        assert int8["quant_logit_max_err"] <= 0.2, int8
+        if int8["tokens_per_s"] >= 0.9 * bf16["tokens_per_s"]:
+            break
+    else:
+        raise AssertionError((int8, bf16))
+
+
 @pytest.mark.slow  # full load run + sequential baseline (two engines, ~2 min CPU)
 def test_bench_serve_full_run_hits_speedup_oracle():
     out = _run(timeout=540)
