@@ -538,8 +538,16 @@ def _run_candidate(cand, iters: int):
     ledger.add_seconds("init", t_build_done - t_candidate_start)
     ledger.add_seconds("compile_first_step", t_warmup_done - t_build_done)
     ledger.add_seconds("train_step", float(np.sum([np.sum(ts) for ts in all_repeats])))
-    goodput = ledger.summary(wall_s=time.perf_counter() - t_candidate_start)
+    candidate_wall_s = time.perf_counter() - t_candidate_start
+    goodput = ledger.summary(wall_s=candidate_wall_s)
     resilience_events = counts_since(resilience_snapshot)
+
+    # Peak -> achieved decomposition over the same ledger: names the MFU gap
+    # (compile vs data stall vs in-step inefficiency) instead of just sizing it.
+    # Deductions sum to peak - mfu_wall exactly (telemetry/waterfall.py closure).
+    from modalities_tpu.telemetry.waterfall import mfu_waterfall
+
+    waterfall = mfu_waterfall(mfu_wall, candidate_wall_s, goodput["buckets"])
 
     baseline_mfu = 0.6867  # reference best (6.7B, 8xA100, README.md:339)
     return {
@@ -561,6 +569,7 @@ def _run_candidate(cand, iters: int):
             "host_stall_s": round(host_stall_s, 4),
             "boundary_stall_s": 0.0,
             "goodput": goodput,
+            "mfu_waterfall": waterfall,
             # per-iteration evidence: each inner list is one repeat's host-synced
             # iteration times; value above = median of the best (fastest-median) repeat
             "repeats_s": [[round(t, 4) for t in ts] for ts in all_repeats],

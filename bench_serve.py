@@ -68,6 +68,16 @@ Quantized serving knobs (PR 14; --quant-kv implies --cache paged):
 Quantized runs are excluded from the bitwise parity pins; instead the logit
 oracle (quant/oracle.py) runs on the same model/params and reports
 `quant_logit_max_err` / `quant_token_match` in the JSON line.
+
+SLO gating (PR 15):
+  --slo PATH               evaluate the run's final metrics registry against a
+                           declarative SLO spec (telemetry/slo.py grammar, same
+                           YAML the serving `slo:` block takes) point-in-time
+                           after the measured window; the JSON line gains
+                           `slo` ("ok"|"breach") and `slo_burning` (objective
+                           names), and a breach makes the process exit 1 —
+                           the provisional-line contract is unchanged (both
+                           keys are null until the final line).
 """
 
 import argparse
@@ -120,6 +130,9 @@ METRIC_KEYS = (
     "quant_bytes_saved",
     "quant_logit_max_err",
     "quant_token_match",
+    # SLO gating (--slo; None otherwise)
+    "slo",
+    "slo_burning",
 )
 
 
@@ -383,6 +396,11 @@ def main() -> int:
         "(int8 pools fit 2x the blocks at the same budget)",
     )
     parser.add_argument(
+        "--slo", type=str, default=None, metavar="PATH",
+        help="SLO spec YAML; the run's final metrics are judged against it "
+        "point-in-time and a breaching objective fails the bench (exit 1)",
+    )
+    parser.add_argument(
         "--hot_swap_every", type=int, default=0,
         help="hot-swap identical weights every N decode steps mid-flight and "
         "oracle the output against a swap-free twin run (token-bitwise); "
@@ -611,6 +629,21 @@ def main() -> int:
         quant["quant_logit_max_err"] = report.max_abs_err
         quant["quant_token_match"] = report.token_match
 
+    # SLO verdict over the measured engine's registry (baseline engines have
+    # their own registries, so their samples never leak into the judgment)
+    slo_verdict = {}
+    slo_failed = False
+    if args.slo:
+        from modalities_tpu.telemetry.slo import evaluate_recorded, load_slo_spec
+
+        objectives, _ = load_slo_spec(args.slo)
+        slo_report = evaluate_recorded(objectives, engine.metrics)
+        slo_failed = bool(slo_report["breaching"])
+        slo_verdict = {
+            "slo": "breach" if slo_failed else "ok",
+            "slo_burning": slo_report["breaching"],
+        }
+
     baseline_tokens_per_s = None
     speedup = None
     if args.spec > 0:
@@ -656,6 +689,7 @@ def main() -> int:
                 **v3,
                 **hot,
                 **quant,
+                **slo_verdict,
                 "cache": args.cache,
                 "perfscope": args.perfscope,
                 "requests": args.requests,
@@ -670,7 +704,7 @@ def main() -> int:
         ),
         flush=True,
     )
-    return 0
+    return 1 if slo_failed else 0
 
 
 if __name__ == "__main__":

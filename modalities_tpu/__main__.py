@@ -436,16 +436,24 @@ def entry_point_analyze_telemetry(sink_path: Path, as_json: bool) -> None:
         straggler_summary,
         summarize_sink,
     )
+    from modalities_tpu.telemetry.waterfall import (
+        format_waterfall_table,
+        last_waterfall_from_sink,
+    )
 
     summary = summarize_sink(sink_path)
     stragglers = straggler_summary(summary)
+    waterfall = last_waterfall_from_sink(sink_path)
     if as_json:
-        click.echo(json.dumps({**summary, "stragglers": stragglers}))
+        click.echo(json.dumps({**summary, "stragglers": stragglers, "mfu_waterfall": waterfall}))
     else:
         click.echo(format_goodput_table(summary))
         if len(summary.get("ranks", {})) > 1:
             click.echo("\nstragglers (slowest rank per bucket):")
             click.echo(format_straggler_table(stragglers))
+        if waterfall is not None:
+            click.echo("\nMFU waterfall (peak -> achieved, deductions close the gap exactly):")
+            click.echo(format_waterfall_table(waterfall))
 
 
 @data.command(name="analyze_serve")
@@ -547,6 +555,73 @@ def entry_point_analyze_bench(artifacts_dir: Path, as_json: bool) -> None:
         click.echo(json.dumps(summary))
     else:
         click.echo(format_trajectory_table(summary))
+
+
+@data.command(name="check_slo")
+@click.option("--slo_path", type=click.Path(exists=True, path_type=Path), required=True,
+              help="YAML SLO spec (same grammar as the telemetry/serving `slo:` block).")
+@click.option("--sink_path", "sink_paths", type=click.Path(exists=True, path_type=Path),
+              multiple=True,
+              help="Telemetry JSONL sink (file or folder); repeatable. serve_request "
+                   "traces rebuild the serve_* histograms, mfu_waterfall records the "
+                   "training_mfu_achieved gauge, spans the goodput ratio.")
+@click.option("--bench_path", "bench_paths", type=click.Path(exists=True, path_type=Path),
+              multiple=True,
+              help="bench_serve JSON-lines output; repeatable. The final result line's "
+                   "numeric fields become bench_<key> gauges.")
+@click.option("--trajectory_path", type=click.Path(exists=True, path_type=Path), default=None,
+              help="Folder of BENCH_r*/MULTICHIP_r* round artifacts (trajectory loader).")
+@click.option("--as_json", is_flag=True, default=False, help="Emit the verdict dict as JSON.")
+@_exception_handling
+def entry_point_check_slo(
+    slo_path: Path, sink_paths: tuple[Path, ...], bench_paths: tuple[Path, ...],
+    trajectory_path: Optional[Path], as_json: bool,
+) -> None:
+    """Evaluate recorded runs against a declarative SLO spec: replay telemetry
+    sinks / bench_serve lines / benchmark-round artifacts into one metrics
+    registry, judge each objective point-in-time (no burn windows — the data is
+    historical), and exit nonzero when any objective breaches. The CI face of
+    the live SLO engine."""
+    from modalities_tpu.telemetry.metrics import MetricsRegistry
+    from modalities_tpu.telemetry.slo import (
+        evaluate_recorded,
+        load_slo_spec,
+        replay_bench_lines_into_registry,
+        replay_sink_into_registry,
+        replay_trajectory_into_registry,
+    )
+
+    registry = MetricsRegistry()
+    replayed = 0
+    for path in sink_paths:
+        replayed += replay_sink_into_registry(path, registry)
+    for path in bench_paths:
+        replayed += replay_bench_lines_into_registry(path, registry)
+    if trajectory_path is not None:
+        replayed += replay_trajectory_into_registry(trajectory_path, registry)
+    objectives, _ = load_slo_spec(slo_path)
+    report = evaluate_recorded(objectives, registry)
+    report["records_replayed"] = replayed
+    if as_json:
+        click.echo(json.dumps(report))
+    else:
+        width = max(len(o.name) for o in objectives)
+        for objective in objectives:
+            value = report["values"].get(objective.name)
+            if objective.name in report["breaching"]:
+                verdict = "BREACH"
+            elif objective.name in report["skipped"]:
+                verdict = "skipped (no data)"
+            else:
+                verdict = "ok"
+            shown = f"{value:.6g}" if value is not None else "-"
+            click.echo(f"{objective.name:<{width}}  {shown:>12}  {verdict}  ({objective.expr})")
+        click.echo(
+            f"{len(objectives)} objectives over {replayed} replayed records: "
+            + ("BREACHING: " + ", ".join(report["breaching"]) if report["breaching"] else "all ok")
+        )
+    if report["breaching"]:
+        raise SystemExit(1)
 
 
 @data.command(name="tune_kernels")

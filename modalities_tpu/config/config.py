@@ -446,6 +446,11 @@ class TelemetryConfig(BaseModel):
     # bumps training_step_time_anomaly_total and emits an anomaly/step_time event
     anomaly_zscore: Annotated[float, Field(gt=0)] = 6.0
     anomaly_window: Annotated[int, Field(ge=2)] = 64
+    # declarative SLOs (PR 15, telemetry/slo.py): {"objectives": [{"name", "expr",
+    # + burn-rate overrides}]} judged at each interval publish; a breaching
+    # goodput/MFU-floor objective counts against the anomaly skip budget.
+    # None (default) is a no-op fast path: no slo_* series, no extra work.
+    slo: Optional[dict] = None
 
 
 class ResilienceConfig(BaseModel):

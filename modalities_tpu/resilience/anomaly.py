@@ -153,6 +153,30 @@ class AnomalyTracker:
                 self.window_steps,
             )
 
+        self._escalate_if_exhausted(step_id, f"first at step {first_bad_step}")
+
+    def observe_slo(self, breaching: list, step_id: int) -> None:
+        """An interval spent in breach of a training SLO (goodput/MFU-floor
+        objective, telemetry/slo.py) counts one anomalous step against the
+        same skip budget, so sustained infra degradation escalates through the
+        identical policy path as bad math."""
+        if not breaching:
+            return
+        self._anomalous_steps.append(step_id)
+        used = self.anomalies_in_window(step_id)
+        record_event(
+            "anomaly/slo_breach",
+            step=step_id, objectives=list(breaching), policy=self.policy,
+            in_window=used, budget=self.skip_budget,
+        )
+        logger.warning(
+            "SLO breach at step %d (%s) counted against anomaly budget "
+            "[%d/%d used in trailing %d steps]",
+            step_id, ", ".join(breaching), used, self.skip_budget, self.window_steps,
+        )
+        self._escalate_if_exhausted(step_id, f"last breaching {', '.join(breaching)}")
+
+    def _escalate_if_exhausted(self, step_id: int, cause: str) -> None:
         used = self.anomalies_in_window(step_id)
         if used > self.skip_budget:
             record_event(
@@ -162,7 +186,7 @@ class AnomalyTracker:
             detail = (
                 f"anomaly skip budget exhausted: {used} anomalous steps in the "
                 f"trailing {self.window_steps} steps (budget {self.skip_budget}), "
-                f"first at step {first_bad_step}"
+                f"{cause}"
             )
             if self.policy == "rollback":
                 raise AnomalyRollback(

@@ -244,11 +244,11 @@ def load_fleet_records(sink_paths: Iterable[Path]) -> dict:
     for sink_path in sink_paths:
         sink_path = Path(sink_path)
         if sink_path.is_dir():
-            found = sorted(sink_path.glob("*.jsonl"))
-            if not found:
-                raise FileNotFoundError(f"no .jsonl sink files under {sink_path}")
-            files.extend(found)
-        else:
+            # a sinkless folder (run died pre-flush, or the wrong --sink_path
+            # of several) contributes nothing — the stitcher renders a clean
+            # "no records" tree instead of crashing the whole analysis
+            files.extend(sorted(sink_path.glob("*.jsonl")))
+        elif sink_path.exists():
             files.append(sink_path)
     out = {"fleet_requests": [], "failovers": [], "serve_requests": []}
     for path in files:

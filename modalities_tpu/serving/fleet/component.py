@@ -154,6 +154,33 @@ class FleetServingComponent(ServingComponent):
             server.start()
             workers.append(worker)
 
+        # one SLO engine PER WORKER over that worker's isolated registry: the
+        # canary's burn rate is judged on its own traffic, its /healthz flips
+        # to "degraded" on breach (router deprioritizes it), and the rollout
+        # controller consumes the same verdicts during probation
+        slo_engines = {}
+        slo_verdict_fn = None
+        if self.slo:
+            from modalities_tpu.telemetry.slo import SLOEngine, load_slo_spec
+
+            objectives, options = load_slo_spec(self.slo)
+            for worker in workers:
+                slo_engine = SLOEngine(
+                    objectives, worker.engine.metrics, scope=worker.name, **options
+                ).start()
+                worker.server.slo_status_fn = slo_engine.breaching
+                slo_engines[worker.name] = slo_engine
+
+            def slo_verdict_fn(worker):
+                engine = slo_engines[worker.name]
+                engine.sample_once()  # probation ticks outpace the sampler thread
+                return engine.breaching()
+
+            logger.info(
+                "fleet SLOs armed per worker: %s",
+                ", ".join(f"{o.name} ({o.expr})" for o in objectives),
+            )
+
         fleet_registry = MetricsRegistry()
         controller = RolloutController(
             workers,
@@ -162,6 +189,7 @@ class FleetServingComponent(ServingComponent):
             probation_tick_s=self.probation_tick_s,
             max_error_delta=self.max_error_delta,
             ttft_regression_factor=self.ttft_regression_factor,
+            slo_verdict_fn=slo_verdict_fn,
         )
         handles = [
             WorkerHandle(w.name, self.http_host, w.server.port) for w in workers
@@ -202,6 +230,8 @@ class FleetServingComponent(ServingComponent):
         finally:
             if watcher is not None:
                 watcher.stop()
+            for slo_engine in slo_engines.values():
+                slo_engine.stop()
             router.stop()
             for worker in workers:  # drain all workers concurrently...
                 worker.server.stop()

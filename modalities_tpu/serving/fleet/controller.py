@@ -14,7 +14,10 @@ bad checkpoint is visible in /metrics, not just absent from the fleet.
 Error deltas are checked every tick (a NaN-weights canary whose requests
 finish with reason "error" rolls back mid-window, fast); the TTFT comparison
 runs once at the end of the window where both sides have accumulated
-observations. Clock and sleep are injectable: unit tests drive probation with
+observations. With an SLO engine wired (``slo_verdict_fn``, telemetry/slo.py)
+each tick also asks for the canary's breaching objectives, and a burn-rate
+verdict rolls back with ``fleet/rollback stage=slo`` — declarative objectives
+outrank the ad-hoc heuristics. Clock and sleep are injectable: unit tests drive probation with
 a fake clock, production uses wall time
 (``MODALITIES_TPU_FLEET_PROBATION_S`` sets the window, default 30 s).
 """
@@ -99,6 +102,7 @@ class RolloutController:
         probation_tick_s: float = 0.25,
         max_error_delta: int = 0,
         ttft_regression_factor: float = 2.0,
+        slo_verdict_fn: Optional[Callable[[EngineWorker], list]] = None,
         time_fn: Callable[[], float] = time.monotonic,
         sleep_fn: Callable[[float], None] = time.sleep,
     ):
@@ -114,6 +118,11 @@ class RolloutController:
         self.probation_tick_s = probation_tick_s
         self.max_error_delta = int(max_error_delta)
         self.ttft_regression_factor = float(ttft_regression_factor)
+        # SLO verdict hook (telemetry/slo.py): worker -> breaching objective
+        # names. Checked every probation tick, so a canary burning its error
+        # budget rolls back on the declared objectives, not only the ad-hoc
+        # error-delta / TTFT-vs-peers heuristics. None keeps the legacy gates.
+        self.slo_verdict_fn = slo_verdict_fn
         self._now = time_fn
         self._sleep = sleep_fn
         self.generation = max(w.engine.weights_generation for w in self.workers)
@@ -151,15 +160,16 @@ class RolloutController:
             )
             self._m_rollbacks.inc()
             return False
-        reason = self._probation(canary, baselines)
-        if reason is not None:
+        verdict = self._probation(canary, baselines)
+        if verdict is not None:
+            stage, reason = verdict
             canary.swap(donor_params, donor_gen)
             logger.warning(
                 "fleet rollback: generation %d off %s (%s) — donor generation %d keeps serving",
                 gen, canary.name, reason, donor_gen,
             )
             record_event(
-                "fleet/rollback", stage="probation", worker=canary.name,
+                "fleet/rollback", stage=stage, worker=canary.name,
                 generation=gen, step=step, reason=reason,
             )
             self._m_rollbacks.inc()
@@ -184,18 +194,29 @@ class RolloutController:
         return min(healthy, key=lambda w: w.load())
 
     # -------------------------------------------------------------- probation
-    def _probation(self, canary: EngineWorker, baselines: dict) -> Optional[str]:
-        """Watch the canary for the probation window. None promotes; a reason
-        string rolls back."""
+    def _probation(
+        self, canary: EngineWorker, baselines: dict
+    ) -> Optional[tuple[str, str]]:
+        """Watch the canary for the probation window. None promotes; a
+        (stage, reason) pair rolls back — stage "slo" for a declared-objective
+        verdict, "probation" for the legacy error/TTFT gates."""
         deadline = self._now() + self.probation_s
         base = baselines[canary.name]
         while True:
+            if self.slo_verdict_fn is not None:
+                burning = list(self.slo_verdict_fn(canary))
+                if burning:
+                    return (
+                        "slo",
+                        f"slo breach on canary: {', '.join(burning)}",
+                    )
             snap = canary.snapshot()
             error_delta = snap["request_errors"] - base["request_errors"]
             if error_delta > self.max_error_delta:
                 return (
+                    "probation",
                     f"request_errors regressed by {error_delta} during probation "
-                    f"(allowed {self.max_error_delta})"
+                    f"(allowed {self.max_error_delta})",
                 )
             if self._now() >= deadline:
                 break
@@ -218,7 +239,8 @@ class RolloutController:
             peer_mean = peer_sum / peer_count
             if peer_mean > 0 and canary_mean > self.ttft_regression_factor * peer_mean:
                 return (
+                    "probation",
                     f"ttft regressed: canary mean {canary_mean:.4f}s vs fleet mean "
-                    f"{peer_mean:.4f}s (factor {self.ttft_regression_factor:g})"
+                    f"{peer_mean:.4f}s (factor {self.ttft_regression_factor:g})",
                 )
         return None

@@ -10,6 +10,11 @@ last successful probe is within the heartbeat deadline
 (``MODALITIES_TPU_FLEET_HEALTH_DEADLINE_S``, default 5 s) and it is not
 draining. Transitions emit ``fleet/worker_unhealthy`` /
 ``fleet/worker_recovered`` events and move the `fleet_workers_healthy` gauge.
+A worker whose /healthz reports ``degraded`` (sustained SLO breach,
+telemetry/slo.py) stays in rotation but is deprioritized — clean peers win
+routing while any exist — with ``fleet/worker_degraded`` /
+``fleet/worker_degradation_cleared`` events and the `fleet_workers_degraded`
+gauge tracking the state.
 
 Failover: when a worker dies mid-stream (connection drops before its final
 SSE `done` event) the router marks it unhealthy, bumps
@@ -66,6 +71,7 @@ class WorkerHandle:
         self.port = int(port)
         self.healthy = True  # optimistic until the first probe says otherwise
         self.draining = False
+        self.degraded = False  # /healthz "degraded": serving, but in SLO breach
         self.last_heartbeat = time.monotonic()
         self.load = 0  # active slots + queue depth, from the last /stats probe
         self.weights_generation = 0
@@ -159,6 +165,11 @@ class FleetRouter:
             "fleet_workers_healthy", "Workers currently passing health checks"
         )
         self._m_workers_healthy.set(len(self.workers))
+        self._m_workers_degraded = self.metrics.gauge(
+            "fleet_workers_degraded", "Workers serving in sustained SLO breach"
+        )
+        self._m_workers_degraded.set(0)
+        self._degraded_seen: dict[str, bool] = {}
         self._m_failovers = self.metrics.counter(
             "fleet_failovers_total", "Generate requests re-routed off a dead worker"
         )
@@ -187,6 +198,7 @@ class FleetRouter:
             if status != 200:
                 return False
             worker.draining = health.get("status") == "draining"
+            worker.degraded = health.get("status") == "degraded"
             worker.weights_generation = int(health.get("weights_generation", 0))
             status, stats = await http_get_json(
                 worker.host, worker.port, "/stats", self.connect_timeout_s
@@ -223,7 +235,23 @@ class FleetRouter:
                         "fleet/worker_recovered", worker=worker.name,
                         address=worker.address,
                     )
+            for worker in self.workers:
+                was_degraded = self._degraded_seen.get(worker.name, False)
+                if worker.degraded and not was_degraded:
+                    logger.warning("fleet router: worker %s degraded (SLO breach)", worker.name)
+                    record_event(
+                        "fleet/worker_degraded", worker=worker.name,
+                        address=worker.address,
+                    )
+                elif was_degraded and not worker.degraded:
+                    logger.info("fleet router: worker %s degradation cleared", worker.name)
+                    record_event(
+                        "fleet/worker_degradation_cleared", worker=worker.name,
+                        address=worker.address,
+                    )
+                self._degraded_seen[worker.name] = worker.degraded
             self._m_workers_healthy.set(sum(1 for w in self.workers if w.healthy))
+            self._m_workers_degraded.set(sum(1 for w in self.workers if w.degraded))
             await asyncio.sleep(self.health_interval_s)
 
     def _pick(self, exclude: set) -> Optional[WorkerHandle]:
@@ -232,7 +260,9 @@ class FleetRouter:
         ]
         if not candidates:
             return None
-        worker = min(candidates, key=lambda w: (w.load, w.picks))
+        # degraded last: an SLO-breaching worker still serves, but only when
+        # every clean peer is excluded or down
+        worker = min(candidates, key=lambda w: (w.degraded, w.load, w.picks))
         worker.picks += 1
         return worker
 
@@ -414,6 +444,7 @@ class FleetRouter:
                     "address": w.address,
                     "healthy": w.healthy,
                     "draining": w.draining,
+                    "degraded": w.degraded,
                     "load": w.load,
                     "weights_generation": w.weights_generation,
                     "picks": w.picks,

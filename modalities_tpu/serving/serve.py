@@ -49,6 +49,10 @@ class ServingComponentConfig(BaseModel):
     quant: Optional[dict] = None  # {"weights": none|int8|fp8, "kv": none|int8}; None = env/off
     http_host: str = "127.0.0.1"
     http_port: Optional[int] = None  # set (0 = ephemeral) to start the HTTP front end
+    # declarative SLOs (telemetry/slo.py): {"objectives": [{"name", "expr", ...}],
+    # "sample_interval_s"?} judged live over the serve metrics registry.
+    # None = no engine, no slo_* series — the pre-SLO behavior exactly.
+    slo: Optional[dict] = None
 
 
 class ServingComponent:
@@ -76,6 +80,7 @@ class ServingComponent:
         quant: Optional[dict] = None,
         http_host: str = "127.0.0.1",
         http_port: Optional[int] = None,
+        slo: Optional[dict] = None,
         params=None,
     ):
         self.model = model
@@ -101,6 +106,8 @@ class ServingComponent:
         self.quant_kv_setting = self.quant.get("kv")
         self.http_host = http_host
         self.http_port = http_port
+        self.slo = slo
+        self.slo_engine = None  # serve() arms it when an slo: block is configured
         self.params = params
         self.stop_fn = None  # graceful drain: serve() wires the SIGTERM flag here
         self._engine = None
@@ -192,6 +199,8 @@ class ServingComponent:
             port=self.http_port or 0,
             default_max_new_tokens=self.max_new_tokens,
         )
+        if self.slo_engine is not None:
+            server.slo_status_fn = self.slo_engine.breaching
         server.start()
         logger.info(
             "serving HTTP on %s:%d (POST /generate, GET /healthz, GET /stats, GET /metrics)",
@@ -399,6 +408,24 @@ def serve(
 
     handler = PreemptionHandler().install()
     component.stop_fn = handler.should_stop
+
+    # arm the SLO sampler for single-engine modes: the engine's registry
+    # defaults to the active telemetry's (PR 10), so judging that registry
+    # covers everything /metrics exposes. Fleet mode builds per-worker
+    # engines inside run_fleet instead (each worker registry is isolated).
+    slo_engine = None
+    if getattr(component, "slo", None) and not hasattr(component, "run_fleet"):
+        from modalities_tpu.telemetry.slo import SLOEngine, load_slo_spec
+
+        objectives, options = load_slo_spec(component.slo)
+        slo_engine = SLOEngine(
+            objectives, get_active_telemetry().metrics, **options
+        ).start()
+        component.slo_engine = slo_engine
+        logger.info(
+            "SLO engine armed: %s",
+            ", ".join(f"{o.name} ({o.expr})" for o in objectives),
+        )
     try:
         if http_port is not None:
             component.http_port = int(http_port)
@@ -431,6 +458,8 @@ def serve(
         stats = component.build_engine().stats()
         logger.info("serve stats: %s", json.dumps(stats))
     finally:
+        if slo_engine is not None:
+            slo_engine.stop()
         handler.uninstall()
         if telemetry is not None:
             telemetry.close()

@@ -26,7 +26,9 @@ Endpoints:
 - `POST /admin/swap` — body `{"checkpoint_folder": str, "generation": int?}`;
   forwarded to the wired `swap_handler` (fleet watcher path); 503 when no
   handler is wired.
-- `GET /healthz` — `{"status": "ok"|"draining", "weights_generation": int}`.
+- `GET /healthz` — `{"status": "ok"|"degraded"|"draining", "weights_generation":
+  int}` (+ `"slo_breaching"` when an SLO engine is wired; "degraded" = serving
+  but in sustained breach).
 - `GET /stats` — one consistent engine-counter snapshot (taken under the
   engine's stats lock) + HTTP counters + queue depth / active slots.
 - `GET /metrics` — Prometheus text exposition of the process metrics registry.
@@ -141,6 +143,11 @@ class ServingHTTPServer:
         # POST /admin/swap delegate: dict body -> dict result (fleet wires the
         # watcher's load+swap path here; None keeps the endpoint disabled)
         self.swap_handler = swap_handler
+        # SLO verdict hook (telemetry/slo.py): () -> list of breaching
+        # objective names; non-empty turns /healthz "ok" into "degraded" so
+        # the fleet router can deprioritize this worker without killing it.
+        # None keeps /healthz exactly on its pre-SLO shape.
+        self.slo_status_fn: Optional[Callable[[], list]] = None
 
         self._pending: queue.Queue = queue.Queue()  # (body dict, stream queue)
         self._streams: dict[int, queue.Queue] = {}  # rid -> stream (engine thread only)
@@ -347,17 +354,20 @@ class ServingHTTPServer:
                 return
             method, path, headers, body_bytes = req
             if method == "GET" and path == "/healthz":
-                writer.write(
-                    json_response_bytes(
-                        200,
-                        {
-                            "status": "draining" if self.draining else "ok",
-                            "weights_generation": getattr(
-                                self.engine, "weights_generation", 0
-                            ),
-                        },
-                    )
-                )
+                health = {
+                    "status": "draining" if self.draining else "ok",
+                    "weights_generation": getattr(
+                        self.engine, "weights_generation", 0
+                    ),
+                }
+                if self.slo_status_fn is not None:
+                    breaching = list(self.slo_status_fn())
+                    health["slo_breaching"] = breaching
+                    if breaching and health["status"] == "ok":
+                        # degraded ≠ unhealthy: still serving, but the router
+                        # prefers clean peers while the breach lasts
+                        health["status"] = "degraded"
+                writer.write(json_response_bytes(200, health))
             elif method == "GET" and path == "/stats":
                 stats = dict(self.engine.stats())
                 stats["http_requests"] = self.http_requests

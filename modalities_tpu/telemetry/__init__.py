@@ -62,6 +62,7 @@ class Telemetry:
         global_rank: Optional[int] = None,
         anomaly_zscore: float = 6.0,
         anomaly_window: int = 64,
+        slo: Optional[dict] = None,
     ):
         self.enabled = enabled
         self.watchdog_deadline_s = float(watchdog_deadline_s)
@@ -81,6 +82,9 @@ class Telemetry:
         self._step_time_detector = None
         self._bucket_detectors: dict[str, object] = {}
         self._last_bucket_seconds: dict[str, float] = {}
+        # optional SLO engine (PR 15): judged objectives over self.metrics;
+        # None (the default) keeps every publish path on the pre-SLO behavior
+        self.slo_engine = None
         if not enabled:
             self.global_rank = 0
             self._recorder = None
@@ -91,6 +95,14 @@ class Telemetry:
         self._recorder = SpanRecorder(on_record=self._on_record, use_jax_annotations=use_jax_annotations)
         if output_folder_path is not None:
             self.set_output_folder(output_folder_path)
+        if slo:
+            # built but NOT started: the trainer samples it at each interval
+            # publish, so training verdicts stay deterministic per interval
+            # (serving paths start their own sampler threads instead)
+            from modalities_tpu.telemetry.slo import SLOEngine, load_slo_spec
+
+            objectives, options = load_slo_spec(slo)
+            self.slo_engine = SLOEngine(objectives, self.metrics, **options)
 
     # ------------------------------------------------------------------ spans
 
@@ -221,6 +233,48 @@ class Telemetry:
         self._observe_bucket_deltas(summary["buckets"])
         return metrics
 
+    def publish_mfu_waterfall(
+        self,
+        mfu_achieved: float,
+        collective_frac: Optional[float] = None,
+    ) -> Optional[dict]:
+        """Decompose the cumulative wall-clock MFU against the goodput ledger
+        (telemetry/waterfall.py) and publish: `training_mfu_achieved` plus one
+        `training_mfu_waterfall_deduction{cause}` gauge per named cause on the
+        scrape surface, and an `mfu_waterfall` record on the sink for
+        `data analyze_telemetry`. Returns the waterfall (None when disabled)."""
+        if not self.enabled:
+            return None
+        from modalities_tpu.telemetry.waterfall import DEDUCTIONS, mfu_waterfall
+
+        summary = self.ledger.summary()
+        waterfall = mfu_waterfall(
+            mfu_achieved,
+            wall_s=summary["wall_s"],
+            buckets=summary["buckets"],
+            collective_frac=collective_frac,
+        )
+        self.metrics.gauge(
+            "training_mfu_achieved", "Cumulative wall-clock MFU of the run"
+        ).set(waterfall["achieved"])
+        deduction_gauge = self.metrics.gauge(
+            "training_mfu_waterfall_deduction",
+            "MFU lost to each named cause; causes sum exactly to peak - achieved",
+        )
+        for cause in DEDUCTIONS:
+            deduction_gauge.set(waterfall["deductions"][cause], cause=cause)
+        if self._sink is not None:
+            # full precision on purpose: the deductions sum to gap EXACTLY, and
+            # rounding here would break that identity for sink replays
+            self._sink.emit({
+                "event": "mfu_waterfall",
+                "peak": waterfall["peak"],
+                "achieved": waterfall["achieved"],
+                "gap": waterfall["gap"],
+                "deductions": dict(waterfall["deductions"]),
+            })
+        return waterfall
+
     # ------------------------------------------------------- anomaly detection
 
     def _detector(self):
@@ -303,6 +357,8 @@ class Telemetry:
     def close(self) -> None:
         """Stop the watchdog and seal the sink with a run summary. Idempotent;
         safe on the exception path."""
+        if self.slo_engine is not None:
+            self.slo_engine.stop()
         if self._watchdog is not None:
             self._watchdog.stop()
         if self._sink is not None:

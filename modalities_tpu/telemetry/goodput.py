@@ -155,8 +155,7 @@ def summarize_sink(path: Union[str, Path]) -> dict:
     """
     path = Path(path)
     files = sorted(path.glob("telemetry_rank_*.jsonl")) if path.is_dir() else [path]
-    if not files:
-        raise FileNotFoundError(f"no telemetry_rank_*.jsonl files under {path}")
+    files = [file for file in files if file.exists()]
 
     ranks: dict[int, dict] = {}
     for file in files:
@@ -186,6 +185,12 @@ def summarize_sink(path: Union[str, Path]) -> dict:
         wall_s = (t_max - t_min) if (t_min is not None and t_max is not None) else 0.0
         ranks[rank] = ledger.summary(wall_s=wall_s)
 
+    if not ranks:
+        # an empty/missing sink (run died before the first flush) analyzes to a
+        # clean zero summary, not a crash — the CLIs print "no records" tables
+        empty = GoodputLedger().summary(wall_s=0.0)
+        return {"ranks": {}, "combined": empty}
+
     n = len(ranks)
     combined = {
         "wall_s": round(sum(s["wall_s"] for s in ranks.values()) / n, 6),
@@ -205,10 +210,11 @@ def straggler_summary(summary: dict) -> dict:
     IS the straggler the ROADMAP's multi-host rounds need named.
 
     Returns {bucket: {"slowest_rank", "seconds", "median_s", "ratio_vs_median"}}
-    for buckets where any rank recorded time; single-rank sinks yield ratios of
-    1.0 (no peer to lag behind)."""
+    for buckets where any rank recorded time. With fewer than two ranks there
+    is no peer to lag behind, so the answer is empty — not a table of every
+    bucket "straggling" behind itself at ratio 1.0."""
     ranks = summary.get("ranks") or {}
-    if not ranks:
+    if len(ranks) < 2:
         return {}
     out: dict[str, dict] = {}
     for bucket in BUCKETS:
@@ -248,6 +254,8 @@ def format_straggler_table(stragglers: dict) -> str:
 
 def format_goodput_table(summary: dict) -> str:
     """Render a summarize_sink() result as an aligned text table."""
+    if not summary.get("ranks"):
+        return "no telemetry span records found"
     lines = []
     header = f"{'bucket':<20}" + "".join(f"rank {r:>2}      " for r in sorted(summary["ranks"]))
     lines.append(header.rstrip())

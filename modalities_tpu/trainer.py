@@ -533,6 +533,20 @@ class Trainer:
             throughput["goodput [%]"] = ResultItem(goodput_metrics.pop("goodput [%]"), 2)
             for key, seconds in goodput_metrics.items():
                 throughput[key] = ResultItem(seconds, 3)
+            if self.mfu_calculator is not None:
+                # cumulative wall-clock MFU decomposed into named deductions
+                # against the same goodput ledger (telemetry/waterfall.py)
+                wall_s = telemetry.ledger.wall_s()
+                if wall_s > 0:
+                    telemetry.publish_mfu_waterfall(
+                        self.mfu_calculator.compute(tokens_total / wall_s)
+                    )
+        if telemetry.slo_engine is not None:
+            telemetry.slo_engine.sample_once()
+            if self.anomaly_tracker is not None:
+                self.anomaly_tracker.observe_slo(
+                    telemetry.slo_engine.breaching(), step_id
+                )
 
         result = EvaluationResultBatch(
             dataloader_tag=dataloader_tag,

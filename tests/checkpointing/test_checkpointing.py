@@ -128,6 +128,10 @@ def test_double_load_guard(tmp_path):
         loader.load_app_state(fns.app_state_handle, folder)
 
 
+@pytest.mark.slow  # ~13 s twin train runs; cross-topology restore stays pinned fast
+# leaf-bitwise by test_restore_reshards_leaves_bitwise_across_topologies below, and
+# warmstart-then-train equivalence by tests/end2end_tests/test_acceptance_recipe_twins.py
+# (test_7b_tp_fsdp_twin_then_32k_warmstart_twin)
 def test_warmstart_topology_change_equivalence(tmp_path):
     """Train 6 steps on dp4 x tp2; resume from step 3's checkpoint on dp8; the last
     3 losses must match the uninterrupted run (reference warmstart oracle)."""

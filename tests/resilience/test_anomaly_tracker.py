@@ -59,6 +59,27 @@ def test_skip_policy_counts_against_budget_and_emits_events():
         tracker.observe_interval(_interval(5, flags=[1, 0]), step_id=6)
 
 
+def test_slo_breach_spends_the_anomaly_budget_and_escalates():
+    """An interval spent in SLO breach (trainer wiring, telemetry/slo.py)
+    charges the SAME skip budget as bad math: healthy intervals are free, each
+    breaching one counts a step, exhaustion escalates through the policy."""
+    tracker = AnomalyTracker(policy="skip_step", skip_budget=1, window_steps=100)
+    snapshot = snapshot_counts()
+    tracker.observe_slo([], step_id=4)  # healthy interval: free
+    assert tracker.anomalies_in_window(4) == 0
+    assert counts_since(snapshot).get("anomaly", 0) == 0
+    tracker.observe_slo(["goodput_floor"], step_id=6)
+    assert tracker.anomalies_in_window(6) == 1
+    assert counts_since(snapshot).get("anomaly") == 1  # anomaly/slo_breach
+    with pytest.raises(RuntimeError, match="skip budget exhausted"):
+        tracker.observe_slo(["goodput_floor", "mfu_floor"], step_id=8)
+
+    # the rollback policy escalates to the resumable warmstart error instead
+    tracker = AnomalyTracker(policy="rollback", skip_budget=0, window_steps=100)
+    with pytest.raises(AnomalyRollback, match="rollback warmstart"):
+        tracker.observe_slo(["goodput_floor"], step_id=1)
+
+
 def test_window_pruning_recovers_the_budget():
     tracker = AnomalyTracker(policy="skip_step", skip_budget=1, window_steps=10)
     tracker.observe_interval(_interval(1, flags=[1]), step_id=1)

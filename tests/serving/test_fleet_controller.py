@@ -8,10 +8,13 @@ per-worker metrics registry, swap_weights) so the tests exercise the REAL
 worker/controller pair, not a mock of it.
 """
 
+import json
+
 import pytest
 
 from modalities_tpu.resilience.events import counts_since, snapshot_counts
 from modalities_tpu.serving.fleet.controller import EngineWorker, RolloutController
+from modalities_tpu.telemetry import Telemetry, set_active_telemetry
 from modalities_tpu.telemetry.metrics import MetricsRegistry, parse_prometheus_text
 
 OLD, NEW = {"w": 1.0}, {"w": 2.0}
@@ -67,7 +70,7 @@ class _Clock:
             self.on_tick()
 
 
-def _fleet(n=3, loads=None):
+def _fleet(n=3, loads=None, **controller_kwargs):
     workers = [
         EngineWorker(f"w{i}", _FakeEngine(load=(loads or [0] * n)[i]))
         for i in range(n)
@@ -81,6 +84,7 @@ def _fleet(n=3, loads=None):
         probation_tick_s=0.25,
         time_fn=clock.now,
         sleep_fn=clock.sleep,
+        **controller_kwargs,
     )
     return workers, controller, clock, registry
 
@@ -143,6 +147,70 @@ def test_ttft_regression_rolls_back_at_window_end():
     assert controller.deploy(NEW) is False
     assert canary.params is OLD and canary.weights_generation == 0
     assert clock.t >= 1.0  # TTFT verdict waits for the full window
+
+
+@pytest.fixture()
+def fleet_events(tmp_path_factory):
+    """Active telemetry sink + a reader for the fleet/* events it captured."""
+    sink = tmp_path_factory.mktemp("telemetry")
+    telemetry = Telemetry(
+        output_folder_path=sink, watchdog_deadline_s=0.0, use_jax_annotations=False
+    )
+    prior = set_active_telemetry(telemetry)
+
+    def events(prefix="fleet/"):
+        telemetry.close()  # flush before reading back
+        out = []
+        for path in sorted(sink.glob("telemetry_rank_*.jsonl")):
+            for line in path.read_text().splitlines():
+                event = json.loads(line)
+                if event.get("name", "").startswith(prefix):
+                    out.append(event)
+        return out
+
+    try:
+        yield events
+    finally:
+        telemetry.close()
+        set_active_telemetry(prior)
+
+
+def test_slo_verdict_rolls_canary_back_before_the_legacy_gates(fleet_events):
+    """A burning SLO on the canary outranks the error/TTFT heuristics: the
+    verdict is checked at the top of every probation tick, rolls back with
+    stage="slo", and names the breaching objectives in the event reason."""
+    verdicts = []
+
+    def slo_verdict(worker):
+        # the canary starts burning its ttft_p99 budget the moment the new
+        # generation lands; peers (still on generation 0) stay clean
+        burning = ["ttft_p99"] if worker.engine.weights_generation == 1 else []
+        verdicts.append((worker.name, burning))
+        return burning
+
+    workers, controller, clock, registry = _fleet(slo_verdict_fn=slo_verdict)
+    canary = workers[0].engine
+    assert controller.deploy(NEW, step=7) is False
+    # the verdict fired on the FIRST check — no probation ticks were needed
+    assert clock.t == 0.0
+    assert verdicts == [("w0", ["ttft_p99"])]
+    # canary is back on the donor tree; peers never saw generation 1
+    assert canary.params is OLD and canary.weights_generation == 0
+    assert workers[1].engine.swaps == [] and workers[2].engine.swaps == []
+    assert _counter(registry, "fleet_rollbacks_total") == 1.0
+    rollbacks = [e for e in fleet_events() if e["name"] == "fleet/rollback"]
+    assert len(rollbacks) == 1
+    assert rollbacks[0]["stage"] == "slo"
+    assert rollbacks[0]["worker"] == "w0" and rollbacks[0]["step"] == 7
+    assert "ttft_p99" in rollbacks[0]["reason"]
+
+
+def test_clean_slo_verdict_leaves_promotion_to_the_legacy_gates():
+    """slo_verdict_fn returning [] every tick never vetoes: a quiet window
+    still promotes, i.e. the SLO hook adds a gate, it does not replace one."""
+    workers, controller, _, _ = _fleet(slo_verdict_fn=lambda worker: [])
+    assert controller.deploy(NEW) is True
+    assert all(w.engine.params is NEW for w in workers)
 
 
 def test_quiet_window_promotes_despite_no_traffic():

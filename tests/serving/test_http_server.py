@@ -76,6 +76,19 @@ def test_http_sse_round_trip_stats_and_drain():
 
         status, health = _get(server.port, "/healthz")
         assert (status, health["status"]) == (200, "ok")
+        assert "slo_breaching" not in health  # no SLO engine -> pre-SLO shape
+
+        # ---- SLO hook: a burning objective turns "ok" into "degraded" (still
+        # HTTP 200 — degraded means "serving, prefer a clean peer", not dead)
+        server.slo_status_fn = lambda: ["ttft_p99"]
+        status, health = _get(server.port, "/healthz")
+        assert (status, health["status"]) == (200, "degraded")
+        assert health["slo_breaching"] == ["ttft_p99"]
+        server.slo_status_fn = lambda: []
+        status, health = _get(server.port, "/healthz")
+        assert (status, health["status"]) == (200, "ok")
+        assert health["slo_breaching"] == []
+        server.slo_status_fn = None
 
         # ---- one streamed round-trip: tokens arrive one SSE event at a time
         status, ctype, events = _post_generate(
@@ -119,8 +132,10 @@ def test_http_sse_round_trip_stats_and_drain():
         # ---- drain: stop() flips healthz, rejects new work with 503, and
         # serve_forever() returns the final stats once the engine loop exits
         server.stop()
+        server.slo_status_fn = lambda: ["ttft_p99"]  # draining outranks degraded
         status, health = _get(server.port, "/healthz")
         assert (status, health["status"]) == (200, "draining")
+        server.slo_status_fn = None
         status, _, err = _post_generate(server.port, {"prompt": "1 2"})
         assert status == 503 and "error" in err
 

@@ -42,6 +42,51 @@ def test_analyze_telemetry_json_over_single_file(tmp_path):
     assert summary["combined"]["goodput_pct"] == 75.0
 
 
+def test_analyze_telemetry_single_rank_prints_no_straggler_table(tmp_path):
+    """One rank has no peer to lag behind: the goodput table renders, the
+    straggler section is simply absent (not a degenerate self-comparison),
+    and the exit code stays 0."""
+    _write_sink(tmp_path, 0, [("train_step", 0.0, 4.0)])
+    result = CliRunner().invoke(cli_main, ["data", "analyze_telemetry", "--sink_path", str(tmp_path)])
+    assert result.exit_code == 0, result.output
+    assert "goodput" in result.output
+    assert "stragglers" not in result.output
+
+
+def test_analyze_telemetry_empty_sink_exits_clean(tmp_path):
+    """A sink from a run that died before its first span must not crash the
+    analyzer: empty file → clean table, exit 0; same for --as_json."""
+    (tmp_path / "telemetry_rank_0.jsonl").write_text("")
+    result = CliRunner().invoke(cli_main, ["data", "analyze_telemetry", "--sink_path", str(tmp_path)])
+    assert result.exit_code == 0, result.output
+    result = CliRunner().invoke(
+        cli_main, ["data", "analyze_telemetry", "--sink_path", str(tmp_path), "--as_json"]
+    )
+    assert result.exit_code == 0, result.output
+    summary = json.loads(result.output)
+    assert summary["stragglers"] == {} and summary["mfu_waterfall"] is None
+
+
+def test_analyze_telemetry_renders_the_mfu_waterfall(tmp_path):
+    sink = _write_sink(tmp_path, 0, [("train_step", 0.0, 8.0)])
+    with open(sink, "a") as f:
+        f.write(json.dumps({
+            "event": "mfu_waterfall", "peak": 1.0, "achieved": 0.4, "gap": 0.6,
+            "deductions": {"data_stall": 0.1, "compile": 0.05, "checkpoint_eval": 0.0,
+                           "collective_exposure": 0.0, "kernel_inefficiency": 0.35,
+                           "other": 0.1},
+        }) + "\n")
+    result = CliRunner().invoke(cli_main, ["data", "analyze_telemetry", "--sink_path", str(tmp_path)])
+    assert result.exit_code == 0, result.output
+    assert "MFU waterfall" in result.output
+    assert "- kernel_inefficiency" in result.output
+    assert "= achieved MFU" in result.output
+    result = CliRunner().invoke(
+        cli_main, ["data", "analyze_telemetry", "--sink_path", str(tmp_path), "--as_json"]
+    )
+    assert json.loads(result.output)["mfu_waterfall"]["achieved"] == 0.4
+
+
 def test_analyze_telemetry_tolerates_torn_tail_line(tmp_path):
     """A sink from a killed run may end mid-line — analysis must not crash."""
     sink = _write_sink(tmp_path, 0, [("train_step", 0.0, 2.0)])

@@ -121,11 +121,20 @@ ENTRY %main (a: f32[16]) -> f32[16] {
 # --------------------------------------------- the acceptance-criterion pin
 
 
-def test_perfscope_closure_on_the_cpu_dryrun_config():
+@pytest.fixture(scope="module")
+def dryrun_report():
+    """ONE lower+compile of the dryrun config's train step, shared by every
+    pin in this module — perfscope_for_config dominates this file's wall time,
+    so new consumers (the PR-15 waterfall pin) must ride this fixture instead
+    of recompiling."""
+    return perfscope_for_config(CONFIG)
+
+
+def test_perfscope_closure_on_the_cpu_dryrun_config(dryrun_report):
     """`data analyze_perfscope` acceptance pin, in-process (the CLI subprocess
     runs this same perfscope_for_config): the dryrun recipe's train step
     lowers, and every bucket cost sums to the module total."""
-    report = perfscope_for_config(CONFIG)
+    report = dryrun_report
     assert report["world_size"] == jax.device_count() == 8
     mod = report["executables"]["train_step"]
     _assert_closure(mod)
@@ -136,6 +145,35 @@ def test_perfscope_closure_on_the_cpu_dryrun_config():
     # the report round-trips through write_report and renders as a table
     table = format_perfscope_table(report)
     assert "train_step" in table and "matmul" in table
+
+
+def test_mfu_waterfall_closure_on_the_cpu_dryrun_config(dryrun_report):
+    """PR-15 acceptance pin: the MFU waterfall built from the dryrun config's
+    REAL perfscope collective fraction closes exactly — deductions sum to
+    peak - achieved as a float identity, every term non-negative."""
+    from modalities_tpu.telemetry.waterfall import (
+        DEDUCTIONS,
+        collective_fraction,
+        mfu_waterfall,
+    )
+
+    cf = collective_fraction(dryrun_report)
+    # the fsdp dryrun step HAS exposed collectives: the fraction is real
+    assert cf is not None and 0.0 < cf < 1.0
+    buckets = {
+        "init": 4.0, "compile_first_step": 9.0, "train_step": 80.0,
+        "data_stall": 3.0, "eval": 1.5, "checkpoint": 1.5, "publish": 0.5,
+        "other": 0.5,
+    }
+    waterfall = mfu_waterfall(0.41, 100.0, buckets, collective_frac=cf)
+    deductions = waterfall["deductions"]
+    assert set(deductions) == set(DEDUCTIONS)
+    assert sum(deductions.values()) == waterfall["gap"]  # EXACT, not approx
+    assert waterfall["peak"] - waterfall["achieved"] == waterfall["gap"]
+    assert all(v >= 0.0 for v in deductions.values())
+    # the in-step split used the report's fraction: both sides are charged
+    assert deductions["collective_exposure"] > 0.0
+    assert deductions["kernel_inefficiency"] > 0.0
 
 
 def test_write_report_is_atomic_and_json(tmp_path):

@@ -98,6 +98,50 @@ def test_sink_buckets_tile_wall_time_within_5pct(tmp_path):
     assert {"first_step", "train_step", "data_wait", "metrics_fetch", "publish"} <= names, names
 
 
+def test_slo_config_is_sampled_at_interval_publish_and_waterfall_lands(tmp_path):
+    """The trainer-side SLO seam (PR 15): an `slo:` block builds the engine
+    UNSTARTED (the trainer samples it at each interval publish, so training
+    verdicts are deterministic per interval), and publish_mfu_waterfall lands
+    achieved + per-cause deduction gauges plus a full-precision sink record
+    whose closure survives the JSON round trip."""
+    telemetry = Telemetry(
+        output_folder_path=tmp_path, watchdog_deadline_s=0,
+        slo={"objectives": [
+            # a floor the fake loop always clears: the pin is the SEAM (the
+            # ledger feeds the gauge, the publish samples the engine), not
+            # this run's incidental goodput number
+            {"name": "goodput_floor", "expr": "training_goodput_ratio >= 0.0"}
+        ]},
+    )
+    engine = telemetry.slo_engine
+    assert engine is not None and engine._thread is None  # built, NOT started
+    assert engine.status()["goodput_floor"]["last_value"] is None  # never sampled
+    _run_trainer(telemetry, step_sleep_s=0.01)
+    # the interval publish drove sample_once() AGAINST THE LEDGER-FED GAUGE:
+    # the sampled value is the run's own goodput ratio, and the verdict is live
+    sampled = engine.status()["goodput_floor"]["last_value"]
+    assert sampled is not None and 0.0 <= sampled <= 1.0
+    assert sampled == telemetry.metrics.get("training_goodput_ratio").value()
+    assert engine.breaching() == []
+    assert telemetry.metrics.get("slo_status").value(objective="goodput_floor") == 1.0
+
+    waterfall = telemetry.publish_mfu_waterfall(0.35)
+    assert telemetry.metrics.get("training_mfu_achieved").value() == waterfall["achieved"]
+    deduction = telemetry.metrics.get("training_mfu_waterfall_deduction")
+    assert sum(
+        deduction.value(cause=cause) for cause in waterfall["deductions"]
+    ) == waterfall["gap"]
+    telemetry.close()
+    rows = [
+        json.loads(ln) for ln in telemetry.sink_path.read_text().splitlines()
+        if '"mfu_waterfall"' in ln
+    ]
+    row = rows[-1]
+    assert row["event"] == "mfu_waterfall"
+    assert sum(row["deductions"].values()) == row["gap"]  # exact, post-JSON
+    assert row["peak"] - row["achieved"] == row["gap"]
+
+
 def test_first_step_classified_as_compile_bucket(tmp_path):
     telemetry = Telemetry(output_folder_path=tmp_path, watchdog_deadline_s=0)
     _run_trainer(telemetry, n_steps=4, step_sleep_s=0.02)

@@ -1,0 +1,122 @@
+"""MFU waterfall (telemetry/waterfall.py): exact closure under fuzzing,
+clamped allocation, the collective split, sink extraction, and the table
+renderer. The dryrun-config acceptance pin lives in test_perfscope.py (it
+shares that module's compiled report)."""
+
+import json
+import random
+
+import pytest
+
+from modalities_tpu.telemetry.waterfall import (
+    DEDUCTIONS,
+    collective_fraction,
+    format_waterfall_table,
+    last_waterfall_from_sink,
+    mfu_waterfall,
+)
+
+
+def test_closure_is_exact_under_fuzzing():
+    """sum(deductions) == gap and peak - achieved == gap as FLOAT IDENTITIES,
+    for arbitrary buckets/peaks — the dyadic-grid construction, not luck."""
+    rng = random.Random(7)
+    names = ("init", "compile_first_step", "train_step", "data_stall",
+             "eval", "checkpoint", "publish", "other")
+    for _ in range(500):
+        wall = rng.uniform(0.1, 1000.0)
+        buckets = {n: rng.uniform(0.0, wall / 3) for n in names}
+        peak = rng.uniform(0.1, 1.0)
+        waterfall = mfu_waterfall(
+            rng.uniform(0.0, peak * 1.2), wall, buckets, peak_mfu=peak,
+            collective_frac=rng.choice([None, rng.random()]),
+        )
+        deductions = waterfall["deductions"]
+        assert tuple(deductions) == DEDUCTIONS
+        assert sum(deductions.values()) == waterfall["gap"]
+        assert waterfall["peak"] - waterfall["achieved"] == waterfall["gap"]
+        assert all(v >= 0.0 for v in deductions.values())
+
+
+def test_wall_buckets_are_charged_at_peak_and_clamped_to_the_gap():
+    # 10% data stall at peak 1.0 → a 0.1 deduction when the gap allows it
+    w = mfu_waterfall(0.5, 100.0, {"data_stall": 10.0, "train_step": 90.0})
+    assert w["deductions"]["data_stall"] == pytest.approx(0.1, abs=1e-9)
+    # tiny gap: the stall's proposed 0.1 is clamped to the 0.05 remaining
+    w = mfu_waterfall(0.95, 100.0, {"data_stall": 10.0, "train_step": 90.0})
+    assert w["deductions"]["data_stall"] == w["gap"]
+    assert sum(w["deductions"].values()) == w["gap"]
+
+
+def test_compile_and_checkpoint_eval_merge_their_buckets():
+    buckets = {"init": 5.0, "compile_first_step": 5.0, "checkpoint": 3.0,
+               "eval": 7.0, "train_step": 80.0}
+    w = mfu_waterfall(0.2, 100.0, buckets)
+    assert w["deductions"]["compile"] == pytest.approx(0.1, abs=1e-9)  # (5+5)/100 at peak 1.0
+    assert w["deductions"]["checkpoint_eval"] == pytest.approx(0.1, abs=1e-9)  # (3+7)/100
+
+
+def test_collective_fraction_splits_the_in_step_gap():
+    buckets = {"train_step": 100.0}
+    # train_frac 1.0, peak 1.0, achieved 0.4: the whole 0.6 gap is in-step
+    w = mfu_waterfall(0.4, 100.0, buckets, collective_frac=0.25)
+    assert w["deductions"]["collective_exposure"] == pytest.approx(0.15, abs=1e-9)
+    assert w["deductions"]["kernel_inefficiency"] == pytest.approx(0.45, abs=1e-9)
+    assert w["deductions"]["other"] == 0.0
+    # no cost model: everything lands on kernel inefficiency
+    w = mfu_waterfall(0.4, 100.0, buckets, collective_frac=None)
+    assert w["deductions"]["collective_exposure"] == 0.0
+    assert w["deductions"]["kernel_inefficiency"] == pytest.approx(0.6, abs=1e-9)
+
+
+def test_unattributed_wall_time_lands_in_other():
+    # half the wall is covered by no bucket at all: nothing names that loss,
+    # so the residual "other" owns it instead of inflating a named cause
+    w = mfu_waterfall(0.2, 100.0, {"train_step": 50.0})
+    assert w["deductions"]["other"] > 0.0
+    assert sum(w["deductions"].values()) == w["gap"]
+
+
+def test_degenerate_inputs_stay_closed():
+    w = mfu_waterfall(0.5, 0.0, {}, peak_mfu=0.5)  # zero wall, zero gap
+    assert w["gap"] == 0.0 and sum(w["deductions"].values()) == 0.0
+    w = mfu_waterfall(1.4, 100.0, {"train_step": 100.0})  # achieved > peak clamps
+    assert w["achieved"] == 1.0 and w["gap"] == 0.0
+
+
+def test_collective_fraction_reads_a_perfscope_report():
+    report = {"executables": {"train_step": {"buckets": {
+        "matmul": {"est_time_s": 6.0},
+        "collective:dp_shard": {"est_time_s": 3.0},
+        "collective:tp": {"est_time_s": 1.0},
+    }}}}
+    assert collective_fraction(report) == 0.4
+    assert collective_fraction({}) is None
+    assert collective_fraction({"executables": {"train_step": {"buckets": {}}}}) is None
+
+
+def test_last_waterfall_from_sink_and_table_render(tmp_path):
+    rows = [
+        {"event": "span", "name": "train_step", "ts": 0.0, "dur_s": 1.0,
+         "self_s": 1.0, "thread": "MainThread", "timeline": True},
+        {"event": "mfu_waterfall", "peak": 1.0, "achieved": 0.2, "gap": 0.8,
+         "deductions": {"kernel_inefficiency": 0.8}},
+        {"event": "mfu_waterfall", "peak": 1.0, "achieved": 0.4, "gap": 0.6,
+         "deductions": {"data_stall": 0.1, "kernel_inefficiency": 0.5}},
+    ]
+    (tmp_path / "telemetry_rank_0.jsonl").write_text(
+        "\n".join(json.dumps(r) for r in rows) + "\n"
+    )
+    waterfall = last_waterfall_from_sink(tmp_path)  # the LAST record wins
+    assert waterfall["achieved"] == 0.4
+    table = format_waterfall_table(waterfall)
+    lines = table.splitlines()
+    assert lines[1].startswith("peak MFU")
+    assert lines[-1].startswith("= achieved MFU")
+    assert any(line.startswith("- data_stall") for line in lines)
+    # the level column walks from peak down to achieved
+    assert "0.4000" in lines[-1]
+
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    assert last_waterfall_from_sink(empty) is None

@@ -40,8 +40,12 @@ def test_bench_serve_smoke_emits_parseable_json_line():
     assert out["requests"] == 6
 
 
+@pytest.mark.slow  # ~25 s subprocess; quant numerics + the oracle gate are pinned fast
+# in-process by tests/serving/test_quant_serving.py (test_logit_oracle_gates_the_
+# fully_quantized_mode), and the bench_serve JSON-line contract stays pinned by
+# test_bench_serve_smoke_emits_parseable_json_line above
 def test_bench_serve_quant_smoke_runs_oracle_and_audits_pool():
-    """Fast tier-1 pin for the quantized path: the int8/int8 smoke completes on
+    """Quantized-path bench smoke: the int8/int8 smoke completes on
     one decode executable with a clean pool audit, reports the quant schema
     keys, and the inline logit oracle holds its gate."""
     out = _run("--smoke", "--quant-weights", "int8", "--quant-kv", "int8", timeout=300)
