@@ -58,7 +58,10 @@ def describe_topology(state_shardings) -> Optional[dict]:
         return None
     mesh = anchor.mesh
     mesh_axes = {name: int(size) for name, size in zip(mesh.axis_names, mesh.devices.shape)}
-    dp_degree = mesh_axes.get("dp_replicate", 1) * mesh_axes.get("dp_shard", 1)
+    # the dcn axis is data-parallel across slices: it multiplies the global
+    # batch striding exactly like dp_replicate/dp_shard do
+    num_slices = mesh_axes.get("dcn", 1)
+    dp_degree = num_slices * mesh_axes.get("dp_replicate", 1) * mesh_axes.get("dp_shard", 1)
 
     leaf_specs: dict[str, str] = {}
     leaves_with_paths = jax.tree_util.tree_flatten_with_path(state_shardings)[0]
@@ -72,6 +75,15 @@ def describe_topology(state_shardings) -> Optional[dict]:
         "mesh_axes": mesh_axes,
         "process_count": int(jax.process_count()),
         "device_count": int(mesh.devices.size),
+        # slice geometry for elastic multi-slice resume: a checkpoint written on
+        # a 2-slice pod restores onto 1 slice (or vice versa) through the same
+        # reshard path as any other dp resize — this block makes the slice
+        # change explicit in the elastic/reshard event instead of leaving it
+        # implied by a missing mesh axis
+        "slices": {
+            "num_slices": num_slices,
+            "devices_per_slice": int(mesh.devices.size) // num_slices,
+        },
         "leaf_specs": leaf_specs,
         "sampler_state": {
             # skip_num_global_samples is topology-free by construction; the dp
@@ -118,6 +130,13 @@ def diff_topology(saved: dict, current: dict) -> list[str]:
     for key in ("mesh_axes", "process_count", "device_count"):
         if saved.get(key) != current.get(key):
             mismatches.append(f"{key}: saved {saved.get(key)} != current {current.get(key)}")
+    # pre-slice records (version 1 without the block) diff as {} vs {...} only
+    # when the current mesh actually has > 1 slice — a single-slice restore of a
+    # single-slice checkpoint stays a clean match
+    saved_slices = (saved.get("slices") or {}).get("num_slices", 1)
+    current_slices = (current.get("slices") or {}).get("num_slices", 1)
+    if saved_slices != current_slices:
+        mismatches.append(f"num_slices: saved {saved_slices} != current {current_slices}")
     saved_specs = saved.get("leaf_specs") or {}
     current_specs = current.get("leaf_specs") or {}
     changed = sum(1 for k, v in current_specs.items() if k in saved_specs and saved_specs[k] != v)

@@ -77,6 +77,10 @@ class DeviceMeshConfig(BaseModel):
     enable_loss_parallel: Optional[bool] = False
     # ZeRO-1 optimizer-state sharding over dp_replicate (see running_env/device_mesh.py)
     zero_stage: Annotated[int, Field(strict=True, ge=0, le=1)] = 0
+    # cross-slice data parallelism over DCN: -1 auto-infers the degree from the
+    # devices' slice structure (multi-slice pods get the outer dcn axis, everything
+    # else resolves to 1); an explicit degree > 1 emulates multi-slice on one slice
+    dcn_parallel_degree: Annotated[int, Field(strict=True, ge=-1)] = -1
     world_size: Annotated[int, Field(strict=True, gt=0)]
 
 
@@ -166,6 +170,42 @@ class PipelinedModelConfig(BaseModel):
     batch_size: Optional[Annotated[int, Field(strict=True, ge=1)]] = None
     microbatch_size: Optional[Annotated[int, Field(strict=True, ge=1)]] = None
     num_virtual_stages: Optional[Annotated[int, Field(strict=True, ge=1)]] = None
+
+    @model_validator(mode="after")
+    def _validate_schedule_virtual_stages(self) -> "PipelinedModelConfig":
+        """Schedule/num_virtual_stages compatibility at CONFIG-build time: the same
+        rules parallel/pipeline_schedules.py enforces, surfaced before any component
+        is built (a bad YAML used to die as a ValueError deep inside trace time).
+        Unknown schedule names pass through — the model factory owns that error."""
+        name = self.pp_schedule_name.strip().lower()
+        if name in ("zbvzerobubble", "zb_v", "zbv_zero_bubble"):
+            name = "zbv"
+        if name in ("dualpipe_v", "dual_pipe_v", "scheduledualpipev"):
+            name = "dualpipev"
+        if name in ("zbv", "dualpipev") and self.num_virtual_stages not in (None, 1, 2):
+            raise ValueError(
+                f"pp_schedule_name: {self.pp_schedule_name!r} uses exactly 2 virtual "
+                f"chunks (the V shape); set num_virtual_stages to 2 or leave it unset "
+                f"(got num_virtual_stages: {self.num_virtual_stages})"
+            )
+        if name == "interleaved_1f1b" and (
+            self.num_virtual_stages is not None and self.num_virtual_stages < 2
+        ):
+            raise ValueError(
+                "pp_schedule_name: 'interleaved_1f1b' requires num_virtual_stages >= 2 "
+                f"(got num_virtual_stages: {self.num_virtual_stages})"
+            )
+        if (
+            name in ("gpipe", "1f1b")
+            and self.num_virtual_stages is not None
+            and self.num_virtual_stages != 1
+        ):
+            raise ValueError(
+                f"num_virtual_stages: {self.num_virtual_stages} requires "
+                f"pp_schedule_name: 'interleaved_1f1b' (got pp_schedule_name: "
+                f"{self.pp_schedule_name!r})"
+            )
+        return self
 
 
 class HuggingFacePretrainedModelConfig(BaseModel):
@@ -530,6 +570,10 @@ class XlaFlagsConfig(BaseModel):
 
     latency_hiding_scheduler: bool = True
     async_collectives: bool = True
+    # multi-slice: async fusion + scheduling for the cross-slice (DCN) grad
+    # all-reduce the hierarchical reduction emits once per step — off by default
+    # (single-slice runs have no DCN collective to overlap)
+    dcn_collective_overlap: bool = False
     all_gather_combine_threshold_bytes: Optional[Annotated[int, Field(strict=True, ge=0)]] = None
     reduce_scatter_combine_threshold_bytes: Optional[Annotated[int, Field(strict=True, ge=0)]] = None
     all_reduce_combine_threshold_bytes: Optional[Annotated[int, Field(strict=True, ge=0)]] = None

@@ -41,6 +41,10 @@ def default_logical_axis_rules(mesh_handle: DeviceMeshHandle, sequence_parallel:
     cp = "cp" if has("cp") else None
     pp = "pp" if has("pp") else None
 
+    # deliberately WITHOUT dcn: on a multi-slice mesh the train/eval steps run the
+    # model under jax.vmap(..., spmd_axis_name="dcn") over per-slice batch groups,
+    # and vmap prepends dcn onto every in-model sharding constraint itself — listing
+    # it here would double-assign the axis inside the vmapped region
     batch_axes = tuple(n for n in ("dp_replicate", "dp_shard") if n in axis_names)
 
     rules: list[tuple[str, Optional[str | tuple[str, ...]]]] = [
@@ -175,8 +179,12 @@ def constrain_activation(x, logical_axes, explicit: bool = False):
 
 ZERO_REPLICA_AXIS = "dp_replicate"
 # axes carrying model parallelism: adding the replica axis to a dim they shard would
-# entangle the update layout with TP/CP/PP resharding — never candidates
-_MODEL_PARALLEL_AXES = frozenset({"tp", "cp", "pp"})
+# entangle the update layout with TP/CP/PP resharding — never candidates. "dcn" is
+# listed for the same reason with sharper stakes: optimizer state sharded across
+# slices would put the (slow) cross-slice fabric inside every tx.update — ZeRO leaf
+# specs must NEVER carry dcn (params/moments replicate across slices; only the
+# once-per-step accumulated-grad reduction crosses DCN).
+_MODEL_PARALLEL_AXES = frozenset({"tp", "cp", "pp", "dcn"})
 
 
 def zero_partition_spec(
@@ -243,9 +251,13 @@ def zero_params_shardings(
 
 
 def batch_sharding(mesh_handle: DeviceMeshHandle) -> NamedSharding:
-    """Global batch: batch dim over (dp_replicate, dp_shard), seq dim over cp."""
+    """Global batch: batch dim over (dcn, dp_replicate, dp_shard), seq dim over cp.
+
+    dcn leads: on a multi-slice mesh each slice owns one contiguous block of the
+    global batch, so the per-slice training compute (train_step's vmap over dcn
+    groups) touches only resident rows — no cross-slice data movement."""
     axis_names = mesh_handle.axis_names
-    batch_axes = tuple(n for n in ("dp_replicate", "dp_shard") if n in axis_names)
+    batch_axes = tuple(n for n in ("dcn", "dp_replicate", "dp_shard") if n in axis_names)
     cp = "cp" if "cp" in axis_names and mesh_handle.degrees.get("cp", 1) > 1 else None
     return NamedSharding(mesh_handle.mesh, P(batch_axes if batch_axes else None, cp))
 

@@ -51,6 +51,16 @@ _ASYNC_COLLECTIVE_ARGS = (
     "--xla_tpu_data_parallel_opt_different_sized_ops=true",
 )
 
+# Multi-slice DCN overlap: the hierarchical reduction (training/train_step.py)
+# leaves exactly one accumulated-grad all-reduce crossing slices per optimizer
+# step; these flags make it asynchronous and fold it into the latency-hiding
+# schedule so the slow cross-slice hop hides under the next step's compute
+# instead of serializing after the microbatch loop.
+_DCN_OVERLAP_ARGS = (
+    "--xla_enable_async_all_reduce=true",
+    "--xla_tpu_enable_async_collective_fusion_fuse_all_reduce=true",
+)
+
 
 def backend_initialized() -> bool:
     """True when a jax backend already exists in this process — flags set after
@@ -81,6 +91,7 @@ class XlaPerformanceFlags:
         self,
         latency_hiding_scheduler: bool = True,
         async_collectives: bool = True,
+        dcn_collective_overlap: bool = False,
         all_gather_combine_threshold_bytes: Optional[int] = None,
         reduce_scatter_combine_threshold_bytes: Optional[int] = None,
         all_reduce_combine_threshold_bytes: Optional[int] = None,
@@ -89,6 +100,7 @@ class XlaPerformanceFlags:
     ):
         self.latency_hiding_scheduler = latency_hiding_scheduler
         self.async_collectives = async_collectives
+        self.dcn_collective_overlap = dcn_collective_overlap
         self.all_gather_combine_threshold_bytes = all_gather_combine_threshold_bytes
         self.reduce_scatter_combine_threshold_bytes = reduce_scatter_combine_threshold_bytes
         self.all_reduce_combine_threshold_bytes = all_reduce_combine_threshold_bytes
@@ -102,6 +114,8 @@ class XlaPerformanceFlags:
             args.extend(_LHS_ARGS)
         if self.async_collectives:
             args.extend(_ASYNC_COLLECTIVE_ARGS)
+        if self.dcn_collective_overlap:
+            args.extend(_DCN_OVERLAP_ARGS)
         thresholds = (
             ("all_gather", self.all_gather_combine_threshold_bytes),
             ("reduce_scatter", self.reduce_scatter_combine_threshold_bytes),

@@ -237,6 +237,7 @@ class Telemetry:
         self,
         mfu_achieved: float,
         collective_frac: Optional[float] = None,
+        dcn_collective_frac: Optional[float] = None,
     ) -> Optional[dict]:
         """Decompose the cumulative wall-clock MFU against the goodput ledger
         (telemetry/waterfall.py) and publish: `training_mfu_achieved` plus one
@@ -253,6 +254,7 @@ class Telemetry:
             wall_s=summary["wall_s"],
             buckets=summary["buckets"],
             collective_frac=collective_frac,
+            dcn_collective_frac=dcn_collective_frac,
         )
         self.metrics.gauge(
             "training_mfu_achieved", "Cumulative wall-clock MFU of the run"

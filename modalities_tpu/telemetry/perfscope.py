@@ -71,6 +71,15 @@ _COMP_START_RE = re.compile(r"^\s*(?:ENTRY\s+)?%?([\w.\-]+)\s+(?:\([^)]*\)\s*->|
 _CALLS_RE = re.compile(r"calls=%?([\w.\-]+)")
 _REPLICA_GROUPS_IOTA_RE = re.compile(r"replica_groups=\[(\d+),(\d+)\]")
 _REPLICA_GROUPS_LIT_RE = re.compile(r"replica_groups=\{\{([^}]*)\}")
+# full-geometry forms of the same attribute: the iota form with its source dims
+# and optional transpose, and the literal form with every group captured — the
+# multi-slice classifier expands these to explicit partition-id sets
+_REPLICA_GROUPS_IOTA_FULL_RE = re.compile(
+    r"replica_groups=\[(\d+),(\d+)\]<=\[([0-9,]+)\](?:T\(([0-9,]+)\))?"
+)
+_REPLICA_GROUPS_LIT_FULL_RE = re.compile(
+    r"replica_groups=\{(\{[^}]*\}(?:,\s*\{[^}]*\})*)\}"
+)
 _CONTRACT_RE = re.compile(r"lhs_contracting_dims=\{([0-9,]*)\}")
 _CUSTOM_TARGET_RE = re.compile(r'custom_call_target="([^"]*)"')
 
@@ -152,22 +161,91 @@ def _line_shapes(text: str) -> list[tuple[int, int, int]]:
     return out
 
 
+def _parse_replica_groups(line: str) -> Optional[list[list[int]]]:
+    """Explicit replica groups (lists of partition ids) from either HLO syntax.
+
+    The iota form ``[G,S]<=[d0,d1,..]T(perm)`` is expanded exactly: an iota over
+    prod(dims) partition ids, reshaped to ``dims``, transposed by ``perm``, and
+    regrouped row-major into G groups of S. Returns None when the line carries
+    no replica-group attribute (or an inconsistent one)."""
+    m = _REPLICA_GROUPS_IOTA_FULL_RE.search(line)
+    if m:
+        n_groups, group_size = int(m.group(1)), int(m.group(2))
+        dims = [int(d) for d in m.group(3).split(",") if d]
+        n = n_groups * group_size
+        if math.prod(dims) != n:
+            return None
+        perm = (
+            [int(i) for i in m.group(4).split(",") if i]
+            if m.group(4)
+            else list(range(len(dims)))
+        )
+        strides = [1] * len(dims)
+        for i in range(len(dims) - 2, -1, -1):
+            strides[i] = strides[i + 1] * dims[i + 1]
+        perm_dims = [dims[p] for p in perm]
+        perm_strides = [strides[p] for p in perm]
+        vals = []
+        for j in range(n):
+            rem, v = j, 0
+            for size, stride in zip(reversed(perm_dims), reversed(perm_strides)):
+                v += (rem % size) * stride
+                rem //= size
+            vals.append(v)
+        return [vals[g * group_size : (g + 1) * group_size] for g in range(n_groups)]
+    m = _REPLICA_GROUPS_LIT_FULL_RE.search(line)
+    if m:
+        return [
+            [int(x) for x in grp.split(",") if x.strip()]
+            for grp in re.findall(r"\{([^}]*)\}", m.group(1))
+        ]
+    return None
+
+
 def _collective_axis(line: str, mesh_axis_sizes: Optional[dict[str, int]]) -> str:
-    """Name the mesh axis a collective runs over by matching its replica-group
-    size against the mesh axis sizes; unmatched sizes keep a `size<g>` tag so
-    the bucket is still stable and greppable."""
-    group_size = None
-    m = _REPLICA_GROUPS_IOTA_RE.search(line)
-    if m:  # iota format [groups,size]<=[n]
-        group_size = int(m.group(2))
+    """Name the mesh axis a collective runs over.
+
+    Multi-slice geometry first: when the mesh has a ``dcn`` axis, the replica
+    groups are expanded to explicit partition-id sets and any group spanning
+    >= 2 dcn coordinates lands in the slow-fabric ``dcn`` bucket — regardless
+    of its size, because a size coincidence with an ICI axis must never hide a
+    cross-slice hop (`mesh_axis_sizes` must preserve mesh axis order; partition
+    ids unravel row-major over it, dcn outermost in the canonical order).
+    Intra-slice groups then match ICI axis sizes as before; unmatched sizes
+    keep a `size<g>` tag so the bucket is still stable and greppable."""
+    sizes = {k: int(v) for k, v in (mesh_axis_sizes or {}).items()}
+    groups = _parse_replica_groups(line)
+    if groups:
+        group_size = len(groups[0])
     else:
-        m = _REPLICA_GROUPS_LIT_RE.search(line)
-        if m:  # literal format {{0,1},{2,3}}: size of the first group
-            group_size = len([t for t in m.group(1).split(",") if t.strip()])
+        group_size = None
+        m = _REPLICA_GROUPS_IOTA_RE.search(line)
+        if m:  # iota format [groups,size]<=[n]
+            group_size = int(m.group(2))
+        else:
+            m = _REPLICA_GROUPS_LIT_RE.search(line)
+            if m:  # literal format {{0,1},{2,3}}: size of the first group
+                group_size = len([t for t in m.group(1).split(",") if t.strip()])
     if group_size is None or group_size <= 1:
         return "all"
-    for axis, size in sorted((mesh_axis_sizes or {}).items()):
-        if int(size) == group_size:
+    dcn_size = sizes.get("dcn", 1)
+    geometry_known = bool(groups) and dcn_size > 1
+    if geometry_known:
+        names = list(sizes)
+        dcn_stride = 1
+        for name in names[names.index("dcn") + 1 :]:
+            dcn_stride *= sizes[name]
+        crossing = any(
+            len({(d // dcn_stride) % dcn_size for d in g}) > 1
+            for g in groups
+            if len(g) > 1
+        )
+        if crossing:
+            return "dcn"
+    for axis, size in sorted(sizes.items()):
+        if axis == "dcn" and geometry_known:
+            continue  # geometry already proved these groups stay intra-slice
+        if size == group_size:
             return axis
     return f"size{group_size}"
 

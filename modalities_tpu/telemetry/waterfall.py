@@ -9,11 +9,18 @@ using perfscope's cost-model fractions; whatever the named causes cannot
 explain lands in ``other`` as an exact residual:
 
     peak − achieved == data_stall + compile + checkpoint_eval
-                       + collective_exposure + kernel_inefficiency + other
+                       + collective_exposure_ici + collective_exposure_dcn
+                       + kernel_inefficiency + other
 
 Each named deduction is clamped to the gap still unexplained (allocation
 order above), so every term is non-negative and the closure is exact — not
 approximately, but as an identity over floats by construction.
+
+Collective exposure is split by fabric: ``collective_exposure_ici`` is the
+within-slice share (fast interconnect), ``collective_exposure_dcn`` the
+cross-slice share (the slow fabric the hierarchical reduction pushes to one
+all-reduce per step) — perfscope's ``collective:dcn`` bucket vs the other
+``collective:*`` buckets, via ``collective_fractions``.
 """
 
 from __future__ import annotations
@@ -26,7 +33,8 @@ DEDUCTIONS = (
     "data_stall",
     "compile",
     "checkpoint_eval",
-    "collective_exposure",
+    "collective_exposure_ici",
+    "collective_exposure_dcn",
     "kernel_inefficiency",
     "other",
 )
@@ -38,6 +46,7 @@ def mfu_waterfall(
     buckets: Mapping[str, float],
     peak_mfu: float = 1.0,
     collective_frac: Optional[float] = None,
+    dcn_collective_frac: Optional[float] = None,
 ) -> dict:
     """Build the waterfall from a goodput bucket summary.
 
@@ -49,9 +58,13 @@ def mfu_waterfall(
         peak_mfu: the theoretical ceiling to decompose against (1.0 = the
             hardware peak the MFU is already normalized to).
         collective_frac: fraction of in-step device time the cost model
-            attributes to exposed collectives (``collective_fraction`` over a
+            attributes to exposed collectives (``collective_fractions`` over a
             perfscope report); None = unknown → the whole in-step gap is
             charged to kernel inefficiency.
+        dcn_collective_frac: the cross-slice (``collective:dcn``) share of
+            in-step device time — a subset of ``collective_frac``, clamped to
+            it; None or 0 on single-slice meshes → the whole collective
+            exposure is ICI.
 
     Returns dict with peak/achieved/gap and a ``deductions`` mapping whose
     values sum exactly to gap.
@@ -90,7 +103,9 @@ def mfu_waterfall(
     train_frac = frac("train_step")
     device_gap = max(train_frac * peak - achieved, 0.0)
     c = min(max(float(collective_frac), 0.0), 1.0) if collective_frac is not None else 0.0
-    proposed["collective_exposure"] = device_gap * c
+    d = min(max(float(dcn_collective_frac), 0.0), c) if dcn_collective_frac is not None else 0.0
+    proposed["collective_exposure_ici"] = device_gap * (c - d)
+    proposed["collective_exposure_dcn"] = device_gap * d
     proposed["kernel_inefficiency"] = device_gap * (1.0 - c)
 
     # Exact closure: allocate each named cause only up to the gap still
@@ -112,10 +127,12 @@ def mfu_waterfall(
     }
 
 
-def collective_fraction(report: Mapping) -> Optional[float]:
-    """Fraction of the train_step cost-model time in ``collective:*`` buckets
-    of a perfscope report (``perfscope_for_config`` shape). None when the
-    report has no usable train_step bucket breakdown."""
+def collective_fractions(report: Mapping) -> Optional[tuple[float, float]]:
+    """(total, dcn) collective fractions of the train_step cost-model time in
+    a perfscope report (``perfscope_for_config`` shape): total spans every
+    ``collective:*`` bucket, dcn only the cross-slice ``collective:dcn`` one
+    (always <= total; 0 on single-slice meshes). None when the report has no
+    usable train_step bucket breakdown."""
     try:
         step = report["executables"]["train_step"]
         bucket_rows = step["buckets"]
@@ -124,12 +141,21 @@ def collective_fraction(report: Mapping) -> Optional[float]:
     total = sum(float(row.get("est_time_s", 0.0)) for row in bucket_rows.values())
     if total <= 0.0:
         return None
-    exposed = sum(
-        float(row.get("est_time_s", 0.0))
-        for name, row in bucket_rows.items()
-        if name.startswith("collective:")
-    )
-    return min(exposed / total, 1.0)
+    exposed = dcn = 0.0
+    for name, row in bucket_rows.items():
+        if not name.startswith("collective:"):
+            continue
+        t = float(row.get("est_time_s", 0.0))
+        exposed += t
+        if name == "collective:dcn":
+            dcn += t
+    return min(exposed / total, 1.0), min(dcn / total, 1.0)
+
+
+def collective_fraction(report: Mapping) -> Optional[float]:
+    """Total collective fraction only (legacy shape of ``collective_fractions``)."""
+    fractions = collective_fractions(report)
+    return None if fractions is None else fractions[0]
 
 
 def last_waterfall_from_sink(sink_path) -> Optional[dict]:
@@ -156,11 +182,16 @@ def last_waterfall_from_sink(sink_path) -> Optional[dict]:
                 last = event
     if last is None:
         return None
+    deductions = dict(last.get("deductions") or {})
+    if "collective_exposure" in deductions and "collective_exposure_ici" not in deductions:
+        # pre-split sink records: the undifferentiated exposure was ICI-only
+        # (single-slice meshes were the only meshes then)
+        deductions["collective_exposure_ici"] = deductions.pop("collective_exposure")
     return {
         "peak": float(last.get("peak", 1.0)),
         "achieved": float(last.get("achieved", 0.0)),
         "gap": float(last.get("gap", 0.0)),
-        "deductions": dict(last.get("deductions") or {}),
+        "deductions": deductions,
     }
 
 

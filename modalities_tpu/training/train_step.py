@@ -239,6 +239,67 @@ class TrainStepBuilder:
             if zero_active
             else None
         )
+
+        # --- multi-slice hierarchical gradient reduction (dcn axis present).
+        # Each microbatch is reshaped into [dcn, mb/dcn, ...] per-slice groups and
+        # the loss/grad computation runs under jax.vmap(spmd_axis_name="dcn"), so
+        # every in-model collective stays within a slice on ICI (the per-microbatch
+        # grad reduction — the ZeRO reduce-scatter included — has within-slice
+        # replica groups). The gradient accumulator carries a leading dcn dim
+        # constrained P("dcn", ...) through the scan; the mean over that dim AFTER
+        # the scan is the ONE point where accumulated grads cross DCN per optimizer
+        # step — GSPMD lowers it to cross-slice all-reduces outside the microbatch
+        # loop (pinned by tests/training/test_dcn_hierarchical.py). The loss rides
+        # the carry as a per-group [dcn] vector for the same reason: a scalar mean
+        # inside the loop body would emit a per-microbatch DCN collective.
+        dcn_degree = mesh_handle.dcn_degree if mesh_handle is not None else 1
+        hierarchical_dcn = dcn_degree > 1
+        dcn_grad_shardings = dcn_loss_sharding = to_dcn_groups = None
+        if hierarchical_dcn:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            dcn_mesh = mesh_handle.mesh
+            acc_base = zero_grad_shardings if zero_active else param_shardings
+            dcn_grad_shardings = jax.tree.map(
+                lambda s: NamedSharding(dcn_mesh, P("dcn", *tuple(s.spec))), acc_base
+            )
+            dcn_loss_sharding = NamedSharding(dcn_mesh, P("dcn"))
+            data_spec = tuple(data_sharding.spec)
+            inner_batch_axes = tuple(a for a in (data_spec[0] or ()) if a != "dcn")
+            dcn_seq_axis = data_spec[1] if len(data_spec) > 1 else None
+            dcn_seq_keys = {
+                k
+                for k in (
+                    getattr(self.model, "sample_key", None),
+                    getattr(self.loss_fn, "target_key", None),
+                )
+                if k is not None
+            }
+
+            def to_dcn_groups(batch_tree):
+                """[mb, ...] leaves -> [dcn, mb/dcn, ...] per-slice groups, with the
+                same per-leaf layout put_batch established (token leaves keep cp on
+                the seq dim) so the constraint is a relabel, not a reshard."""
+
+                def one(path, x):
+                    if x.shape[0] % dcn_degree:
+                        raise ValueError(
+                            f"batch dim {x.shape[0]} of leaf "
+                            f"{jax.tree_util.keystr(path)} is not divisible by "
+                            f"dcn_parallel_degree {dcn_degree}: every slice must own "
+                            "an equal share of each microbatch"
+                        )
+                    g = x.reshape(dcn_degree, x.shape[0] // dcn_degree, *x.shape[1:])
+                    leaf_key = getattr(path[-1], "key", None) if path else None
+                    tail = [None] * (g.ndim - 2)
+                    if g.ndim == 3 and leaf_key in dcn_seq_keys:
+                        tail[0] = dcn_seq_axis
+                    return jax.lax.with_sharding_constraint(
+                        g, NamedSharding(dcn_mesh, P("dcn", inner_batch_axes, *tail))
+                    )
+
+                return jax.tree_util.tree_map_with_path(one, batch_tree)
+
         schedule = self.scheduler_spec.absolute_lr_schedule() if self.scheduler_spec is not None else None
         tx = self.optimizer_spec.build(abstract_params, schedule)
         from modalities_tpu.training.gradient_clipping import (
@@ -465,10 +526,33 @@ class TrainStepBuilder:
                 def micro(acc, xs):
                     mb_index, s, t = xs
                     dropout_rng = jax.random.fold_in(step_rng, mb_index)
-                    loss, grads = loss_and_grads(state.params, s, t, dropout_rng)
                     g_acc, l_acc = acc
+                    if hierarchical_dcn:
+                        # per-slice groups: each slice computes grads over its own
+                        # batch rows; all in-model collectives stay intra-slice
+                        # (spmd_axis_name prepends dcn to every internal constraint)
+                        s, t = to_dcn_groups(s), to_dcn_groups(t)
+                        group_rngs = jax.vmap(
+                            lambda i: jax.random.fold_in(dropout_rng, i)
+                        )(jnp.arange(dcn_degree))
+                        loss, grads = jax.vmap(
+                            loss_and_grads,
+                            in_axes=(None, 0, 0, 0),
+                            spmd_axis_name="dcn",
+                        )(state.params, s, t, group_rngs)
+                        loss = jax.lax.with_sharding_constraint(loss, dcn_loss_sharding)
+                    else:
+                        loss, grads = loss_and_grads(state.params, s, t, dropout_rng)
                     # accumulate in reduce_dtype (fp32 by default) even when grads are bf16
                     g_acc = jax.tree.map(lambda a, g: a + g.astype(reduce_dtype), g_acc, grads)
+                    if hierarchical_dcn:
+                        # per-group partial sums keep the dcn dim sharded in place —
+                        # NO cross-slice reduction inside the microbatch loop
+                        g_acc = jax.lax.with_sharding_constraint(g_acc, dcn_grad_shardings)
+                        l_acc = jax.lax.with_sharding_constraint(
+                            l_acc + loss, dcn_loss_sharding
+                        )
+                        return (g_acc, l_acc), None
                     if zero_grad_shardings is not None:
                         # each microbatch's partial-sum grads reshard into the ZeRO
                         # layout here — this is the constraint GSPMD lowers to the
@@ -477,14 +561,38 @@ class TrainStepBuilder:
                         g_acc = jax.lax.with_sharding_constraint(g_acc, zero_grad_shardings)
                     return (g_acc, l_acc + loss), None
 
-                zero_grads = jax.tree.map(lambda p: jnp.zeros(p.shape, reduce_dtype), state.params)
-                if zero_grad_shardings is not None:
-                    zero_grads = jax.lax.with_sharding_constraint(zero_grads, zero_grad_shardings)
+                if hierarchical_dcn:
+                    zero_grads = jax.tree.map(
+                        lambda p: jnp.zeros((dcn_degree, *p.shape), reduce_dtype), state.params
+                    )
+                    zero_grads = jax.lax.with_sharding_constraint(zero_grads, dcn_grad_shardings)
+                    loss_init = jax.lax.with_sharding_constraint(
+                        jnp.zeros((dcn_degree,), jnp.float32), dcn_loss_sharding
+                    )
+                else:
+                    zero_grads = jax.tree.map(lambda p: jnp.zeros(p.shape, reduce_dtype), state.params)
+                    if zero_grad_shardings is not None:
+                        zero_grads = jax.lax.with_sharding_constraint(zero_grads, zero_grad_shardings)
+                    loss_init = 0.0
                 (grads, loss_sum), _ = jax.lax.scan(
-                    micro, (zero_grads, 0.0), (jnp.arange(acc_steps), samples, targets)
+                    micro, (zero_grads, loss_init), (jnp.arange(acc_steps), samples, targets)
                 )
-                grads = jax.tree.map(lambda g, p: (g / acc_steps).astype(p.dtype), grads, state.params)
-                loss = loss_sum / acc_steps
+                if hierarchical_dcn:
+                    # THE hierarchical-reduction crossing point: the mean over the
+                    # dcn group dim reduces the fully-accumulated grads across
+                    # slices once per optimizer step, outside the scan body
+                    grads = jax.tree.map(
+                        lambda g, p: (g.mean(axis=0) / acc_steps).astype(p.dtype),
+                        grads,
+                        state.params,
+                    )
+                    grads = jax.lax.with_sharding_constraint(
+                        grads, zero_grad_shardings if zero_grad_shardings is not None else param_shardings
+                    )
+                    loss = loss_sum.mean() / acc_steps
+                else:
+                    grads = jax.tree.map(lambda g, p: (g / acc_steps).astype(p.dtype), grads, state.params)
+                    loss = loss_sum / acc_steps
 
                 if nan_grads_fault is not None:
                     poison = (
@@ -552,15 +660,31 @@ class TrainStepBuilder:
 
         if chunked_loss:
 
+            def eval_loss(params, samples, targets):
+                hidden = model.apply_hidden(params, samples, train=False)
+                return _chunked_ce(params, hidden, targets[loss_fn.target_key])
+
+        else:
+
+            def eval_loss(params, samples, targets):
+                predictions = model.apply(params, samples, train=False)
+                return loss_fn(predictions, targets)
+
+        if hierarchical_dcn:
+            # same per-slice grouping as the train path: eval activations stay
+            # intra-slice and only the final scalar mean crosses DCN
             def eval_step(state: AppState, batch: dict) -> dict:
-                hidden = model.apply_hidden(state.params, batch["samples"], train=False)
-                return {"loss": _chunked_ce(state.params, hidden, batch["targets"][loss_fn.target_key])}
+                samples = to_dcn_groups(batch["samples"])
+                targets = to_dcn_groups(batch["targets"])
+                losses = jax.vmap(
+                    eval_loss, in_axes=(None, 0, 0), spmd_axis_name="dcn"
+                )(state.params, samples, targets)
+                return {"loss": losses.mean()}
 
         else:
 
             def eval_step(state: AppState, batch: dict) -> dict:
-                predictions = model.apply(state.params, batch["samples"], train=False)
-                return {"loss": loss_fn(predictions, batch["targets"])}
+                return {"loss": eval_loss(state.params, batch["samples"], batch["targets"])}
 
         if mesh_handle is not None:
             mesh = mesh_handle.mesh

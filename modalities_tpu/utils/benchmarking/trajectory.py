@@ -8,10 +8,13 @@ hand mid-incident — this module folds them into one trend table with each
 round explicitly classified:
 
 - ``ok``        the round produced a metric (BENCH) / passed (MULTICHIP)
-- ``wedged``    rc=124: the harness timeout killed it (VERDICT r5 — a wedged
-                TPU probe, not a code failure)
-- ``no_metric`` rc=0 but nothing parsed — the run completed without reaching
-                the measurement (a distinct failure flavor from wedged)
+- ``wedged``    rc=124 (the harness timeout killed it) OR ``parsed: null``
+                with a tail that names a wedge — the rounds-4/5 shape where
+                the TPU probe wedged (VERDICT r5: a wedged probe, not a code
+                failure; the retry loop can surface it under any rc)
+- ``no_metric`` rc=0 but nothing parsed and no wedge in the tail — the run
+                completed without reaching the measurement (a distinct
+                failure flavor from wedged)
 - ``failed``    nonzero rc other than the timeout's
 - ``skipped``   the round declared itself not applicable
 
@@ -29,6 +32,11 @@ from typing import Optional
 _ROUND_RE = re.compile(r"_r(\d+)\.json$")
 
 _TIMEOUT_RC = 124  # the driver wraps rounds in `timeout`
+
+# the probe's own wedge report in the artifact tail ("TPU probe attempt N
+# wedged; retrying ...") — the rc depends on which layer gave up first, the
+# tail marker does not
+_WEDGE_TAIL_RE = re.compile(r"\bwedged\b", re.IGNORECASE)
 
 
 def _round_of(path: Path) -> int:
@@ -54,10 +62,11 @@ def _classify(data: Optional[dict], kind: str) -> str:
     if not isinstance(data, dict):
         return "failed"
     rc = data.get("rc")
+    wedge_tail = bool(_WEDGE_TAIL_RE.search(data.get("tail") or ""))
     if kind == "bench":
         if data.get("parsed") is not None:
             return "ok"
-        if rc == _TIMEOUT_RC:
+        if rc == _TIMEOUT_RC or wedge_tail:
             return "wedged"
         return "no_metric" if rc == 0 else "failed"
     # multichip
@@ -65,7 +74,7 @@ def _classify(data: Optional[dict], kind: str) -> str:
         return "skipped"
     if data.get("ok"):
         return "ok"
-    return "wedged" if rc == _TIMEOUT_RC else "failed"
+    return "wedged" if rc == _TIMEOUT_RC or wedge_tail else "failed"
 
 
 def summarize_trajectory(folder) -> dict:

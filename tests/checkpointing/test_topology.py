@@ -30,6 +30,11 @@ def _mesh(n_devices):
     return Mesh(devices, ("dp_shard",))
 
 
+def _dcn_mesh(num_slices=2, dp_shard=4):
+    devices = np.array(jax.devices()[: num_slices * dp_shard]).reshape(num_slices, dp_shard)
+    return Mesh(devices, ("dcn", "dp_shard"))
+
+
 def _state_and_shardings(mesh):
     sharded = NamedSharding(mesh, PartitionSpec("dp_shard"))
     replicated = NamedSharding(mesh, PartitionSpec())
@@ -68,6 +73,27 @@ def test_topology_round_trip_and_self_diff(tmp_path):
     assert saved["sampler_state"]["skip_semantics"] == "global"
     assert any("params" in k and "w" in k for k in saved["leaf_specs"])
     assert diff_topology(saved, describe_topology(shardings)) == []
+
+
+def test_topology_records_slice_geometry(tmp_path):
+    """A multi-slice mesh's record carries the slice block explicitly and folds
+    the dcn axis into the sampler dp_degree (dcn IS data parallelism: the global
+    batch strides across slices exactly like it strides across dp_shard)."""
+    _, shardings = _state_and_shardings(_dcn_mesh(2, 4))
+    record = describe_topology(shardings)
+    assert record["mesh_axes"] == {"dcn": 2, "dp_shard": 4}
+    assert record["slices"] == {"num_slices": 2, "devices_per_slice": 4}
+    assert record["sampler_state"]["dp_degree"] == 8  # dcn * dp_shard
+    # single-slice record: slices block present, degree unchanged
+    _, single = _state_and_shardings(_mesh(8))
+    single_record = describe_topology(single)
+    assert single_record["slices"] == {"num_slices": 1, "devices_per_slice": 8}
+    # the 2-slice -> 1-slice resize is named explicitly in the diff
+    mismatches = diff_topology(record, single_record)
+    assert any("num_slices: saved 2 != current 1" in m for m in mismatches)
+    # a legacy record (no slices block) vs a current single-slice mesh is clean
+    legacy = {k: v for k, v in single_record.items() if k != "slices"}
+    assert diff_topology(legacy, single_record) == []
 
 
 def test_topology_diff_reports_mesh_change(tmp_path):
@@ -112,6 +138,27 @@ def test_reshard_at_load_restores_on_smaller_mesh(tmp_path):
     np.testing.assert_array_equal(np.asarray(restored.opt_state["m"]), np.ones(16, dtype=np.float32))
     assert int(restored.step) == 3
     assert restored.params["w"].sharding.mesh.devices.size == 4
+
+
+def test_two_slice_checkpoint_restores_on_single_slice_mesh(tmp_path):
+    """Elastic multi-slice resume: a checkpoint written under a dcn2 x dp4 mesh
+    restores onto a single-slice dp8 mesh with every value exact — the slice
+    resize is just another topology mismatch riding the same reshard path."""
+    state_dcn, shardings_dcn = _state_and_shardings(_dcn_mesh(2, 4))
+    folder = _save_checkpoint(tmp_path, state_dcn)
+    write_topology(folder, shardings_dcn)
+    assert read_topology(folder)["slices"]["num_slices"] == 2
+    write_manifest(folder)
+
+    state_8, shardings_8 = _state_and_shardings(_mesh(8))
+    handle = AppStateHandle(state_8, shardings_8, tx=None, lr_fn=None, model=None)
+    before = snapshot_counts()
+    restored = OrbaxCheckpointLoading(elastic=True).load_app_state(handle, folder)
+    assert counts_since(before).get("elastic", 0) == 1
+    np.testing.assert_array_equal(np.asarray(restored.params["w"]), np.arange(16, dtype=np.float32))
+    np.testing.assert_array_equal(np.asarray(restored.opt_state["m"]), np.ones(16, dtype=np.float32))
+    assert int(restored.step) == 3
+    assert "dcn" not in restored.params["w"].sharding.mesh.axis_names
 
 
 def test_reshard_downgrades_manifest_failure_to_event(tmp_path):

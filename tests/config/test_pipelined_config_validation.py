@@ -1,0 +1,49 @@
+"""Config-time pipeline schedule validation (config/config.py
+PipelinedModelConfig): schedule/num_virtual_stages incompatibilities die when
+the YAML is validated, with a message naming the offending knob — not as a
+ValueError deep inside trace time (parallel/pipeline_schedules.py keeps the
+same rules as the runtime backstop)."""
+
+import pytest
+from pydantic import ValidationError
+
+from modalities_tpu.config.config import PipelinedModelConfig
+from tests.models.test_gpt2_model import tiny_gpt2
+
+
+@pytest.fixture(scope="module")
+def model():
+    return tiny_gpt2("manual")
+
+
+def test_v_schedules_reject_incompatible_num_virtual(model):
+    for name in ("zbv", "dualpipev", "ZBVZeroBubble", "dual_pipe_v"):
+        with pytest.raises(ValidationError, match="num_virtual_stages"):
+            PipelinedModelConfig(model=model, pp_schedule_name=name, num_virtual_stages=4)
+    # the V shape is 2 chunks; None (auto), 1 and 2 all validate
+    for nv in (None, 1, 2):
+        PipelinedModelConfig(model=model, pp_schedule_name="zbv", num_virtual_stages=nv)
+        PipelinedModelConfig(model=model, pp_schedule_name="dualpipev", num_virtual_stages=nv)
+
+
+def test_interleaved_requires_at_least_two_virtual_stages(model):
+    with pytest.raises(ValidationError, match="num_virtual_stages >= 2"):
+        PipelinedModelConfig(
+            model=model, pp_schedule_name="interleaved_1f1b", num_virtual_stages=1
+        )
+    PipelinedModelConfig(model=model, pp_schedule_name="interleaved_1f1b", num_virtual_stages=2)
+    PipelinedModelConfig(model=model, pp_schedule_name="interleaved_1f1b")  # auto
+
+
+def test_flat_schedules_reject_virtual_stages(model):
+    for name in ("gpipe", "1f1b"):
+        with pytest.raises(ValidationError, match="interleaved_1f1b"):
+            PipelinedModelConfig(model=model, pp_schedule_name=name, num_virtual_stages=2)
+        PipelinedModelConfig(model=model, pp_schedule_name=name, num_virtual_stages=1)
+        PipelinedModelConfig(model=model, pp_schedule_name=name)
+
+
+def test_unknown_schedule_names_pass_through(model):
+    # the model factory owns the unknown-schedule error; the validator must not
+    # preempt it (forward compat with schedules it does not know)
+    PipelinedModelConfig(model=model, pp_schedule_name="some_future_schedule")

@@ -71,6 +71,9 @@ def tiny_coca():
     )
 
 
+@pytest.mark.slow  # ~7 s init; the ViT forward stays pinned fast by
+# test_vit_encoder_mode_shapes below (same tower, no head) and by
+# test_coca_forward_shapes (a ViT tower embedded in CoCa)
 def test_vit_classification_shapes():
     model = tiny_vit()
     params = model.init_params(jax.random.PRNGKey(0))
@@ -98,6 +101,9 @@ def test_coca_forward_shapes():
     assert out["text_cls"].shape == (2, 64)
 
 
+@pytest.mark.slow  # ~21 s; coca family — test_coca_forward_shapes keeps the
+# CoCa forward contract in tier-1 (grad/train machinery is pinned model-agnostically
+# by tests/training/test_train_step.py::test_loss_decreases_dp)
 def test_coca_trains_with_nce_plus_ce():
     """Captioning CE + contrastive NCE both produce finite grads (CoCa loss recipe)."""
     import optax

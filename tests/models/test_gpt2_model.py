@@ -71,6 +71,9 @@ def test_attention_impl_equivalence():
     )
 
 
+@pytest.mark.slow  # ~9 s (two model inits); tier equivalence stays pinned fast
+# at op level by test_attention_impl_equivalence above, and the tiers' shared
+# dropout path by test_manual_and_sdpa_tiers_share_attn_dropout_path below
 def test_model_level_attention_tier_equivalence():
     m1 = tiny_gpt2("manual")
     m2 = tiny_gpt2("pytorch_flash")

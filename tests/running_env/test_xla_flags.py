@@ -42,6 +42,23 @@ def test_knobs_gate_their_arg_groups():
     assert not any("all_reduce_combine" in a for a in libtpu)
 
 
+def test_dcn_collective_overlap_gates_async_all_reduce():
+    # off by default: single-slice runs keep the all-reduce synchronous (the
+    # data-parallel all-reduce opt in the async group already covers ICI)
+    default_args = XlaPerformanceFlags().libtpu_args()
+    assert not any("async_all_reduce" in a for a in default_args)
+
+    libtpu = XlaPerformanceFlags(dcn_collective_overlap=True).libtpu_args()
+    assert "--xla_enable_async_all_reduce=true" in libtpu
+    assert "--xla_tpu_enable_async_collective_fusion_fuse_all_reduce=true" in libtpu
+    # the knob adds the DCN group on top of the defaults, not instead of them
+    assert all(a in libtpu for a in default_args)
+
+    cfg = XlaFlagsConfig()
+    assert cfg.dcn_collective_overlap is False
+    assert XlaFlagsConfig(dcn_collective_overlap=True).dcn_collective_overlap is True
+
+
 def test_operator_environment_wins():
     # pre-existing values are appended AFTER the assembled args; both the libtpu
     # and XLA_FLAGS parsers give later flags precedence

@@ -123,6 +123,10 @@ def test_spec_requires_paged_cache(model, params):
 # ------------------------------------------------ greedy identity + pinning
 
 
+@pytest.mark.slow  # ~8 s extra engine; spec bitwise identity (greedy accept path
+# included) stays pinned fast by
+# test_spec_mixed_batch_bitwise_with_eod_and_sampled_rider below — this adds the
+# mid-draft budget clamp + executable-count accounting on top
 def test_spec_greedy_solo_bitwise_with_budget_clamp(model, params, ref):
     """ISSUE acceptance: greedy spec decode == interactive path token for
     token; a second request on the SAME engine whose budget cuts an accepted
@@ -180,7 +184,7 @@ def test_spec_mixed_batch_bitwise_with_eod_and_sampled_rider(model, params, ref)
 
 @pytest.mark.slow  # ~4 s extra engine; the preemption mechanics stay pinned
 # fast by test_pool_exhaustion_preempts_youngest_and_requeues and spec identity
-# by the two tier-1 tests above
+# by the mixed-batch tier-1 test above
 def test_spec_preemption_replays_bitwise(model, params, ref):
     """Pool exhaustion preempts a speculating slot: on re-admission the pure
     drafter re-proposes from the identical context and the greedy trajectory

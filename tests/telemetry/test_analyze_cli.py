@@ -84,7 +84,11 @@ def test_analyze_telemetry_renders_the_mfu_waterfall(tmp_path):
     result = CliRunner().invoke(
         cli_main, ["data", "analyze_telemetry", "--sink_path", str(tmp_path), "--as_json"]
     )
-    assert json.loads(result.output)["mfu_waterfall"]["achieved"] == 0.4
+    waterfall = json.loads(result.output)["mfu_waterfall"]
+    assert waterfall["achieved"] == 0.4
+    # the pre-split sink record's exposure key folded into the ICI bucket
+    assert waterfall["deductions"]["collective_exposure_ici"] == 0.0
+    assert "collective_exposure" not in waterfall["deductions"]
 
 
 def test_analyze_telemetry_tolerates_torn_tail_line(tmp_path):

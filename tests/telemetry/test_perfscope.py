@@ -76,6 +76,35 @@ def test_collective_bucket_is_keyed_by_mesh_axis():
     assert "collective:tp" in collective
 
 
+def test_collective_axis_classifies_dcn_crossing_groups_by_geometry():
+    """Multi-slice classification: on a dcn2 x dp_shard4 mesh (partition id =
+    slice * 4 + local), a group spanning two slices lands in `collective:dcn`
+    even when its size coincides with an ICI axis, while the intra-slice
+    all-reduce keeps its axis bucket — and bucket sums still close."""
+    hlo = """
+HloModule dcn_test
+
+ENTRY %main (a: f32[16]) -> f32[16] {
+  %a = f32[16] parameter(0)
+  %intra = f32[16] all-reduce(f32[16] %a), replica_groups={{0,1,2,3},{4,5,6,7}}, to_apply=%add
+  %cross = f32[16] all-reduce(f32[16] %intra), replica_groups=[4,2]<=[2,4]T(1,0), to_apply=%add
+  ROOT %r = f32[16] add(f32[16] %cross, f32[16] %a)
+}
+"""
+    sizes = {"dcn": 2, "dp_shard": 4}  # dict order == mesh axis order, dcn outer
+    report = analyze_hlo_text(hlo, mesh_axis_sizes=sizes)
+    _assert_closure(report)
+    assert report["buckets"]["collective:dp_shard"]["ops"] == 1
+    # the iota form [4,2]<=[2,4]T(1,0) pairs {0,4},{1,5},... — each group
+    # spans both slices, so it is dcn despite being size 2
+    assert report["buckets"]["collective:dcn"]["ops"] == 1
+    # same module on a single-slice mesh: no geometry check, size matching only
+    single = analyze_hlo_text(hlo, mesh_axis_sizes={"dcn": 1, "dp_shard": 4, "tp": 2})
+    assert "collective:dcn" not in single["buckets"]
+    assert single["buckets"]["collective:dp_shard"]["ops"] == 1
+    assert single["buckets"]["collective:tp"]["ops"] == 1
+
+
 def test_fusion_double_count_rule_splits_flops_and_bytes():
     """A fused computation: the fusion instruction carries bytes but no flops,
     its inner ops flops but no bytes — each side counted exactly once."""
@@ -153,26 +182,32 @@ def test_mfu_waterfall_closure_on_the_cpu_dryrun_config(dryrun_report):
     peak - achieved as a float identity, every term non-negative."""
     from modalities_tpu.telemetry.waterfall import (
         DEDUCTIONS,
-        collective_fraction,
+        collective_fractions,
         mfu_waterfall,
     )
 
-    cf = collective_fraction(dryrun_report)
+    fractions = collective_fractions(dryrun_report)
     # the fsdp dryrun step HAS exposed collectives: the fraction is real
-    assert cf is not None and 0.0 < cf < 1.0
+    assert fractions is not None
+    cf, dcn_cf = fractions
+    assert 0.0 < cf < 1.0
+    assert dcn_cf == 0.0  # single-slice dryrun mesh: nothing crosses DCN
     buckets = {
         "init": 4.0, "compile_first_step": 9.0, "train_step": 80.0,
         "data_stall": 3.0, "eval": 1.5, "checkpoint": 1.5, "publish": 0.5,
         "other": 0.5,
     }
-    waterfall = mfu_waterfall(0.41, 100.0, buckets, collective_frac=cf)
+    waterfall = mfu_waterfall(
+        0.41, 100.0, buckets, collective_frac=cf, dcn_collective_frac=dcn_cf
+    )
     deductions = waterfall["deductions"]
     assert set(deductions) == set(DEDUCTIONS)
     assert sum(deductions.values()) == waterfall["gap"]  # EXACT, not approx
     assert waterfall["peak"] - waterfall["achieved"] == waterfall["gap"]
     assert all(v >= 0.0 for v in deductions.values())
     # the in-step split used the report's fraction: both sides are charged
-    assert deductions["collective_exposure"] > 0.0
+    assert deductions["collective_exposure_ici"] > 0.0
+    assert deductions["collective_exposure_dcn"] == 0.0
     assert deductions["kernel_inefficiency"] > 0.0
 
 

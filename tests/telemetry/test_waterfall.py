@@ -11,6 +11,7 @@ import pytest
 from modalities_tpu.telemetry.waterfall import (
     DEDUCTIONS,
     collective_fraction,
+    collective_fractions,
     format_waterfall_table,
     last_waterfall_from_sink,
     mfu_waterfall,
@@ -30,6 +31,7 @@ def test_closure_is_exact_under_fuzzing():
         waterfall = mfu_waterfall(
             rng.uniform(0.0, peak * 1.2), wall, buckets, peak_mfu=peak,
             collective_frac=rng.choice([None, rng.random()]),
+            dcn_collective_frac=rng.choice([None, rng.random()]),
         )
         deductions = waterfall["deductions"]
         assert tuple(deductions) == DEDUCTIONS
@@ -60,13 +62,32 @@ def test_collective_fraction_splits_the_in_step_gap():
     buckets = {"train_step": 100.0}
     # train_frac 1.0, peak 1.0, achieved 0.4: the whole 0.6 gap is in-step
     w = mfu_waterfall(0.4, 100.0, buckets, collective_frac=0.25)
-    assert w["deductions"]["collective_exposure"] == pytest.approx(0.15, abs=1e-9)
+    # no dcn fraction: the whole collective share is ICI
+    assert w["deductions"]["collective_exposure_ici"] == pytest.approx(0.15, abs=1e-9)
+    assert w["deductions"]["collective_exposure_dcn"] == 0.0
     assert w["deductions"]["kernel_inefficiency"] == pytest.approx(0.45, abs=1e-9)
     assert w["deductions"]["other"] == 0.0
     # no cost model: everything lands on kernel inefficiency
     w = mfu_waterfall(0.4, 100.0, buckets, collective_frac=None)
-    assert w["deductions"]["collective_exposure"] == 0.0
+    assert w["deductions"]["collective_exposure_ici"] == 0.0
+    assert w["deductions"]["collective_exposure_dcn"] == 0.0
     assert w["deductions"]["kernel_inefficiency"] == pytest.approx(0.6, abs=1e-9)
+
+
+def test_dcn_fraction_splits_collective_exposure_by_fabric():
+    buckets = {"train_step": 100.0}
+    # 25% collectives, 10% of the step on DCN: 0.6 gap splits 0.09/0.06/0.45
+    w = mfu_waterfall(0.4, 100.0, buckets, collective_frac=0.25,
+                      dcn_collective_frac=0.10)
+    assert w["deductions"]["collective_exposure_ici"] == pytest.approx(0.09, abs=1e-9)
+    assert w["deductions"]["collective_exposure_dcn"] == pytest.approx(0.06, abs=1e-9)
+    assert w["deductions"]["kernel_inefficiency"] == pytest.approx(0.45, abs=1e-9)
+    assert sum(w["deductions"].values()) == w["gap"]
+    # dcn share is clamped to the total collective share, never exceeds it
+    w = mfu_waterfall(0.4, 100.0, buckets, collective_frac=0.25,
+                      dcn_collective_frac=0.9)
+    assert w["deductions"]["collective_exposure_ici"] == 0.0
+    assert w["deductions"]["collective_exposure_dcn"] == pytest.approx(0.15, abs=1e-9)
 
 
 def test_unattributed_wall_time_lands_in_other():
@@ -84,15 +105,24 @@ def test_degenerate_inputs_stay_closed():
     assert w["achieved"] == 1.0 and w["gap"] == 0.0
 
 
-def test_collective_fraction_reads_a_perfscope_report():
+def test_collective_fractions_read_a_perfscope_report():
     report = {"executables": {"train_step": {"buckets": {
-        "matmul": {"est_time_s": 6.0},
+        "matmul": {"est_time_s": 5.0},
         "collective:dp_shard": {"est_time_s": 3.0},
+        "collective:dcn": {"est_time_s": 1.0},
         "collective:tp": {"est_time_s": 1.0},
     }}}}
-    assert collective_fraction(report) == 0.4
-    assert collective_fraction({}) is None
-    assert collective_fraction({"executables": {"train_step": {"buckets": {}}}}) is None
+    # total spans every collective:* bucket; dcn only the cross-slice one
+    assert collective_fractions(report) == (0.5, 0.1)
+    assert collective_fraction(report) == 0.5  # legacy total-only wrapper
+    assert collective_fractions({}) is None
+    assert collective_fractions({"executables": {"train_step": {"buckets": {}}}}) is None
+    # single-slice report: dcn share is exactly zero, not None
+    single = {"executables": {"train_step": {"buckets": {
+        "matmul": {"est_time_s": 6.0},
+        "collective:dp_shard": {"est_time_s": 4.0},
+    }}}}
+    assert collective_fractions(single) == (0.4, 0.0)
 
 
 def test_last_waterfall_from_sink_and_table_render(tmp_path):
@@ -102,13 +132,17 @@ def test_last_waterfall_from_sink_and_table_render(tmp_path):
         {"event": "mfu_waterfall", "peak": 1.0, "achieved": 0.2, "gap": 0.8,
          "deductions": {"kernel_inefficiency": 0.8}},
         {"event": "mfu_waterfall", "peak": 1.0, "achieved": 0.4, "gap": 0.6,
-         "deductions": {"data_stall": 0.1, "kernel_inefficiency": 0.5}},
+         "deductions": {"data_stall": 0.1, "collective_exposure": 0.2,
+                        "kernel_inefficiency": 0.3}},
     ]
     (tmp_path / "telemetry_rank_0.jsonl").write_text(
         "\n".join(json.dumps(r) for r in rows) + "\n"
     )
     waterfall = last_waterfall_from_sink(tmp_path)  # the LAST record wins
     assert waterfall["achieved"] == 0.4
+    # pre-split sink records fold their undifferentiated exposure into ICI
+    assert waterfall["deductions"]["collective_exposure_ici"] == 0.2
+    assert "collective_exposure" not in waterfall["deductions"]
     table = format_waterfall_table(waterfall)
     lines = table.splitlines()
     assert lines[1].startswith("peak MFU")

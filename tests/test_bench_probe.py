@@ -210,6 +210,9 @@ def test_never_lower_guard_only_when_leader_was_not_timed(bench, monkeypatch, ca
 # ------------------------------------------------- end-to-end CPU smoke
 
 
+@pytest.mark.slow  # ~41 s; bench e2e family — the ladder/JSON-line contract stays
+# in tier-1 via test_wedged_ladder_emits_probe_wedged_json_and_exits_clean (and
+# the subprocess budget e2e below already rides slow)
 def test_bench_cpu_smoke_emits_one_json_line():
     """The whole bench, minimally configured, as the driver runs it: forced CPU,
     probe off, one iteration — must exit 0 and print EXACTLY one parseable JSON

@@ -840,6 +840,9 @@ def test_tp_placement_colwise_rowwise_and_vocab():
         )
 
 
+@pytest.mark.slow  # ~23 s; chunked-CE family — test_chunked_lm_head_loss_equivalence
+# keeps the chunked-vs-dense loss pin in tier-1; the fused kernel's interpret
+# bitwise pin rides the kernel-dispatch closure
 def test_fused_ce_matches_chunked_and_elides_logits_hlo(monkeypatch):
     """MODALITIES_TPU_FUSED_CE=1 (interpret mode on CPU) must reproduce the
     chunked-scan losses AND lower to a train-step HLO without any vocab-shaped

@@ -31,16 +31,26 @@ def _seed_rounds(folder):
     })
     _write(folder, "BENCH_r3.json", {"n": 3, "rc": 0, "tail": "", "parsed": None})
     _write(folder, "BENCH_r4.json", {"n": 4, "rc": 124, "tail": "", "parsed": None})
+    # the rounds-4/5 wedge shape with the retry loop exiting clean: rc=0 but the
+    # tail names the wedge — triaged as wedged, NOT no_metric
+    _write(folder, "BENCH_r5.json", {
+        "n": 5, "rc": 0,
+        "tail": "bench: TPU probe attempt 3 wedged; giving up", "parsed": None,
+    })
     _write(folder, "MULTICHIP_r1.json", {"n_devices": 8, "rc": 124, "ok": False, "skipped": False, "tail": ""})
     _write(folder, "MULTICHIP_r2.json", {"n_devices": 8, "rc": 0, "ok": True, "skipped": False, "tail": ""})
     _write(folder, "MULTICHIP_r3.json", {"n_devices": 0, "rc": 0, "ok": False, "skipped": True, "tail": ""})
+    _write(folder, "MULTICHIP_r4.json", {
+        "n_devices": 8, "rc": 1, "ok": False, "skipped": False,
+        "tail": "dryrun: TPU probe attempt 1 wedged; retrying in 600s",
+    })
 
 
 def test_round_loading_sorts_by_round_and_keeps_torn_artifacts(tmp_path):
     _seed_rounds(tmp_path)
     (tmp_path / "BENCH_r10.json").write_text('{"torn')  # crashed mid-write
     rounds = load_round_artifacts(tmp_path, "BENCH")
-    assert [r["round"] for r in rounds] == [1, 2, 3, 4, 10]
+    assert [r["round"] for r in rounds] == [1, 2, 3, 4, 5, 10]
     assert rounds[-1]["data"] is None  # torn artifact is itself a signal
 
 
@@ -51,17 +61,20 @@ def test_summarize_classifies_every_flavor_and_flags_non_ok(tmp_path):
     assert by_round[1]["status"] == "failed"
     assert by_round[2]["status"] == "ok" and by_round[2]["value"] == 0.382
     assert by_round[2]["tokens_per_sec"] == 2244.2
-    assert by_round[3]["status"] == "no_metric"  # rc=0 but nothing measured
+    assert by_round[3]["status"] == "no_metric"  # rc=0, empty tail: no wedge
     assert by_round[4]["status"] == "wedged"  # the timeout's rc
+    assert by_round[5]["status"] == "wedged"  # rc=0 but the tail names the wedge
     mc = {r["round"]: r["status"] for r in summary["multichip"]}
-    assert mc == {1: "wedged", 2: "ok", 3: "skipped"}
+    assert mc == {1: "wedged", 2: "ok", 3: "skipped", 4: "wedged"}
     assert summary["best_bench_value"] == 0.382
     # every non-ok bench round + non-ok/skipped multichip round is named
     assert sorted(summary["flags"]) == [
         "BENCH r1: failed (rc=1)",
         "BENCH r3: no_metric (rc=0)",
         "BENCH r4: wedged (rc=124)",
+        "BENCH r5: wedged (rc=0)",
         "MULTICHIP r1: wedged (rc=124)",
+        "MULTICHIP r4: wedged (rc=1)",
     ]
 
 
@@ -89,4 +102,4 @@ def test_analyze_bench_cli_table_and_json(tmp_path):
     assert result.exit_code == 0, result.output
     summary = json.loads(result.output)
     assert summary["best_bench_value"] == 0.382
-    assert len(summary["bench"]) == 4 and len(summary["multichip"]) == 3
+    assert len(summary["bench"]) == 5 and len(summary["multichip"]) == 4
