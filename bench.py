@@ -549,6 +549,19 @@ def _run_candidate(cand, iters: int):
 
     waterfall = mfu_waterfall(mfu_wall, candidate_wall_s, goodput["buckets"])
 
+    # static memory attribution for the measured executable (telemetry/memscope):
+    # the scoreboard line ships its HBM composition next to its peak, so a
+    # memory-gated MFU (batch capped by activations vs optimizer moments vs
+    # params) is diagnosable from the BENCH artifact alone
+    try:
+        mem = fns.memscope_report(batch)
+        memscope_detail = {
+            "buckets": mem["buckets"],
+            "predicted_peak_bytes": mem["memory_analysis"]["total_bytes"],
+        }
+    except Exception as e:
+        memscope_detail = {"error": repr(e)}
+
     baseline_mfu = 0.6867  # reference best (6.7B, 8xA100, README.md:339)
     return {
         "metric": "gpt_train_mfu_single_chip",
@@ -585,6 +598,7 @@ def _run_candidate(cand, iters: int):
             "zero_stage": getattr(mesh, "zero_stage", 0),
             "opt_state_bytes_per_device": opt_state_bytes_per_device,
             "peak_hbm_bytes": peak_hbm_bytes,
+            "memscope": memscope_detail,
             "device": dev.device_kind,
             "seq": seq,
             "micro_batch": mb,

@@ -383,6 +383,12 @@ def main() -> int:
         "hardware round's throughput number ships with its attribution",
     )
     parser.add_argument(
+        "--memscope", type=str, default=None, metavar="PATH",
+        help="write the decode step's static HBM attribution (memscope report "
+        "JSON: params/KV-pool/workspace buckets closed against "
+        "memory_analysis totals) to PATH after warmup",
+    )
+    parser.add_argument(
         "--quant-weights", choices=("none", "int8", "fp8"), default="none",
         help="weight-only quantized serving mode",
     )
@@ -497,6 +503,12 @@ def main() -> int:
         from modalities_tpu.telemetry.perfscope import write_report
 
         write_report(engine.perfscope_report(), args.perfscope)
+    if args.memscope:
+        # same post-warmup seam as --perfscope: the decode executable exists and
+        # the static memory walk never perturbs the measured window below
+        from modalities_tpu.telemetry.memscope import write_report as write_memscope
+
+        write_memscope(engine.memscope_report(), args.memscope)
     engine.metrics.reset()  # compile-window samples stay out of the scrape
     warm_tokens = engine.decode_token_count
     swap_records = []
@@ -692,6 +704,7 @@ def main() -> int:
                 **slo_verdict,
                 "cache": args.cache,
                 "perfscope": args.perfscope,
+                "memscope": args.memscope,
                 "requests": args.requests,
                 "long_requests": args.long,
                 "slots": args.slots,

@@ -510,6 +510,37 @@ def entry_point_analyze_perfscope(
         click.echo(format_perfscope_table(report))
 
 
+@data.command(name="analyze_memscope")
+@click.option("--config_file_path", type=click.Path(exists=True, path_type=Path), required=True,
+              help="Training config; its jitted step is lowered + compiled on virtual "
+                   "CPU devices and memory_analysis() is carved into semantic buckets.")
+@click.option("--report_path", type=click.Path(path_type=Path), default=None,
+              help="Also write the report JSON here (e.g. memscope.json).")
+@click.option("--as_json", is_flag=True, default=False, help="Emit the report dict as JSON.")
+@_exception_handling
+def entry_point_analyze_memscope(
+    config_file_path: Path, report_path: Optional[Path], as_json: bool
+) -> None:
+    """Static memory attribution: where the compiled train step's HBM bytes go —
+    params vs optimizer moments vs gradients vs activations/workspace vs KV pool
+    — with the static estimate beside the runtime peak and headroom when the
+    backend reports memory stats. Bucket sums equal the memory_analysis() totals
+    by construction. Runs entirely on CPU."""
+    from modalities_tpu.telemetry.memscope import (
+        format_memscope_table,
+        run_memscope_subprocess,
+        write_report,
+    )
+
+    report = run_memscope_subprocess(config_file_path)
+    if report_path is not None:
+        write_report(report, report_path)
+    if as_json:
+        click.echo(json.dumps(report))
+    else:
+        click.echo(format_memscope_table(report))
+
+
 @data.command(name="analyze_fleet")
 @click.option("--sink_path", "sink_paths", type=click.Path(exists=True, path_type=Path),
               required=True, multiple=True,
@@ -571,11 +602,16 @@ def entry_point_analyze_bench(artifacts_dir: Path, as_json: bool) -> None:
                    "numeric fields become bench_<key> gauges.")
 @click.option("--trajectory_path", type=click.Path(exists=True, path_type=Path), default=None,
               help="Folder of BENCH_r*/MULTICHIP_r* round artifacts (trajectory loader).")
+@click.option("--memscope_path", "memscope_paths", type=click.Path(exists=True, path_type=Path),
+              multiple=True,
+              help="memscope.json static report; repeatable. Buckets become "
+                   "memscope_bucket_bytes{executable,bucket} gauges (timeline sink "
+                   "events replay via --sink_path).")
 @click.option("--as_json", is_flag=True, default=False, help="Emit the verdict dict as JSON.")
 @_exception_handling
 def entry_point_check_slo(
     slo_path: Path, sink_paths: tuple[Path, ...], bench_paths: tuple[Path, ...],
-    trajectory_path: Optional[Path], as_json: bool,
+    trajectory_path: Optional[Path], memscope_paths: tuple[Path, ...], as_json: bool,
 ) -> None:
     """Evaluate recorded runs against a declarative SLO spec: replay telemetry
     sinks / bench_serve lines / benchmark-round artifacts into one metrics
@@ -587,6 +623,7 @@ def entry_point_check_slo(
         evaluate_recorded,
         load_slo_spec,
         replay_bench_lines_into_registry,
+        replay_memscope_into_registry,
         replay_sink_into_registry,
         replay_trajectory_into_registry,
     )
@@ -599,6 +636,8 @@ def entry_point_check_slo(
         replayed += replay_bench_lines_into_registry(path, registry)
     if trajectory_path is not None:
         replayed += replay_trajectory_into_registry(trajectory_path, registry)
+    for path in memscope_paths:
+        replayed += replay_memscope_into_registry(path, registry)
     objectives, _ = load_slo_spec(slo_path)
     report = evaluate_recorded(objectives, registry)
     report["records_replayed"] = replayed

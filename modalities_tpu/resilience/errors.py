@@ -24,3 +24,9 @@ class AnomalyRollback(ResumableError):
 class PeerFailure(ResumableError):
     """A peer process died or wedged past its heartbeat/rendezvous deadline; this
     process exits resumable instead of hanging in a collective forever."""
+
+
+class OutOfMemory(ResumableError):
+    """Device allocation failed (RESOURCE_EXHAUSTED); the memscope OOM forensics
+    dump was written. Exit resumable so the supervisor can warmstart — possibly
+    degraded, per the dump's suggested levers."""

@@ -30,6 +30,10 @@ preemptible pods. Spec grammar (env ``MODALITIES_TPU_FAULTS`` or `arm_faults`):
 - ``peer_death@step`` — `os._exit(1)` after completing `step` on whichever
   process armed it: an abrupt peer death (no signal, no cleanup), caught by the
   peer-health heartbeat deadline.
+- ``oom@step`` — the trainer/serving dispatch of `step` raises a RuntimeError
+  whose text carries RESOURCE_EXHAUSTED (the fault-injection stand-in for an
+  XLA device allocation failure), exercising the memscope OOM forensics path
+  (dump + resumable exit) on CPU.
 - ``host_loss@step[:host]`` — PERMANENT loss of host `host` (default 0) after
   `step`: SIGKILLs that host's supervisor (so nothing restarts the dead host)
   and then dies abruptly itself. The surviving supervisors' next resume vote
@@ -66,6 +70,7 @@ FAULT_POINTS = (
     "peer_hang",
     "peer_death",
     "host_loss",
+    "oom",
 )
 
 
@@ -166,6 +171,21 @@ def fire_sigterm_if_armed(step: int) -> bool:
     logger.warning("FAULT FIRING: sigterm_at_step at step %d", step)
     os.kill(os.getpid(), signal.SIGTERM)
     return True
+
+
+def fire_oom_if_armed(step: int) -> bool:
+    """Raise an injected RESOURCE_EXHAUSTED when `oom` is armed for `step` —
+    placed at the trainer/serving dispatch seams so the memscope OOM forensics
+    path (dump, resumable exit, supervisor warmstart) is e2e-testable on CPU."""
+    fault = _consume("oom", step=step)
+    if fault is None:
+        return False
+    record_event("fault/oom", step=step)
+    logger.warning("FAULT FIRING: oom at step %d", step)
+    raise RuntimeError(
+        f"RESOURCE_EXHAUSTED: injected fault: oom at step {step} "
+        "(fault-injection stand-in for an XLA device allocation failure)"
+    )
 
 
 def fire_sigterm_one_rank_if_armed(step: int) -> bool:

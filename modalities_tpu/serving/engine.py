@@ -80,6 +80,9 @@ from typing import Callable, Optional
 
 import numpy as np
 
+from pathlib import Path
+
+from modalities_tpu.resilience.faults import fire_oom_if_armed
 from modalities_tpu.serving.paged_cache import BlockTableState, blocks_for_tokens
 from modalities_tpu.serving.spec_decode import propose_ngram, resolve_spec_config
 from modalities_tpu.telemetry import get_active_telemetry, span
@@ -1623,12 +1626,27 @@ class ServingEngine:
             telemetry.arm_watchdog(self._dispatch_seq, first_step=self._dispatch_seq == 1)
         self._admit(t0)
         did = False
-        if self.kv_cache == "paged" and self._prefilling_slots():
-            self._prefill_dispatch(t0)
-            did = True
-        if self._decoding_count():
-            self._decode_dispatch(t0)
-            did = True
+        try:
+            fire_oom_if_armed(self._dispatch_seq)
+            if self.kv_cache == "paged" and self._prefilling_slots():
+                self._prefill_dispatch(t0)
+                did = True
+            if self._decoding_count():
+                self._decode_dispatch(t0)
+                did = True
+        except Exception as e:
+            from modalities_tpu.telemetry.memscope import is_oom_error, oom_forensics
+
+            if is_oom_error(e):
+                raise oom_forensics(
+                    telemetry.sink_path.parent if telemetry.sink_path is not None else Path("."),
+                    rank=telemetry.global_rank,
+                    step=self._dispatch_seq,
+                    exc=e,
+                    static_report=getattr(self, "_memscope_cache", None),
+                    metrics_snapshot=self.metrics.snapshot(),
+                ) from e
+            raise
         if armed:
             if did:
                 telemetry.beat_watchdog(self._dispatch_seq)
@@ -1767,3 +1785,29 @@ class ServingEngine:
         with self._rules_ctx():
             compiled = self._decode_lowered().compile()
         return perfscope_from_compiled(compiled, mesh_axis_sizes, hw)
+
+    def memscope_report(self) -> dict:
+        """Compile the batched decode step and carve its memory_analysis() bytes
+        into semantic buckets (telemetry/memscope.py): params + KV pool dominate
+        a decode executable, and the KV bucket is the one paged_num_blocks /
+        quant_kv actually move. Cached — the OOM forensics dump reuses it."""
+        from modalities_tpu.quant.core import tree_bytes
+        from modalities_tpu.telemetry.memscope import memscope_from_compiled
+
+        known = {
+            "params": int(tree_bytes(self.params)),
+            "kv_pool": int(self.kv_pool_bytes),
+        }
+        context = {
+            "kind": "serving",
+            "kv_cache": self.kv_cache,
+            "quant_kv": self.quant_kv,
+            "quant_weights": self.quant_weights,
+        }
+        if self.kv_cache == "paged":
+            context["paged_num_blocks"] = self.num_blocks
+        with self._rules_ctx():
+            compiled = self._decode_lowered().compile()
+        report = memscope_from_compiled(compiled, known, context)
+        self._memscope_cache = report
+        return report

@@ -354,6 +354,45 @@ class Telemetry:
                 "training_peak_memory_mbytes", "Max over devices of peak HBM in use (MB)"
             ).set(peak_memory_mb)
 
+    def publish_memory_timeline(self, sample: dict) -> None:
+        """One memscope timeline sample (telemetry/memscope.py) onto the scrape
+        surface and the sink: worst-device bytes in use, per-device headroom
+        (the SLO floor objective's source), and a `memscope_timeline` sink event
+        so headroom objectives replay offline via `data check_slo`."""
+        if not self.enabled:
+            return
+        self.metrics.gauge(
+            "training_hbm_bytes_in_use", "Max over devices of HBM bytes in use"
+        ).set(sample["bytes_in_use"])
+        headroom_gauge = self.metrics.gauge(
+            "memscope_device_headroom_bytes",
+            "Per-device bytes_limit - bytes_in_use (absent on backends with no limit)",
+        )
+        for device, headroom in (sample.get("headroom_bytes") or {}).items():
+            headroom_gauge.set(headroom, device=device)
+        if self._sink is not None:
+            self._sink.emit({
+                "event": "memscope_timeline",
+                "step": sample.get("step"),
+                "executable": sample.get("executable"),
+                "bytes_in_use": sample["bytes_in_use"],
+                "headroom_bytes": dict(sample.get("headroom_bytes") or {}),
+            })
+
+    def publish_memscope_report(self, report: dict, executable: str = "train_step") -> None:
+        """Static memscope buckets onto the scrape surface:
+        `memscope_bucket_bytes{executable,bucket}` — the memory sibling of the
+        goodput bucket gauges, closed against memory_analysis() by construction."""
+        if not self.enabled:
+            return
+        bucket_gauge = self.metrics.gauge(
+            "memscope_bucket_bytes",
+            "Static per-device bytes attributed to each memscope bucket; buckets "
+            "sum exactly to the executable's memory_analysis total",
+        )
+        for bucket, nbytes in (report.get("buckets") or {}).items():
+            bucket_gauge.set(nbytes, executable=executable, bucket=bucket)
+
     # -------------------------------------------------------------- lifecycle
 
     def close(self) -> None:

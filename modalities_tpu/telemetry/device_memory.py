@@ -1,0 +1,108 @@
+"""Shared device-memory stat walk (PR 17).
+
+Three call sites grew the same loop independently — the trainer's resource
+gauges (peak HBM + headroom), the hang watchdog's forensic dump, and the
+SteppableMemoryProfiler's per-step jsonl — each with its own tolerance bugs
+(device-0 only, uncached device list, crash on backends whose
+``memory_stats()`` returns ``None``). This module is the one walk they all
+share: a cached local-device list and stat readers that tolerate ``None``,
+``{}``, missing keys, and outright raising backends, because memory telemetry
+must never be the thing that kills the run it is observing.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+# cached across calls: jax.local_devices() is not free and the device set is
+# fixed for the life of the process. None = not yet resolved.
+_cached_devices: Optional[list] = None
+
+
+def local_devices() -> list:
+    """The process-local device list, resolved once. [] when JAX is absent or
+    the backend fails to initialize — callers degrade to 'no data', not a crash."""
+    global _cached_devices
+    if _cached_devices is None:
+        try:
+            import jax
+
+            _cached_devices = list(jax.local_devices())
+        except Exception:
+            _cached_devices = []
+    return _cached_devices
+
+
+def reset_device_cache() -> None:
+    """Test hook: forget the cached device list so fakes can be injected."""
+    global _cached_devices
+    _cached_devices = None
+
+
+def device_memory_stats(devices=None) -> dict:
+    """Per-device numeric memory stats, keyed by ``str(device)``.
+
+    A device whose ``memory_stats()`` raises contributes ``{"error": repr(e)}``
+    instead of silently vanishing — a half-dead device is itself a finding in a
+    forensic dump. Non-numeric values are dropped (JSON-safety)."""
+    out = {}
+    for device in local_devices() if devices is None else devices:
+        try:
+            stats = device.memory_stats() or {}
+            out[str(device)] = {
+                k: int(v) for k, v in stats.items() if isinstance(v, (int, float))
+            }
+        except Exception as e:
+            out[str(device)] = {"error": repr(e)}
+    return out
+
+
+def _stat_dicts(devices=None):
+    """Yield the numeric stat dict of each device that produced one."""
+    for device in local_devices() if devices is None else devices:
+        try:
+            stats = device.memory_stats() or {}
+        except Exception:
+            continue
+        yield {k: int(v) for k, v in stats.items() if isinstance(v, (int, float))}
+
+
+def peak_memory_mb(devices=None) -> Optional[float]:
+    """Max ``peak_bytes_in_use`` across local devices, in MiB. None when no
+    device reports one (CPU backends)."""
+    peak = 0
+    for stats in _stat_dicts(devices):
+        peak = max(peak, stats.get("peak_bytes_in_use", 0))
+    return peak / (1024 * 1024) if peak else None
+
+
+def hbm_headroom_mb(devices=None) -> Optional[float]:
+    """Min of (bytes_limit - peak_bytes_in_use) across devices that report a
+    limit, in MiB — the worst-device headroom, which is the one that OOMs
+    first. None when no device reports a limit (CPU backends)."""
+    headroom = None
+    for stats in _stat_dicts(devices):
+        limit = stats.get("bytes_limit", 0)
+        if not limit:
+            continue
+        room = (limit - stats.get("peak_bytes_in_use", 0)) / (1024 * 1024)
+        headroom = room if headroom is None else min(headroom, room)
+    return headroom
+
+
+def min_bytes_limit(devices=None) -> Optional[int]:
+    """Smallest per-device allocation budget — the fits-check bound. None on
+    backends that report no limit (the check is then inert)."""
+    limits = [s["bytes_limit"] for s in _stat_dicts(devices) if s.get("bytes_limit")]
+    return min(limits) if limits else None
+
+
+def worst_case_memory_stats(devices=None) -> dict:
+    """Key-wise max across all local devices — a single flat dict in the same
+    shape one device's ``memory_stats()`` returns, so existing per-step jsonl
+    consumers keep their record format while covering every device."""
+    worst: dict = {}
+    for stats in _stat_dicts(devices):
+        for k, v in stats.items():
+            worst[k] = max(worst.get(k, 0), v)
+    return worst

@@ -174,6 +174,19 @@ class Gauge(_Metric):
             return float(fn())
         return self._series.get(key, 0.0)
 
+    def series_snapshot(self) -> dict:
+        """Every series value keyed by its label tuple, scrape-time callbacks
+        included — lets consumers (the SLO judge) aggregate across labels."""
+        with self._lock:
+            series = dict(self._series)
+            fns = dict(self._fns)
+        for key, fn in fns.items():
+            try:
+                series[key] = float(fn())
+            except Exception:
+                pass  # a broken callback must never take the reader down
+        return series
+
     def reset(self) -> None:
         with self._lock:
             self._series.clear()

@@ -125,6 +125,15 @@ def _metric_value(objective: Objective, registry: MetricsRegistry) -> Optional[f
         return metric.value() / den_value
     if not isinstance(metric, (Counter, Gauge)):
         return None
+    if isinstance(metric, Gauge):
+        series = metric.series_snapshot()
+        if series and () not in series:
+            # labeled-only gauge (per-device headroom, per-executable memscope
+            # peak): judge the WORST series for the op's direction — max for a
+            # ceiling objective, min for a floor — so one bad device/executable
+            # cannot hide behind a healthy sibling.
+            worst = max if objective.op in ("<", "<=") else min
+            return worst(series.values())
     return metric.value()
 
 
@@ -377,6 +386,8 @@ def replay_sink_into_registry(sink_path: Union[str, Path], registry: MetricsRegi
     c_requests = registry.counter("serve_requests_total", "finished requests")
     c_errors = registry.counter("serve_request_errors_total", "failed requests")
     replayed = 0
+    max_in_use: Optional[float] = None
+    min_headroom: dict[str, float] = {}
     for path in files:
         for row in _iter_jsonl(path):
             event = row.get("event")
@@ -393,6 +404,24 @@ def replay_sink_into_registry(sink_path: Union[str, Path], registry: MetricsRegi
                 replayed += 1
                 if row.get("achieved") is not None:
                     registry.gauge("training_mfu_achieved", "").set(float(row["achieved"]))
+            elif event == "memscope_timeline":
+                replayed += 1
+                if row.get("bytes_in_use") is not None:
+                    # fold to the run's MAX in-use: the worst moment is the one
+                    # a ceiling objective should judge
+                    max_in_use = max(float(row["bytes_in_use"]), max_in_use or 0.0)
+                for device, headroom in (row.get("headroom_bytes") or {}).items():
+                    # MIN per device: a headroom FLOOR objective must see the
+                    # tightest sample, not the last one
+                    prior = min_headroom.get(device)
+                    value = float(headroom)
+                    min_headroom[device] = value if prior is None else min(value, prior)
+    if max_in_use is not None:
+        registry.gauge("training_hbm_bytes_in_use", "").set(max_in_use)
+    if min_headroom:
+        headroom_gauge = registry.gauge("memscope_device_headroom_bytes", "")
+        for device, headroom in min_headroom.items():
+            headroom_gauge.set(headroom, device=device)
     try:
         from modalities_tpu.telemetry.goodput import summarize_sink
 
@@ -404,6 +433,32 @@ def replay_sink_into_registry(sink_path: Union[str, Path], registry: MetricsRegi
     except Exception:  # sink without span records — serving-only is fine
         pass
     return replayed
+
+
+def replay_memscope_into_registry(
+    report_path: Union[str, Path], registry: MetricsRegistry
+) -> int:
+    """Fold a ``memscope.json`` static report into
+    ``memscope_bucket_bytes{executable,bucket}`` gauges so bucket-level memory
+    objectives are judgeable offline — accepts both the multi-executable shape
+    (``{"executables": {...}}``) and a single bare report."""
+    import json
+
+    data = json.loads(Path(report_path).read_text())
+    executables = data.get("executables") or {"executable": data}
+    bucket_gauge = registry.gauge("memscope_bucket_bytes", "")
+    lifted = 0
+    for executable, report in executables.items():
+        for bucket, nbytes in (report.get("buckets") or {}).items():
+            bucket_gauge.set(float(nbytes), executable=executable, bucket=bucket)
+            lifted += 1
+        total = (report.get("memory_analysis") or {}).get("total_bytes")
+        if total is not None:
+            registry.gauge("memscope_predicted_peak_bytes", "").set(
+                float(total), executable=executable
+            )
+            lifted += 1
+    return lifted
 
 
 def replay_bench_lines_into_registry(
@@ -440,7 +495,7 @@ def replay_trajectory_into_registry(
         rows = summary.get(suite) or []
         if not rows:
             continue
-        bad = sum(1 for r in rows if r.get("status") in ("failed", "wedged", "no_metric"))
+        bad = sum(1 for r in rows if r.get("status") in ("failed", "wedged", "no_metric", "oom"))
         registry.gauge(f"{suite}_failed_rounds", "").set(float(bad))
         lifted += 1
     return lifted

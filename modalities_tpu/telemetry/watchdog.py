@@ -39,13 +39,9 @@ def collect_thread_stacks() -> dict[str, list[str]]:
 
 def _collect_device_memory() -> dict:
     try:
-        import jax
+        from modalities_tpu.telemetry.device_memory import device_memory_stats
 
-        out = {}
-        for device in jax.local_devices():
-            stats = device.memory_stats() or {}
-            out[str(device)] = {k: int(v) for k, v in stats.items() if isinstance(v, (int, float))}
-        return out
+        return device_memory_stats()
     except Exception as e:
         return {"error": repr(e)}
 

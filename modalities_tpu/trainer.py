@@ -34,7 +34,9 @@ sides of the split:
 from __future__ import annotations
 
 import gc
+import os
 import time
+from pathlib import Path
 from typing import Callable, Optional
 
 import numpy as np
@@ -53,6 +55,7 @@ from modalities_tpu.resilience.coordination import (
 from modalities_tpu.resilience.errors import AnomalyRollback, PreemptionShutdown
 from modalities_tpu.resilience.events import record_event
 from modalities_tpu.resilience.faults import (
+    fire_oom_if_armed,
     fire_sigterm_if_armed,
     fire_sigterm_one_rank_if_armed,
     host_loss_if_armed,
@@ -60,6 +63,18 @@ from modalities_tpu.resilience.faults import (
     peer_hang_if_armed,
 )
 from modalities_tpu.telemetry import Telemetry, get_active_telemetry
+from modalities_tpu.telemetry.device_memory import (
+    hbm_headroom_mb,
+    min_bytes_limit,
+    peak_memory_mb,
+)
+from modalities_tpu.telemetry.memscope import (
+    MemoryTimeline,
+    MemscopeWindow,
+    is_oom_error,
+    oom_forensics,
+    preflight_fits_check,
+)
 from modalities_tpu.telemetry.perfscope import ProfileWindow
 from modalities_tpu.training.train_step import StepFunctions
 from modalities_tpu.training.training_progress import TrainingProgress
@@ -119,6 +134,30 @@ class Trainer:
     def _telemetry(self) -> Telemetry:
         return self.telemetry if self.telemetry is not None else get_active_telemetry()
 
+    @staticmethod
+    def _preflight_memscope(step_functions: StepFunctions, device_batch) -> Optional[dict]:
+        """Static memscope report + fits-check before the first dispatch. Only
+        runs where it can act: a backend with a bytes_limit (TPU) and a check
+        mode other than off — on CPU this is a no-op, so e2e tests pay nothing.
+        A FitsCheckFailure propagates (fail-fast is the point); any other
+        failure degrades to 'no static report', never a dead run."""
+        from modalities_tpu.telemetry.memscope import FITS_CHECK_ENV
+
+        mode = (os.environ.get(FITS_CHECK_ENV) or "fail").strip().lower()
+        if (
+            mode == "off"
+            or getattr(step_functions, "lower_train_step", None) is None
+            or min_bytes_limit() is None
+        ):
+            return None
+        try:
+            report = step_functions.memscope_report(device_batch)
+        except Exception:
+            logger.exception("memscope: static report failed; fits-check skipped")
+            return None
+        preflight_fits_check(report)
+        return report
+
     def train(
         self,
         step_functions: StepFunctions,
@@ -176,6 +215,16 @@ class Trainer:
         profile_window = ProfileWindow.from_env(
             fallback_dir=telemetry.sink_path.parent if telemetry.sink_path is not None else None
         )
+        # memscope runtime pillar: per-step memory timeline (inert on backends
+        # with no numeric memory_stats), env-armed live-array snapshots, and the
+        # static report for the preflight fits-check + OOM forensics. Purely
+        # observational — pinned bitwise by tests/telemetry/test_memscope.py.
+        mem_timeline = MemoryTimeline(telemetry=telemetry, executable="train_step")
+        memscope_window = MemscopeWindow.from_env(
+            fallback_dir=telemetry.sink_path.parent if telemetry.sink_path is not None else None
+        )
+        memscope_static: Optional[dict] = None
+        fits_checked = False
         profiler_cm = self.profiler
         if profiler_cm is not None:
             profiler_cm.__enter__()
@@ -213,10 +262,38 @@ class Trainer:
                     device_batch[BALLOT_KEY] = make_ballot(local_vote, mesh_handle)
                 if profile_window is not None:
                     profile_window.maybe_start(step_id + 1)
+                if not fits_checked:
+                    # preflight fits-check: on backends with a bytes_limit, AOT-
+                    # compile the step's memory scope and compare its predicted
+                    # peak against the budget BEFORE the first dispatch — an
+                    # over-budget run fails here with levers named instead of
+                    # dying inside XLA allocation. CPU (no limit): skipped.
+                    fits_checked = True
+                    memscope_static = self._preflight_memscope(step_functions, device_batch)
+                    if memscope_static is not None:
+                        telemetry.publish_memscope_report(memscope_static, executable="train_step")
                 step_t0 = time.perf_counter()
-                with telemetry.step_annotation(step_id + 1):
-                    with telemetry.span("first_step" if step_id == first_step_id else "train_step"):
-                        state, metrics = step_fn(state, device_batch)
+                try:
+                    fire_oom_if_armed(step_id + 1)  # chaos: oom@N
+                    with telemetry.step_annotation(step_id + 1):
+                        with telemetry.span("first_step" if step_id == first_step_id else "train_step"):
+                            state, metrics = step_fn(state, device_batch)
+                except Exception as e:
+                    if is_oom_error(e):
+                        # forensics first (static scope + timeline tail + live
+                        # arrays + levers), then exit resumable: a degraded
+                        # warmstart beats a dead pod with an opaque traceback
+                        raise oom_forensics(
+                            telemetry.sink_path.parent if telemetry.sink_path is not None else Path("."),
+                            rank=telemetry.global_rank,
+                            step=step_id + 1,
+                            exc=e,
+                            static_report=memscope_static,
+                            timeline=mem_timeline,
+                            window=memscope_window,
+                            metrics_snapshot=telemetry.metrics.snapshot(),
+                        ) from e
+                    raise
                 # host-side dispatch time: in steady state the dispatch queue's
                 # backpressure makes this track device step time — feed the rolling
                 # anomaly detector (compile-dominated first step excluded)
@@ -308,6 +385,9 @@ class Trainer:
                     # block on this step's metrics so the captured device work has
                     # actually executed before the trace closes
                     profile_window.maybe_stop(step_id, block_on=metrics)
+                mem_timeline.sample(step_id)
+                if memscope_window is not None:
+                    memscope_window.maybe_snapshot(step_id)
 
                 # step completed end-to-end (callbacks included): re-arm the hang
                 # deadline for the next one
@@ -567,44 +647,17 @@ class Trainer:
             self.evaluation_result_publisher.publish_message(result, MessageTypes.EVALUATION_RESULT)
         return fetch_done
 
-    _local_devices = None  # cached once per process: device topology is fixed
+    # thin delegations to the shared device-stat walk (telemetry/device_memory.py)
+    # — kept as methods so interval-publish call sites and their tests are stable
 
     @classmethod
     def _peak_memory_mb(cls) -> Optional[float]:
-        """Max peak_bytes_in_use across ALL local devices, in MB. The device list
-        is looked up once, not per interval (it cannot change mid-run)."""
-        if cls._local_devices is None:
-            try:
-                import jax
-
-                cls._local_devices = jax.local_devices()
-            except Exception:
-                cls._local_devices = []
-        peak_bytes = 0
-        for device in cls._local_devices:
-            try:
-                stats = device.memory_stats() or {}
-            except Exception:
-                continue
-            peak_bytes = max(peak_bytes, stats.get("peak_bytes_in_use", 0))
-        return peak_bytes / 2**20 if peak_bytes else None
+        """Max peak_bytes_in_use across ALL local devices, in MB."""
+        return peak_memory_mb()
 
     @classmethod
     def _hbm_headroom_mb(cls) -> Optional[float]:
         """Min over local devices of ``bytes_limit - peak_bytes_in_use``, in MB —
         the tightest remaining on-device allocation margin. None when the backend
         does not report a bytes_limit (CPU), so the key is simply absent there."""
-        if cls._local_devices is None:
-            cls._peak_memory_mb()  # populates the cached device list
-        headroom_bytes = None
-        for device in cls._local_devices or []:
-            try:
-                stats = device.memory_stats() or {}
-            except Exception:
-                continue
-            limit = stats.get("bytes_limit")
-            if not limit:
-                continue
-            margin = limit - stats.get("peak_bytes_in_use", 0)
-            headroom_bytes = margin if headroom_bytes is None else min(headroom_bytes, margin)
-        return headroom_bytes / 2**20 if headroom_bytes is not None else None
+        return hbm_headroom_mb()

@@ -83,6 +83,10 @@ class StepFunctions:
     # step program (AOT partitioning check without executing); present whenever a
     # mesh is attached, and the only executable surface in materialize=False mode
     lower_train_step: Optional[Callable[[Any], Any]] = None
+    # build-time config memscope needs to rank memory levers (zero_stage=1 sheds
+    # nothing if already on; accumulation halves the live microbatch only if raisable)
+    zero_stage: int = 0
+    gradient_acc_steps: int = 1
 
     def perfscope_report(self, batch_abstract, hw=None) -> dict:
         """Lower + compile the sharded step and bucket its optimized-HLO cost by
@@ -102,6 +106,36 @@ class StepFunctions:
         )
         return perfscope_from_compiled(
             self.lower_train_step(batch_abstract).compile(), mesh_axis_sizes, hw
+        )
+
+    def memscope_report(self, batch_abstract) -> dict:
+        """Lower + compile the sharded step and carve its memory_analysis() bytes
+        into semantic buckets (telemetry/memscope.py) — the static half of memory
+        attribution, the bytes-sibling of perfscope_report."""
+        if self.lower_train_step is None:
+            raise ValueError(
+                "memscope_report needs the AOT lowering surface; this StepFunctions "
+                "was built without lower_train_step"
+            )
+        from modalities_tpu.telemetry.memscope import (
+            memscope_from_compiled,
+            train_step_known_bytes,
+        )
+
+        known = train_step_known_bytes(self.app_state_handle, self.mesh_handle)
+        degrees = getattr(self.mesh_handle, "degrees", None) or {}
+        context = {
+            "kind": "train",
+            "zero_stage": self.zero_stage,
+            "gradient_accumulation_steps": self.gradient_acc_steps,
+            "dp_replicate": int(degrees.get("dp_replicate", 1) or 1),
+            "remat_variant": getattr(
+                getattr(self.app_state_handle.model, "config_spec", None),
+                "remat_variant", None,
+            ),
+        }
+        return memscope_from_compiled(
+            self.lower_train_step(batch_abstract).compile(), known, context
         )
 
 
@@ -764,6 +798,8 @@ class TrainStepBuilder:
             mesh_handle=mesh_handle,
             train_step_debug=train_step_debug_c,
             lower_train_step=lower_train_step,
+            zero_stage=self.zero_stage,
+            gradient_acc_steps=self.gradient_acc_steps,
         )
 
     # ------------------------------------------------------------------ data

@@ -12,6 +12,10 @@ round explicitly classified:
                 with a tail that names a wedge — the rounds-4/5 shape where
                 the TPU probe wedged (VERDICT r5: a wedged probe, not a code
                 failure; the retry loop can surface it under any rc)
+- ``oom``       the tail carries RESOURCE_EXHAUSTED — the round died in device
+                allocation; named explicitly so the next hardware round's
+                failure mode reads "oom", not "wedged"/"no_metric" (the
+                memscope levers, not a retry, are the fix)
 - ``no_metric`` rc=0 but nothing parsed and no wedge in the tail — the run
                 completed without reaching the measurement (a distinct
                 failure flavor from wedged)
@@ -63,9 +67,12 @@ def _classify(data: Optional[dict], kind: str) -> str:
         return "failed"
     rc = data.get("rc")
     wedge_tail = bool(_WEDGE_TAIL_RE.search(data.get("tail") or ""))
+    oom_tail = "RESOURCE_EXHAUSTED" in (data.get("tail") or "")
     if kind == "bench":
         if data.get("parsed") is not None:
             return "ok"
+        if oom_tail:
+            return "oom"
         if rc == _TIMEOUT_RC or wedge_tail:
             return "wedged"
         return "no_metric" if rc == 0 else "failed"
@@ -74,6 +81,8 @@ def _classify(data: Optional[dict], kind: str) -> str:
         return "skipped"
     if data.get("ok"):
         return "ok"
+    if oom_tail:
+        return "oom"
     return "wedged" if rc == _TIMEOUT_RC or wedge_tail else "failed"
 
 

@@ -150,13 +150,16 @@ class SteppableMemoryProfiler(SteppableProfilerIF):
         return False
 
     def step(self) -> None:
+        # shared device-stat walk (telemetry/device_memory.py): key-wise max
+        # across ALL local devices — same flat record shape the single-device
+        # sampler wrote, but the worst device is the one that OOMs first
         try:
-            import jax
+            from modalities_tpu.telemetry.device_memory import worst_case_memory_stats
 
-            stats = jax.local_devices()[0].memory_stats() or {}
+            stats = worst_case_memory_stats()
         except Exception:
             stats = {}
-        record = {"step": self._step, **{k: int(v) for k, v in stats.items()}}
+        record = {"step": self._step, **stats}
         self._step += 1
         if self._file is None:  # step() without __enter__ (harness misuse): open lazily
             self.output_folder_path.mkdir(parents=True, exist_ok=True)

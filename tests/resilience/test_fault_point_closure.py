@@ -26,6 +26,7 @@ FIRE_SITES = {
     "peer_hang": "peer_hang_if_armed",
     "peer_death": "peer_death_if_armed",
     "host_loss": "host_loss_if_armed",
+    "oom": "fire_oom_if_armed",
 }
 
 

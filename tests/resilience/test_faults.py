@@ -106,6 +106,7 @@ def test_registry_is_the_documented_set():
         "peer_hang",
         "peer_death",
         "host_loss",
+        "oom",
     )
     assert ENV_VAR == "MODALITIES_TPU_FAULTS"
 

@@ -1,0 +1,124 @@
+"""OOM forensics e2e (PR 17 acceptance): a run armed with the `oom` fault
+point dies at its injected step the way an XLA RESOURCE_EXHAUSTED does — the
+dispatch seam writes a parseable `oom_dump_rank_*_step_*.json` naming at least
+one mitigation lever, re-raises as the resumable `OutOfMemory` (exit 75), and
+the --resilient supervisor warmstarts the next incarnation. Covers BOTH seams:
+the Trainer's step dispatch (full config-driven Main run) and the serving
+engine's scheduler round."""
+
+import json
+
+import numpy as np
+import pytest
+
+from modalities_tpu.dataloader.packed_data import write_pbin_file
+from modalities_tpu.main import Main
+from modalities_tpu.resilience import RESUMABLE_EXIT_CODE
+from modalities_tpu.resilience.errors import OutOfMemory, ResumableError
+from modalities_tpu.resilience.events import counts_since, snapshot_counts
+from modalities_tpu.resilience.faults import arm_faults, fire_oom_if_armed
+from tests.resilience.test_chaos_e2e import CONFIG
+from tests.resilience.test_supervisor import _seal_pointer, _supervise
+
+
+# ------------------------------------------------------------- fire-site unit
+
+
+def test_oom_fault_fires_only_at_its_step_and_reads_like_xla():
+    arm_faults("oom@3")
+    assert fire_oom_if_armed(2) is False  # wrong step: nothing happens
+    with pytest.raises(RuntimeError, match="RESOURCE_EXHAUSTED"):
+        fire_oom_if_armed(3)
+    assert fire_oom_if_armed(3) is False  # one shot, then disarmed
+
+
+def test_out_of_memory_is_resumable_exit_75():
+    """The supervisor contract: OutOfMemory must ride the warmstart path, not
+    the crash path — unlike FitsCheckFailure, which would re-die identically."""
+    from modalities_tpu.telemetry.memscope import FitsCheckFailure
+
+    assert issubclass(OutOfMemory, ResumableError)
+    assert RESUMABLE_EXIT_CODE == 75
+    assert not issubclass(FitsCheckFailure, ResumableError)
+
+
+# --------------------------------------------------------- trainer seam (e2e)
+
+
+@pytest.fixture
+def workdir(tmp_path, monkeypatch):
+    rng = np.random.default_rng(0)
+    (tmp_path / "data").mkdir()
+    tokens = rng.integers(0, 256, size=40000)
+    write_pbin_file(tmp_path / "data" / "lorem_ipsum.pbin", iter([tokens]), token_size_in_bytes=2)
+    monkeypatch.chdir(tmp_path)
+    return tmp_path
+
+
+def test_injected_oom_writes_forensics_dump_and_exits_resumable(workdir):
+    """The acceptance e2e: oom@2 through the full config-driven app. The run
+    must raise OutOfMemory (not the injected RuntimeError) pointing at the
+    dump, and the dump must be parseable JSON naming at least one lever."""
+    arm_faults("oom@2")
+    snapshot = snapshot_counts()
+    main = Main(
+        CONFIG,
+        experiments_root_path=workdir / "data" / "experiments",
+        experiment_id="oom_run",
+    )
+    with pytest.raises(OutOfMemory, match="step 2") as err:
+        main.run(main.build_components())
+    assert "warmstart" in str(err.value)  # the message tells the operator the plan
+    assert counts_since(snapshot).get("fault") == 1  # the injected oom fired once
+
+    dumps = list(workdir.rglob("oom_dump_rank_*_step_2.json"))
+    assert len(dumps) == 1, f"expected exactly one dump, found {dumps}"
+    dump = json.loads(dumps[0].read_text())
+    assert dump["event"] == "oom" and dump["step"] == 2
+    assert "RESOURCE_EXHAUSTED" in dump["error"]
+    # at least one concrete, named mitigation lever
+    levers = [entry["lever"] for entry in dump["suggested_levers"]]
+    assert levers and set(levers) & {
+        "zero_stage", "remat", "gradient_accumulation_steps", "paged_num_blocks", "quant_kv"
+    }
+    # step 1 completed before the injection, so the run is resumable in truth,
+    # not just by exit code: the evaluation sink shows progress
+    results = workdir / "data" / "experiments" / "oom_run" / "evaluation_results.jsonl"
+    assert results.exists()
+
+
+def test_supervisor_warmstarts_after_an_oom_exit(tmp_path):
+    """Exit-75 from an OOM incarnation + a sealed checkpoint pointer ⇒ the
+    resilient supervisor's next child command is a warmstart."""
+    _seal_pointer(tmp_path)
+    code, runner, _naps = _supervise(tmp_path, [RESUMABLE_EXIT_CODE, 0])
+    assert code == 0
+    assert len(runner.commands) == 2
+    assert "warmstart" in runner.commands[1]
+
+
+# ------------------------------------------------------------- serving seam
+
+
+def test_engine_dispatch_oom_raises_resumable_and_dumps(tmp_path, monkeypatch):
+    """The serving engine's scheduler round has the same seam: an allocation
+    failure during dispatch becomes OutOfMemory plus a forensics dump (in the
+    cwd when no telemetry sink is active)."""
+    import jax
+    from flax.core import meta
+
+    from modalities_tpu.serving.engine import ServingEngine
+    from tests.models.test_gpt2_model import tiny_gpt2
+
+    monkeypatch.chdir(tmp_path)
+    model = tiny_gpt2("manual")
+    params = meta.unbox(model.init_params(jax.random.PRNGKey(0)))
+    engine = ServingEngine(model, params, max_batch_slots=1)
+    engine.submit([3, 17, 42], 4, temperature=0.0, seed=0)
+    arm_faults("oom@1")  # the first dispatch round
+    with pytest.raises(OutOfMemory, match="step 1"):
+        engine.step(0.0)
+    dumps = list(tmp_path.rglob("oom_dump_rank_*_step_1.json"))
+    assert len(dumps) == 1
+    dump = json.loads(dumps[0].read_text())
+    assert dump["event"] == "oom" and dump["suggested_levers"]

@@ -170,7 +170,11 @@ def test_latency_poisoned_canary_rolls_back_on_slo_with_zero_drops(tmp_path):
         assert parsed["fleet_workers_degraded"][()] == 1.0
         assert parsed["fleet_rollbacks_total"][()] == 1.0
 
-        time.sleep(0.5)  # traffic keeps flowing after the rollback
+        # traffic keeps flowing after the rollback — wait for round-trips, not
+        # wall time, so a loaded box with slow decodes still accumulates enough
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline and len(results) < 3:
+            time.sleep(0.05)
         stop.set()
         client_thread.join(timeout=30.0)
         assert not client_thread.is_alive()

@@ -1,0 +1,124 @@
+"""Shared device-memory stat walk (telemetry/device_memory.py, PR 17): the one
+loop behind the trainer's resource gauges, the watchdog dump, the steppable
+memory profiler, and memscope. The contract under test is tolerance — a
+backend whose `memory_stats()` returns None, {}, partial keys, or raises must
+degrade to 'no data' / an error entry, never crash the run it observes."""
+
+import pytest
+
+from modalities_tpu.telemetry.device_memory import (
+    device_memory_stats,
+    hbm_headroom_mb,
+    local_devices,
+    min_bytes_limit,
+    peak_memory_mb,
+    reset_device_cache,
+    worst_case_memory_stats,
+)
+
+MIB = 1024 * 1024
+
+
+class FakeDevice:
+    """stats=None/{}/dict mimics the backend flavors; stats=Exception raises."""
+
+    def __init__(self, name, stats):
+        self._name = name
+        self._stats = stats
+
+    def __str__(self):
+        return self._name
+
+    def memory_stats(self):
+        if isinstance(self._stats, Exception):
+            raise self._stats
+        return self._stats
+
+
+def _fleet():
+    return [
+        FakeDevice("tpu:0", {"bytes_in_use": 10 * MIB, "peak_bytes_in_use": 12 * MIB,
+                             "bytes_limit": 100 * MIB, "backend": "tpu"}),
+        FakeDevice("tpu:1", {"bytes_in_use": 30 * MIB, "peak_bytes_in_use": 40 * MIB,
+                             "bytes_limit": 90 * MIB}),
+        FakeDevice("cpu:0", None),           # CPU backends report nothing
+        FakeDevice("tpu:2", {}),             # empty dict flavor
+        FakeDevice("tpu:3", RuntimeError("stats probe failed")),
+    ]
+
+
+def test_stats_walk_tolerates_every_backend_flavor():
+    stats = device_memory_stats(_fleet())
+    # numeric-only values survive (the "backend" string is dropped: JSON-safety)
+    assert stats["tpu:0"] == {"bytes_in_use": 10 * MIB, "peak_bytes_in_use": 12 * MIB,
+                              "bytes_limit": 100 * MIB}
+    assert stats["cpu:0"] == {} and stats["tpu:2"] == {}
+    # a raising device contributes an error entry instead of vanishing — a
+    # half-dead device is itself a forensic finding
+    assert "RuntimeError" in stats["tpu:3"]["error"]
+
+
+def test_peak_is_max_and_headroom_is_worst_device():
+    devices = _fleet()
+    assert peak_memory_mb(devices) == 40.0  # max over devices, in MiB
+    # tpu:1 has the least room (90-40=50 vs 100-12=88): the device that OOMs
+    # first is the only headroom that matters
+    assert hbm_headroom_mb(devices) == 50.0
+    assert min_bytes_limit(devices) == 90 * MIB
+
+
+def test_no_data_backends_return_none_not_zero():
+    quiet = [FakeDevice("cpu:0", None), FakeDevice("cpu:1", {})]
+    assert peak_memory_mb(quiet) is None
+    assert hbm_headroom_mb(quiet) is None
+    assert min_bytes_limit(quiet) is None
+    assert device_memory_stats(quiet) == {"cpu:0": {}, "cpu:1": {}}
+
+
+def test_worst_case_is_keywise_max_in_flat_record_shape():
+    worst = worst_case_memory_stats(_fleet())
+    # flat single-device shape (the SteppableMemoryProfiler's jsonl contract),
+    # each key the max across the fleet
+    assert worst == {"bytes_in_use": 30 * MIB, "peak_bytes_in_use": 40 * MIB,
+                     "bytes_limit": 100 * MIB}
+    assert worst_case_memory_stats([FakeDevice("cpu:0", None)]) == {}
+
+
+def test_device_list_is_cached_until_reset(monkeypatch):
+    import jax
+
+    calls = []
+
+    def fake_local_devices():
+        calls.append(1)
+        return [FakeDevice("fake:0", {"bytes_in_use": 1})]
+
+    reset_device_cache()
+    try:
+        monkeypatch.setattr(jax, "local_devices", fake_local_devices)
+        first = local_devices()
+        assert [str(d) for d in first] == ["fake:0"]
+        local_devices()
+        assert len(calls) == 1  # resolved once, cached after
+        # the default-device walk rides the cache
+        assert device_memory_stats() == {"fake:0": {"bytes_in_use": 1}}
+        reset_device_cache()
+        local_devices()
+        assert len(calls) == 2
+    finally:
+        reset_device_cache()  # never leak fakes into other tests
+
+
+def test_real_backend_walk_never_raises():
+    """Whatever this test host's backend reports, the walk returns a dict per
+    device (numeric stats or an error entry) — the never-crash contract."""
+    reset_device_cache()
+    stats = device_memory_stats()
+    assert isinstance(stats, dict)
+    for entry in stats.values():
+        assert isinstance(entry, dict)
+    # and the derived readers accept the same backend without raising
+    peak_memory_mb()
+    hbm_headroom_mb()
+    min_bytes_limit()
+    assert isinstance(worst_case_memory_stats(), dict)

@@ -278,6 +278,10 @@ def _lr_builder(model, mesh_handle, lr):
     )
 
 
+@pytest.mark.slow  # ~20 s; ZeRO-1 correctness stays pinned fast by
+# test_zero_stage0_is_byte_identical + test_zero_stage1_reduce_scatter_contract
+# + test_zero_stage1_donation_audit (HLO contract on the shared hsdp_compiles
+# fixture); the 8-step loss twin runs in the slow tier
 def test_zero_numeric_equivalence():
     """stage 1 == stage 0 losses to rtol 1e-5 over 8 steps on a pure
     dp_replicate=2 mesh. lr=1e-4 keeps the comparison below this CPU backend's

@@ -103,3 +103,31 @@ def test_analyze_bench_cli_table_and_json(tmp_path):
     summary = json.loads(result.output)
     assert summary["best_bench_value"] == 0.382
     assert len(summary["bench"]) == 5 and len(summary["multichip"]) == 4
+
+
+def test_oom_tails_classify_as_oom_not_wedged(tmp_path):
+    """PR-17 satellite: a round whose tail carries RESOURCE_EXHAUSTED died in
+    device allocation — name it `oom` so the trend table points at the memscope
+    levers instead of suggesting a retry. A round that still produced a metric
+    stays ok (a late allocation warning must not hide a measurement)."""
+    _write(tmp_path, "BENCH_r1.json", {
+        "n": 1, "rc": 1, "parsed": None,
+        "tail": "RESOURCE_EXHAUSTED: Out of memory allocating 68719476736 bytes",
+    })
+    # even the wedge-shaped rc wins oom when the tail names the allocator
+    _write(tmp_path, "BENCH_r2.json", {
+        "n": 2, "rc": 124, "parsed": None, "tail": "RESOURCE_EXHAUSTED while compiling",
+    })
+    _write(tmp_path, "BENCH_r3.json", {
+        "n": 3, "rc": 0, "tail": "RESOURCE_EXHAUSTED in warmup retry (recovered)",
+        "parsed": {"metric": "mfu", "value": 0.4, "unit": "ratio"},
+    })
+    _write(tmp_path, "MULTICHIP_r1.json", {
+        "n_devices": 8, "rc": 1, "ok": False, "skipped": False,
+        "tail": "RESOURCE_EXHAUSTED: hbm budget",
+    })
+    summary = summarize_trajectory(tmp_path)
+    assert [r["status"] for r in summary["bench"]] == ["oom", "oom", "ok"]
+    assert summary["multichip"][0]["status"] == "oom"
+    assert "BENCH r1: oom (rc=1)" in summary["flags"]
+    assert "MULTICHIP r1: oom (rc=1)" in summary["flags"]
