@@ -69,6 +69,26 @@ Quantized runs are excluded from the bitwise parity pins; instead the logit
 oracle (quant/oracle.py) runs on the same model/params and reports
 `quant_logit_max_err` / `quant_token_match` in the JSON line.
 
+Disaggregated serving knobs (PR 18; both imply --cache paged):
+  --disagg                 replay the trace through an in-process 1-prefill +
+                           1-decode DisaggPair (serving/disagg/): prefill-tier
+                           engine exports a KV handoff record per request, the
+                           decode-tier engine imports it and streams the rest.
+                           The report gains per-tier latency (`prefill_ttft_*`,
+                           `decode_tpot_*`), `handoff_seconds_p50/p99` (decode
+                           worker's arrival->seeded histogram),
+                           `kv_bytes_shipped`, `handoffs`, `import_requeues`;
+                           both tiers' block pools are invariant-audited.
+  --disagg-oracle          the TPOT-isolation oracle on a DETERMINISTIC
+                           modeled-cost clock (decode step 1ms, prefill chunk
+                           row 4ms, import/CoW 0.02ms per block): four runs —
+                           {disagg, combined} x {mixed long+short prompts,
+                           short-only} — pin that long prefills inflate the
+                           combined engine's TPOT p99 >= 1.5x its own
+                           short-only baseline while the disagg decode tier
+                           stays <= 1.2x ITS short-only baseline (prefill
+                           never co-schedules with decode). A miss exits 1.
+
 SLO gating (PR 15):
   --slo PATH               evaluate the run's final metrics registry against a
                            declarative SLO spec (telemetry/slo.py grammar, same
@@ -133,6 +153,20 @@ METRIC_KEYS = (
     # SLO gating (--slo; None otherwise)
     "slo",
     "slo_burning",
+    # disaggregated serving (--disagg / --disagg-oracle; None otherwise)
+    "disagg",
+    "prefill_ttft_p50_ms",
+    "prefill_ttft_p99_ms",
+    "decode_tpot_p50_ms",
+    "decode_tpot_p99_ms",
+    "handoff_seconds_p50",
+    "handoff_seconds_p99",
+    "kv_bytes_shipped",
+    "handoffs",
+    "import_requeues",
+    "tpot_isolation",
+    "disagg_tpot_inflation",
+    "combined_tpot_inflation",
 )
 
 
@@ -341,6 +375,358 @@ def _percentiles_ms(values):
     return float(np.percentile(arr, 50)), float(np.percentile(arr, 99))
 
 
+# ---------------------------------------------------------------------------
+# disaggregated serving (--disagg / --disagg-oracle)
+
+# modeled per-dispatch costs for the deterministic TPOT oracle: a decode step
+# is the unit, a prefill chunk row is 4x it (the long-prompt pressure source),
+# block import/CoW are noise-level (they must NOT hide a real isolation break)
+_C_DECODE_STEP = 0.001
+_C_PREFILL_ROW = 0.004
+_C_IMPORT_BLOCK = 0.00002
+_C_COW = 0.00002
+
+
+class _CostClock:
+    """Deterministic modeled-cost clock: `now()` is a sum of explicit
+    `advance()` calls, so latency percentiles depend only on WHAT was
+    dispatched, never on host speed. Each engine gets its OWN clock — two
+    tiers on two machines have independent timelines (the combined engine's
+    single clock is exactly what charges prefill chunks to decode gaps)."""
+
+    def __init__(self):
+        self._t = 0.0
+
+    def now(self) -> float:
+        return self._t
+
+    def advance(self, dt: float) -> None:
+        self._t += dt
+
+
+def _cost_tracker(engine, clock):
+    """Advance `clock` by the modeled cost of whatever `engine` dispatched
+    since the last call (counter deltas; create AFTER warmup)."""
+    last = {
+        "d": engine.decode_steps, "p": engine.prefill_chunk_count,
+        "i": engine.imported_blocks, "c": engine.cow_copies,
+    }
+
+    def advance():
+        cur = {
+            "d": engine.decode_steps, "p": engine.prefill_chunk_count,
+            "i": engine.imported_blocks, "c": engine.cow_copies,
+        }
+        clock.advance(
+            (cur["d"] - last["d"]) * _C_DECODE_STEP
+            + (cur["p"] - last["p"]) * _C_PREFILL_ROW
+            + (cur["i"] - last["i"]) * _C_IMPORT_BLOCK
+            + (cur["c"] - last["c"]) * _C_COW
+        )
+        last.update(cur)
+
+    return advance
+
+
+def _run_pair(model, params, trace, slots, *, quant_kv="none", paged_max_len=64,
+              arrivals=True):
+    """One 1-prefill + 1-decode DisaggPair over `trace` (warmup first, so
+    compiles stay out of the window). Returns (results in trace order,
+    prefill engine, decode engine, wall_s)."""
+    from modalities_tpu.serving.disagg.pair import DisaggPair
+    from modalities_tpu.serving.engine import ServingEngine
+    from modalities_tpu.telemetry.metrics import MetricsRegistry
+
+    def mk(role):
+        return ServingEngine(
+            model, params, max_batch_slots=slots, eod_token_id=-1,
+            kv_cache="paged", paged_block_size=8, paged_max_len=paged_max_len,
+            quant_kv=quant_kv, metrics=MetricsRegistry(), role=role,
+        )
+
+    peng, deng = mk("prefill"), mk("decode")
+    pair = DisaggPair(peng, deng)
+
+    # warmup covers prefill ladder + handoff gather on the prefill tier and
+    # import scatter + decode on the decode tier
+    pair.submit(list(range(21)), 3, temperature=0.0, seed=0)
+    pair.submit(list(range(5)), 3, temperature=0.8, seed=1)
+    pair.run()
+    peng.metrics.reset()
+    deng.metrics.reset()
+    # warmup's handoffs stay out of the reported shipped-bytes numbers
+    peng.handoff_bytes_shipped = 0
+    peng.handoffs_exported = 0
+    deng.handoffs_imported = 0
+
+    t0 = time.monotonic()
+    rids = [
+        pair.submit(
+            r["prompt"], r["max_new_tokens"], temperature=r["temperature"],
+            seed=r["seed"],
+            arrival_offset_s=r["arrival_offset_s"] if arrivals else 0.0,
+        )
+        for r in trace
+    ]
+    results = pair.run()
+    wall = time.monotonic() - t0
+    return [results[r] for r in rids], peng, deng, wall
+
+
+def _drive_modeled(engine, clock, advance):
+    """Step `engine` to drain on its OWN modeled clock: each step advances the
+    clock by the modeled cost of what it dispatched; an idle step with queued
+    arrivals jumps the clock to the next arrival (an idle machine costs
+    nothing, it just waits)."""
+    t0 = clock.now()
+    while engine._queue or engine._active_count():
+        did = engine.step(t0)
+        advance()
+        if not did and engine._queue:
+            head = min(r.arrival_offset_s for r in engine._queue)
+            wait = head - (clock.now() - t0)
+            clock.advance(wait if wait > 0 else _C_DECODE_STEP)
+    return t0
+
+
+class _MergedResult:
+    """A two-tier request's client view for the oracle: token #1 off the
+    prefill tier, the rest off the decode tier."""
+
+    def __init__(self, prefill_res, decode_res):
+        self.tokens = list(prefill_res.tokens)
+        self.token_times_s = list(prefill_res.token_times_s)
+        if decode_res is not None:
+            self.tokens += list(decode_res.tokens)
+            self.token_times_s += list(decode_res.token_times_s)
+
+
+def _run_disagg_modeled(model, params, trace, slots, paged_max_len):
+    """The oracle's disagg arm: each tier runs on its OWN modeled clock (two
+    machines, one epoch). The prefill tier drains first — its work never
+    depends on decode feedback — then every handoff record is imported with
+    `arrival_offset_s` = the moment its prefill finished, and the decode tier
+    drains. Decode-tier gaps therefore contain ONLY decode steps and block
+    imports: prefill chunks never land on this timeline, which is the
+    isolation claim itself."""
+    from modalities_tpu.serving.engine import ServingEngine
+    from modalities_tpu.telemetry.metrics import MetricsRegistry
+
+    pclock, dclock = _CostClock(), _CostClock()
+    peng = ServingEngine(
+        model, params, max_batch_slots=slots, eod_token_id=-1,
+        kv_cache="paged", paged_block_size=8, paged_max_len=paged_max_len,
+        metrics=MetricsRegistry(), role="prefill", time_fn=pclock.now,
+    )
+    deng = ServingEngine(
+        model, params, max_batch_slots=slots, eod_token_id=-1,
+        kv_cache="paged", paged_block_size=8, paged_max_len=paged_max_len,
+        metrics=MetricsRegistry(), role="decode", time_fn=dclock.now,
+    )
+    # warmup both tiers' executables before the trackers exist, so compiles
+    # cost zero modeled time
+    w0 = peng.submit(list(range(21)), 3, temperature=0.0, seed=0)
+    w1 = peng.submit(list(range(5)), 3, temperature=0.8, seed=1)
+    peng.run()
+    for w in (w0, w1):
+        deng.import_handoff(peng._results[w].handoff)
+    deng.run()
+
+    padv, dadv = _cost_tracker(peng, pclock), _cost_tracker(deng, dclock)
+    rids = [
+        peng.submit(
+            r["prompt"], r["max_new_tokens"], temperature=r["temperature"],
+            seed=r["seed"], arrival_offset_s=r["arrival_offset_s"],
+        )
+        for r in trace
+    ]
+    t0p = _drive_modeled(peng, pclock, padv)
+    imported = {}
+    for rid in rids:
+        res = peng._results[rid]
+        if res.finish_reason != "handoff":
+            continue
+        # the record becomes importable the moment its prefill finished
+        imported[rid] = deng.import_handoff(
+            res.handoff, arrival_offset_s=res.token_times_s[0]
+        )
+    _drive_modeled(deng, dclock, dadv)
+    return [
+        _MergedResult(peng._results[rid], deng._results.get(imported.get(rid)))
+        for rid in rids
+    ]
+
+
+def _run_combined_modeled(model, params, trace, slots, paged_max_len):
+    """The oracle's combined twin: ONE engine, ONE modeled clock — prefill
+    chunk costs and decode step costs land on the same timeline, which is the
+    TPOT interference being measured."""
+    from modalities_tpu.serving.engine import ServingEngine
+    from modalities_tpu.telemetry.metrics import MetricsRegistry
+
+    clock = _CostClock()
+    engine = ServingEngine(
+        model, params, max_batch_slots=slots, eod_token_id=-1,
+        kv_cache="paged", paged_block_size=8, paged_max_len=paged_max_len,
+        metrics=MetricsRegistry(), time_fn=clock.now,
+    )
+    engine.submit(list(range(21)), 3, temperature=0.0, seed=0)
+    engine.submit(list(range(5)), 3, temperature=0.8, seed=1)
+    engine.run()
+    adv = _cost_tracker(engine, clock)
+    rids = [
+        engine.submit(
+            r["prompt"], r["max_new_tokens"], temperature=r["temperature"],
+            seed=r["seed"], arrival_offset_s=r["arrival_offset_s"],
+        )
+        for r in trace
+    ]
+    _drive_modeled(engine, clock, adv)
+    return [engine._results[r] for r in rids]
+
+
+def _steady_tpot_gaps(token_times_lists):
+    """Inter-token gaps past token #3 of each request: the first gap crosses
+    the prefill->decode boundary (in the pair, the tier clock boundary too)
+    and the second still sits in the admission burst every mode shares, so
+    the steady-state decode cadence starts after both."""
+    gaps = []
+    for ts in token_times_lists:
+        tail = ts[2:]
+        gaps.extend(b - a for a, b in zip(tail, tail[1:]))
+    return gaps
+
+
+def _oracle_traces(seed: int):
+    """The oracle's workload: 8 single-chunk short prompts (arriving at t=0,
+    decoding for a while) plus 2 long prompts (48 tokens = 6 chunk rows at
+    block 8) arriving MID-DECODE at staggered modeled times. The short-only
+    baseline therefore has zero mid-decode prefill — its steady TPOT is the
+    pure decode-step cost — while the mixed run's long prefills land squarely
+    on the combined engine's decode timeline."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    shorts = [
+        {
+            "prompt": [int(x) for x in rng.integers(0, 127, size=int(rng.integers(4, 9)))],
+            "max_new_tokens": 24,
+            "temperature": 0.0,
+            "seed": i,
+            "arrival_offset_s": 0.0,
+        }
+        for i in range(8)
+    ]
+    longs = [
+        {
+            "prompt": [int(x) for x in rng.integers(0, 127, size=48)],
+            "max_new_tokens": 8,
+            "temperature": 0.0,
+            "seed": 100 + i,
+            "arrival_offset_s": 0.04 + 0.008 * i,
+        }
+        for i in range(2)
+    ]
+    return shorts, longs
+
+
+def _p99(values):
+    import numpy as np
+
+    return float(np.percentile(np.asarray(values, dtype=float), 99))
+
+
+def _run_disagg_mode(args, model, params) -> int:
+    """The --disagg branch of main(): pair replay report, plus the modeled
+    TPOT-isolation oracle under --disagg-oracle."""
+    from modalities_tpu.telemetry.metrics import (
+        histogram_quantile_from_parsed,
+        parse_prometheus_text,
+    )
+
+    paged_max_len = 64
+    oracle = {}
+    oracle_failed = False
+    if args.disagg_oracle:
+        shorts, longs = _oracle_traces(args.seed)
+        slots = len(shorts) + len(longs)  # slots never gate admission here
+        d_mixed = _run_disagg_modeled(model, params, shorts + longs, slots, paged_max_len)
+        d_short = _run_disagg_modeled(model, params, shorts, slots, paged_max_len)
+        c_mixed = _run_combined_modeled(model, params, shorts + longs, slots, paged_max_len)
+        c_short = _run_combined_modeled(model, params, shorts, slots, paged_max_len)
+        # disagg TPOT = the decode TIER's cadence; combined TPOT = the one
+        # engine's cadence. Each mode is judged against ITS OWN short-only
+        # baseline, so the ratio isolates long-prefill interference.
+        d_ratio = _p99(_steady_tpot_gaps([r.token_times_s for r in d_mixed])) / _p99(
+            _steady_tpot_gaps([r.token_times_s for r in d_short])
+        )
+        c_ratio = _p99(_steady_tpot_gaps([r.token_times_s for r in c_mixed])) / _p99(
+            _steady_tpot_gaps([r.token_times_s for r in c_short])
+        )
+        # cross-check: same trace, both modes, bitwise-identical greedy tokens
+        tokens_match = all(
+            a.tokens == b.tokens for a, b in zip(d_mixed, c_mixed)
+        )
+        oracle_failed = not (d_ratio <= 1.2 and c_ratio >= 1.5 and tokens_match)
+        oracle = {
+            "disagg_tpot_inflation": d_ratio,
+            "combined_tpot_inflation": c_ratio,
+            "tpot_isolation": "fail" if oracle_failed else "ok",
+        }
+
+    trace = _make_trace(args.requests, args.rate, args.max_new, args.seed, 0, paged_max_len)
+    results, peng, deng, wall = _run_pair(
+        model, params, trace, args.slots,
+        quant_kv=args.quant_kv, paged_max_len=paged_max_len,
+    )
+    generated = sum(len(r.tokens) for r in results)
+
+    prefill_ttft_p50, prefill_ttft_p99 = _percentiles_ms([r.ttft_s for r in results])
+    decode_tpot_p50, decode_tpot_p99 = _percentiles_ms(
+        _steady_tpot_gaps([r.token_times_s for r in results])
+    )
+    parsed_decode = parse_prometheus_text(deng.metrics.render())
+
+    def _handoff_pct(q):
+        return histogram_quantile_from_parsed(parsed_decode, "disagg_handoff_seconds", q)
+
+    # both tiers' pools must come back pristine (same audit as combined runs)
+    for engine in (peng, deng):
+        engine._table_state.check()
+        stats = engine.stats()
+        assert stats["free_blocks"] == stats["num_blocks"], "blocks leaked"
+
+    print(
+        _line(
+            {
+                "provisional": False,
+                "disagg": True,
+                "tokens_per_s": generated / wall if wall > 0 else 0.0,
+                "prefill_ttft_p50_ms": prefill_ttft_p50,
+                "prefill_ttft_p99_ms": prefill_ttft_p99,
+                "decode_tpot_p50_ms": decode_tpot_p50,
+                "decode_tpot_p99_ms": decode_tpot_p99,
+                "handoff_seconds_p50": _handoff_pct(0.50),
+                "handoff_seconds_p99": _handoff_pct(0.99),
+                "kv_bytes_shipped": peng.handoff_bytes_shipped,
+                "handoffs": peng.handoffs_exported,
+                "import_requeues": deng.import_requeues,
+                "quant_kv": peng.stats()["quant_kv"],
+                "pool_audit": "ok",
+                **oracle,
+                "cache": "paged",
+                "requests": args.requests,
+                "slots": args.slots,
+                "generated_tokens": generated,
+                "wall_s": wall,
+                "smoke": args.smoke,
+            }
+        ),
+        flush=True,
+    )
+    return 1 if oracle_failed else 0
+
+
 def main() -> int:
     parser = argparse.ArgumentParser(description=__doc__.split("\n", 1)[0])
     parser.add_argument("--slots", type=int, default=8)
@@ -407,6 +793,18 @@ def main() -> int:
         "point-in-time and a breaching objective fails the bench (exit 1)",
     )
     parser.add_argument(
+        "--disagg", action="store_true",
+        help="replay through an in-process 1-prefill + 1-decode DisaggPair "
+        "(implies --cache paged); reports per-tier TTFT/TPOT, handoff "
+        "latency, and KV bytes shipped",
+    )
+    parser.add_argument(
+        "--disagg-oracle", action="store_true",
+        help="run the modeled-clock TPOT-isolation oracle (implies --disagg): "
+        "combined TPOT p99 must inflate >= 1.5x under long prompts while the "
+        "disagg decode tier stays <= 1.2x its own baseline; a miss exits 1",
+    )
+    parser.add_argument(
         "--hot_swap_every", type=int, default=0,
         help="hot-swap identical weights every N decode steps mid-flight and "
         "oracle the output against a swap-free twin run (token-bitwise); "
@@ -425,6 +823,12 @@ def main() -> int:
         args.cache = "paged"  # prefix sharing + spec decode live on the block pool
     if args.quant_kv != "none" or args.kv_pool_bytes is not None:
         args.cache = "paged"  # quantized KV blocks live on the block pool
+    if args.disagg_oracle:
+        args.disagg = True
+    if args.disagg:
+        args.cache = "paged"  # KV handoff is block-granular
+        if args.spec or args.hot_swap_every or args.shared_prefix_frac is not None:
+            parser.error("--disagg composes with --quant-kv only")
 
     print(_line({"provisional": True, "reason": "startup"}), flush=True)
     _arm_budget_guard()
@@ -441,6 +845,9 @@ def main() -> int:
 
     model = _tiny_model()
     params = meta.unbox(model.init_params(jax.random.PRNGKey(0)))
+
+    if args.disagg:
+        return _run_disagg_mode(args, model, params)
 
     capacity = 64  # _tiny_model sequence_length == default ring cache_capacity
     if args.shared_prefix_frac is not None:

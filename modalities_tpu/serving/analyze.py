@@ -317,16 +317,22 @@ def format_fleet_trace_tree(traces: list[dict]) -> str:
                 f"forwarded_tokens={router.get('forwarded_tokens')}"
             )
             for leg in router.get("legs") or ():
+                # disagg routers tag each leg with its tier: the prefill and
+                # decode halves of one answer render as separate spans
+                tier = f"  tier={leg['tier']}" if leg.get("tier") else ""
                 lines.append(
-                    f"  router leg hop={leg.get('hop')}  worker={leg.get('worker')}  "
-                    f"outcome={leg.get('outcome')}  "
+                    f"  router leg hop={leg.get('hop')}  worker={leg.get('worker')}"
+                    f"{tier}  outcome={leg.get('outcome')}  "
                     f"forwarded_tokens={leg.get('forwarded_tokens')}"
                 )
         else:
             lines.append(f"trace {trace['trace_id']}  (no router record)")
         for rec in trace["worker_legs"]:
+            # tiered engines stamp their role into the request record; a
+            # combined engine's record stays the bare "worker leg"
+            kind = f"{rec['role']} leg" if rec.get("role") else "worker leg"
             row = (
-                f"  worker leg hop={rec.get('hop')}  rid={rec.get('rid')}  "
+                f"  {kind} hop={rec.get('hop')}  rid={rec.get('rid')}  "
                 f"finish={rec.get('finish_reason')}  tokens={rec.get('tokens')}"
             )
             if rec.get("ttft_s") is not None:

@@ -1,0 +1,202 @@
+"""`serve --fleet` glue for the DISAGGREGATED fleet: prefill tier + decode
+tier behind a DisaggRouter.
+
+The `inference_component.disagg` variant (configs/config_disagg.yaml) boots
+`prefill_workers` engines with ``role="prefill"`` and `decode_workers` engines
+with ``role="decode"`` — each with its own MetricsRegistry and loopback HTTP
+front end — and a DisaggRouter as the public face. `POST /generate` on the
+router runs the two-leg dispatch (prefill leg -> KV handoff -> decode leg)
+and streams ONE SSE answer.
+
+SLO wiring is PER TIER: each objective is armed only on the workers whose
+tier owns its metric — TTFT objectives (`serve_ttft_seconds`) guard the
+prefill tier, TPOT objectives (`serve_tpot_seconds`) guard the decode tier,
+everything else (error rates, queue depth) guards both. A breaching worker's
+/healthz flips to "degraded" carrying the breaching objective names; the
+router's health loop folds those into `fleet/tier_pressure` recommendations
+naming WHICH tier to grow. That is the sizing loop: TTFT burn -> grow
+prefill, TPOT burn -> grow decode.
+
+Workers keep the per-worker /admin/swap seam (same handler as the flat
+fleet), so a hot swap bumps that worker's weights generation — and the decode
+tier's import-time generation gate is what turns a half-swapped fleet into
+`fleet/rollback stage=generation` events instead of silent KV corruption.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Optional
+
+from modalities_tpu.serving.fleet.component import FleetServingComponent
+from modalities_tpu.serving.serve import ServingComponent, ServingComponentConfig
+
+logger = logging.getLogger(__name__)
+
+# metric -> owning tier; objectives over other metrics arm on both tiers
+_TIER_METRICS = {
+    "serve_ttft_seconds": "prefill",
+    "serve_tpot_seconds": "decode",
+}
+
+
+class DisaggComponentConfig(ServingComponentConfig):
+    """Schema of the `serving_component` node in configs/config_disagg.yaml."""
+
+    prefill_workers: int = 1
+    decode_workers: int = 1
+    health_interval_s: float = 0.5
+    heartbeat_deadline_s: Optional[float] = None  # None = env / 5s
+
+
+class DisaggServingComponent(ServingComponent):
+    """ServingComponent whose run mode is a two-tier disagg fleet."""
+
+    def __init__(
+        self,
+        *args,
+        prefill_workers: int = 1,
+        decode_workers: int = 1,
+        health_interval_s: float = 0.5,
+        heartbeat_deadline_s: Optional[float] = None,
+        **kwargs,
+    ):
+        super().__init__(*args, **kwargs)
+        if prefill_workers < 1 or decode_workers < 1:
+            raise ValueError("disagg needs >= 1 worker in EACH tier")
+        if self.kv_cache not in (None, "paged"):
+            raise ValueError(
+                f"kv_cache={self.kv_cache!r}: disagg tiers require the paged "
+                "KV cache (block-granular handoff)"
+            )
+        self.kv_cache = "paged"
+        self.prefill_workers = int(prefill_workers)
+        self.decode_workers = int(decode_workers)
+        self.health_interval_s = health_interval_s
+        self.heartbeat_deadline_s = heartbeat_deadline_s
+
+    # ------------------------------------------------------------- fleet run
+    def run_fleet(self) -> dict:
+        """Boot both tiers → DisaggRouter → per-tier SLOs; block until the
+        stop flag drains everything (same contract as the flat fleet)."""
+        from modalities_tpu.serving.disagg.router import DisaggRouter
+        from modalities_tpu.serving.engine import ServingEngine
+        from modalities_tpu.serving.fleet.controller import EngineWorker
+        from modalities_tpu.serving.fleet.router import WorkerHandle
+        from modalities_tpu.serving.serve import load_serving_params
+        from modalities_tpu.serving.server import ServingHTTPServer
+        from modalities_tpu.telemetry.metrics import MetricsRegistry
+
+        if self.params is None:
+            raise ValueError("params not resolved — serve() loads them first")
+
+        import functools
+
+        load_quantized = functools.partial(
+            load_serving_params, quant_weights=self.quant_weights_setting
+        )
+
+        def encode(prompt: str) -> list[int]:
+            text = self.prompt_template.format(prompt=prompt) if self.prompt_template else prompt
+            return list(self.tokenizer.tokenize(text))
+
+        def boot(name: str, role: str) -> EngineWorker:
+            engine = ServingEngine(
+                self.model,
+                self.params,
+                max_batch_slots=self.max_batch_slots,
+                cache_capacity=self.cache_capacity,
+                eod_token_id=self._eod_id(),
+                default_temperature=self.temperature,
+                kv_cache="paged",
+                paged_block_size=self.paged_block_size,
+                paged_num_blocks=self.paged_num_blocks,
+                paged_max_len=self.paged_max_len,
+                prefix_sharing=self.prefix_sharing,
+                # prefill tier never decodes — spec_decode only arms decode
+                spec_decode=self.spec_decode if role == "decode" else None,
+                quant_weights=self.quant_weights_setting,
+                quant_kv=self.quant_kv_setting,
+                stop_fn=self.stop_fn,
+                mesh_handle=self.device_mesh,
+                metrics=MetricsRegistry(),  # per-worker: tier SLOs stay isolated
+                role=role,
+            )
+            server = ServingHTTPServer(
+                engine,
+                encode=encode,
+                decode=self.tokenizer.decode,
+                host=self.http_host,
+                port=0,  # loopback ephemeral: the router is the public face
+                default_max_new_tokens=self.max_new_tokens,
+            )
+            worker = EngineWorker(name, engine, server)
+            server.swap_handler = FleetServingComponent._swap_handler(
+                worker, load_quantized
+            )
+            server.start()
+            return worker
+
+        prefill = [boot(f"prefill{i}", "prefill") for i in range(self.prefill_workers)]
+        decode = [boot(f"decode{i}", "decode") for i in range(self.decode_workers)]
+        workers = prefill + decode
+        tier_of = {w.name: ("prefill" if w in prefill else "decode") for w in workers}
+
+        # per-TIER SLOs: each worker arms only the objectives its tier owns
+        slo_engines = []
+        if self.slo:
+            from modalities_tpu.telemetry.slo import SLOEngine, load_slo_spec
+
+            objectives, options = load_slo_spec(self.slo)
+            for worker in workers:
+                tier = tier_of[worker.name]
+                armed = [
+                    o for o in objectives
+                    if _TIER_METRICS.get(o.metric, tier) == tier
+                ]
+                if not armed:
+                    continue
+                slo_engine = SLOEngine(
+                    armed, worker.engine.metrics, scope=worker.name, **options
+                ).start()
+                worker.server.slo_status_fn = slo_engine.breaching
+                slo_engines.append(slo_engine)
+                logger.info(
+                    "disagg SLOs armed on %s (%s tier): %s",
+                    worker.name, tier, ", ".join(o.name for o in armed),
+                )
+
+        fleet_registry = MetricsRegistry()
+        router = DisaggRouter(
+            [WorkerHandle(w.name, self.http_host, w.server.port) for w in prefill],
+            [WorkerHandle(w.name, self.http_host, w.server.port) for w in decode],
+            host=self.http_host,
+            port=self.http_port or 0,
+            metrics=fleet_registry,
+            health_interval_s=self.health_interval_s,
+            heartbeat_deadline_s=self.heartbeat_deadline_s,
+        )
+        router.start()
+
+        logger.info(
+            "disagg serving: %d prefill + %d decode workers behind router on %s:%d",
+            len(prefill), len(decode), self.http_host, router.port,
+        )
+        try:
+            while not (self.stop_fn is not None and self.stop_fn()):
+                time.sleep(0.2)
+        finally:
+            for slo_engine in slo_engines:
+                slo_engine.stop()
+            router.stop()
+            for worker in workers:  # drain all workers concurrently...
+                worker.server.stop()
+            worker_stats = {}
+            for worker in workers:  # ...then reap each one
+                worker_stats[worker.name] = worker.server.serve_forever()
+            router.close()
+        return {
+            "fleet": router._fleet_table(),
+            "workers": worker_stats,
+        }

@@ -1,0 +1,204 @@
+"""The versioned KV handoff record — the only thing that crosses the
+prefill->decode tier boundary.
+
+A record carries everything the decode tier needs to continue a request as if
+it had prefilled locally:
+
+- `payload`: the request's pool blocks in POOL LAYOUT — one host array per
+  cache-tree leaf (tree-flatten order), shaped ``[n_blocks, *block_row]``
+  where ``block_row`` is the leaf's shape with the block axis removed
+  (``[layers, block_size, kv_heads, head_dim]`` for the scanned K/V pools,
+  plus the ``[layers, block_size, kv_heads, 1]`` f32 scale mirror under
+  ``quant_kv: int8``). Quantized blocks ship VERBATIM: int8 data + f32
+  scales, no dequant/requant round trip — the bytes the decode tier scatters
+  into its pool are the bytes the prefill tier gathered out of its own.
+- the position-ordered logical block order is the payload's first axis
+  (block i covers positions ``[i*block_size, (i+1)*block_size)``); physical
+  pool ids never cross the wire — each tier owns its own pool.
+- sampler state: the PRNG key AFTER the first-token draw, temperature, and
+  the remaining decode budget (the admission clamp already applied), so the
+  decode tier's key-split discipline continues bitwise where prefill left it.
+- `last_token`: the first generated token — the decode tier feeds it as its
+  first decode input exactly like the combined engine does post-prefill.
+- `generation`: the weights generation the KV was computed under. The decode
+  tier REJECTS cross-generation imports (``fleet/rollback stage=generation``):
+  after a hot swap, old-generation KV spliced under new weights would decode
+  garbage that no digest can catch.
+- `digest`: sha256 over the payload bytes + the token/sampler metadata,
+  recomputed and checked at import (reason="digest_mismatch" on failure).
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+HANDOFF_VERSION = 1
+
+
+class HandoffRejected(Exception):
+    """An import-side validation failure. `reason` is the
+    `disagg_handoff_failures_total` label value (digest_mismatch,
+    generation_mismatch, version_mismatch, config_mismatch, ...)."""
+
+    def __init__(self, reason: str, detail: str):
+        super().__init__(detail)
+        self.reason = reason
+        self.detail = detail
+
+
+def _dtype_from_name(name: str) -> np.dtype:
+    """np.dtype by name, reaching into ml_dtypes for the extended float
+    families (bfloat16, float8_*) numpy itself does not know."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes  # jax dependency, always present
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+@dataclass
+class HandoffRecord:
+    """One prefilled request, packaged for the decode tier. See module
+    docstring for field semantics."""
+
+    version: int
+    generation: int
+    quant_kv: str  # "none" | "int8" — must match the importing pool
+    block_size: int
+    window: list[int]  # admitted prompt window (positions [0, len) resident)
+    last_token: int  # first generated token, fed by the decode tier next
+    key: np.ndarray  # [2] uint32 sampler key AFTER the first-token draw
+    temperature: float
+    remaining: int  # decode budget left (admission clamp already applied)
+    seed: int
+    payload: list[np.ndarray]  # per cache leaf: [n_blocks, *block_row]
+    digest: str = ""
+    trace_id: str = ""
+    trace_hop: int = 0
+    rid: int = -1  # prefill-side rid (diagnostics only)
+    prompt_len: int = 0  # original prompt length (pre-truncation)
+    truncated: bool = False
+
+    @property
+    def kv_bytes(self) -> int:
+        """Bytes shipped across the tier boundary (payload only)."""
+        return int(sum(arr.nbytes for arr in self.payload))
+
+    @property
+    def num_blocks(self) -> int:
+        return int(self.payload[0].shape[0]) if self.payload else 0
+
+    # ------------------------------------------------------------- digest
+    def compute_digest(self) -> str:
+        """sha256 over the payload bytes + every field that changes what the
+        decode tier would generate. Leaf order/dtype/shape are folded in, so
+        a layout mix-up fails as loudly as a flipped byte."""
+        h = hashlib.sha256()
+        h.update(
+            repr(
+                (
+                    self.version,
+                    self.generation,
+                    self.quant_kv,
+                    self.block_size,
+                    tuple(int(t) for t in self.window),
+                    int(self.last_token),
+                    float(self.temperature),
+                    int(self.remaining),
+                    int(self.seed),
+                )
+            ).encode()
+        )
+        h.update(np.ascontiguousarray(self.key, dtype=np.uint32).tobytes())
+        for arr in self.payload:
+            h.update(str(arr.dtype).encode())
+            h.update(repr(tuple(arr.shape)).encode())
+            h.update(np.ascontiguousarray(arr).tobytes())
+        return h.hexdigest()
+
+    def seal(self) -> "HandoffRecord":
+        self.digest = self.compute_digest()
+        return self
+
+    def verify_digest(self) -> None:
+        got = self.compute_digest()
+        if got != self.digest:
+            raise HandoffRejected(
+                "digest_mismatch",
+                f"handoff payload digest {got[:12]}... != sealed {self.digest[:12]}...",
+            )
+
+    # --------------------------------------------------------------- wire
+    def to_wire(self) -> dict:
+        """JSON-safe dict (arrays as base64 + dtype/shape), for the HTTP legs.
+        The in-process pair skips this entirely and hands records by
+        reference — serialization is a transport concern, not a semantic
+        one."""
+        return {
+            "version": self.version,
+            "generation": self.generation,
+            "quant_kv": self.quant_kv,
+            "block_size": self.block_size,
+            "window": [int(t) for t in self.window],
+            "last_token": int(self.last_token),
+            "key": [int(v) for v in np.asarray(self.key, dtype=np.uint32).ravel()],
+            "temperature": float(self.temperature),
+            "remaining": int(self.remaining),
+            "seed": int(self.seed),
+            "digest": self.digest,
+            "trace_id": self.trace_id,
+            "trace_hop": int(self.trace_hop),
+            "rid": int(self.rid),
+            "prompt_len": int(self.prompt_len),
+            "truncated": bool(self.truncated),
+            "payload": [
+                {
+                    "dtype": str(arr.dtype),
+                    "shape": list(arr.shape),
+                    "data": base64.b64encode(
+                        np.ascontiguousarray(arr).tobytes()
+                    ).decode("ascii"),
+                }
+                for arr in self.payload
+            ],
+        }
+
+    @classmethod
+    def from_wire(cls, wire: dict) -> "HandoffRecord":
+        try:
+            payload = [
+                np.frombuffer(
+                    base64.b64decode(leaf["data"]),
+                    dtype=_dtype_from_name(leaf["dtype"]),
+                ).reshape(leaf["shape"])
+                for leaf in wire["payload"]
+            ]
+            return cls(
+                version=int(wire["version"]),
+                generation=int(wire["generation"]),
+                quant_kv=str(wire["quant_kv"]),
+                block_size=int(wire["block_size"]),
+                window=[int(t) for t in wire["window"]],
+                last_token=int(wire["last_token"]),
+                key=np.asarray(wire["key"], dtype=np.uint32),
+                temperature=float(wire["temperature"]),
+                remaining=int(wire["remaining"]),
+                seed=int(wire.get("seed") or 0),
+                payload=payload,
+                digest=str(wire.get("digest") or ""),
+                trace_id=str(wire.get("trace_id") or ""),
+                trace_hop=int(wire.get("trace_hop") or 0),
+                rid=int(wire.get("rid", -1)),
+                prompt_len=int(wire.get("prompt_len") or 0),
+                truncated=bool(wire.get("truncated", False)),
+            )
+        except (KeyError, TypeError, ValueError) as exc:
+            raise HandoffRejected(
+                "malformed", f"unreadable handoff record: {type(exc).__name__}: {exc}"
+            ) from exc

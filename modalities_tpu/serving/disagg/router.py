@@ -1,0 +1,376 @@
+"""DisaggRouter: two-leg dispatch over a tiered fleet.
+
+Extends the flat FleetRouter (serving/fleet/router.py) with the disagg
+request shape: `POST /generate` becomes
+
+    prefill leg  — POST /disagg/prefill on a prefill-tier worker; ONE JSON
+                   response carrying token #1 + the wire handoff record
+    decode leg   — POST /disagg/import on a decode-tier worker; SSE stream of
+                   tokens #2.. relayed to the client
+
+and the client still sees ONE SSE answer: the router re-emits the prefill
+token as the first SSE event, relays the decode stream behind it, and merges
+the prefill token into the final `done` event (completion + token_ids cover
+the whole answer). `X-Trace-Id` rides every leg — router -> prefill worker ->
+decode worker carry the SAME trace_id with the hop counter incrementing per
+leg, so analyze_fleet stitches all three record streams under one trace.
+
+Failover is tier-aware:
+- prefill leg dies (connection refused/timeout, bounded by
+  ``MODALITIES_TPU_DISAGG_HANDOFF_TIMEOUT_S``) -> worker out of rotation,
+  retry another prefill worker; nothing was streamed, so the replay is exact.
+- decode leg dies mid-stream -> decode worker out of rotation and the request
+  REPLAYS through a fresh prefill on a healthy pair: same trace_id, hop
+  incremented, and the token splice skips everything the client already has
+  (prefill re-emits token #1 — skipped; the new decode stream starts at
+  overall position 2 via `stream_offset`). Deterministic engines make the
+  splice exact.
+- decode worker REJECTS the import (digest_mismatch after a flaky wire,
+  generation_mismatch after a hot swap): the worker is healthy, the RECORD is
+  bad — it stays in rotation and the request replays via fresh prefill, which
+  re-exports on the current weights generation.
+
+Per-tier SLO wiring rides the health loop: each worker's /healthz carries its
+breaching objective names (the disagg component points TTFT objectives at
+prefill workers and TPOT objectives at decode workers), and
+`_after_health_round` turns sustained breach or dead workers into
+``fleet/tier_pressure`` recommendation events naming WHICH tier to grow —
+replacing ad-hoc thresholds with error-budget burn.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import time
+import uuid
+from typing import Optional
+
+from modalities_tpu.resilience.events import record_event
+from modalities_tpu.serving.fleet.router import (
+    FleetRouter,
+    WorkerHandle,
+    _ClientGone,
+    _read_response_head,
+)
+from modalities_tpu.serving.server import (
+    SSE_HEADER_BYTES,
+    json_response_bytes,
+    sse_event_bytes,
+)
+from modalities_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+def _handoff_timeout_s() -> float:
+    """Prefill-leg deadline: chunked prefill of a long prompt takes real time,
+    but a wedged prefill worker must not hold the client forever."""
+    return float(os.environ.get("MODALITIES_TPU_DISAGG_HANDOFF_TIMEOUT_S", "30.0"))
+
+
+class DisaggRouter(FleetRouter):
+    """FleetRouter over a prefill tier + a decode tier (see module docstring)."""
+
+    def __init__(
+        self,
+        prefill_workers: list[WorkerHandle],
+        decode_workers: list[WorkerHandle],
+        **kwargs,
+    ):
+        if not prefill_workers or not decode_workers:
+            raise ValueError("DisaggRouter needs >= 1 worker in EACH tier")
+        for w in prefill_workers:
+            w.tier = "prefill"
+        for w in decode_workers:
+            w.tier = "decode"
+        super().__init__(list(prefill_workers) + list(decode_workers), **kwargs)
+        self.handoff_timeout_s = _handoff_timeout_s()
+        # the router's slice of the handoff-failure ledger: reasons the ENGINE
+        # can never see (a decode peer that died before answering). pool_full/
+        # digest_mismatch/generation_mismatch land on the decode worker's own
+        # registry — same metric name, per-process registries.
+        self._m_handoff_failures = self.metrics.counter(
+            "disagg_handoff_failures_total",
+            "Handoff legs that failed at the router, by reason (peer_down, "
+            "and rejected-import reasons relayed off decode workers)",
+        )
+        self._tier_pressure_seen: dict[str, bool] = {}
+
+    # ----------------------------------------------------------- tier sizing
+    def _after_health_round(self) -> None:
+        """Error-budget burn -> tier sizing: a tier is under pressure while
+        any of its workers is SLO-breaching (degraded) or dead. Transitions
+        emit ONE `fleet/tier_pressure` recommendation naming the tier to grow
+        and the breaching objectives driving it (action "hold" on recovery)."""
+        for tier in ("prefill", "decode"):
+            members = [w for w in self.workers if w.tier == tier]
+            if not members:
+                continue
+            breaching = sorted(
+                {name for w in members if w.degraded for name in w.slo_breaching}
+            )
+            unhealthy = sorted(w.name for w in members if not w.healthy)
+            healthy = sum(1 for w in members if w.healthy)
+            pressure = bool(breaching or unhealthy)
+            was = self._tier_pressure_seen.get(tier, False)
+            if pressure and not was:
+                logger.warning(
+                    "disagg router: grow tier %s (breaching=%s unhealthy=%s)",
+                    tier, breaching, unhealthy,
+                )
+                record_event(
+                    "fleet/tier_pressure", tier=tier, action="grow",
+                    breaching=breaching, unhealthy=unhealthy,
+                    workers_healthy=healthy, workers_total=len(members),
+                )
+            elif was and not pressure:
+                record_event(
+                    "fleet/tier_pressure", tier=tier, action="hold",
+                    breaching=[], unhealthy=[],
+                    workers_healthy=healthy, workers_total=len(members),
+                )
+            self._tier_pressure_seen[tier] = pressure
+
+    # ---------------------------------------------------------- prefill leg
+    async def _prefill_leg(
+        self, worker: WorkerHandle, body_bytes: bytes, state: dict
+    ) -> Optional[dict]:
+        """One POST /disagg/prefill round-trip. Returns {"status", "body"} or
+        None when the worker is unreachable/timed out (caller fails over)."""
+        try:
+            reader, writer = await asyncio.wait_for(
+                asyncio.open_connection(worker.host, worker.port),
+                self.connect_timeout_s,
+            )
+        except (OSError, asyncio.TimeoutError):
+            return None
+        try:
+            head = (
+                f"POST /disagg/prefill HTTP/1.1\r\nHost: {worker.host}\r\n"
+                "Content-Type: application/json\r\n"
+                f"X-Trace-Id: {state['trace_id']}\r\n"
+                f"X-Trace-Hop: {state['hop']}\r\n"
+                f"Content-Length: {len(body_bytes)}\r\nConnection: close\r\n\r\n"
+            )
+            writer.write(head.encode("latin-1") + body_bytes)
+            await writer.drain()
+
+            async def _read():
+                status, headers = await _read_response_head(reader)
+                length = headers.get("content-length")
+                body = await (
+                    reader.readexactly(int(length)) if length else reader.read()
+                )
+                return status, body
+
+            status, body = await asyncio.wait_for(_read(), self.handoff_timeout_s)
+            return {"status": status, "body": json.loads(body or b"{}")}
+        except (
+            ConnectionError,
+            asyncio.TimeoutError,
+            asyncio.IncompleteReadError,
+            OSError,
+            ValueError,
+        ):
+            return None
+        finally:
+            writer.close()
+            try:
+                await writer.wait_closed()
+            except (ConnectionError, OSError):
+                pass
+
+    def _fail_worker(self, worker: WorkerHandle, state: dict, reason: str) -> None:
+        """Tier-aware copy of the base failover bookkeeping (+ the handoff
+        failure reason the engine can't observe)."""
+        worker.healthy = False
+        worker.last_heartbeat = float("-inf")
+        self.failovers += 1
+        self._m_failovers.inc()
+        self._m_workers_healthy.set(sum(1 for w in self.workers if w.healthy))
+        self._m_handoff_failures.inc(reason=reason)
+        logger.warning(
+            "disagg router: failover off %s (%s tier) after %d forwarded tokens",
+            worker.name, worker.tier, state["forwarded"],
+        )
+        record_event(
+            "fleet/failover", worker=worker.name, tier=worker.tier,
+            forwarded_tokens=state["forwarded"], trace_id=state["trace_id"],
+            reason=reason,
+        )
+
+    # ---------------------------------------------------------------- proxy
+    async def _proxy_generate(
+        self, body_bytes: bytes, client_writer, headers: Optional[dict] = None
+    ) -> None:
+        self.http_requests += 1
+        if self._shutdown:
+            client_writer.write(json_response_bytes(503, {"error": "router is draining"}))
+            return
+        trace_id = (headers or {}).get("x-trace-id") or uuid.uuid4().hex[:16]
+        state = {"forwarded": 0, "headers_sent": False, "trace_id": trace_id, "hop": 0}
+        legs: list[dict] = []
+        t_arrival = time.monotonic()
+        outcome = "client_gone"
+        self._active_relays += 1
+
+        async def send_client(data: bytes) -> None:
+            try:
+                client_writer.write(data)
+                await client_writer.drain()
+            except (ConnectionError, OSError) as exc:
+                raise _ClientGone() from exc
+
+        async def no_workers(which: str) -> None:
+            payload = {"error": f"no healthy {which} workers", "trace_id": trace_id}
+            try:
+                if state["headers_sent"]:
+                    client_writer.write(sse_event_bytes(payload))
+                else:
+                    client_writer.write(json_response_bytes(503, payload))
+                await client_writer.drain()
+            except (ConnectionError, OSError):
+                pass
+
+        try:
+            for _attempt in range(len(self.workers) + 1):
+                # ------------------------------------------- prefill leg
+                pworker = self._pick(set(), tier="prefill")
+                if pworker is None:
+                    await no_workers("prefill")
+                    outcome = "no_healthy_workers"
+                    return
+                pleg = {
+                    "worker": pworker.name, "tier": "prefill", "hop": state["hop"],
+                    "t_start_s": round(time.monotonic() - t_arrival, 6),
+                }
+                resp = await self._prefill_leg(pworker, body_bytes, state)
+                state["hop"] += 1
+                if resp is None:
+                    pleg["outcome"] = "failover"
+                    legs.append(pleg)
+                    self._fail_worker(pworker, state, "peer_down")
+                    continue
+                pbody = resp["body"]
+                if resp["status"] != 200:
+                    # engine-side rejection (bad prompt, wrong role, draining
+                    # mid-drain): deterministic — surface it, don't retry
+                    pleg["outcome"] = "error"
+                    legs.append(pleg)
+                    if state["headers_sent"]:
+                        await send_client(sse_event_bytes(pbody))
+                    else:
+                        await send_client(json_response_bytes(resp["status"], pbody))
+                    outcome = "error"
+                    return
+                pleg["outcome"] = "done"
+                token_ids = [int(t) for t in (pbody.get("token_ids") or [])]
+                pleg["tokens"] = len(token_ids)
+                legs.append(pleg)
+                completion = pbody.get("completion") or ""
+                # token #1 to the client now (skipped on a replay: the splice
+                # counter says the client already has it)
+                if not state["headers_sent"]:
+                    await send_client(SSE_HEADER_BYTES)
+                    state["headers_sent"] = True
+                for i, tok in enumerate(token_ids):
+                    if i < state["forwarded"]:
+                        continue
+                    await send_client(
+                        sse_event_bytes({"token_id": tok, "text": completion})
+                    )
+                    state["forwarded"] += 1
+                if pbody.get("finish_reason") != "handoff" or not pbody.get("record"):
+                    # prefill short-circuit (eod / budget<=1 / error): the
+                    # prefill leg IS the whole answer
+                    await send_client(
+                        sse_event_bytes(
+                            {
+                                "done": True,
+                                "completion": completion,
+                                "token_ids": token_ids,
+                                "finish_reason": pbody.get("finish_reason"),
+                                "truncated": bool(pbody.get("truncated")),
+                                "prompt_len": int(pbody.get("prompt_len") or 0),
+                                "ttft_s": pbody.get("ttft_s"),
+                                "weights_generation": int(
+                                    pbody.get("weights_generation") or 0
+                                ),
+                                "trace_id": trace_id,
+                            }
+                        )
+                    )
+                    outcome = "done"
+                    return
+                # -------------------------------------------- decode leg
+                dworker = self._pick(set(), tier="decode")
+                if dworker is None:
+                    await no_workers("decode")
+                    outcome = "no_healthy_workers"
+                    return
+                import_body = json.dumps(
+                    {
+                        "record": pbody["record"],
+                        "trace_id": trace_id,
+                        "trace_hop": state["hop"],
+                    }
+                ).encode()
+                dleg = {
+                    "worker": dworker.name, "tier": "decode", "hop": state["hop"],
+                    "t_start_s": round(time.monotonic() - t_arrival, 6),
+                }
+
+                def merge_done(event, _toks=tuple(token_ids), _text=completion):
+                    if event.get("retryable"):
+                        # rejected import (digest/generation): the WORKER is
+                        # fine, the record is not — replay via fresh prefill
+                        state["reject_reason"] = event.get("reason") or "rejected"
+                        return None
+                    if event.get("done"):
+                        event = dict(event)
+                        event["token_ids"] = list(_toks) + list(
+                            event.get("token_ids") or []
+                        )
+                        event["completion"] = _text + (event.get("completion") or "")
+                        event["trace_id"] = trace_id
+                    return event
+
+                leg_outcome = await self._relay_from_worker(
+                    dworker, import_body, client_writer, state,
+                    path="/disagg/import", stream_offset=len(token_ids),
+                    done_transform=merge_done,
+                )
+                dleg["outcome"] = leg_outcome
+                dleg["forwarded_tokens"] = state["forwarded"]
+                legs.append(dleg)
+                state["hop"] += 1
+                if leg_outcome == "done":
+                    outcome = "done"
+                    return
+                reject = state.pop("reject_reason", None)
+                if reject is not None:
+                    dleg["outcome"] = f"rejected:{reject}"
+                    self._m_handoff_failures.inc(reason=reject)
+                    record_event(
+                        "fleet/handoff_rejected", worker=dworker.name,
+                        reason=reject, trace_id=trace_id,
+                    )
+                    continue  # decode worker stays in rotation
+                self._fail_worker(dworker, state, "peer_down")
+                # loop: fresh prefill on a healthy pair, SAME trace_id
+            await no_workers("pair")
+            outcome = "no_healthy_workers"
+        except _ClientGone:
+            outcome = "client_gone"
+            return
+        finally:
+            self._active_relays -= 1
+            e2e_s = time.monotonic() - t_arrival
+            self._m_e2e.observe(e2e_s, exemplar=trace_id)
+            record_event(
+                "fleet/request", trace_id=trace_id, outcome=outcome,
+                forwarded_tokens=state["forwarded"], e2e_s=round(e2e_s, 6),
+                legs=legs, disagg=True,
+            )

@@ -148,7 +148,7 @@ class ServeRequest:
 class ServeResult:
     rid: int
     tokens: list[int] = field(default_factory=list)
-    finish_reason: str = ""  # "eod" | "budget" | "capacity" | "error"
+    finish_reason: str = ""  # "eod" | "budget" | "capacity" | "error" | "handoff"
     prompt_len: int = 0
     weights_generation: int = 0  # generation serving when the request finished
     truncated: bool = False  # prompt window-clipped at admission
@@ -161,10 +161,25 @@ class ServeResult:
     # worker leg (a failover replay keeps the id, hop increments per leg)
     trace_id: str = ""
     trace_hop: int = 0
+    # disaggregated serving (serving/disagg/): a prefill-tier engine finishes
+    # with reason "handoff" and parks the exported record here for the caller
+    # (HTTP /disagg/prefill or the in-process pair) to ship to the decode tier
+    handoff: Optional[object] = None
 
     @property
     def ttft_s(self) -> float:
         return self.first_token_s - self.arrival_s
+
+
+@dataclass
+class _ImportRequest(ServeRequest):
+    """A queued KV import on a decode-tier engine. Rides the same FIFO queue
+    and preemption path as a plain request (``_preempt`` requeues it at the
+    front; re-admission re-imports from the retained record — deterministic
+    replay from the sealed sampler state)."""
+
+    record: object = None  # HandoffRecord (kept untyped: no disagg import here)
+    pool_full_seen: bool = False  # count the pool_full failure once per import
 
 
 @dataclass
@@ -178,6 +193,7 @@ class _SlotState:
     key: object = None  # paged: jax PRNG key while prefilling
     temp: float = 0.0
     seq: int = 0  # admission order — preemption picks the max (youngest)
+    imported: bool = False  # disagg: seeded from a handoff (TTFT = first decode)
 
 
 class ServingEngine:
@@ -209,7 +225,13 @@ class ServingEngine:
         mesh_handle=None,
         time_fn=None,
         metrics: Optional[MetricsRegistry] = None,
+        role: str = "combined",
     ):
+        if role not in ("combined", "prefill", "decode"):
+            raise ValueError(
+                f"role={role!r}: must be 'combined', 'prefill' or 'decode'"
+            )
+        self.role = role
         if not (hasattr(model, "init_slot_cache") and hasattr(model, "decode_slots")):
             raise ValueError(
                 f"{type(model).__name__} does not expose the slot-cache decode API "
@@ -278,6 +300,19 @@ class ServingEngine:
                     "spec_decode.k > 0 requires kv_cache='paged': the verify "
                     "forward runs through the paged block tables"
                 )
+        # disaggregated roles (serving/disagg/): the handoff payload is pool
+        # blocks, so both tiers require the paged cache; the prefill tier never
+        # decodes, so speculative decode there is a config error, not a no-op
+        if self.role != "combined" and self.kv_cache != "paged":
+            raise ValueError(
+                f"role={self.role!r} requires kv_cache='paged': the KV handoff "
+                "ships pool blocks"
+            )
+        if self.role == "prefill" and self.spec.enabled:
+            raise ValueError(
+                "role='prefill' excludes spec_decode: the prefill tier stops at "
+                "the first token and never builds a decode (or verify) program"
+            )
         self._now = time_fn if time_fn is not None else time.monotonic
         self._stop_fn = stop_fn
         self._on_token = on_token
@@ -349,6 +384,11 @@ class ServingEngine:
             self._table_state = None
         if self._cache_shardings is not None:
             self.cache = jax.device_put(self.cache, self._cache_shardings)
+        # handoff payloads are per-leaf host arrays in tree-flatten order; the
+        # treedef rebuilds them into a cache-shaped tree on the import side
+        self._cache_treedef = (
+            jax.tree.structure(self.cache) if self.kv_cache == "paged" else None
+        )
 
         # host-side mirrors of the per-slot device state
         b = self.slots
@@ -393,6 +433,17 @@ class ServingEngine:
         self.verify_steps = 0
         self.spec_proposed = 0
         self.spec_accepted = 0
+        # disaggregated serving (serving/disagg/): export/import accounting plus
+        # the two extra one-executable pins (_handoff_traces on the prefill
+        # tier's gather, _import_traces on the decode tier's scatter)
+        self._handoff_traces = 0
+        self._import_traces = 0
+        self.handoffs_exported = 0
+        self.handoffs_imported = 0
+        self.import_requeues = 0
+        self.imported_blocks = 0
+        self.handoff_bytes_shipped = 0
+        self.prefill_chunk_count = 0  # packed prefill rows (modeled-cost clocks)
         # counters/gauges above mutate only under this lock, and stats() reads
         # under it — /stats sees one consistent snapshot, never a mid-dispatch
         # tear (decode_tokens without its decode_steps)
@@ -518,6 +569,29 @@ class ServingEngine:
             reg.gauge(
                 "serve_shared_blocks", "Pool blocks referenced by more than one table"
             ).set_fn(lambda: self._table_state.pool.shared_count)
+
+        # disaggregated serving: both tiers register the family so a scrape of
+        # either worker names every series; the prefill tier moves handoffs_total
+        # + kv_bytes, the decode tier moves failures + the handoff latency
+        # histogram (arrival -> slot seeded, so pool_full starvation shows up as
+        # tail inflation — the runbook signal)
+        self._m_handoffs = reg.counter(
+            "disagg_handoffs_total", "KV handoff records exported by the prefill tier"
+        )
+        self._m_handoff_failures = reg.counter(
+            "disagg_handoff_failures_total",
+            "Handoff imports rejected or requeued, by reason "
+            "(pool_full, digest_mismatch, generation_mismatch, peer_down, ...)",
+        )
+        self._m_kv_shipped = reg.counter(
+            "disagg_kv_bytes_shipped_total",
+            "KV payload bytes shipped across the prefill->decode tier boundary",
+        )
+        self._m_handoff_seconds = reg.histogram(
+            "disagg_handoff_seconds",
+            "Handoff latency: prefill-side export (or import arrival) to the "
+            "decode-tier slot being seeded",
+        )
 
         # a wedged serve dispatch dumps the same watchdog artifact as a wedged
         # train step, with the engine's own stats in the `state` section
@@ -825,11 +899,39 @@ class ServingEngine:
 
             return _constrain_cache(jax.tree.map(copy_leaf, cache))
 
+        def handoff_gather_fn(cache, src):
+            # disagg export (prefill tier): read pool row `src` out of every
+            # leaf — int8 data and f32 scales leave as-is, no dequant. src is
+            # a traced int32 scalar so every exported block reuses ONE
+            # executable; the cache is NOT donated (blocks stay live until
+            # _finish releases the table)
+            engine._handoff_traces += 1
+
+            def gather_leaf(leaf):
+                axis = 1 if leaf.ndim == 5 else 0  # same layout rule as cow_fn
+                return jax.lax.dynamic_index_in_dim(leaf, src, axis=axis, keepdims=False)
+
+            return jax.tree.map(gather_leaf, cache)
+
+        def handoff_scatter_fn(cache, rows, dst):
+            # disagg import (decode tier): write one foreign block row into
+            # pool row `dst` of every leaf. dst is traced -> ONE executable;
+            # the cache IS donated (in-place pool update, like cow_fn)
+            engine._import_traces += 1
+
+            def scatter_leaf(leaf, row):
+                axis = 1 if leaf.ndim == 5 else 0
+                return jax.lax.dynamic_update_index_in_dim(leaf, row, dst, axis=axis)
+
+            return _constrain_cache(jax.tree.map(scatter_leaf, cache, rows))
+
         if self.kv_cache == "paged":
             self._prefill_jit = jax.jit(paged_prefill_fn, donate_argnums=(1,))
             self._decode_jit = jax.jit(paged_decode_fn, donate_argnums=(1,))
             self._verify_jit = jax.jit(spec_verify_fn, donate_argnums=(1,))
             self._cow_jit = jax.jit(cow_fn, donate_argnums=(0,))
+            self._handoff_gather_jit = jax.jit(handoff_gather_fn)
+            self._handoff_scatter_jit = jax.jit(handoff_scatter_fn, donate_argnums=(0,))
         else:
             self._prefill_jit = jax.jit(prefill_fn, donate_argnums=(1,))
             self._decode_jit = jax.jit(decode_fn, donate_argnums=(1,))
@@ -845,6 +947,11 @@ class ServingEngine:
         trace_id: Optional[str] = None,
         trace_hop: int = 0,
     ) -> int:
+        if self.role == "decode":
+            raise ValueError(
+                "role='decode' engines take work via import_handoff(), not "
+                "submit(): the decode tier never prefills a raw prompt"
+            )
         if not prompt_tokens:
             raise ValueError("empty prompt: the engine needs at least one prompt token")
         rid = self._next_rid
@@ -870,6 +977,106 @@ class ServingEngine:
         self._trace_event(rid, "enqueue", arrival)
         self._m_submitted.inc()
         self._m_prompt_tokens.inc(len(prompt_tokens))
+        return rid
+
+    # ----------------------------------------------------------- disagg imports
+    def _check_import_generation(self, record, trace_id: str = "") -> None:
+        """Cross-generation KV must never splice under different weights: the
+        decode would be silently wrong in a way no digest can catch. Rejection
+        is recorded as a `fleet/rollback stage=generation` resilience event —
+        the same stream the quant-drift gate uses."""
+        from modalities_tpu.serving.disagg.handoff import HandoffRejected
+
+        if int(record.generation) != int(self.weights_generation):
+            from modalities_tpu.resilience.events import record_event
+
+            record_event(
+                "fleet/rollback",
+                stage="generation",
+                offered=int(record.generation),
+                installed=int(self.weights_generation),
+                trace_id=trace_id or record.trace_id,
+            )
+            raise HandoffRejected(
+                "generation_mismatch",
+                f"handoff KV computed under weights generation {record.generation} "
+                f"cannot splice under generation {self.weights_generation} — "
+                "re-prefill on the current generation instead",
+            )
+
+    def import_handoff(
+        self,
+        record,
+        *,
+        arrival_offset_s: float = 0.0,
+        trace_id: Optional[str] = None,
+        trace_hop: int = 0,
+    ) -> int:
+        """Decode tier: validate a sealed HandoffRecord and queue it for slot
+        seeding. Validation (digest, version, pool-config, weights generation)
+        happens HERE so a bad record fails the caller synchronously — raises
+        HandoffRejected and counts `disagg_handoff_failures_total{reason=}`.
+        Admission (local block allocation + payload scatter + slot arm) runs
+        inside step() under the same FIFO/arrival/pool invariants as a plain
+        request: pool-full leaves the import queued, never corrupts."""
+        from modalities_tpu.serving.disagg.handoff import HANDOFF_VERSION, HandoffRejected
+
+        if self.role != "decode":
+            raise ValueError(
+                f"import_handoff() needs role='decode' (engine is {self.role!r})"
+            )
+        try:
+            if int(record.version) != HANDOFF_VERSION:
+                raise HandoffRejected(
+                    "version_mismatch",
+                    f"handoff version {record.version} != engine {HANDOFF_VERSION}",
+                )
+            if int(record.block_size) != self.block_size:
+                raise HandoffRejected(
+                    "config_mismatch",
+                    f"handoff block_size {record.block_size} != pool {self.block_size}",
+                )
+            if str(record.quant_kv) != self.quant_kv:
+                raise HandoffRejected(
+                    "config_mismatch",
+                    f"handoff quant_kv {record.quant_kv!r} != pool {self.quant_kv!r}",
+                )
+            if len(record.window) < 1 or len(record.window) > self.max_len - 1:
+                raise HandoffRejected(
+                    "config_mismatch",
+                    f"handoff window {len(record.window)} tokens does not fit "
+                    f"max_len {self.max_len}",
+                )
+            record.verify_digest()
+            self._check_import_generation(record, trace_id or "")
+        except HandoffRejected as exc:
+            self._m_handoff_failures.inc(reason=exc.reason)
+            raise
+        rid = self._next_rid
+        self._next_rid += 1
+        req = _ImportRequest(
+            rid=rid,
+            prompt_tokens=[int(t) for t in record.window],
+            max_new_tokens=int(record.remaining),
+            temperature=float(record.temperature),
+            seed=int(record.seed),
+            arrival_offset_s=float(arrival_offset_s),
+            record=record,
+        )
+        self._queue.append(req)
+        arrival = max(float(arrival_offset_s), 0.0)
+        self._traces[rid] = {
+            "events": [], "preemptions": 0, "wait_from": arrival,
+            "queue_wait_s": 0.0,
+            "trace_id": trace_id or record.trace_id or uuid.uuid4().hex[:16],
+            "trace_hop": int(trace_hop or record.trace_hop),
+        }
+        self._trace_event(
+            rid, "import_enqueue", arrival,
+            blocks=record.num_blocks, kv_bytes=record.kv_bytes,
+            source_rid=int(record.rid),
+        )
+        self._m_submitted.inc()
         return rid
 
     # ------------------------------------------------------------------ tracing
@@ -917,6 +1124,9 @@ class ServingEngine:
                 "rid": result.rid,
                 "trace_id": result.trace_id,
                 "hop": result.trace_hop,
+                # disagg: tier tag so analyze_fleet can render "prefill leg" /
+                # "decode leg" spans; combined engines stay unlabelled
+                **({"role": self.role} if self.role != "combined" else {}),
                 "prompt_len": result.prompt_len,
                 "tokens": len(result.tokens),
                 "finish_reason": result.finish_reason,
@@ -1025,6 +1235,9 @@ class ServingEngine:
         hand the slot to the cross-request prefill dispatcher. A draining engine
         (`stop_fn`) admits nothing."""
         if self._stopping():
+            return
+        if self.role == "decode":
+            self._admit_imports(t0)
             return
         if self.kv_cache == "paged":
             self._admit_paged(t0)
@@ -1174,6 +1387,138 @@ class ServingEngine:
                     key=jax.random.PRNGKey(req.seed), temp=temp, seq=self._admit_seq,
                 )
                 self._admit_seq += 1
+
+    def _admit_imports(self, t0: float) -> None:
+        """Decode tier: seed idle slots from queued KV imports (FIFO,
+        arrival-gated, pool gate BEFORE popleft — exactly the plain-admission
+        invariants). Seeding allocates local blocks, scatters the foreign
+        payload in (int8 data + f32 scales verbatim — no dequant/requant),
+        registers the prompt in the prefix index, and arms the slot straight
+        into the shared decode dispatch. A full pool leaves the head queued
+        and counts ONE `disagg_handoff_failures_total{reason=pool_full}` per
+        import; preemption later requeues the _ImportRequest whole, so replay
+        re-imports deterministically from the retained record."""
+        import jax
+
+        from modalities_tpu.serving.disagg.handoff import HandoffRejected
+
+        jnp = self._jnp
+        ts = self._table_state
+        for slot in range(self.slots):
+            if not self._queue:
+                break
+            if self._slot_states[slot] is not None:
+                continue
+            now = self._now() - t0
+            req = self._queue[0]
+            if req.arrival_offset_s > now:
+                break  # FIFO: later imports can't jump an unarrived head
+            record = req.record
+            with span("serve/import"):
+                window = [int(t) for t in record.window]
+                wl = len(window)
+                matched = ts.match_prefix(window) if self.prefix_sharing else []
+                nblk = blocks_for_tokens(wl, self.block_size)
+                # admission gate (BEFORE popleft): unmatched payload blocks
+                # must fit, or the head stays queued until decoders free blocks
+                # (the first decode write past wl is _ensure_decode_blocks'
+                # job, same as a locally-prefilled slot)
+                need = nblk - len(matched)
+                if ts.pool.free_count < need:
+                    if not req.pool_full_seen:  # once per import, not per round
+                        req.pool_full_seen = True
+                        with self._stats_lock:
+                            self.import_requeues += 1
+                        self._m_handoff_failures.inc(reason="pool_full")
+                        self._trace_event(
+                            req.rid, "import_requeue", now,
+                            free=ts.pool.free_count, need=need,
+                        )
+                    break
+                result = ServeResult(
+                    rid=req.rid, prompt_len=int(record.prompt_len) or wl,
+                    arrival_s=max(req.arrival_offset_s, 0.0),
+                    truncated=bool(record.truncated),
+                )
+                # generation re-check at admission: a hot swap may have landed
+                # between import_handoff() and this slot coming free — stale KV
+                # finishes "error" here rather than decoding garbage
+                try:
+                    self._check_import_generation(record)
+                except HandoffRejected as exc:
+                    self._queue.popleft()
+                    self._m_handoff_failures.inc(reason=exc.reason)
+                    self._trace_event(req.rid, "import_rejected", now, reason=exc.reason)
+                    now2 = self._now() - t0
+                    result.first_token_s = now2
+                    self._finish_immediate(result, "error", now2)
+                    continue
+                self._queue.popleft()
+                self._trace_admit(req.rid, now)
+                if matched:
+                    ts.fork_prefix(req.rid, matched)
+                if not ts.ensure(req.rid, wl):
+                    raise AssertionError("import admission gate let a dry pool through")
+                # scatter ONLY the unmatched tail: matched blocks already hold
+                # byte-identical KV (same tokens, same weights generation — the
+                # prefix-index contract), so a prefix hit saves wire bytes AND
+                # pool writes
+                table_blocks = ts.blocks(req.rid)
+                scattered = 0
+                with self._rules_ctx():
+                    for i in range(len(matched), nblk):
+                        rows = jax.tree.unflatten(
+                            self._cache_treedef,
+                            [jnp.asarray(arr[i]) for arr in record.payload],
+                        )
+                        self.cache = self._handoff_scatter_jit(
+                            self.cache, rows, np.int32(table_blocks[i])
+                        )
+                        scattered += 1
+                if self.prefix_sharing:
+                    ts.register_prefix(req.rid, window, upto=wl)
+                if matched:
+                    hit_tokens = min(len(matched) * self.block_size, wl)
+                    result.prefix_hit_tokens = hit_tokens
+                    with self._stats_lock:
+                        self.prefix_hit_requests += 1
+                        self.prefix_hit_blocks += len(matched)
+                        self.prefix_hit_tokens += hit_tokens
+                    self._m_prefix_hit_requests.inc()
+                    self._m_prefix_hit_blocks.inc(len(matched))
+                    self._trace_event(
+                        req.rid, "prefix_hit", now,
+                        blocks=len(matched), tokens=hit_tokens,
+                    )
+                # arm the slot exactly where the combined engine stands after
+                # its prefill completion branch: last_token pending at position
+                # wl, sampler key already past the first-token draw. window
+                # grows the shipped token so spec-decode's ngram proposals see
+                # the same context string as the combined path.
+                self._slot_states[slot] = _SlotState(
+                    request=req, result=result, remaining=int(record.remaining),
+                    phase="decode", window=window + [int(record.last_token)],
+                    temp=float(record.temperature), seq=self._admit_seq,
+                    imported=True,
+                )
+                self._admit_seq += 1
+                self._tokens[slot, 0] = int(record.last_token)
+                self._positions[slot] = wl
+                self._keys[slot] = np.asarray(record.key, dtype=np.uint32)
+                self._temps[slot] = float(record.temperature)
+                self._eods[slot] = self.eod_token_id
+                self._remaining[slot] = int(record.remaining)
+                with self._stats_lock:
+                    self.handoffs_imported += 1
+                    self.imported_blocks += scattered
+                self._m_handoff_seconds.observe(
+                    max(0.0, now - max(req.arrival_offset_s, 0.0)),
+                    exemplar=self._traces.get(req.rid, {}).get("trace_id"),
+                )
+                self._trace_event(
+                    req.rid, "import_seeded", now,
+                    blocks=nblk, scattered=scattered, kv_bytes=record.kv_bytes,
+                )
 
     def _cow_copy(self, src: int, dst: int) -> None:
         """Device row copy backing a copy-on-write: pool block `src` -> `dst`
@@ -1336,6 +1681,8 @@ class ServingEngine:
 
         now = self._now() - t0
         self._m_prefill_chunks.inc(len(rows))
+        with self._stats_lock:
+            self.prefill_chunk_count += len(rows)  # modeled-cost clocks read this
         for r, (slot, start, ntok, is_last) in enumerate(rows):
             state = self._slot_states[slot]
             state.prefill_pos = start + ntok
@@ -1373,6 +1720,16 @@ class ServingEngine:
             if allowed <= 1:
                 self._finish(slot, "budget", now)
                 continue
+            if self.role == "prefill":
+                # disagg: the prefill tier stops at the first token — export
+                # the live pool blocks + sampler state as a sealed handoff
+                # record (gather runs BEFORE _finish releases the table) and
+                # finish "handoff"; the decode tier continues from out_keys[r]
+                result.handoff = self._export_handoff(
+                    state, first_tok, out_keys[r], allowed - 1, now
+                )
+                self._finish(slot, "handoff", now)
+                continue
             state.phase = "decode"
             state.remaining = allowed - 1
             self._tokens[slot, 0] = first_tok
@@ -1381,6 +1738,60 @@ class ServingEngine:
             self._temps[slot] = state.temp
             self._eods[slot] = self.eod_token_id
             self._remaining[slot] = allowed - 1
+
+    def _export_handoff(self, state, first_tok, key, remaining, now):
+        """Prefill tier: gather the request's pool blocks (position order, ONE
+        jitted gather reused per block) to host and seal them with the sampler
+        state into a HandoffRecord. Quantized pools ship int8 data + f32
+        scales verbatim — the decode tier scatters the same bytes."""
+        import jax
+
+        from modalities_tpu.serving.disagg.handoff import HANDOFF_VERSION, HandoffRecord
+
+        req, result = state.request, state.result
+        rid = req.rid
+        wl = len(state.window)
+        nblk = blocks_for_tokens(wl, self.block_size)
+        blocks = self._table_state.blocks(rid)[:nblk]
+        with span("serve/handoff_export"):
+            with self._rules_ctx():
+                gathered = [
+                    self._handoff_gather_jit(self.cache, np.int32(b)) for b in blocks
+                ]
+            host_rows = [jax.tree.flatten(jax.device_get(row))[0] for row in gathered]
+        payload = [
+            np.stack([row[leaf] for row in host_rows])
+            for leaf in range(len(host_rows[0]))
+        ]
+        trace = self._traces.get(rid) or {}
+        record = HandoffRecord(
+            version=HANDOFF_VERSION,
+            generation=int(self.weights_generation),
+            quant_kv=self.quant_kv,
+            block_size=self.block_size,
+            window=list(state.window),
+            last_token=int(first_tok),
+            key=np.asarray(key, dtype=np.uint32),
+            temperature=float(state.temp),
+            remaining=int(remaining),
+            seed=int(req.seed),
+            payload=payload,
+            trace_id=str(trace.get("trace_id", "")),
+            trace_hop=int(trace.get("trace_hop", 0)),
+            rid=rid,
+            prompt_len=len(req.prompt_tokens),
+            truncated=bool(result.truncated),
+        ).seal()
+        with self._stats_lock:
+            self.handoffs_exported += 1
+            self.handoff_bytes_shipped += record.kv_bytes
+        self._m_handoffs.inc()
+        self._m_kv_shipped.inc(record.kv_bytes)
+        self._trace_event(
+            rid, "handoff_export", now,
+            blocks=record.num_blocks, kv_bytes=record.kv_bytes,
+        )
+        return record
 
     def _decode_dispatch(self, t0: float) -> None:
         """ONE compiled step for the whole batch, then host bookkeeping on the
@@ -1440,6 +1851,11 @@ class ServingEngine:
             self._positions[slot] += 1  # the fed token landed in the cache
             tok = int(toks[slot])
             self._keys[slot] = keys[slot]
+            if state.imported and not state.result.token_times_s:
+                # decode-tier TTFT: the first LOCAL token (the request's 2nd
+                # overall — token #1 shipped inside the handoff record)
+                state.result.first_token_s = now
+                self._record_first_token(state.result, now)
             if not bool(ok[slot]):  # non-finite logits: the token is garbage
                 self._finish(slot, "error", now)
                 continue
@@ -1549,6 +1965,11 @@ class ServingEngine:
             if state is None or state.phase != "decode":
                 continue
             self._keys[slot] = keys[slot]
+            if state.imported and not state.result.token_times_s:
+                # imported slot's first round took the verify path: same
+                # decode-tier TTFT point as the plain-decode branch
+                state.result.first_token_s = now
+                self._record_first_token(state.result, now)
             if not bool(ok[slot]):  # non-finite logits: nothing here is a token
                 self._finish(slot, "error", now)
                 continue
@@ -1700,8 +2121,15 @@ class ServingEngine:
             spec_accepted = self.spec_accepted
             weight_swaps = self.weight_swaps
             request_errors = self.request_errors
+            handoffs_exported = self.handoffs_exported
+            handoffs_imported = self.handoffs_imported
+            import_requeues = self.import_requeues
+            imported_blocks = self.imported_blocks
+            handoff_bytes = self.handoff_bytes_shipped
+            prefill_chunk_count = self.prefill_chunk_count
         occupancy = occupancy_sum / (decode_steps * self.slots) if decode_steps else 0.0
         out = {
+            "role": self.role,
             "kv_cache": self.kv_cache,
             "decode_steps": decode_steps,
             "decode_tokens": decode_tokens,
@@ -1742,6 +2170,17 @@ class ServingEngine:
                 verify_executables=self._verify_traces,
                 spec_proposed=spec_proposed,
                 spec_accepted=spec_accepted,
+                prefill_chunk_count=prefill_chunk_count,
+            )
+        if self.role != "combined":
+            out.update(
+                handoffs_exported=handoffs_exported,
+                handoffs_imported=handoffs_imported,
+                import_requeues=import_requeues,
+                imported_blocks=imported_blocks,
+                handoff_bytes_shipped=handoff_bytes,
+                handoff_executables=self._handoff_traces,
+                import_executables=self._import_traces,
             )
         return out
 

@@ -65,13 +65,17 @@ class _ClientGone(Exception):
 class WorkerHandle:
     """Router-side view of one worker: address + live health/load state."""
 
-    def __init__(self, name: str, host: str, port: int):
+    def __init__(self, name: str, host: str, port: int, tier: str = "serve"):
         self.name = name
         self.host = host
         self.port = int(port)
+        # disagg (serving/disagg/): "prefill" / "decode" partition one fleet
+        # into tiers; the flat fleet keeps the default single "serve" tier
+        self.tier = tier
         self.healthy = True  # optimistic until the first probe says otherwise
         self.draining = False
         self.degraded = False  # /healthz "degraded": serving, but in SLO breach
+        self.slo_breaching: list[str] = []  # breaching objective names, from /healthz
         self.last_heartbeat = time.monotonic()
         self.load = 0  # active slots + queue depth, from the last /stats probe
         self.weights_generation = 0
@@ -199,6 +203,7 @@ class FleetRouter:
                 return False
             worker.draining = health.get("status") == "draining"
             worker.degraded = health.get("status") == "degraded"
+            worker.slo_breaching = list(health.get("slo_breaching") or [])
             worker.weights_generation = int(health.get("weights_generation", 0))
             status, stats = await http_get_json(
                 worker.host, worker.port, "/stats", self.connect_timeout_s
@@ -252,11 +257,27 @@ class FleetRouter:
                 self._degraded_seen[worker.name] = worker.degraded
             self._m_workers_healthy.set(sum(1 for w in self.workers if w.healthy))
             self._m_workers_degraded.set(sum(1 for w in self.workers if w.degraded))
+            tiers = {w.tier for w in self.workers}
+            if tiers != {"serve"}:
+                # tiered fleet (disagg): one labelled series per tier so the
+                # sizing signal names WHICH tier is thin
+                for tier in sorted(tiers):
+                    self._m_workers_healthy.set(
+                        sum(1 for w in self.workers if w.tier == tier and w.healthy),
+                        tier=tier,
+                    )
+            self._after_health_round()
             await asyncio.sleep(self.health_interval_s)
 
-    def _pick(self, exclude: set) -> Optional[WorkerHandle]:
+    def _after_health_round(self) -> None:
+        """Hook: subclasses react to a completed probe round (the disagg
+        router derives `fleet/tier_pressure` recommendations here)."""
+
+    def _pick(self, exclude: set, tier: Optional[str] = None) -> Optional[WorkerHandle]:
         candidates = [
-            w for w in self.workers if w.healthy and w.name not in exclude
+            w
+            for w in self.workers
+            if w.healthy and w.name not in exclude and (tier is None or w.tier == tier)
         ]
         if not candidates:
             return None
@@ -268,12 +289,28 @@ class FleetRouter:
 
     # ----------------------------------------------------------------- proxy
     async def _relay_from_worker(
-        self, worker: WorkerHandle, body_bytes: bytes, client_writer, state: dict
+        self,
+        worker: WorkerHandle,
+        body_bytes: bytes,
+        client_writer,
+        state: dict,
+        path: str = "/generate",
+        stream_offset: int = 0,
+        done_transform=None,
     ) -> str:
         """Stream one worker's answer through to the client. Returns "done"
         (client got its final event) or "failover" (worker refused or died
         before finishing — the caller retries a peer). Raises _ClientGone when
-        the CLIENT hangs up (no retry: nobody is listening)."""
+        the CLIENT hangs up (no retry: nobody is listening).
+
+        Disagg hooks: `path` points the leg at a tier endpoint;
+        `stream_offset` is how many of the request's tokens were produced
+        BEFORE this worker's stream starts (the decode leg starts at overall
+        token #2, so its offset is the prefill-leg token count) — the replay
+        skip is computed against overall position; `done_transform(event)`
+        rewrites the final done/error event (merging the prefill token into
+        the client's done), returning None to turn a retryable error event
+        into a failover."""
 
         async def send_client(data: bytes) -> None:
             try:
@@ -291,7 +328,7 @@ class FleetRouter:
             return "failover"
         try:
             head = (
-                f"POST /generate HTTP/1.1\r\nHost: {worker.host}\r\n"
+                f"POST {path} HTTP/1.1\r\nHost: {worker.host}\r\n"
                 "Content-Type: application/json\r\n"
                 # fleet tracing: every leg of this request (failover replays
                 # included) carries the SAME trace_id; the hop counter tells the
@@ -330,7 +367,7 @@ class FleetRouter:
             # has from a previous worker (failover replay overlap)
             buf = b""
             seen_tokens = 0
-            skip = state["forwarded"]
+            skip = state["forwarded"] - stream_offset
             while True:
                 chunk = await reader.read(4096)
                 if not chunk:
@@ -347,6 +384,15 @@ class FleetRouter:
                             continue
                         state["forwarded"] += 1
                         await send_client(raw + b"\n\n")
+                    elif done_transform is not None:
+                        # disagg: the final event is rewritten (prefill token
+                        # merged in) or, when the transform returns None,
+                        # retried on a fresh pair (retryable import rejection)
+                        rewritten = done_transform(event)
+                        if rewritten is None:
+                            return "failover"
+                        await send_client(sse_event_bytes(rewritten))
+                        return "done"
                     else:
                         # done / engine-side error: deterministic, never retried
                         await send_client(raw + b"\n\n")
@@ -442,6 +488,7 @@ class FleetRouter:
                 {
                     "name": w.name,
                     "address": w.address,
+                    "tier": w.tier,
                     "healthy": w.healthy,
                     "draining": w.draining,
                     "degraded": w.degraded,

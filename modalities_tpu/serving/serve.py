@@ -236,6 +236,10 @@ def build_serving_components(config_dict: dict):
     from modalities_tpu.registry.components import COMPONENTS
     from modalities_tpu.registry.registry import ComponentEntity, Registry
 
+    from modalities_tpu.serving.disagg.component import (
+        DisaggComponentConfig,
+        DisaggServingComponent,
+    )
     from modalities_tpu.serving.fleet.component import (
         FleetComponentConfig,
         FleetServingComponent,
@@ -247,6 +251,9 @@ def build_serving_components(config_dict: dict):
     )
     registry.add_entity(
         ComponentEntity("inference_component", "fleet", FleetServingComponent, FleetComponentConfig)
+    )
+    registry.add_entity(
+        ComponentEntity("inference_component", "disagg", DisaggServingComponent, DisaggComponentConfig)
     )
     return ComponentFactory(registry).build_components(config_dict, ServeInstantiationModel)
 

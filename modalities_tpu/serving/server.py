@@ -23,6 +23,14 @@ Endpoints:
   (`text/event-stream`): one `data: {"token_id", "text"}` event per token, a
   final `data: {"done": true, "completion", "finish_reason", ...}` event, then
   the connection closes. 503 while draining.
+- `POST /disagg/prefill` (prefill-tier workers only, 409 otherwise) — same
+  body as /generate; runs the prompt to its first token and replies with ONE
+  JSON document carrying the emitted token ids and, on finish_reason
+  "handoff", the wire-format KV handoff record.
+- `POST /disagg/import` (decode-tier workers only, 409 otherwise) — body
+  `{"record": <handoff wire dict>}`; imports the KV and streams the
+  continuation as SSE with /generate's framing. A rejected record streams one
+  error event with `reason` and `retryable`.
 - `POST /admin/swap` — body `{"checkpoint_folder": str, "generation": int?}`;
   forwarded to the wired `swap_handler` (fleet watcher path); 503 when no
   handler is wired.
@@ -195,6 +203,48 @@ class ServingHTTPServer:
                 return drained
             drained += 1
             try:
+                if "disagg_record" in body:
+                    # decode-tier import (POST /disagg/import): the body carries
+                    # a wire-format HandoffRecord instead of a prompt; rejection
+                    # reasons stream back tagged so the router knows whether a
+                    # fresh-prefill replay can fix it
+                    from modalities_tpu.serving.disagg.handoff import (
+                        HandoffRecord,
+                        HandoffRejected,
+                    )
+
+                    try:
+                        record = HandoffRecord.from_wire(body["disagg_record"])
+                        rid = self.engine.import_handoff(
+                            record,
+                            arrival_offset_s=self.engine._now() - t0,
+                            trace_id=body.get("trace_id") or None,
+                            trace_hop=int(body.get("trace_hop") or 0),
+                        )
+                    except HandoffRejected as exc:
+                        stream.put(
+                            (
+                                "error",
+                                {
+                                    "error": exc.detail,
+                                    "reason": exc.reason,
+                                    # a replay via fresh prefill runs on the
+                                    # CURRENT weights over an uncorrupted wire,
+                                    # so it fixes these; config/version skew is
+                                    # a deployment problem no replay fixes
+                                    "retryable": exc.reason
+                                    in (
+                                        "digest_mismatch",
+                                        "generation_mismatch",
+                                        "malformed",
+                                    ),
+                                },
+                            )
+                        )
+                        continue
+                    self._streams[rid] = stream
+                    stream.put(("rid", rid))
+                    continue
                 prompt_tokens = self._encode(body["prompt"])
                 rid = self.engine.submit(
                     prompt_tokens,
@@ -285,8 +335,9 @@ class ServingHTTPServer:
                     )
                     await writer.drain()
                     return
-                else:  # "error"
-                    writer.write(sse_event_bytes({"error": value}))
+                else:  # "error" — dict payloads (disagg rejections) pass through
+                    payload = value if isinstance(value, dict) else {"error": value}
+                    writer.write(sse_event_bytes(payload))
                     await writer.drain()
                     return
         except (ConnectionError, BrokenPipeError):
@@ -319,11 +370,150 @@ class ServingHTTPServer:
             except (ValueError, json.JSONDecodeError) as exc:
                 writer.write(json_response_bytes(400, {"error": f"bad JSON body: {exc}"}))
                 return
+            if getattr(self.engine, "role", "combined") != "combined":
+                # a tier worker serves its tier endpoint only — a client hitting
+                # /generate here is misrouted, not malformed
+                writer.write(
+                    json_response_bytes(
+                        409,
+                        {
+                            "error": f"role={self.engine.role!r} worker: use "
+                            "/disagg/prefill (prefill tier) or /disagg/import "
+                            "(decode tier) via the disagg router"
+                        },
+                    )
+                )
+                return
             if self.draining:
                 self.http_rejected += 1
                 self._m_http_rejected.inc()
                 writer.write(json_response_bytes(503, {"error": "server is draining"}))
                 return
+            stream: queue.Queue = queue.Queue()
+            self.submit_stream(body, stream)
+            await self._relay_stream(stream, writer)
+
+    async def _handle_disagg_prefill(
+        self,
+        body_bytes: bytes,
+        writer: asyncio.StreamWriter,
+        headers: Optional[dict] = None,
+    ) -> None:
+        """Prefill-tier leg: run the prompt to its first token, reply with ONE
+        JSON document — the emitted token ids (0 or 1 of them), the finish
+        reason, and (on reason "handoff") the wire-format HandoffRecord the
+        router ships to a decode worker. Not SSE: the prefill leg's output is a
+        record, not a stream."""
+        with span("serve/http"):
+            self.http_requests += 1
+            self._m_http.inc()
+            if getattr(self.engine, "role", "combined") != "prefill":
+                writer.write(
+                    json_response_bytes(
+                        409,
+                        {"error": f"role={getattr(self.engine, 'role', 'combined')!r}: "
+                         "/disagg/prefill needs a prefill-tier worker"},
+                    )
+                )
+                return
+            try:
+                body = json.loads(body_bytes or b"{}")
+                if headers and headers.get("x-trace-id"):
+                    body.setdefault("trace_id", headers["x-trace-id"])
+                    body.setdefault("trace_hop", headers.get("x-trace-hop") or 0)
+                prompt = body.get("prompt")
+                if not isinstance(prompt, str) or not prompt:
+                    writer.write(
+                        json_response_bytes(400, {"error": "body needs a non-empty 'prompt'"})
+                    )
+                    return
+            except (ValueError, json.JSONDecodeError) as exc:
+                writer.write(json_response_bytes(400, {"error": f"bad JSON body: {exc}"}))
+                return
+            if self.draining:
+                self.http_rejected += 1
+                self._m_http_rejected.inc()
+                writer.write(json_response_bytes(503, {"error": "server is draining"}))
+                return
+            stream: queue.Queue = queue.Queue()
+            self.submit_stream(body, stream)
+            result = None
+            while result is None:
+                try:
+                    kind, value = stream.get_nowait()
+                except queue.Empty:
+                    if self._closing:
+                        return
+                    await asyncio.sleep(0.002)
+                    continue
+                if kind in ("rid", "token"):
+                    continue  # tokens ride inside the done result
+                if kind == "error":
+                    payload = value if isinstance(value, dict) else {"error": value}
+                    writer.write(json_response_bytes(500, payload))
+                    return
+                result = value  # "done"
+            record = result.handoff
+            writer.write(
+                json_response_bytes(
+                    200,
+                    {
+                        "rid": result.rid,
+                        "finish_reason": result.finish_reason,
+                        "token_ids": list(result.tokens),
+                        "completion": self._decode(result.tokens),
+                        "truncated": result.truncated,
+                        "prompt_len": result.prompt_len,
+                        "ttft_s": result.ttft_s,
+                        "weights_generation": result.weights_generation,
+                        "trace_id": result.trace_id,
+                        "record": record.to_wire() if record is not None else None,
+                    },
+                )
+            )
+
+    async def _handle_disagg_import(
+        self,
+        body_bytes: bytes,
+        writer: asyncio.StreamWriter,
+        headers: Optional[dict] = None,
+    ) -> None:
+        """Decode-tier leg: import the posted HandoffRecord and stream the
+        continuation out as SSE — same event framing as /generate, so the
+        router's relay loop works unchanged. A HandoffRejected streams one
+        error event carrying `reason` + `retryable`."""
+        with span("serve/http"):
+            self.http_requests += 1
+            self._m_http.inc()
+            if getattr(self.engine, "role", "combined") != "decode":
+                writer.write(
+                    json_response_bytes(
+                        409,
+                        {"error": f"role={getattr(self.engine, 'role', 'combined')!r}: "
+                         "/disagg/import needs a decode-tier worker"},
+                    )
+                )
+                return
+            try:
+                body = json.loads(body_bytes or b"{}")
+                if headers and headers.get("x-trace-id"):
+                    body.setdefault("trace_id", headers["x-trace-id"])
+                    body.setdefault("trace_hop", headers.get("x-trace-hop") or 0)
+                record = body.get("record")
+                if not isinstance(record, dict):
+                    writer.write(
+                        json_response_bytes(400, {"error": "body needs a 'record' object"})
+                    )
+                    return
+            except (ValueError, json.JSONDecodeError) as exc:
+                writer.write(json_response_bytes(400, {"error": f"bad JSON body: {exc}"}))
+                return
+            if self.draining:
+                self.http_rejected += 1
+                self._m_http_rejected.inc()
+                writer.write(json_response_bytes(503, {"error": "server is draining"}))
+                return
+            body["disagg_record"] = record
             stream: queue.Queue = queue.Queue()
             self.submit_stream(body, stream)
             await self._relay_stream(stream, writer)
@@ -379,6 +569,10 @@ class ServingHTTPServer:
                 writer.write(response_bytes(200, CONTENT_TYPE_LATEST, data))
             elif method == "POST" and path == "/generate":
                 await self._handle_generate(body_bytes, writer, headers)
+            elif method == "POST" and path == "/disagg/prefill":
+                await self._handle_disagg_prefill(body_bytes, writer, headers)
+            elif method == "POST" and path == "/disagg/import":
+                await self._handle_disagg_import(body_bytes, writer, headers)
             elif method == "POST" and path == "/admin/swap":
                 await self._handle_admin_swap(body_bytes, writer)
             else:

@@ -152,6 +152,10 @@ def test_sigterm_forces_checkpoint_and_warmstart_matches_uninterrupted_run(workd
 # -------------------------------------------------------- (b) skip_step
 
 
+@pytest.mark.slow  # ~15 s subprocess; skip_step budget/window/event semantics
+# stay pinned fast by tests/resilience/test_anomaly_tracker.py
+# (test_skip_policy_counts_against_budget_and_emits_events) and the raise path
+# by test_trainer_raises_on_nonfinite_grads
 def test_nan_grads_skip_step_finishes_with_finite_loss(workdir):
     config_text = CONFIG.read_text().replace("anomaly_policy: raise", "anomaly_policy: skip_step")
     config = _write_config(workdir, "config_skip_step.yaml", config_text)
@@ -176,8 +180,9 @@ def test_nan_grads_skip_step_finishes_with_finite_loss(workdir):
     assert skipped[0]["in_window"] == 1 and skipped[0]["budget"] == 2
 
 
-@pytest.mark.slow  # ~20 s; the poison path stays pinned by the skip-step chaos
-# test above and the raise message by test_trainer_raises_on_nonfinite_grads
+@pytest.mark.slow  # ~20 s; anomaly-policy semantics stay pinned fast by
+# tests/resilience/test_anomaly_tracker.py and the raise message by
+# test_trainer_raises_on_nonfinite_grads
 def test_nan_grads_default_raise_policy_is_legacy_identical(workdir):
     """Under the default policy the same poison must still kill the run with the
     exact legacy message — resilience armed != behavior changed. The legacy

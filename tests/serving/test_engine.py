@@ -93,6 +93,10 @@ def test_single_slot_matches_interactive_path_bitwise(model, params, ref):
     assert engine.stats()["decode_executables"] == 1
 
 
+@pytest.mark.slow  # ~13 s; concurrency-invisible-in-tokens stays pinned fast by
+# test_single_slot_matches_interactive_path_bitwise above and by the disagg
+# parity suite (tests/serving/test_disagg.py runs a 5-request mixed
+# temperature/budget trace through 2 slots on pair AND combined engines)
 def test_mixed_concurrent_batch_matches_sequential_references(model, params, ref):
     """Five requests with mixed temperatures/seeds/budgets through 2 slots:
     every completion must equal its solo interactive reference (concurrency is

@@ -123,6 +123,10 @@ def test_engine_quantizes_identically_to_the_load_seam(model, params, quant_engi
 # ------------------------------------------------ preemption replay (quantized)
 
 
+@pytest.mark.slow  # ~12 s; preemption-replay determinism stays pinned fast on
+# the bf16 pool by tests/serving/test_paged_engine.py (pool-squeeze replay
+# family) and quantize-on-write numerics by
+# test_logit_oracle_gates_the_fully_quantized_mode
 def test_preemption_replay_deterministic_on_quantized_pool(model, params):
     """The seed-replay determinism contract survives quantization: a pool too
     small for both requests preempts the youngest, and re-admission reproduces

@@ -84,6 +84,41 @@ def test_bench_serve_quant_kv_half_budget_capacity_oracle():
         raise AssertionError((int8, bf16))
 
 
+def test_bench_serve_disagg_smoke_reports_tier_percentiles():
+    """--disagg smoke: the prefill/decode pair replays the trace and the JSON
+    line carries the per-tier schema — split TTFT/TPOT percentiles, handoff
+    latency percentiles, shipped KV bytes — with a clean pool audit on BOTH
+    tiers."""
+    out = _run("--disagg", "--smoke", timeout=300)
+    assert out["disagg"] is True
+    assert out["cache"] == "paged" and out["pool_audit"] == "ok"
+    assert out["requests"] == 6
+    assert out["handoffs"] >= 1
+    assert out["kv_bytes_shipped"] > 0
+    assert out["import_requeues"] == 0
+    for key in ("prefill_ttft_p50_ms", "prefill_ttft_p99_ms",
+                "decode_tpot_p50_ms", "decode_tpot_p99_ms",
+                "handoff_seconds_p50", "handoff_seconds_p99"):
+        assert isinstance(out[key], float), (key, out)
+
+
+@pytest.mark.slow  # four modeled engine runs (~2 min CPU); the disagg JSON-line
+# contract stays pinned fast by test_bench_serve_disagg_smoke_reports_tier_
+# percentiles above, and handoff/parity semantics in-process by
+# tests/serving/test_disagg.py
+def test_bench_serve_disagg_tpot_isolation_oracle():
+    """ISSUE PR-18 acceptance: under a mixed short-decode + long-prefill trace,
+    the decode tier's steady-state p99 TPOT stays within 1.2x its short-only
+    baseline (prefill interference isolated to the other tier) while the
+    combined engine inflates >= 1.5x on the same trace — with bitwise-equal
+    greedy tokens across both modes."""
+    out = _run("--disagg-oracle", "--smoke", timeout=540)
+    assert out["disagg"] is True
+    assert out["tpot_isolation"] == "ok", out
+    assert out["disagg_tpot_inflation"] <= 1.2, out
+    assert out["combined_tpot_inflation"] >= 1.5, out
+
+
 @pytest.mark.slow  # full load run + sequential baseline (two engines, ~2 min CPU)
 def test_bench_serve_full_run_hits_speedup_oracle():
     out = _run(timeout=540)

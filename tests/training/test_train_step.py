@@ -71,6 +71,9 @@ def test_loss_decreases_dp():
     assert float(metrics["grad_norm"]) > 0
 
 
+@pytest.mark.slow  # ~17 s; TP numerics stay pinned fast by
+# test_loss_parallel_equivalence_and_rule (tp mesh, numerics unchanged) and TP
+# sharding rules by test_tp_placement_colwise_rowwise_and_vocab
 def test_dp_tp_equivalence():
     """Same seed + same data must give identical losses under pure-DP vs DP x TP —
     the TP-correctness oracle (reference test_tensor_parallelism.py:42-120)."""
@@ -135,7 +138,7 @@ def test_params_actually_sharded():
     assert big and any(not x.sharding.is_fully_replicated for x in big)
 
 
-@pytest.mark.slow  # ~15 s; one of the dp/pp/cp equivalence family — dp_tp,
+@pytest.mark.slow  # ~15 s; one of the dp/pp/cp equivalence family —
 # loss_parallel and the pp combinations keep the mesh-equivalence net in tier-1
 def test_dp_hsdp_equivalence():
     """dp8 vs HSDP (dp_replicate2 x dp_shard4): the reference's HYBRID_SHARD
