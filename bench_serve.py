@@ -124,6 +124,7 @@ METRIC_KEYS = (
     "capacity_finishes",
     "preemptions",
     "truncated_requests",
+    "client_timeouts",
     # serving v3 (paged only; None on ring runs)
     "prefill_chunks",
     "prefill_tokens_saved",
@@ -313,7 +314,10 @@ def _make_repetitive_trace(n: int, rate: float, max_new: int, seed: int):
     return trace
 
 
-def _replay(engine, trace, arrivals: bool):
+def _replay(engine, trace, arrivals: bool, deadline_ms=None):
+    # deadline_ms is the client-side per-request deadline (--deadline-ms):
+    # a hung/slow engine finishes those requests reason="deadline" at the
+    # next scheduler seam instead of wedging the bench into the budget guard
     t0 = time.monotonic()
     rids = [
         engine.submit(
@@ -322,6 +326,7 @@ def _replay(engine, trace, arrivals: bool):
             temperature=r["temperature"],
             seed=r["seed"],
             arrival_offset_s=r["arrival_offset_s"] if arrivals else 0.0,
+            deadline_ms=deadline_ms,
         )
         for r in trace
     ]
@@ -734,6 +739,11 @@ def main() -> int:
     parser.add_argument("--rate", type=float, default=500.0, help="Poisson arrivals/s; 0 = full queue at t=0")
     parser.add_argument("--max-new", type=int, default=44)
     parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument(
+        "--deadline-ms", type=float, default=0.0,
+        help="client-side per-request deadline in ms (0 = none); expired "
+        "requests finish reason='deadline' and count as client_timeouts",
+    )
     parser.add_argument("--cache", choices=("ring", "paged"), default="ring", help="KV-cache layout")
     parser.add_argument(
         "--long",
@@ -924,7 +934,10 @@ def main() -> int:
             engine, trace, params, args.hot_swap_every
         )
     else:
-        results, wall = _replay(engine, trace, arrivals=True)
+        results, wall = _replay(
+            engine, trace, arrivals=True,
+            deadline_ms=args.deadline_ms if args.deadline_ms > 0 else None,
+        )
     generated = sum(len(r.tokens) for r in results)
     # throughput counts ALL emitted tokens (prefill-sampled first tokens included)
     tokens_per_s = generated / wall if wall > 0 else 0.0
@@ -1105,6 +1118,9 @@ def main() -> int:
                 "capacity_finishes": sum(1 for r in results if r.finish_reason == "capacity"),
                 "preemptions": stats.get("preemptions", 0),
                 "truncated_requests": stats.get("truncated_requests", 0),
+                "client_timeouts": sum(
+                    1 for r in results if r.finish_reason == "deadline"
+                ),
                 **v3,
                 **hot,
                 **quant,

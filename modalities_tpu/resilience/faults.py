@@ -39,6 +39,22 @@ preemptible pods. Spec grammar (env ``MODALITIES_TPU_FAULTS`` or `arm_faults`):
   and then dies abruptly itself. The surviving supervisors' next resume vote
   misses the quorum — the elastic-resume chaos (degraded quorum, shrunk-mesh
   warmstart) exists for exactly this.
+- ``serve_worker_hang@n[:s]`` — the serving engine's step loop sleeps `s`
+  seconds (default 5) at scheduler round `n`: a wedged worker whose HTTP
+  front end (separate thread) keeps answering health probes — the hang the
+  deadline/shedding layer must absorb instead of the heartbeat deadline.
+- ``serve_slow_decode[@n]:ms`` — one decode dispatch stalls `ms` milliseconds
+  before running (TPOT chaos: trips the burn-rate brownout without killing
+  anything).
+- ``handoff_corrupt@rid`` — the prefill tier's exported handoff record for
+  request `rid` is corrupted after sealing: the decode tier's digest check
+  rejects it and the disagg router must replay via a fresh prefill.
+- ``sse_torn@n`` — the HTTP server tears the `n`-th /generate SSE stream
+  after its first token event (connection cut, no done event): the fleet
+  router sees a mid-stream death and fails over.
+- ``queue_storm@rid:n`` — submit() of request `rid` is amplified by `n`
+  lowest-priority synthetic clones: an arrival storm aimed at the bounded
+  admission queue and the brownout shedder.
 
 Unknown names are rejected at parse time; the static closure test
 (tests/resilience/test_fault_point_closure.py) keeps FAULT_POINTS and the chaos
@@ -71,6 +87,11 @@ FAULT_POINTS = (
     "peer_death",
     "host_loss",
     "oom",
+    "serve_worker_hang",
+    "serve_slow_decode",
+    "handoff_corrupt",
+    "sse_torn",
+    "queue_storm",
 )
 
 
@@ -280,3 +301,65 @@ def wedge_if_armed(index: int) -> None:
         record_event("fault/feeder_wedge", index=index, seconds=seconds)
         logger.warning("FAULT FIRING: feeder_wedge for %.1fs at batch %d", seconds, index)
         time.sleep(seconds)
+
+
+def fire_serve_worker_hang_if_armed(step: int) -> bool:
+    """Wedge the serving engine's scheduler loop for `arg` seconds (default 5)
+    at round `step` — the worker's HTTP thread keeps answering /healthz, so
+    only deadlines/shedding (not the heartbeat deadline) can save its queue."""
+    fault = _consume("serve_worker_hang", step=step)
+    if fault is None:
+        return False
+    seconds = fault.arg if fault.arg is not None else 5.0
+    record_event("fault/serve_worker_hang", step=step, seconds=seconds)
+    logger.warning("FAULT FIRING: serve_worker_hang for %.1fs at round %d", seconds, step)
+    time.sleep(seconds)
+    return True
+
+
+def fire_slow_decode_if_armed(step: int) -> bool:
+    """Stall one decode dispatch by `arg` milliseconds (default 100) — TPOT
+    chaos that burns the fast SLO window without killing anything."""
+    fault = _consume("serve_slow_decode", step=step)
+    if fault is None:
+        return False
+    ms = fault.arg if fault.arg is not None else 100.0
+    record_event("fault/serve_slow_decode", step=step, ms=ms)
+    logger.warning("FAULT FIRING: serve_slow_decode for %.0fms at round %d", ms, step)
+    time.sleep(ms / 1000.0)
+    return True
+
+
+def fire_handoff_corrupt_if_armed(rid: int) -> bool:
+    """True when the exported handoff record for request `rid` should be
+    corrupted after sealing (the exporter flips payload bytes so the decode
+    tier's digest check rejects the import)."""
+    fault = _consume("handoff_corrupt", step=rid)
+    if fault is None:
+        return False
+    record_event("fault/handoff_corrupt", rid=rid)
+    logger.warning("FAULT FIRING: handoff_corrupt on rid %d", rid)
+    return True
+
+
+def fire_sse_torn_if_armed(step: int) -> bool:
+    """True when the `step`-th SSE stream should be torn after its first token
+    event (connection cut, no done event — the router's failover trigger)."""
+    fault = _consume("sse_torn", step=step)
+    if fault is None:
+        return False
+    record_event("fault/sse_torn", step=step)
+    logger.warning("FAULT FIRING: sse_torn on stream %d", step)
+    return True
+
+
+def fire_queue_storm_if_armed(rid: int) -> int:
+    """Number of lowest-priority synthetic clones to enqueue alongside request
+    `rid` (0 when unarmed) — an arrival storm aimed at the bounded queue."""
+    fault = _consume("queue_storm", step=rid)
+    if fault is None:
+        return 0
+    n = int(fault.arg) if fault.arg is not None else 4
+    record_event("fault/queue_storm", rid=rid, clones=n)
+    logger.warning("FAULT FIRING: queue_storm of %d clones at rid %d", n, rid)
+    return n

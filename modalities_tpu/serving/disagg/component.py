@@ -101,7 +101,13 @@ class DisaggServingComponent(ServingComponent):
             text = self.prompt_template.format(prompt=prompt) if self.prompt_template else prompt
             return list(self.tokenizer.tokenize(text))
 
+        self._seed_deadline_env()  # deadline_default_ms applies to both tiers
+        slo_breach_hooks: dict[str, dict] = {}  # worker name -> late brownout hook
+
         def boot(name: str, role: str) -> EngineWorker:
+            brownout, hook = self._worker_brownout()
+            if hook is not None:
+                slo_breach_hooks[name] = hook
             engine = ServingEngine(
                 self.model,
                 self.params,
@@ -118,6 +124,8 @@ class DisaggServingComponent(ServingComponent):
                 spec_decode=self.spec_decode if role == "decode" else None,
                 quant_weights=self.quant_weights_setting,
                 quant_kv=self.quant_kv_setting,
+                max_queue_depth=self.max_queue_depth,
+                brownout=brownout,
                 stop_fn=self.stop_fn,
                 mesh_handle=self.device_mesh,
                 metrics=MetricsRegistry(),  # per-worker: tier SLOs stay isolated
@@ -162,6 +170,9 @@ class DisaggServingComponent(ServingComponent):
                 ).start()
                 worker.server.slo_status_fn = slo_engine.breaching
                 slo_engines.append(slo_engine)
+                if worker.name in slo_breach_hooks:
+                    # bind the worker's brownout to ITS tier's burn signal
+                    slo_breach_hooks[worker.name]["fn"] = slo_engine.breaching
                 logger.info(
                     "disagg SLOs armed on %s (%s tier): %s",
                     worker.name, tier, ", ".join(o.name for o in armed),

@@ -84,6 +84,10 @@ class HandoffRecord:
     rid: int = -1  # prefill-side rid (diagnostics only)
     prompt_len: int = 0  # original prompt length (pre-truncation)
     truncated: bool = False
+    # request deadline, riding OUTSIDE the digest like the trace id: it
+    # re-anchors to the decode tier's local arrival clock, so it never
+    # changes what the decode tier would generate — only whether it bothers
+    deadline_ms: Optional[float] = None
 
     @property
     def kv_bytes(self) -> int:
@@ -157,6 +161,7 @@ class HandoffRecord:
             "rid": int(self.rid),
             "prompt_len": int(self.prompt_len),
             "truncated": bool(self.truncated),
+            "deadline_ms": self.deadline_ms,
             "payload": [
                 {
                     "dtype": str(arr.dtype),
@@ -197,6 +202,9 @@ class HandoffRecord:
                 rid=int(wire.get("rid", -1)),
                 prompt_len=int(wire.get("prompt_len") or 0),
                 truncated=bool(wire.get("truncated", False)),
+                deadline_ms=(
+                    float(wire["deadline_ms"]) if wire.get("deadline_ms") else None
+                ),
             )
         except (KeyError, TypeError, ValueError) as exc:
             raise HandoffRejected(

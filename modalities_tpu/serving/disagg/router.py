@@ -55,6 +55,7 @@ from modalities_tpu.serving.fleet.router import (
     _read_response_head,
 )
 from modalities_tpu.serving.server import (
+    RETRY_AFTER_S,
     SSE_HEADER_BYTES,
     json_response_bytes,
     sse_event_bytes,
@@ -147,11 +148,17 @@ class DisaggRouter(FleetRouter):
         except (OSError, asyncio.TimeoutError):
             return None
         try:
+            deadline_line = (
+                f"X-Deadline-Ms: {state['deadline_ms']}\r\n"
+                if state.get("deadline_ms")
+                else ""
+            )
             head = (
                 f"POST /disagg/prefill HTTP/1.1\r\nHost: {worker.host}\r\n"
                 "Content-Type: application/json\r\n"
                 f"X-Trace-Id: {state['trace_id']}\r\n"
                 f"X-Trace-Hop: {state['hop']}\r\n"
+                f"{deadline_line}"
                 f"Content-Length: {len(body_bytes)}\r\nConnection: close\r\n\r\n"
             )
             writer.write(head.encode("latin-1") + body_bytes)
@@ -187,6 +194,7 @@ class DisaggRouter(FleetRouter):
         failure reason the engine can't observe)."""
         worker.healthy = False
         worker.last_heartbeat = float("-inf")
+        self._record_worker_result(worker, ok=False)
         self.failovers += 1
         self._m_failovers.inc()
         self._m_workers_healthy.set(sum(1 for w in self.workers if w.healthy))
@@ -207,10 +215,17 @@ class DisaggRouter(FleetRouter):
     ) -> None:
         self.http_requests += 1
         if self._shutdown:
-            client_writer.write(json_response_bytes(503, {"error": "router is draining"}))
+            client_writer.write(
+                json_response_bytes(
+                    503, {"error": "router is draining"}, {"Retry-After": RETRY_AFTER_S}
+                )
+            )
             return
         trace_id = (headers or {}).get("x-trace-id") or uuid.uuid4().hex[:16]
-        state = {"forwarded": 0, "headers_sent": False, "trace_id": trace_id, "hop": 0}
+        state = {
+            "forwarded": 0, "headers_sent": False, "trace_id": trace_id, "hop": 0,
+            "deadline_ms": (headers or {}).get("x-deadline-ms") or "",
+        }
         legs: list[dict] = []
         t_arrival = time.monotonic()
         outcome = "client_gone"
@@ -229,10 +244,35 @@ class DisaggRouter(FleetRouter):
                 if state["headers_sent"]:
                     client_writer.write(sse_event_bytes(payload))
                 else:
-                    client_writer.write(json_response_bytes(503, payload))
+                    client_writer.write(
+                        json_response_bytes(503, payload, {"Retry-After": RETRY_AFTER_S})
+                    )
                 await client_writer.drain()
             except (ConnectionError, OSError):
                 pass
+
+        async def retry_allowed(worker_name: str) -> bool:
+            # every replay (fresh prefill or decode re-leg) spends one retry
+            # token; a dry budget ends the request instead of storming peers
+            if self.retry_budget.try_retry():
+                return True
+            self._m_retry_exhausted.inc()
+            record_event(
+                "fleet/retry_budget_exhausted", trace_id=trace_id,
+                worker=worker_name,
+            )
+            payload = {"error": "retry budget exhausted", "trace_id": trace_id}
+            try:
+                if state["headers_sent"]:
+                    client_writer.write(sse_event_bytes(payload))
+                else:
+                    client_writer.write(
+                        json_response_bytes(503, payload, {"Retry-After": RETRY_AFTER_S})
+                    )
+                await client_writer.drain()
+            except (ConnectionError, OSError):
+                pass
+            return False
 
         try:
             for _attempt in range(len(self.workers) + 1):
@@ -252,6 +292,9 @@ class DisaggRouter(FleetRouter):
                     pleg["outcome"] = "failover"
                     legs.append(pleg)
                     self._fail_worker(pworker, state, "peer_down")
+                    if not await retry_allowed(pworker.name):
+                        outcome = "retry_budget_exhausted"
+                        return
                     continue
                 pbody = resp["body"]
                 if resp["status"] != 200:
@@ -266,6 +309,7 @@ class DisaggRouter(FleetRouter):
                     outcome = "error"
                     return
                 pleg["outcome"] = "done"
+                self._record_worker_result(pworker, ok=True)
                 token_ids = [int(t) for t in (pbody.get("token_ids") or [])]
                 pleg["tokens"] = len(token_ids)
                 legs.append(pleg)
@@ -348,6 +392,7 @@ class DisaggRouter(FleetRouter):
                 state["hop"] += 1
                 if leg_outcome == "done":
                     outcome = "done"
+                    self._record_worker_result(dworker, ok=True)
                     return
                 reject = state.pop("reject_reason", None)
                 if reject is not None:
@@ -357,8 +402,14 @@ class DisaggRouter(FleetRouter):
                         "fleet/handoff_rejected", worker=dworker.name,
                         reason=reject, trace_id=trace_id,
                     )
+                    if not await retry_allowed(dworker.name):
+                        outcome = "retry_budget_exhausted"
+                        return
                     continue  # decode worker stays in rotation
                 self._fail_worker(dworker, state, "peer_down")
+                if not await retry_allowed(dworker.name):
+                    outcome = "retry_budget_exhausted"
+                    return
                 # loop: fresh prefill on a healthy pair, SAME trace_id
             await no_workers("pair")
             outcome = "no_healthy_workers"

@@ -82,8 +82,15 @@ import numpy as np
 
 from pathlib import Path
 
-from modalities_tpu.resilience.faults import fire_oom_if_armed
+from modalities_tpu.resilience.faults import (
+    fire_handoff_corrupt_if_armed,
+    fire_oom_if_armed,
+    fire_queue_storm_if_armed,
+    fire_serve_worker_hang_if_armed,
+    fire_slow_decode_if_armed,
+)
 from modalities_tpu.serving.paged_cache import BlockTableState, blocks_for_tokens
+from modalities_tpu.serving.resilience import deadline_expired
 from modalities_tpu.serving.spec_decode import propose_ngram, resolve_spec_config
 from modalities_tpu.telemetry import get_active_telemetry, span
 from modalities_tpu.telemetry.metrics import MetricsRegistry
@@ -142,13 +149,19 @@ class ServeRequest:
     temperature: Optional[float] = None
     seed: int = 0
     arrival_offset_s: float = 0.0
+    # serving resilience (PR 19): `deadline_ms` is the request's budget from
+    # LOCAL arrival — once elapsed the scheduler cancels it at the next seam
+    # (finish reason "deadline"); `priority` orders brownout shedding (higher
+    # number = shed first), FIFO is preserved within a priority class
+    deadline_ms: Optional[float] = None
+    priority: int = 0
 
 
 @dataclass
 class ServeResult:
     rid: int
     tokens: list[int] = field(default_factory=list)
-    finish_reason: str = ""  # "eod" | "budget" | "capacity" | "error" | "handoff"
+    finish_reason: str = ""  # "eod" | "budget" | "capacity" | "error" | "handoff" | "deadline" | "shed"
     prompt_len: int = 0
     weights_generation: int = 0  # generation serving when the request finished
     truncated: bool = False  # prompt window-clipped at admission
@@ -219,6 +232,8 @@ class ServingEngine:
         spec_decode=None,
         quant_weights: Optional[str] = None,
         quant_kv: Optional[str] = None,
+        max_queue_depth: Optional[int] = None,
+        brownout=None,
         stop_fn: Optional[Callable[[], bool]] = None,
         on_token: Optional[Callable[[int, int], None]] = None,
         on_finish: Optional[Callable[[int, ServeResult], None]] = None,
@@ -408,6 +423,15 @@ class ServingEngine:
         self._results: dict[int, ServeResult] = {}
         self._next_rid = 0
         self._admit_seq = 0
+        # overload protection (PR 19): a bounded queue is the 429 signal for
+        # the HTTP layer; `brownout` (serving/resilience.py) is the SLO-driven
+        # shedder the scheduler consults once per round. Both default off, so
+        # existing entry points are untouched.
+        if max_queue_depth is None:
+            env_depth = int(os.environ.get("MODALITIES_TPU_SERVE_QUEUE_LIMIT", "0"))
+            max_queue_depth = env_depth if env_depth > 0 else None
+        self.max_queue_depth = max_queue_depth
+        self.brownout = brownout
         self._streamed: dict[int, int] = {}  # rid -> tokens already on_token'd
         self._truncated_rids: set[int] = set()  # count once even across preemption
 
@@ -455,6 +479,8 @@ class ServingEngine:
         self.weights_generation = 0
         self.weight_swaps = 0
         self.request_errors = 0  # finishes with reason "error" (non-finite logits)
+        self.deadline_expired_requests = 0  # finishes with reason "deadline"
+        self.shed_requests = 0  # finishes with reason "shed" (brownout)
         self.swap_history: list[dict] = []
         self._swap_lock = threading.Lock()
         self._pending_swap: Optional[tuple] = None
@@ -536,6 +562,17 @@ class ServingEngine:
         self._m_req_errors = reg.counter(
             "serve_request_errors_total",
             "Requests finished with reason=error (non-finite logits)",
+        )
+        # serving resilience (PR 19): cancellation + overload accounting
+        self._m_deadline_expired = reg.counter(
+            "serve_deadline_expired_total",
+            "Requests cancelled at a scheduler seam after their deadline expired",
+        )
+        self._m_shed = reg.counter(
+            "serve_shed_total",
+            "Requests shed under overload, by reason (brownout = queued work "
+            "dropped by the SLO shedder, queue_full/brownout_reject = new "
+            "arrivals refused with 429 at the HTTP layer)",
         )
         self._m_generation = reg.gauge(
             "serve_weights_generation", "Weights generation currently installed"
@@ -946,6 +983,8 @@ class ServingEngine:
         arrival_offset_s: float = 0.0,
         trace_id: Optional[str] = None,
         trace_hop: int = 0,
+        deadline_ms: Optional[float] = None,
+        priority: int = 0,
     ) -> int:
         if self.role == "decode":
             raise ValueError(
@@ -965,6 +1004,8 @@ class ServingEngine:
                 temperature=temp,
                 seed=int(seed),
                 arrival_offset_s=float(arrival_offset_s),
+                deadline_ms=float(deadline_ms) if deadline_ms else None,
+                priority=int(priority),
             )
         )
         arrival = max(float(arrival_offset_s), 0.0)
@@ -977,6 +1018,14 @@ class ServingEngine:
         self._trace_event(rid, "enqueue", arrival)
         self._m_submitted.inc()
         self._m_prompt_tokens.inc(len(prompt_tokens))
+        # chaos: an armed queue_storm amplifies this submit with lowest-priority
+        # synthetic clones (one-shot, so the recursion fires exactly once)
+        for _ in range(fire_queue_storm_if_armed(rid)):
+            self.submit(
+                prompt_tokens, max_new_tokens, temperature=temp, seed=seed,
+                arrival_offset_s=arrival_offset_s, deadline_ms=deadline_ms,
+                priority=max(int(priority), 0) + 9,
+            )
         return rid
 
     # ----------------------------------------------------------- disagg imports
@@ -1054,6 +1103,7 @@ class ServingEngine:
             raise
         rid = self._next_rid
         self._next_rid += 1
+        deadline_ms = getattr(record, "deadline_ms", None)
         req = _ImportRequest(
             rid=rid,
             prompt_tokens=[int(t) for t in record.window],
@@ -1061,6 +1111,9 @@ class ServingEngine:
             temperature=float(record.temperature),
             seed=int(record.seed),
             arrival_offset_s=float(arrival_offset_s),
+            # the deadline rides the handoff record (outside the digest, like
+            # the trace id) and restarts from the decode tier's local arrival
+            deadline_ms=float(deadline_ms) if deadline_ms else None,
             record=record,
         )
         self._queue.append(req)
@@ -1210,6 +1263,97 @@ class ServingEngine:
     def _finish_immediate(self, result: ServeResult, reason: str, now: float) -> None:
         self._record_result(result, reason, now)
 
+    # ------------------------------------------------- resilience (PR 19)
+    def _deadline_expired(self, req: ServeRequest, now: float) -> bool:
+        return deadline_expired(req.arrival_offset_s, req.deadline_ms, now)
+
+    def overload_reason(self) -> Optional[str]:
+        """Why new work should be refused right now (None = admit): the HTTP
+        layer turns this into a 429 + Retry-After."""
+        if self.max_queue_depth is not None and len(self._queue) >= self.max_queue_depth:
+            return "queue_full"
+        if self.brownout is not None and self.brownout.active:
+            return "brownout_reject"
+        return None
+
+    def note_rejected(self, reason: str) -> None:
+        """Count one refused arrival (the HTTP layer's 429) on the engine's
+        shed counter, so shedding has ONE metric family whatever the seam."""
+        with self._stats_lock:
+            self.shed_requests += 1
+        self._m_shed.inc(reason=reason)
+
+    def _finish_queued(self, req: ServeRequest, reason: str, now: float) -> None:
+        """Drop one QUEUED request (deadline/shed): it owns no slot and no
+        blocks, so the cancellation is a pure dequeue + result record."""
+        result = ServeResult(
+            rid=req.rid, prompt_len=len(req.prompt_tokens),
+            arrival_s=max(req.arrival_offset_s, 0.0),
+        )
+        result.first_token_s = now
+        if reason == "deadline":
+            with self._stats_lock:
+                self.deadline_expired_requests += 1
+            self._m_deadline_expired.inc()
+        else:
+            with self._stats_lock:
+                self.shed_requests += 1
+            self._m_shed.inc(reason="brownout")
+        self._trace_event(req.rid, reason, now, queued=True)
+        self._finish_immediate(result, reason, now)
+
+    def _sweep_queue(self, t0: float) -> None:
+        """Seam 1 (queue admission): expire dead-on-arrival work, then let the
+        brownout controller shed the lowest-priority queued requests. Runs
+        before every admission round; a queue with no deadlines and no
+        brownout controller passes through untouched."""
+        now = self._now() - t0
+        if any(req.deadline_ms is not None for req in self._queue):
+            kept: deque[ServeRequest] = deque()
+            for req in self._queue:
+                if self._deadline_expired(req, now):
+                    self._finish_queued(req, "deadline", now)
+                else:
+                    kept.append(req)
+            self._queue = kept
+        if self.brownout is None:
+            return
+        self.brownout.update(len(self._queue))
+        for _ in range(self.brownout.shed_target(len(self._queue))):
+            # shed the YOUNGEST request of the LOWEST-priority class: older
+            # work and higher classes keep their FIFO positions
+            victim = None
+            for req in self._queue:
+                if victim is None or req.priority >= victim.priority:
+                    victim = req
+            if victim is None:
+                break
+            self._queue.remove(victim)
+            self._finish_queued(victim, "shed", now)
+
+    def _expire_active(self, t0: float) -> None:
+        """Seams 2+3 (chunk/step boundary): cancel expired slots between
+        dispatches. `_finish` releases the block-table entry, so the pool
+        audit (`free + Σ unique owned == num_blocks`) stays exact, and the
+        cancelled request never occupies another device step."""
+        now = self._now() - t0
+        for slot in range(self.slots):
+            state = self._slot_states[slot]
+            if state is None or state.request.deadline_ms is None:
+                continue
+            if self._deadline_expired(state.request, now):
+                with self._stats_lock:
+                    self.deadline_expired_requests += 1
+                self._m_deadline_expired.inc()
+                self._trace_event(
+                    state.request.rid, "deadline", now, phase=state.phase
+                )
+                if not state.result.tokens:
+                    # never streamed: ttft_s reads as time-to-cancellation
+                    # (matching _finish_queued), not a garbage negative
+                    state.result.first_token_s = now
+                self._finish(slot, "deadline", now)
+
     def _truncate_window(self, req: ServeRequest, result: ServeResult) -> list[int]:
         """Clip the prompt to the admission window (capacity-1 / max_len-1 so at
         least one token can be generated). Truncation is RECORDED, not silent:
@@ -1236,6 +1380,7 @@ class ServingEngine:
         (`stop_fn`) admits nothing."""
         if self._stopping():
             return
+        self._sweep_queue(t0)
         if self.role == "decode":
             self._admit_imports(t0)
             return
@@ -1270,6 +1415,7 @@ class ServingEngine:
                     continue
                 key = jax.random.PRNGKey(req.seed)
                 pos = 0
+                expired_mid_prefill = False
                 with span("serve/prefill"):
                     while pos < len(window):
                         chunk = next(c for c in self.prefill_chunks if c <= len(window) - pos)
@@ -1286,6 +1432,23 @@ class ServingEngine:
                             req.rid, "prefill_chunk", self._now() - t0, start=pos, ntok=chunk
                         )
                         pos += chunk
+                        # seam 2 (chunk boundary): an expired request stops
+                        # burning prefill chunks; the ring slot holds no pooled
+                        # resources, so reuse just overwrites it
+                        if pos < len(window) and self._deadline_expired(
+                            req, self._now() - t0
+                        ):
+                            expired_mid_prefill = True
+                            break
+                if expired_mid_prefill:
+                    now2 = self._now() - t0
+                    result.first_token_s = now2
+                    with self._stats_lock:
+                        self.deadline_expired_requests += 1
+                    self._m_deadline_expired.inc()
+                    self._trace_event(req.rid, "deadline", now2, phase="prefill")
+                    self._finish_immediate(result, "deadline", now2)
+                    continue
                 first_tok = int(tok)  # device sync: the request's TTFT point
                 now2 = self._now() - t0
                 result.first_token_s = now2
@@ -1627,6 +1790,7 @@ class ServingEngine:
         chunk ends its prompt sample the request's first token on-device."""
         import jax
 
+        self._expire_active(t0)  # seam 2: no chunk for an expired request
         jnp = self._jnp
         R, C = self.slots, self.block_size
         nb = self.num_blocks
@@ -1781,7 +1945,13 @@ class ServingEngine:
             rid=rid,
             prompt_len=len(req.prompt_tokens),
             truncated=bool(result.truncated),
+            deadline_ms=req.deadline_ms,
         ).seal()
+        if fire_handoff_corrupt_if_armed(rid):
+            # flip one payload byte AFTER sealing: the decode tier's digest
+            # check must reject the import (retryable) rather than decode
+            # from corrupt KV
+            record.payload[0].view(np.uint8).flat[0] ^= 0xFF
         with self._stats_lock:
             self.handoffs_exported += 1
             self.handoff_bytes_shipped += record.kv_bytes
@@ -1799,6 +1969,10 @@ class ServingEngine:
         their positions never advance and admission re-prefills over their rows."""
         import jax
 
+        self._expire_active(t0)  # seam 3: no step for an expired request
+        if self._decoding_count() == 0:
+            return  # every decoder just expired
+        fire_slow_decode_if_armed(self._dispatch_seq)
         jnp = self._jnp
         if self.kv_cache == "paged":
             props = self._collect_proposals() if self.spec.enabled else {}
@@ -2049,6 +2223,7 @@ class ServingEngine:
         did = False
         try:
             fire_oom_if_armed(self._dispatch_seq)
+            fire_serve_worker_hang_if_armed(self._dispatch_seq)
             if self.kv_cache == "paged" and self._prefilling_slots():
                 self._prefill_dispatch(t0)
                 did = True
@@ -2121,6 +2296,8 @@ class ServingEngine:
             spec_accepted = self.spec_accepted
             weight_swaps = self.weight_swaps
             request_errors = self.request_errors
+            deadline_expired = self.deadline_expired_requests
+            shed = self.shed_requests
             handoffs_exported = self.handoffs_exported
             handoffs_imported = self.handoffs_imported
             import_requeues = self.import_requeues
@@ -2146,6 +2323,8 @@ class ServingEngine:
             "weights_generation": self.weights_generation,
             "weight_swaps": weight_swaps,
             "request_errors": request_errors,
+            "deadline_expired_requests": deadline_expired,
+            "shed_requests": shed,
             "quant_weights": self.quant_weights,
             "quant_kv": self.quant_kv,
             "kv_pool_bytes": self.kv_pool_bytes,

@@ -118,8 +118,13 @@ class FleetServingComponent(ServingComponent):
             text = self.prompt_template.format(prompt=prompt) if self.prompt_template else prompt
             return list(self.tokenizer.tokenize(text))
 
+        self._seed_deadline_env()  # deadline_default_ms applies fleet-wide
+        slo_breach_hooks: dict[str, dict] = {}  # worker name -> late brownout hook
         workers: list[EngineWorker] = []
         for i in range(self.num_workers):
+            brownout, hook = self._worker_brownout()
+            if hook is not None:
+                slo_breach_hooks[f"worker{i}"] = hook
             engine = ServingEngine(
                 self.model,
                 self.params,
@@ -135,6 +140,8 @@ class FleetServingComponent(ServingComponent):
                 spec_decode=self.spec_decode,
                 quant_weights=self.quant_weights_setting,
                 quant_kv=self.quant_kv_setting,
+                max_queue_depth=self.max_queue_depth,
+                brownout=brownout,
                 stop_fn=self.stop_fn,
                 mesh_handle=self.device_mesh,
                 metrics=MetricsRegistry(),  # per-worker: canary metrics stay isolated
@@ -170,6 +177,10 @@ class FleetServingComponent(ServingComponent):
                 ).start()
                 worker.server.slo_status_fn = slo_engine.breaching
                 slo_engines[worker.name] = slo_engine
+                if worker.name in slo_breach_hooks:
+                    # bind the worker's brownout to ITS burn signal (late:
+                    # the SLO engine needs the worker's registry to exist)
+                    slo_breach_hooks[worker.name]["fn"] = slo_engine.breaching
 
             def slo_verdict_fn(worker):
                 engine = slo_engines[worker.name]

@@ -41,7 +41,9 @@ import uuid
 from typing import Optional
 
 from modalities_tpu.resilience.events import record_event
+from modalities_tpu.serving.resilience import CircuitBreaker, ProbeBackoff, RetryBudget
 from modalities_tpu.serving.server import (
+    RETRY_AFTER_S,
     SSE_HEADER_BYTES,
     json_response_bytes,
     read_http_request,
@@ -183,6 +185,26 @@ class FleetRouter:
             "fleet_request_e2e_seconds",
             "Router-observed latency from generate arrival to the final SSE event",
         )
+        # resilience (PR 19): per-worker circuit breakers, one shared retry
+        # budget funded by successful requests, and per-dead-worker probe
+        # backoff so a recovering worker never takes a synchronized herd
+        self._breakers = {w.name: CircuitBreaker() for w in self.workers}
+        self.retry_budget = RetryBudget()
+        self._probe_backoff = {
+            w.name: ProbeBackoff(base_s=max(self.health_interval_s, 0.05))
+            for w in self.workers
+        }
+        self._probe_fail_seen: dict[str, bool] = {}
+        self._m_retry_exhausted = self.metrics.counter(
+            "fleet_retry_budget_exhausted_total",
+            "Failover retries refused because the retry budget ran dry",
+        )
+        self._m_circuit = self.metrics.gauge(
+            "fleet_circuit_state",
+            "Per-worker circuit breaker state (0 closed, 1 half-open, 2 open)",
+        )
+        for w in self.workers:
+            self._m_circuit.set(0.0, worker=w.name)
         from modalities_tpu.telemetry.metrics import register_process_metrics
 
         from modalities_tpu import __version__
@@ -219,8 +241,24 @@ class FleetRouter:
     async def _health_loop(self) -> None:
         while True:
             for worker in self.workers:
+                backoff = self._probe_backoff.setdefault(
+                    worker.name, ProbeBackoff(base_s=max(self.health_interval_s, 0.05))
+                )
+                if not worker.healthy and not backoff.due(time.monotonic()):
+                    continue  # dead worker: wait out the jittered backoff
                 if await self._probe(worker):
                     worker.last_heartbeat = time.monotonic()
+                    backoff.reset()
+                    self._probe_fail_seen.pop(worker.name, None)
+                elif not worker.healthy:
+                    backoff.failed(time.monotonic())
+                    if not self._probe_fail_seen.get(worker.name):
+                        # ONE deduped line per outage, not one per probe
+                        logger.info(
+                            "fleet router: probe of dead worker %s failed; "
+                            "re-probing with exponential backoff", worker.name,
+                        )
+                        self._probe_fail_seen[worker.name] = True
             now = time.monotonic()
             for worker in self.workers:
                 was_healthy = worker.healthy
@@ -279,13 +317,34 @@ class FleetRouter:
             for w in self.workers
             if w.healthy and w.name not in exclude and (tier is None or w.tier == tier)
         ]
-        if not candidates:
-            return None
         # degraded last: an SLO-breaching worker still serves, but only when
         # every clean peer is excluded or down
-        worker = min(candidates, key=lambda w: (w.degraded, w.load, w.picks))
-        worker.picks += 1
-        return worker
+        candidates.sort(key=lambda w: (w.degraded, w.load, w.picks))
+        for w in candidates:
+            # circuit breaker gate: an open breaker hides the worker; a
+            # half-open one admits exactly this request as its probe
+            breaker = self._breakers.get(w.name)
+            if breaker is not None and not breaker.allow():
+                self._m_circuit.set(breaker.state_value(), worker=w.name)
+                continue
+            if breaker is not None:
+                self._m_circuit.set(breaker.state_value(), worker=w.name)
+            w.picks += 1
+            return w
+        return None
+
+    def _record_worker_result(self, worker: WorkerHandle, *, ok: bool) -> None:
+        """Feed one leg's outcome to the worker's breaker and (on success)
+        the shared retry budget, keeping the circuit gauge current."""
+        breaker = self._breakers.get(worker.name)
+        if breaker is None:
+            breaker = self._breakers[worker.name] = CircuitBreaker()
+        if ok:
+            breaker.record_success()
+            self.retry_budget.record_success()
+        else:
+            breaker.record_failure()
+        self._m_circuit.set(breaker.state_value(), worker=worker.name)
 
     # ----------------------------------------------------------------- proxy
     async def _relay_from_worker(
@@ -327,6 +386,13 @@ class FleetRouter:
         except (OSError, asyncio.TimeoutError):
             return "failover"
         try:
+            # the deadline rides every leg like the trace id (the worker
+            # re-anchors it to its own arrival clock)
+            deadline_line = (
+                f"X-Deadline-Ms: {state['deadline_ms']}\r\n"
+                if state.get("deadline_ms")
+                else ""
+            )
             head = (
                 f"POST {path} HTTP/1.1\r\nHost: {worker.host}\r\n"
                 "Content-Type: application/json\r\n"
@@ -335,6 +401,7 @@ class FleetRouter:
                 # legs apart in the stitched span tree
                 f"X-Trace-Id: {state['trace_id']}\r\n"
                 f"X-Trace-Hop: {state['hop']}\r\n"
+                f"{deadline_line}"
                 f"Content-Length: {len(body_bytes)}\r\nConnection: close\r\n\r\n"
             )
             writer.write(head.encode("latin-1") + body_bytes)
@@ -411,13 +478,20 @@ class FleetRouter:
     ) -> None:
         self.http_requests += 1
         if self._shutdown:
-            client_writer.write(json_response_bytes(503, {"error": "router is draining"}))
+            client_writer.write(
+                json_response_bytes(
+                    503, {"error": "router is draining"}, {"Retry-After": RETRY_AFTER_S}
+                )
+            )
             return
         # mint the fleet-wide trace_id here (or honor one a client/upstream tier
         # propagated): every worker leg, metric exemplar, and sink record of
         # this request carries it — analyze_fleet stitches on it
         trace_id = (headers or {}).get("x-trace-id") or uuid.uuid4().hex[:16]
-        state = {"forwarded": 0, "headers_sent": False, "trace_id": trace_id, "hop": 0}
+        state = {
+            "forwarded": 0, "headers_sent": False, "trace_id": trace_id, "hop": 0,
+            "deadline_ms": (headers or {}).get("x-deadline-ms") or "",
+        }
         legs: list[dict] = []
         t_arrival = time.monotonic()
         outcome = "client_gone"
@@ -431,7 +505,11 @@ class FleetRouter:
                     if state["headers_sent"]:
                         client_writer.write(sse_event_bytes(payload))
                     else:
-                        client_writer.write(json_response_bytes(503, payload))
+                        client_writer.write(
+                            json_response_bytes(
+                                503, payload, {"Retry-After": RETRY_AFTER_S}
+                            )
+                        )
                     outcome = "no_healthy_workers"
                     return
                 tried.add(worker.name)
@@ -445,6 +523,7 @@ class FleetRouter:
                 legs.append(leg)
                 state["hop"] += 1
                 if outcome == "done":
+                    self._record_worker_result(worker, ok=True)
                     return
                 # the worker failed under us: out of rotation until a probe
                 # succeeds again, and the request moves to a peer. The
@@ -453,6 +532,7 @@ class FleetRouter:
                 # in the health loop's evaluation phase.
                 worker.healthy = False
                 worker.last_heartbeat = float("-inf")
+                self._record_worker_result(worker, ok=False)
                 self.failovers += 1
                 self._m_failovers.inc()
                 self._m_workers_healthy.set(
@@ -466,6 +546,28 @@ class FleetRouter:
                     "fleet/failover", worker=worker.name,
                     forwarded_tokens=state["forwarded"], trace_id=trace_id,
                 )
+                # retry budget: the replay about to happen must be funded by
+                # recent successful traffic, or a worker flap amplifies into
+                # a retry storm against the survivors
+                if not self.retry_budget.try_retry():
+                    self._m_retry_exhausted.inc()
+                    record_event(
+                        "fleet/retry_budget_exhausted", trace_id=trace_id,
+                        worker=worker.name,
+                    )
+                    payload = {
+                        "error": "retry budget exhausted", "trace_id": trace_id,
+                    }
+                    if state["headers_sent"]:
+                        client_writer.write(sse_event_bytes(payload))
+                    else:
+                        client_writer.write(
+                            json_response_bytes(
+                                503, payload, {"Retry-After": RETRY_AFTER_S}
+                            )
+                        )
+                    outcome = "retry_budget_exhausted"
+                    return
         except _ClientGone:
             outcome = "client_gone"
             return
@@ -495,11 +597,18 @@ class FleetRouter:
                     "load": w.load,
                     "weights_generation": w.weights_generation,
                     "picks": w.picks,
+                    "circuit": (
+                        self._breakers[w.name].state
+                        if w.name in self._breakers
+                        else "closed"
+                    ),
                 }
                 for w in self.workers
             ],
             "failovers": self.failovers,
             "http_requests": self.http_requests,
+            "retry_budget_tokens": self.retry_budget.tokens,
+            "retry_budget_exhausted": self.retry_budget.exhausted,
         }
 
     async def _handle(self, reader, writer) -> None:

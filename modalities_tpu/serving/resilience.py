@@ -1,0 +1,272 @@
+"""Serving-side resilience primitives: deadlines, brownout shedding, circuit
+breakers, retry budgets, and probe backoff.
+
+The training path has a full resilience stack (resilience/); this module is
+the serving fleet's counterpart, consumed by the engine scheduler
+(serving/engine.py), the HTTP front end (serving/server.py) and both routers
+(serving/fleet/router.py, serving/disagg/router.py):
+
+- **Deadlines** ride requests the way trace ids do (PR 13): the client sends
+  ``X-Deadline-Ms`` (or the per-process default below applies), the header is
+  folded into the request body at every HTTP seam, and the engine cancels the
+  request at the next scheduler boundary once it expires — finish reason
+  ``"deadline"``, slots/blocks freed transactionally. A deadline is measured
+  from the request's LOCAL arrival on each leg (router clock skew never
+  cancels early); the record a disagg prefill exports carries it to the
+  decode tier outside the digest, exactly like the trace id.
+- :class:`BrownoutController` — SLO-driven overload state machine. The
+  brownout signal is the PR-15 fast-window burn (``breaching_fn``, typically
+  ``lambda: bool(slo_engine.breaching())``) OR queue depth at/over
+  ``queue_high``; while active the engine sheds the lowest-priority queued
+  requests down to ``queue_low`` (finish reason ``"shed"``) and the HTTP
+  layer rejects new work with 429 + ``Retry-After``. Recovery needs the
+  signal clear AND the queue drained below ``queue_low`` (hysteresis).
+- :class:`CircuitBreaker` — per-worker, router-side: consecutive failures
+  open the circuit; after a jittered exponential backoff one half-open probe
+  request is let through, and its outcome closes or re-opens the breaker.
+- :class:`RetryBudget` — a token bucket funded by successful traffic: each
+  success deposits ``ratio`` tokens (capped), each retry withdraws one, so
+  failover replay can never exceed ~``ratio`` of recent successes — a worker
+  flap degrades to a few retries instead of a retry storm.
+- :class:`ProbeBackoff` — jittered exponential backoff for health probes of
+  a DEAD worker, so a recovering worker is not hit by a synchronized probe
+  herd while healthy peers keep the fixed cadence.
+
+Everything here is plain host-side Python: no jitted program changes, so the
+non-deadline serving path keeps its executable pins byte-identical.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import threading
+import time
+from typing import Callable, Optional
+
+# header name as read_http_request lowercases it; mirrors "x-trace-id"
+DEADLINE_HEADER = "x-deadline-ms"
+
+
+def default_deadline_ms() -> Optional[float]:
+    """Per-process default request deadline (``MODALITIES_TPU_SERVE_DEADLINE_DEFAULT_MS``,
+    0 = no default). Applied only when the client sent no deadline."""
+    raw = os.environ.get("MODALITIES_TPU_SERVE_DEADLINE_DEFAULT_MS", "0")
+    value = float(raw)
+    return value if value > 0 else None
+
+
+def resolve_deadline_ms(value) -> Optional[float]:
+    """Client-supplied deadline (header/body, may be None/unparseable) or the
+    env default; non-positive values disable the deadline explicitly."""
+    if value is None:
+        return default_deadline_ms()
+    try:
+        ms = float(value)
+    except (TypeError, ValueError):
+        return default_deadline_ms()
+    return ms if ms > 0 else None
+
+
+def deadline_expired(arrival_s: float, deadline_ms: Optional[float], now_s: float) -> bool:
+    """True once ``deadline_ms`` elapsed since the request's local arrival."""
+    if deadline_ms is None:
+        return False
+    return (now_s - max(arrival_s, 0.0)) * 1000.0 >= deadline_ms
+
+
+class BrownoutController:
+    """Two-state overload machine: ``ok`` <-> ``brownout`` (see module doc).
+
+    ``update(queue_depth)`` is called once per scheduler round by the engine;
+    ``shed_target(queue_depth)`` says how many queued requests to shed this
+    round (down to ``queue_low``). With no ``queue_high`` the controller is
+    purely SLO-driven; with no ``breaching_fn`` it is purely queue-driven."""
+
+    def __init__(
+        self,
+        breaching_fn: Optional[Callable[[], bool]] = None,
+        *,
+        queue_high: Optional[int] = None,
+        queue_low: Optional[int] = None,
+    ):
+        if breaching_fn is None and queue_high is None:
+            raise ValueError("BrownoutController needs breaching_fn or queue_high")
+        self.breaching_fn = breaching_fn
+        self.queue_high = queue_high
+        if queue_low is None:
+            queue_low = queue_high // 2 if queue_high is not None else 0
+        self.queue_low = queue_low
+        self.state = "ok"
+        self.transitions = 0
+
+    def _signal(self, queue_depth: int) -> bool:
+        slo = bool(self.breaching_fn()) if self.breaching_fn is not None else False
+        pressure = self.queue_high is not None and queue_depth >= self.queue_high
+        return slo or pressure
+
+    def update(self, queue_depth: int) -> str:
+        if self.state == "ok":
+            if self._signal(queue_depth):
+                self.state = "brownout"
+                self.transitions += 1
+        else:
+            # hysteresis: clear signal AND drained queue, or brownout flaps
+            if not self._signal(queue_depth) and queue_depth <= self.queue_low:
+                self.state = "ok"
+                self.transitions += 1
+        return self.state
+
+    @property
+    def active(self) -> bool:
+        return self.state == "brownout"
+
+    def shed_target(self, queue_depth: int) -> int:
+        if not self.active:
+            return 0
+        return max(0, queue_depth - self.queue_low)
+
+
+class CircuitBreaker:
+    """Per-worker circuit breaker (router-side).
+
+    closed: traffic flows; ``failure_threshold`` CONSECUTIVE failures trip it
+    open. open: no traffic until a jittered exponential backoff elapses, then
+    ONE half-open probe is allowed. half_open: the probe's success closes the
+    breaker (backoff reset); its failure re-opens with doubled backoff."""
+
+    _STATE_VALUES = {"closed": 0.0, "half_open": 1.0, "open": 2.0}
+
+    def __init__(
+        self,
+        failure_threshold: int = 3,
+        open_s: float = 1.0,
+        max_open_s: float = 30.0,
+        jitter: float = 0.25,
+        time_fn: Callable[[], float] = time.monotonic,
+        rng: Callable[[], float] = random.random,
+    ):
+        if failure_threshold < 1:
+            raise ValueError("failure_threshold must be >= 1")
+        self.failure_threshold = int(failure_threshold)
+        self.base_open_s = float(open_s)
+        self.max_open_s = float(max_open_s)
+        self.jitter = float(jitter)
+        self._time_fn = time_fn
+        self._rng = rng
+        self.state = "closed"
+        self.failures = 0
+        self._open_s = self.base_open_s
+        self._until = float("-inf")
+        self._probing = False
+
+    def allow(self) -> bool:
+        """May a request be routed to this worker right now? Transitions
+        open -> half_open when the backoff elapsed (and admits ONE probe)."""
+        if self.state == "closed":
+            return True
+        if self.state == "open":
+            if self._time_fn() < self._until:
+                return False
+            self.state = "half_open"
+            self._probing = False
+        if self._probing:
+            return False  # one probe at a time in half_open
+        self._probing = True
+        return True
+
+    def record_success(self) -> None:
+        self.state = "closed"
+        self.failures = 0
+        self._open_s = self.base_open_s
+        self._probing = False
+
+    def record_failure(self) -> None:
+        self.failures += 1
+        if self.state == "half_open" or self.failures >= self.failure_threshold:
+            self.state = "open"
+            self._until = self._time_fn() + self._open_s * (1.0 + self.jitter * self._rng())
+            self._open_s = min(self._open_s * 2.0, self.max_open_s)
+            self._probing = False
+
+    def state_value(self) -> float:
+        """Gauge encoding for ``fleet_circuit_state{worker}``: 0 closed,
+        1 half_open, 2 open."""
+        return self._STATE_VALUES[self.state]
+
+
+def _default_retry_budget_ratio() -> float:
+    return float(os.environ.get("MODALITIES_TPU_FLEET_RETRY_BUDGET_RATIO", "0.2"))
+
+
+class RetryBudget:
+    """Token bucket capping retries at a fraction of recent successful
+    traffic: ``record_success()`` deposits ``ratio`` tokens (capped at
+    ``cap``), ``try_retry()`` withdraws one whole token or refuses. The
+    bucket starts at ``initial`` (default: full) so cold-start failover
+    still has a few retries before any success funded it."""
+
+    def __init__(
+        self,
+        ratio: Optional[float] = None,
+        cap: float = 10.0,
+        initial: Optional[float] = None,
+    ):
+        self.ratio = _default_retry_budget_ratio() if ratio is None else float(ratio)
+        self.cap = float(cap)
+        self.tokens = self.cap if initial is None else float(initial)
+        self.exhausted = 0  # refused retries (the storm that did NOT happen)
+        self._lock = threading.Lock()
+
+    def record_success(self) -> None:
+        with self._lock:
+            self.tokens = min(self.cap, self.tokens + self.ratio)
+
+    def try_retry(self) -> bool:
+        with self._lock:
+            if self.tokens >= 1.0:
+                self.tokens -= 1.0
+                return True
+            self.exhausted += 1
+            return False
+
+
+def _default_probe_backoff_max_s() -> float:
+    return float(os.environ.get("MODALITIES_TPU_FLEET_PROBE_BACKOFF_MAX_S", "8.0"))
+
+
+class ProbeBackoff:
+    """Jittered exponential backoff schedule for probing ONE dead worker.
+
+    ``due(now)`` gates the probe; ``failed(now)`` reschedules with doubled
+    (jittered) delay; ``reset()`` restores the fixed healthy cadence. The
+    jitter decorrelates routers so a recovering worker never takes a
+    synchronized probe herd."""
+
+    def __init__(
+        self,
+        base_s: float = 0.5,
+        max_s: Optional[float] = None,
+        jitter: float = 0.25,
+        rng: Callable[[], float] = random.random,
+    ):
+        self.base_s = float(base_s)
+        self.max_s = _default_probe_backoff_max_s() if max_s is None else float(max_s)
+        self.jitter = float(jitter)
+        self._rng = rng
+        self._delay = self.base_s
+        self._next = float("-inf")
+        self.failures = 0
+
+    def due(self, now: float) -> bool:
+        return now >= self._next
+
+    def failed(self, now: float) -> None:
+        self.failures += 1
+        self._next = now + self._delay * (1.0 + self.jitter * self._rng())
+        self._delay = min(self._delay * 2.0, self.max_s)
+
+    def reset(self) -> None:
+        self._delay = self.base_s
+        self._next = float("-inf")
+        self.failures = 0

@@ -53,6 +53,12 @@ class ServingComponentConfig(BaseModel):
     # "sample_interval_s"?} judged live over the serve metrics registry.
     # None = no engine, no slo_* series — the pre-SLO behavior exactly.
     slo: Optional[dict] = None
+    # resilience (PR 19): bounded admission queue (None = env/unbounded) and
+    # default per-request deadline (None = env/off); with an slo: block the
+    # brownout controller sheds queued work while the fast burn window breaches
+    max_queue_depth: Optional[int] = None
+    deadline_default_ms: Optional[float] = None
+    brownout_queue_high: Optional[int] = None  # queue-pressure brownout trigger
 
 
 class ServingComponent:
@@ -81,6 +87,9 @@ class ServingComponent:
         http_host: str = "127.0.0.1",
         http_port: Optional[int] = None,
         slo: Optional[dict] = None,
+        max_queue_depth: Optional[int] = None,
+        deadline_default_ms: Optional[float] = None,
+        brownout_queue_high: Optional[int] = None,
         params=None,
     ):
         self.model = model
@@ -107,6 +116,9 @@ class ServingComponent:
         self.http_host = http_host
         self.http_port = http_port
         self.slo = slo
+        self.max_queue_depth = max_queue_depth
+        self.deadline_default_ms = deadline_default_ms
+        self.brownout_queue_high = brownout_queue_high
         self.slo_engine = None  # serve() arms it when an slo: block is configured
         self.params = params
         self.stop_fn = None  # graceful drain: serve() wires the SIGTERM flag here
@@ -118,12 +130,54 @@ class ServingComponent:
         except Exception:
             return -1
 
+    def _build_brownout(self):
+        """SLO-driven (PR-15 fast-window burn) and/or queue-pressure brownout;
+        None when neither signal is configured — the pre-PR-19 behavior."""
+        if self.brownout_queue_high is None and self.slo_engine is None:
+            return None
+        from modalities_tpu.serving.resilience import BrownoutController
+
+        breaching_fn = None
+        if self.slo_engine is not None:
+            slo_engine = self.slo_engine
+            breaching_fn = lambda: bool(slo_engine.breaching())  # noqa: E731
+        return BrownoutController(breaching_fn, queue_high=self.brownout_queue_high)
+
+    def _seed_deadline_env(self) -> None:
+        """env > config, like every other serving knob: the config default
+        only lands when no env override is present."""
+        if self.deadline_default_ms is not None and not os.environ.get(
+            "MODALITIES_TPU_SERVE_DEADLINE_DEFAULT_MS"
+        ):
+            os.environ["MODALITIES_TPU_SERVE_DEADLINE_DEFAULT_MS"] = str(
+                self.deadline_default_ms
+            )
+
+    def _worker_brownout(self):
+        """Brownout for a fleet/disagg worker engine. Per-worker SLO engines
+        are armed only AFTER the engine loop (they watch each worker's
+        isolated registry), so the SLO signal binds late: the caller sets
+        ``hook["fn"]`` to the worker's ``SLOEngine.breaching`` once it exists;
+        until then the signal reads clear. Returns (brownout_or_None, hook)."""
+        if self.brownout_queue_high is None and not self.slo:
+            return None, None
+        from modalities_tpu.serving.resilience import BrownoutController
+
+        hook: dict = {"fn": None}
+        breaching_fn = None
+        if self.slo:
+            breaching_fn = (  # noqa: E731
+                lambda: bool(hook["fn"]()) if hook["fn"] is not None else False
+            )
+        return BrownoutController(breaching_fn, queue_high=self.brownout_queue_high), hook
+
     def build_engine(self):
         from modalities_tpu.serving.engine import ServingEngine
 
         if self._engine is None:
             if self.params is None:
                 raise ValueError("params not resolved — serve() loads them first")
+            self._seed_deadline_env()
             self._engine = ServingEngine(
                 self.model,
                 self.params,
@@ -139,6 +193,8 @@ class ServingComponent:
                 spec_decode=self.spec_decode,
                 quant_weights=self.quant_weights_setting,
                 quant_kv=self.quant_kv_setting,
+                max_queue_depth=self.max_queue_depth,
+                brownout=self._build_brownout(),
                 stop_fn=self.stop_fn,
                 mesh_handle=self.device_mesh,
             )
@@ -147,6 +203,8 @@ class ServingComponent:
     def run_requests(self, requests: list[dict]) -> list[dict]:
         """Replay parsed requests ({"prompt", "max_new_tokens"?, "temperature"?,
         "seed"?, "arrival_offset_s"?}) through the engine; returns JSONL-ready rows."""
+        from modalities_tpu.serving.resilience import resolve_deadline_ms
+
         engine = self.build_engine()
         rid_to_req = {}
         for req in requests:
@@ -157,6 +215,9 @@ class ServingComponent:
                 temperature=req.get("temperature", self.temperature),
                 seed=int(req.get("seed", self.seed)),
                 arrival_offset_s=float(req.get("arrival_offset_s", 0.0)),
+                # same ingress resolution as the HTTP server: explicit row
+                # value > env/config default > no deadline
+                deadline_ms=resolve_deadline_ms(req.get("deadline_ms")),
             )
             rid_to_req[rid] = req
         results = engine.run()

@@ -56,6 +56,8 @@ import time
 from http import HTTPStatus
 from typing import Callable, Optional
 
+from modalities_tpu.resilience.faults import fire_sse_torn_if_armed
+from modalities_tpu.serving.resilience import DEADLINE_HEADER, resolve_deadline_ms
 from modalities_tpu.telemetry import get_active_telemetry, span
 from modalities_tpu.telemetry.metrics import CONTENT_TYPE_LATEST
 
@@ -95,20 +97,36 @@ async def read_http_request(
         return None
 
 
-def response_bytes(code: int, content_type: str, body: bytes) -> bytes:
+def response_bytes(
+    code: int,
+    content_type: str,
+    body: bytes,
+    extra_headers: Optional[dict] = None,
+) -> bytes:
     """A complete fixed-length HTTP/1.1 response (connection closes after)."""
     phrase = HTTPStatus(code).phrase
+    extra = "".join(f"{k}: {v}\r\n" for k, v in (extra_headers or {}).items())
     head = (
         f"HTTP/1.1 {code} {phrase}\r\n"
         f"Content-Type: {content_type}\r\n"
         f"Content-Length: {len(body)}\r\n"
+        f"{extra}"
         "Connection: close\r\n\r\n"
     )
     return head.encode("latin-1") + body
 
 
-def json_response_bytes(code: int, payload: dict) -> bytes:
-    return response_bytes(code, "application/json", json.dumps(payload).encode())
+def json_response_bytes(
+    code: int, payload: dict, extra_headers: Optional[dict] = None
+) -> bytes:
+    return response_bytes(
+        code, "application/json", json.dumps(payload).encode(), extra_headers
+    )
+
+
+# overload/drain rejections tell clients when to come back (seconds); fixed
+# and small — the client's own jittered backoff does the decorrelation
+RETRY_AFTER_S = "1"
 
 
 SSE_HEADER_BYTES = (
@@ -159,6 +177,7 @@ class ServingHTTPServer:
 
         self._pending: queue.Queue = queue.Queue()  # (body dict, stream queue)
         self._streams: dict[int, queue.Queue] = {}  # rid -> stream (engine thread only)
+        self._sse_seq = 0  # streams started (event-loop thread only)
         self._shutdown = False
         self._closing = False
         self._t0: Optional[float] = None
@@ -254,6 +273,8 @@ class ServingHTTPServer:
                     arrival_offset_s=self.engine._now() - t0,
                     trace_id=body.get("trace_id") or None,
                     trace_hop=int(body.get("trace_hop") or 0),
+                    deadline_ms=resolve_deadline_ms(body.get("deadline_ms")),
+                    priority=int(body.get("priority") or 0),
                 )
                 self._streams[rid] = stream
                 stream.put(("rid", rid))
@@ -297,6 +318,8 @@ class ServingHTTPServer:
         """Relay one request's engine stream out as SSE. The engine thread puts
         into `stream`; we poll it at the engine's own idle cadence (2 ms) so the
         event loop never blocks on a thread queue."""
+        self._sse_seq += 1
+        sse_seq = self._sse_seq  # fault point: sse_torn@n tears the n-th stream
         writer.write(SSE_HEADER_BYTES)
         try:
             while True:
@@ -316,6 +339,10 @@ class ServingHTTPServer:
                         )
                     )
                     await writer.drain()
+                    if fire_sse_torn_if_armed(sse_seq):
+                        # torn stream: the connection drops with no done event;
+                        # the router failovers and splices the replay
+                        return
                 elif kind == "done":
                     result = value
                     writer.write(
@@ -361,6 +388,10 @@ class ServingHTTPServer:
                 if headers and headers.get("x-trace-id"):
                     body.setdefault("trace_id", headers["x-trace-id"])
                     body.setdefault("trace_hop", headers.get("x-trace-hop") or 0)
+                if headers and headers.get(DEADLINE_HEADER):
+                    # the deadline rides like the trace id: header -> body ->
+                    # engine; it re-anchors to THIS worker's arrival clock
+                    body.setdefault("deadline_ms", headers[DEADLINE_HEADER])
                 prompt = body.get("prompt")
                 if not isinstance(prompt, str) or not prompt:
                     writer.write(
@@ -387,11 +418,37 @@ class ServingHTTPServer:
             if self.draining:
                 self.http_rejected += 1
                 self._m_http_rejected.inc()
-                writer.write(json_response_bytes(503, {"error": "server is draining"}))
+                writer.write(
+                    json_response_bytes(
+                        503, {"error": "server is draining"},
+                        {"Retry-After": RETRY_AFTER_S},
+                    )
+                )
+                return
+            if self._reject_overload(writer):
                 return
             stream: queue.Queue = queue.Queue()
             self.submit_stream(body, stream)
             await self._relay_stream(stream, writer)
+
+    def _reject_overload(self, writer: asyncio.StreamWriter) -> bool:
+        """429 + Retry-After when the engine is refusing new work (bounded
+        queue full, or the brownout controller is active). The engine counts
+        the rejection on `serve_shed_total{reason}`."""
+        reason = self.engine.overload_reason()
+        if reason is None:
+            return False
+        self.http_rejected += 1
+        self._m_http_rejected.inc()
+        self.engine.note_rejected(reason)
+        writer.write(
+            json_response_bytes(
+                429,
+                {"error": f"overloaded ({reason}), retry later", "reason": reason},
+                {"Retry-After": RETRY_AFTER_S},
+            )
+        )
+        return True
 
     async def _handle_disagg_prefill(
         self,
@@ -421,6 +478,8 @@ class ServingHTTPServer:
                 if headers and headers.get("x-trace-id"):
                     body.setdefault("trace_id", headers["x-trace-id"])
                     body.setdefault("trace_hop", headers.get("x-trace-hop") or 0)
+                if headers and headers.get(DEADLINE_HEADER):
+                    body.setdefault("deadline_ms", headers[DEADLINE_HEADER])
                 prompt = body.get("prompt")
                 if not isinstance(prompt, str) or not prompt:
                     writer.write(
@@ -433,7 +492,14 @@ class ServingHTTPServer:
             if self.draining:
                 self.http_rejected += 1
                 self._m_http_rejected.inc()
-                writer.write(json_response_bytes(503, {"error": "server is draining"}))
+                writer.write(
+                    json_response_bytes(
+                        503, {"error": "server is draining"},
+                        {"Retry-After": RETRY_AFTER_S},
+                    )
+                )
+                return
+            if self._reject_overload(writer):
                 return
             stream: queue.Queue = queue.Queue()
             self.submit_stream(body, stream)
@@ -499,6 +565,8 @@ class ServingHTTPServer:
                 if headers and headers.get("x-trace-id"):
                     body.setdefault("trace_id", headers["x-trace-id"])
                     body.setdefault("trace_hop", headers.get("x-trace-hop") or 0)
+                # no deadline header here: an import's deadline rides INSIDE
+                # the handoff record (re-anchored to the decode tier's clock)
                 record = body.get("record")
                 if not isinstance(record, dict):
                     writer.write(
@@ -511,7 +579,12 @@ class ServingHTTPServer:
             if self.draining:
                 self.http_rejected += 1
                 self._m_http_rejected.inc()
-                writer.write(json_response_bytes(503, {"error": "server is draining"}))
+                writer.write(
+                    json_response_bytes(
+                        503, {"error": "server is draining"},
+                        {"Retry-After": RETRY_AFTER_S},
+                    )
+                )
                 return
             body["disagg_record"] = record
             stream: queue.Queue = queue.Queue()

@@ -27,6 +27,12 @@ FIRE_SITES = {
     "peer_death": "peer_death_if_armed",
     "host_loss": "host_loss_if_armed",
     "oom": "fire_oom_if_armed",
+    # serving chaos (PR 19)
+    "serve_worker_hang": "fire_serve_worker_hang_if_armed",
+    "serve_slow_decode": "fire_slow_decode_if_armed",
+    "handoff_corrupt": "fire_handoff_corrupt_if_armed",
+    "sse_torn": "fire_sse_torn_if_armed",
+    "queue_storm": "fire_queue_storm_if_armed",
 }
 
 

@@ -107,6 +107,11 @@ def test_registry_is_the_documented_set():
         "peer_death",
         "host_loss",
         "oom",
+        "serve_worker_hang",
+        "serve_slow_decode",
+        "handoff_corrupt",
+        "sse_torn",
+        "queue_storm",
     )
     assert ENV_VAR == "MODALITIES_TPU_FAULTS"
 

@@ -365,6 +365,87 @@ def test_scheduler_property_randomized(model, params, kv_cache, case_seed):
         assert firsts == sorted(firsts)
 
 
+@pytest.mark.parametrize("kv_cache", ["ring", "paged"])
+def test_scheduler_property_deadlines_and_shedding(model, params, kv_cache):
+    """PR-19 extension of the scheduler property: deadlines + brownout
+    shedding join the trace. Legal finish reasons now include "deadline" and
+    "shed"; cancellation at the queue seam never dispatches a decode step for
+    the victim; slots/blocks still return to pristine; and FIFO holds WITHIN
+    a priority class (the shedder only ever reorders across classes)."""
+    from modalities_tpu.serving.resilience import BrownoutController
+
+    ticks = {"v": 0.0}
+
+    def clock():
+        ticks["v"] += 0.01
+        return ticks["v"]
+
+    brownout = BrownoutController(queue_high=4, queue_low=2)
+    kwargs = dict(max_batch_slots=1, time_fn=clock, brownout=brownout)
+    if kv_cache == "paged":
+        kwargs.update(kv_cache="paged", paged_block_size=4, paged_max_len=24)
+    engine = ServingEngine(model, params, **kwargs)
+
+    rng = np.random.default_rng(7)
+    expected = {"deadline": set(), "sheddable": set(), "normal": set()}
+    budgets = {}
+    for i in range(9):
+        plen = int(rng.integers(2, 9))
+        prompt = [int(x) for x in rng.integers(0, 127, size=plen)]
+        budget = int(rng.integers(2, 6))
+        if i in (1, 2):
+            # dead on arrival: the fake clock ticks 10 ms per read, so a
+            # 0.5 ms deadline expires before the first admission sweep
+            kind, deadline, priority = "deadline", 0.5, 0
+        elif i % 2 == 1:
+            kind, deadline, priority = "sheddable", None, 1
+        else:
+            kind, deadline, priority = "normal", None, 0
+        rid = engine.submit(
+            prompt, budget, temperature=0.0, seed=i, arrival_offset_s=0.0,
+            deadline_ms=deadline, priority=priority,
+        )
+        expected[kind].add(rid)
+        budgets[rid] = budget
+    results = engine.run()
+
+    legal = ("eod", "budget", "deadline", "shed")
+    legal += ("capacity",) if kv_cache == "ring" else ()
+    assert sorted(results) == sorted(budgets)
+    for rid, result in results.items():
+        assert result.finish_reason in legal, (rid, result.finish_reason)
+    # every dead-on-arrival deadline fired at the queue seam: reason
+    # "deadline", zero tokens — the request never dispatched a decode step
+    for rid in expected["deadline"]:
+        assert results[rid].finish_reason == "deadline", rid
+        assert results[rid].tokens == []
+    # the queue (7+ deep behind 1 slot) crossed queue_high: brownout engaged
+    # and shed lowest-priority queued work, which also never decoded
+    shed = {r for r, res in results.items() if res.finish_reason == "shed"}
+    assert shed, "brownout never shed despite queue_high=4"
+    # class ordering: the shedder only touches priority-0 work after every
+    # queued priority-1 request has already been shed
+    if shed - expected["sheddable"]:
+        assert expected["sheddable"] <= shed
+    for rid in shed:
+        assert results[rid].tokens == []
+    assert brownout.transitions >= 1
+    # no leaks: slots empty, paged pool tiles exactly
+    assert all(s is None for s in engine._slot_states)
+    stats = engine.stats()
+    assert stats["deadline_expired_requests"] == len(expected["deadline"])
+    assert stats["shed_requests"] == len(shed)
+    if kv_cache == "paged":
+        engine._table_state.check()
+        assert stats["free_blocks"] == stats["num_blocks"]
+    # FIFO within a priority class: priority-0 survivors start in rid order
+    if stats["preemptions"] == 0:
+        served = [r for r in sorted(results)
+                  if r in expected["normal"] and results[r].tokens]
+        firsts = [results[r].first_token_s for r in served]
+        assert firsts == sorted(firsts)
+
+
 # ------------------------------------------------------------ mesh sharding
 
 

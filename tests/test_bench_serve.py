@@ -38,6 +38,30 @@ def test_bench_serve_smoke_emits_parseable_json_line():
     assert 0.0 < out["slot_occupancy"] <= 1.0
     assert out["decode_executables"] == 1  # ONE compiled decode step end to end
     assert out["requests"] == 6
+    assert out["client_timeouts"] == 0  # no --deadline-ms: nothing lapsed
+
+
+def test_replay_deadline_counts_client_timeouts():
+    """--deadline-ms rides every replayed request into the engine: lapsed rows
+    finish reason="deadline" and the bench reports them as client_timeouts
+    (in-process: the subprocess JSON contract is pinned by the smoke test)."""
+    import bench_serve
+    from modalities_tpu.serving.engine import ServingEngine
+    from tests.serving.test_observability import FakeModel, _tick_clock
+
+    engine = ServingEngine(
+        FakeModel(), {}, max_batch_slots=1, eod_token_id=-1, time_fn=_tick_clock()
+    )
+    trace = [
+        {"prompt": [3, 4], "max_new_tokens": 3, "temperature": 0.0, "seed": i,
+         "arrival_offset_s": 0.0}
+        for i in range(3)
+    ]
+    # the fake clock ticks 10ms per read, so a 0.5ms deadline lapses before
+    # the first admission sweep: every request times out client-side
+    results, _wall = bench_serve._replay(engine, trace, arrivals=True, deadline_ms=0.5)
+    assert sum(1 for r in results if r.finish_reason == "deadline") == len(trace)
+    assert "client_timeouts" in bench_serve.METRIC_KEYS
 
 
 @pytest.mark.slow  # ~25 s subprocess; quant numerics + the oracle gate are pinned fast
