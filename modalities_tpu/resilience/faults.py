@@ -55,6 +55,10 @@ preemptible pods. Spec grammar (env ``MODALITIES_TPU_FAULTS`` or `arm_faults`):
 - ``queue_storm@rid:n`` — submit() of request `rid` is amplified by `n`
   lowest-priority synthetic clones: an arrival storm aimed at the bounded
   admission queue and the brownout shedder.
+- ``tenant_flood@rid:n`` — submit() of request `rid` is amplified by `n`
+  synthetic clones charged to a BULK tenant: a noisy-neighbor flood aimed at
+  the multi-tenant DRR scheduler and burn-aware victim selection (the
+  interactive tenants must stay bitwise unaffected).
 
 Unknown names are rejected at parse time; the static closure test
 (tests/resilience/test_fault_point_closure.py) keeps FAULT_POINTS and the chaos
@@ -92,6 +96,7 @@ FAULT_POINTS = (
     "handoff_corrupt",
     "sse_torn",
     "queue_storm",
+    "tenant_flood",
 )
 
 
@@ -362,4 +367,17 @@ def fire_queue_storm_if_armed(rid: int) -> int:
     n = int(fault.arg) if fault.arg is not None else 4
     record_event("fault/queue_storm", rid=rid, clones=n)
     logger.warning("FAULT FIRING: queue_storm of %d clones at rid %d", n, rid)
+    return n
+
+
+def fire_tenant_flood_if_armed(rid: int) -> int:
+    """Number of bulk-tenant synthetic clones to enqueue alongside request
+    `rid` (0 when unarmed) — the noisy-neighbor flood the multi-tenant
+    scheduler must contain without touching other tenants' streams."""
+    fault = _consume("tenant_flood", step=rid)
+    if fault is None:
+        return 0
+    n = int(fault.arg) if fault.arg is not None else 4
+    record_event("fault/tenant_flood", rid=rid, clones=n)
+    logger.warning("FAULT FIRING: tenant_flood of %d clones at rid %d", n, rid)
     return n

@@ -147,6 +147,29 @@ def summarize_serve(records: list[dict]) -> dict:
     for bucket in generations.values():
         ttfts = sorted(bucket.pop("_ttfts"))
         bucket["ttft_p50_s"] = _quantile(ttfts, 0.5) if ttfts else None
+    # multi-tenant serving (PR 20): per-tenant breakdown from the tenant tag
+    # each record carries; records from a tenant-off run (no tag) fold into
+    # the implicit "-" row so mixed sinks still sum to the totals
+    tenants: dict[str, dict] = {}
+    for rec in records:
+        name = str(rec.get("tenant") or "-")
+        bucket = tenants.setdefault(
+            name,
+            {"requests": 0, "errors": 0, "sheds": 0, "preemptions": 0, "_ttfts": []},
+        )
+        bucket["requests"] += 1
+        reason = rec.get("finish_reason")
+        if reason == "error":
+            bucket["errors"] += 1
+        if reason == "shed":
+            bucket["sheds"] += 1
+        bucket["preemptions"] += int(rec.get("preemptions") or 0)
+        if rec.get("ttft_s") is not None:
+            bucket["_ttfts"].append(float(rec["ttft_s"]))
+    for bucket in tenants.values():
+        ttfts = sorted(bucket.pop("_ttfts"))
+        bucket["ttft_p50_s"] = _quantile(ttfts, 0.5) if ttfts else None
+        bucket["ttft_p99_s"] = _quantile(ttfts, 0.99) if ttfts else None
     return {
         "requests": len(records),
         "finish_reasons": dict(sorted(reasons.items())),
@@ -164,6 +187,8 @@ def summarize_serve(records: list[dict]) -> dict:
         "spec_acceptance": (spec_accepted / spec_proposed) if spec_proposed else None,
         # fleet hot swaps: which weights generation served each request
         "generations": {gen: generations[gen] for gen in sorted(generations)},
+        # multi-tenant serving: per-tenant requests/errors/sheds/preemptions
+        "tenants": {name: tenants[name] for name in sorted(tenants)},
         "latency": latency,
         "occupancy_timeline": _occupancy_timeline(records),
     }
@@ -197,6 +222,20 @@ def format_serve_table(summary: dict) -> str:
             ttft = f"{row['ttft_p50_s']:.4f}" if row.get("ttft_p50_s") is not None else "-"
             lines.append(
                 f"{gen:<12} {row['requests']:>9} {row['errors']:>7} {ttft:>9}"
+            )
+    tenants = summary.get("tenants") or {}
+    if len(tenants) > 1 or any(name != "-" for name in tenants):
+        lines += [
+            "",
+            f"{'tenant':<14} {'requests':>9} {'errors':>7} {'sheds':>6} "
+            f"{'preempts':>9} {'ttft_p50':>9} {'ttft_p99':>9}",
+        ]
+        for name, row in tenants.items():
+            p50 = f"{row['ttft_p50_s']:.4f}" if row.get("ttft_p50_s") is not None else "-"
+            p99 = f"{row['ttft_p99_s']:.4f}" if row.get("ttft_p99_s") is not None else "-"
+            lines.append(
+                f"{name:<14} {row['requests']:>9} {row['errors']:>7} "
+                f"{row['sheds']:>6} {row['preemptions']:>9} {p50:>9} {p99:>9}"
             )
     lines += ["", f"{'latency':<14} {'n':>5} {'mean':>9} {'p50':>9} {'p95':>9} {'p99':>9}"]
     for field, label in LATENCY_FIELDS:

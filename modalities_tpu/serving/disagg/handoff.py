@@ -88,6 +88,9 @@ class HandoffRecord:
     # re-anchors to the decode tier's local arrival clock, so it never
     # changes what the decode tier would generate — only whether it bothers
     deadline_ms: Optional[float] = None
+    # tenant id, also OUTSIDE the digest: it changes scheduling order and
+    # accounting on the decode tier, never the generated tokens
+    tenant: str = ""
 
     @property
     def kv_bytes(self) -> int:
@@ -162,6 +165,7 @@ class HandoffRecord:
             "prompt_len": int(self.prompt_len),
             "truncated": bool(self.truncated),
             "deadline_ms": self.deadline_ms,
+            "tenant": self.tenant,
             "payload": [
                 {
                     "dtype": str(arr.dtype),
@@ -205,6 +209,7 @@ class HandoffRecord:
                 deadline_ms=(
                     float(wire["deadline_ms"]) if wire.get("deadline_ms") else None
                 ),
+                tenant=str(wire.get("tenant") or ""),
             )
         except (KeyError, TypeError, ValueError) as exc:
             raise HandoffRejected(

@@ -153,12 +153,18 @@ class DisaggRouter(FleetRouter):
                 if state.get("deadline_ms")
                 else ""
             )
+            # tenant id rides the prefill leg as a header; the decode leg gets
+            # it INSIDE the handoff record the prefill worker seals
+            tenant_line = (
+                f"X-Tenant-Id: {state['tenant']}\r\n" if state.get("tenant") else ""
+            )
             head = (
                 f"POST /disagg/prefill HTTP/1.1\r\nHost: {worker.host}\r\n"
                 "Content-Type: application/json\r\n"
                 f"X-Trace-Id: {state['trace_id']}\r\n"
                 f"X-Trace-Hop: {state['hop']}\r\n"
                 f"{deadline_line}"
+                f"{tenant_line}"
                 f"Content-Length: {len(body_bytes)}\r\nConnection: close\r\n\r\n"
             )
             writer.write(head.encode("latin-1") + body_bytes)
@@ -225,6 +231,7 @@ class DisaggRouter(FleetRouter):
         state = {
             "forwarded": 0, "headers_sent": False, "trace_id": trace_id, "hop": 0,
             "deadline_ms": (headers or {}).get("x-deadline-ms") or "",
+            "tenant": (headers or {}).get("x-tenant-id") or "",
         }
         legs: list[dict] = []
         t_arrival = time.monotonic()

@@ -88,9 +88,14 @@ from modalities_tpu.resilience.faults import (
     fire_queue_storm_if_armed,
     fire_serve_worker_hang_if_armed,
     fire_slow_decode_if_armed,
+    fire_tenant_flood_if_armed,
 )
 from modalities_tpu.serving.paged_cache import BlockTableState, blocks_for_tokens
-from modalities_tpu.serving.resilience import deadline_expired
+from modalities_tpu.serving.resilience import (
+    TenantRegistry,
+    deadline_expired,
+    resolve_tenant,
+)
 from modalities_tpu.serving.spec_decode import propose_ngram, resolve_spec_config
 from modalities_tpu.telemetry import get_active_telemetry, span
 from modalities_tpu.telemetry.metrics import MetricsRegistry
@@ -155,6 +160,9 @@ class ServeRequest:
     # number = shed first), FIFO is preserved within a priority class
     deadline_ms: Optional[float] = None
     priority: int = 0
+    # multi-tenant isolation (PR 20): the tenant this request is charged to.
+    # "" = the engine runs tenant-off (single implicit tenant, pure FIFO)
+    tenant: str = ""
 
 
 @dataclass
@@ -234,6 +242,8 @@ class ServingEngine:
         quant_kv: Optional[str] = None,
         max_queue_depth: Optional[int] = None,
         brownout=None,
+        tenants: Optional[TenantRegistry] = None,
+        tenant_budget_fn: Optional[Callable[[str], float]] = None,
         stop_fn: Optional[Callable[[], bool]] = None,
         on_token: Optional[Callable[[int, int], None]] = None,
         on_finish: Optional[Callable[[int, ServeResult], None]] = None,
@@ -432,6 +442,16 @@ class ServingEngine:
             max_queue_depth = env_depth if env_depth > 0 else None
         self.max_queue_depth = max_queue_depth
         self.brownout = brownout
+        # multi-tenant isolation (PR 20): with a TenantRegistry the admission
+        # order becomes weighted deficit-round-robin across tenants (within
+        # each priority class, FIFO within a tenant) and every destructive
+        # choice (shed, preempt) becomes burn-aware. `tenants=None` keeps the
+        # HEAD scheduler byte-for-byte: single implicit tenant, pure FIFO.
+        self._tenants = tenants
+        self._tenant_budget_fn = tenant_budget_fn
+        self._drr_deficit: dict[str, float] = {}
+        self._drr_cursor: str = ""
+        self._tenant_stats: dict[str, dict] = {}
         self._streamed: dict[int, int] = {}  # rid -> tokens already on_token'd
         self._truncated_rids: set[int] = set()  # count once even across preemption
 
@@ -574,6 +594,35 @@ class ServingEngine:
             "dropped by the SLO shedder, queue_full/brownout_reject = new "
             "arrivals refused with 429 at the HTTP layer)",
         )
+        # multi-tenant isolation (PR 20): every series carries a tenant label;
+        # the families are registered unconditionally so a tenant-off scrape
+        # still names them, but series only appear once tenants move traffic
+        self._m_tenant_requests = reg.counter(
+            "serve_tenant_requests_total", "Requests accepted by submit(), by tenant"
+        )
+        self._m_tenant_tokens = reg.counter(
+            "serve_tenant_tokens_total", "Generated tokens delivered, by tenant"
+        )
+        self._m_tenant_shed = reg.counter(
+            "serve_tenant_shed_total",
+            "Requests shed under overload, by tenant (brownout sheds + HTTP-layer "
+            "429 rejections)",
+        )
+        self._m_tenant_preempt = reg.counter(
+            "serve_tenant_preemptions_total", "Slots preempted on pool exhaustion, by tenant"
+        )
+        self._m_tenant_rate_limited = reg.counter(
+            "serve_tenant_rate_limited_total",
+            "Requests refused 429 by the per-tenant token-rate bucket",
+        )
+        self._m_tenant_active = reg.gauge(
+            "serve_tenant_active_slots", "Slots holding a live request, by tenant"
+        )
+        if self._tenants is not None:
+            for _name in self._tenants.names():
+                self._m_tenant_active.set_fn(
+                    lambda n=_name: self._tenant_active_slots(n), tenant=_name
+                )
         self._m_generation = reg.gauge(
             "serve_weights_generation", "Weights generation currently installed"
         )
@@ -985,6 +1034,7 @@ class ServingEngine:
         trace_hop: int = 0,
         deadline_ms: Optional[float] = None,
         priority: int = 0,
+        tenant: str = "",
     ) -> int:
         if self.role == "decode":
             raise ValueError(
@@ -1006,6 +1056,7 @@ class ServingEngine:
                 arrival_offset_s=float(arrival_offset_s),
                 deadline_ms=float(deadline_ms) if deadline_ms else None,
                 priority=int(priority),
+                tenant=str(tenant or ""),
             )
         )
         arrival = max(float(arrival_offset_s), 0.0)
@@ -1014,19 +1065,40 @@ class ServingEngine:
         self._traces[rid] = {"events": [], "preemptions": 0, "wait_from": arrival,
                              "queue_wait_s": 0.0,
                              "trace_id": trace_id or uuid.uuid4().hex[:16],
-                             "trace_hop": int(trace_hop)}
+                             "trace_hop": int(trace_hop),
+                             "tenant": str(tenant or "")}
         self._trace_event(rid, "enqueue", arrival)
         self._m_submitted.inc()
         self._m_prompt_tokens.inc(len(prompt_tokens))
+        if tenant:
+            self._m_tenant_requests.inc(tenant=tenant)
+            self._tenant_stat(tenant, "submitted")
         # chaos: an armed queue_storm amplifies this submit with lowest-priority
         # synthetic clones (one-shot, so the recursion fires exactly once)
         for _ in range(fire_queue_storm_if_armed(rid)):
             self.submit(
                 prompt_tokens, max_new_tokens, temperature=temp, seed=seed,
                 arrival_offset_s=arrival_offset_s, deadline_ms=deadline_ms,
-                priority=max(int(priority), 0) + 9,
+                priority=max(int(priority), 0) + 9, tenant=tenant,
+            )
+        # chaos: an armed tenant_flood amplifies this submit with clones charged
+        # to a BULK tenant — the noisy neighbor the DRR scheduler must contain
+        for _ in range(fire_tenant_flood_if_armed(rid)):
+            self.submit(
+                prompt_tokens, max_new_tokens, temperature=temp, seed=seed,
+                arrival_offset_s=arrival_offset_s, deadline_ms=deadline_ms,
+                priority=int(priority), tenant=self._flood_tenant(),
             )
         return rid
+
+    def _flood_tenant(self) -> str:
+        """The tenant a tenant_flood clone is charged to: the first declared
+        bulk tenant, falling back to the name "bulk"."""
+        if self._tenants is not None:
+            for name in self._tenants.names():
+                if self._tenants.spec(name).is_bulk:
+                    return name
+        return "bulk"
 
     # ----------------------------------------------------------- disagg imports
     def _check_import_generation(self, record, trace_id: str = "") -> None:
@@ -1114,6 +1186,8 @@ class ServingEngine:
             # the deadline rides the handoff record (outside the digest, like
             # the trace id) and restarts from the decode tier's local arrival
             deadline_ms=float(deadline_ms) if deadline_ms else None,
+            # the tenant rides the record the same way (outside the digest)
+            tenant=str(getattr(record, "tenant", "") or ""),
             record=record,
         )
         self._queue.append(req)
@@ -1123,6 +1197,7 @@ class ServingEngine:
             "queue_wait_s": 0.0,
             "trace_id": trace_id or record.trace_id or uuid.uuid4().hex[:16],
             "trace_hop": int(trace_hop or record.trace_hop),
+            "tenant": req.tenant,
         }
         self._trace_event(
             rid, "import_enqueue", arrival,
@@ -1180,6 +1255,9 @@ class ServingEngine:
                 # disagg: tier tag so analyze_fleet can render "prefill leg" /
                 # "decode leg" spans; combined engines stay unlabelled
                 **({"role": self.role} if self.role != "combined" else {}),
+                # tenant tag (PR 20): analyze_serve's per-tenant breakdown
+                # keys on it; tenant-off records stay unlabelled
+                **({"tenant": trace["tenant"]} if trace.get("tenant") else {}),
                 "prompt_len": result.prompt_len,
                 "tokens": len(result.tokens),
                 "finish_reason": result.finish_reason,
@@ -1229,6 +1307,12 @@ class ServingEngine:
             with self._stats_lock:
                 self.request_errors += 1
             self._m_req_errors.inc()
+        tenant = trace.get("tenant") if trace is not None else ""
+        if tenant:
+            self._tenant_stat(tenant, "finished")
+            if result.tokens:
+                self._m_tenant_tokens.inc(len(result.tokens), tenant=tenant)
+                self._tenant_stat(tenant, "tokens", len(result.tokens))
         self._results[result.rid] = result
         self._streamed.pop(result.rid, None)
         self._trace_event(
@@ -1276,12 +1360,185 @@ class ServingEngine:
             return "brownout_reject"
         return None
 
-    def note_rejected(self, reason: str) -> None:
+    def note_rejected(self, reason: str, tenant: str = "") -> None:
         """Count one refused arrival (the HTTP layer's 429) on the engine's
         shed counter, so shedding has ONE metric family whatever the seam."""
         with self._stats_lock:
             self.shed_requests += 1
         self._m_shed.inc(reason=reason)
+        if tenant:
+            self._m_tenant_shed.inc(tenant=tenant)
+            self._tenant_stat(tenant, "shed")
+            if reason == "rate_limited":
+                self._m_tenant_rate_limited.inc(tenant=tenant)
+                self._tenant_stat(tenant, "rate_limited")
+
+    # ------------------------------------------------- multi-tenancy (PR 20)
+    def _tenant_stat(self, tenant: str, key: str, amount: int = 1) -> None:
+        with self._stats_lock:
+            bucket = self._tenant_stats.setdefault(
+                tenant,
+                {"submitted": 0, "finished": 0, "tokens": 0, "shed": 0,
+                 "preemptions": 0, "rate_limited": 0},
+            )
+            bucket[key] += amount
+
+    def _tenant_active_slots(self, tenant: str) -> int:
+        return sum(
+            1 for s in self._slot_states
+            if s is not None and s.request.tenant == tenant
+        )
+
+    def _tenant_slot_counts(self) -> dict[str, int]:
+        counts: dict[str, int] = {}
+        for s in self._slot_states:
+            if s is not None:
+                counts[s.request.tenant] = counts.get(s.request.tenant, 0) + 1
+        return counts
+
+    def _tenant_budget_remaining(self, tenant: str) -> float:
+        """This tenant's SLO error budget still unburned (1 = untouched) — a
+        tenant with MORE budget left is the preferred victim ("least burned"):
+        destroying its work costs the least reliability promise."""
+        if self._tenant_budget_fn is None:
+            return 1.0
+        try:
+            return float(self._tenant_budget_fn(tenant))
+        except Exception:
+            return 1.0
+
+    def _demand_weight(self, slot_counts: dict[str, int]) -> float:
+        names = set(slot_counts) | {r.tenant for r in self._queue}
+        return sum(self._tenants.spec(n).weight for n in names if n)
+
+    def _victim_key(
+        self, tenant: str, slot_counts: dict[str, int], total_weight: float
+    ) -> tuple:
+        """Burn-aware victim ordering (max = preferred victim): over-quota or
+        over-fair-share tenants first, then bulk before interactive — an
+        under-budget interactive tenant is NEVER picked while any bulk
+        candidate exists — then the least-burned error budget."""
+        spec = self._tenants.spec(tenant)
+        count = slot_counts.get(tenant, 0)
+        fair = (
+            self.slots * spec.weight / total_weight if total_weight > 0 else self.slots
+        )
+        over_quota = spec.max_slots is not None and count > spec.max_slots
+        over = over_quota or count > fair
+        return (
+            1 if over else 0,
+            1 if spec.is_bulk else 0,
+            self._tenant_budget_remaining(tenant),
+        )
+
+    def resolve_submit_tenant(self, value) -> str:
+        """Ingress tenant resolution, shared by both front ends (mirrors how
+        `resolve_deadline_ms` rides the deadline seam): with tenants
+        configured a missing/blank id maps to the env-default tenant; with
+        tenants off everything collapses to the implicit "" tenant so the
+        engine stays bitwise on its pre-tenant behavior."""
+        if self._tenants is None:
+            return ""
+        return resolve_tenant(value)
+
+    def tenant_reject_reason(self, tenant: str, max_new_tokens: int):
+        """Per-tenant admission gate for the HTTP layer, BEFORE submit():
+        ``None`` to admit (the token bucket was charged ``max_new_tokens``),
+        else ``("rate_limited", retry_after_s)`` with the refill-derived
+        wait."""
+        if self._tenants is None or not tenant:
+            return None
+        retry_after = self._tenants.rate_limit_retry_after_s(
+            tenant, float(max_new_tokens), self._now()
+        )
+        if retry_after is None:
+            return None
+        return ("rate_limited", retry_after)
+
+    def retry_after_s(self, reason: str) -> float:
+        """Derived Retry-After for an overload rejection: the time for the
+        queue to drain to where the reason clears, estimated as the excess
+        requests over the parallel drain width (one slot retires roughly one
+        request per recovery interval). Floor 1s — never tell a client 0."""
+        depth = len(self._queue)
+        if reason == "queue_full" and self.max_queue_depth is not None:
+            excess = depth - self.max_queue_depth + 1
+        elif reason == "brownout_reject" and self.brownout is not None:
+            # brownout hysteresis: recovery needs the queue at/below queue_low
+            excess = depth - int(self.brownout.queue_low)
+        else:
+            return 1.0
+        return float(max(1, -(-max(excess, 0) // max(self.slots, 1))))
+
+    def _next_admittable(self, now: float) -> Optional[ServeRequest]:
+        """Pop the next request to admit (None = nothing admissible).
+        Tenant-off: the FIFO head, arrival-gated — later requests never jump
+        an unarrived head (the pinned HEAD order). Tenant-on: weighted
+        deficit-round-robin across tenants (see `_drr_pick`)."""
+        if self._tenants is None:
+            if not self._queue:
+                return None
+            req = self._queue[0]
+            if req.arrival_offset_s > now:
+                return None
+            self._queue.popleft()
+            return req
+        req = self._drr_pick(self._drr_candidates(now, set()))
+        if req is not None:
+            self._queue.remove(req)
+        return req
+
+    def _drr_candidates(
+        self, now: float, blocked: set
+    ) -> dict[str, ServeRequest]:
+        """Per-tenant admission heads: for each tenant (not `blocked`, not at
+        its slot quota) the FIRST queued arrived request of the best (lowest
+        number) priority class present — DRR schedules within one priority
+        class at a time, FIFO within (tenant, class)."""
+        counts = self._tenant_slot_counts()
+        eligible = []
+        for r in self._queue:
+            if r.arrival_offset_s > now or r.tenant in blocked:
+                continue
+            spec = self._tenants.spec(r.tenant)
+            if spec.max_slots is not None and counts.get(r.tenant, 0) >= spec.max_slots:
+                continue
+            eligible.append(r)
+        if not eligible:
+            return {}
+        best = min(r.priority for r in eligible)
+        heads: dict[str, ServeRequest] = {}
+        for r in eligible:
+            if r.priority == best and r.tenant not in heads:
+                heads[r.tenant] = r
+        return heads
+
+    def _drr_pick(self, heads: dict[str, ServeRequest]) -> Optional[ServeRequest]:
+        """One weighted deficit-round-robin selection over the per-tenant
+        heads: unit cost per request, quantum = weight, so under saturation
+        admissions converge to the weight ratio. The deficit of a tenant with
+        no eligible work resets (an idle tenant banks no credit); the cursor
+        keeps rotation position across rounds."""
+        if not heads:
+            return None
+        for name in list(self._drr_deficit):
+            if name not in heads:
+                del self._drr_deficit[name]
+        names = sorted(heads)
+        idx = 0
+        for i, n in enumerate(names):
+            if n >= self._drr_cursor:
+                idx = i
+                break
+        name = names[idx]
+        deficit = self._drr_deficit.get(name, 0.0)
+        if deficit < 1.0:
+            deficit += self._tenants.spec(name).weight
+        deficit -= 1.0
+        self._drr_deficit[name] = deficit
+        # stay on this tenant while it has credit, else advance the rotation
+        self._drr_cursor = name if deficit >= 1.0 else names[(idx + 1) % len(names)]
+        return heads[name]
 
     def _finish_queued(self, req: ServeRequest, reason: str, now: float) -> None:
         """Drop one QUEUED request (deadline/shed): it owns no slot and no
@@ -1299,6 +1556,9 @@ class ServingEngine:
             with self._stats_lock:
                 self.shed_requests += 1
             self._m_shed.inc(reason="brownout")
+            if req.tenant:
+                self._m_tenant_shed.inc(tenant=req.tenant)
+                self._tenant_stat(req.tenant, "shed")
         self._trace_event(req.rid, reason, now, queued=True)
         self._finish_immediate(result, reason, now)
 
@@ -1320,12 +1580,28 @@ class ServingEngine:
             return
         self.brownout.update(len(self._queue))
         for _ in range(self.brownout.shed_target(len(self._queue))):
-            # shed the YOUNGEST request of the LOWEST-priority class: older
-            # work and higher classes keep their FIFO positions
-            victim = None
-            for req in self._queue:
-                if victim is None or req.priority >= victim.priority:
-                    victim = req
+            if self._tenants is None:
+                # shed the YOUNGEST request of the LOWEST-priority class: older
+                # work and higher classes keep their FIFO positions
+                victim = None
+                for req in self._queue:
+                    if victim is None or req.priority >= victim.priority:
+                        victim = req
+            else:
+                # burn-aware (PR 20): over-quota tenants first, bulk before
+                # interactive, least-burned budget next; priority and
+                # youngest-within-class break ties (the `>=` keeps the HEAD
+                # youngest-wins rule inside an equal key)
+                slot_counts = self._tenant_slot_counts()
+                total_w = self._demand_weight(slot_counts)
+                victim = None
+                victim_key = None
+                for req in self._queue:
+                    key = self._victim_key(req.tenant, slot_counts, total_w) + (
+                        req.priority,
+                    )
+                    if victim is None or key >= victim_key:
+                        victim, victim_key = req, key
             if victim is None:
                 break
             self._queue.remove(victim)
@@ -1396,10 +1672,9 @@ class ServingEngine:
             if self._slot_states[slot] is not None:
                 continue
             now = self._now() - t0
-            req = self._queue[0]
-            if req.arrival_offset_s > now:
+            req = self._next_admittable(now)
+            if req is None:
                 break  # FIFO: later requests can't jump an unarrived head
-            self._queue.popleft()
             with span("serve/admission"):
                 temp = req.temperature if req.temperature is not None else 0.0
                 result = ServeResult(
@@ -1476,43 +1751,74 @@ class ServingEngine:
                 self._eods[slot] = self.eod_token_id
                 self._remaining[slot] = req.max_new_tokens - 1
 
+    def _paged_admission_need(self, req: ServeRequest) -> tuple:
+        """(window, matched, full_match, need) for one admission candidate.
+
+        full-window match: every prompt position is already resident, but the
+        LAST token must be re-forwarded to produce the first-token logits —
+        its K/V write lands in the final shared block, so admission
+        copy-on-writes that block (one fresh block + a jitted device row
+        copy). `need` is the admission gate's free-block demand: unmatched
+        tail blocks + the CoW copy."""
+        window = req.prompt_tokens[-(self.max_len - 1) :]
+        ts = self._table_state
+        matched = ts.match_prefix(window) if self.prefix_sharing else []
+        full_match = matched and len(matched) * self.block_size >= len(window)
+        need = (
+            blocks_for_tokens(len(window), self.block_size)
+            - len(matched)
+            + (1 if full_match else 0)
+        )
+        return window, matched, full_match, need
+
     def _admit_paged(self, t0: float) -> None:
         import jax
 
+        ts = self._table_state
         for slot in range(self.slots):
             if not self._queue:
                 break
             if self._slot_states[slot] is not None:
                 continue
             now = self._now() - t0
-            req = self._queue[0]
-            if req.arrival_offset_s > now:
-                break  # FIFO: later requests can't jump an unarrived head
+            if self._tenants is None:
+                req = self._queue[0]
+                if req.arrival_offset_s > now:
+                    break  # FIFO: later requests can't jump an unarrived head
+                window, matched, full_match, need = self._paged_admission_need(req)
+                # admission gate (BEFORE popleft): the demand must fit in free
+                # blocks, or the head stays queued
+                if ts.pool.free_count < need:
+                    break  # head stays queued; decoders will free blocks
+                self._queue.popleft()
+            else:
+                # per-tenant head-of-line (PR 20): a tenant whose head does
+                # not fit the pool is blocked for THIS round only — its big
+                # prompt never stalls the other tenants' admissions
+                req = None
+                blocked: set = set()
+                while True:
+                    heads = self._drr_candidates(now, blocked)
+                    unfit = {
+                        name
+                        for name, cand in heads.items()
+                        if ts.pool.free_count < self._paged_admission_need(cand)[3]
+                    }
+                    if unfit:
+                        blocked |= unfit
+                        continue
+                    req = self._drr_pick(heads)
+                    break
+                if req is None:
+                    break  # nothing arrived, under quota, AND pool-admissible
+                window, matched, full_match, need = self._paged_admission_need(req)
+                self._queue.remove(req)
             with span("serve/admission"):
                 temp = req.temperature if req.temperature is not None else 0.0
                 result = ServeResult(
                     rid=req.rid, prompt_len=len(req.prompt_tokens),
                     arrival_s=max(req.arrival_offset_s, 0.0),
                 )
-                window = req.prompt_tokens[-(self.max_len - 1) :]
-                ts = self._table_state
-                matched = ts.match_prefix(window) if self.prefix_sharing else []
-                # full-window match: every prompt position is already resident,
-                # but the LAST token must be re-forwarded to produce the
-                # first-token logits — its K/V write lands in the final shared
-                # block, so admission copy-on-writes that block (one fresh
-                # block + a jitted device row copy)
-                full_match = matched and len(matched) * self.block_size >= len(window)
-                # admission gate (BEFORE popleft): unmatched tail blocks + the
-                # CoW copy must fit in free blocks, or the head stays queued
-                need = (
-                    blocks_for_tokens(len(window), self.block_size)
-                    - len(matched)
-                    + (1 if full_match else 0)
-                )
-                if ts.pool.free_count < need:
-                    break  # head stays queued; decoders will free blocks
-                self._queue.popleft()
                 self._trace_admit(req.rid, now)
                 window = self._truncate_window(req, result)
                 if req.max_new_tokens <= 0:
@@ -1718,6 +2024,9 @@ class ServingEngine:
         with self._stats_lock:
             self.preemptions += 1
         self._m_preempt.inc()
+        if state.request.tenant:
+            self._m_tenant_preempt.inc(tenant=state.request.tenant)
+            self._tenant_stat(state.request.tenant, "preemptions")
         now = self._now() - t0
         self._trace_event(
             rid, "preempt", now,
@@ -1744,7 +2053,8 @@ class ServingEngine:
         default 1; w > 1 under spec decode), each exclusively owned — a shared
         block is copy-on-written first. Allocation failure preempts the
         YOUNGEST active slot (never an older one — FIFO fairness, no livelock:
-        the pool admits at least one max-length request by construction)."""
+        the pool admits at least one max-length request by construction);
+        tenant mode replaces that order with the burn-aware `_victim_key`."""
         ts = self._table_state
         for slot in range(self.slots):
             state = self._slot_states[slot]
@@ -1768,9 +2078,26 @@ class ServingEngine:
                             self._cow_copy(*res)
                     if not dry:
                         break
-                victims = [
-                    (s.seq, i) for i, s in enumerate(self._slot_states) if s is not None
-                ]
+                if self._tenants is None:
+                    victims = [
+                        (s.seq, i) for i, s in enumerate(self._slot_states) if s is not None
+                    ]
+                else:
+                    # burn-aware (PR 20): over-quota tenants first, bulk
+                    # before interactive, least-burned budget next — an
+                    # under-quota interactive slot survives while any bulk
+                    # slot exists; seq keeps youngest-first inside a tenant
+                    slot_counts = self._tenant_slot_counts()
+                    total_w = self._demand_weight(slot_counts)
+                    victims = [
+                        (
+                            self._victim_key(s.request.tenant, slot_counts, total_w)
+                            + (s.seq,),
+                            i,
+                        )
+                        for i, s in enumerate(self._slot_states)
+                        if s is not None
+                    ]
                 _, victim = max(victims)
                 self._preempt(victim, t0)
                 if victim == slot:
@@ -1946,6 +2273,7 @@ class ServingEngine:
             prompt_len=len(req.prompt_tokens),
             truncated=bool(result.truncated),
             deadline_ms=req.deadline_ms,
+            tenant=req.tenant,
         ).seal()
         if fire_handoff_corrupt_if_armed(rid):
             # flip one payload byte AFTER sealing: the decode tier's digest
@@ -2304,6 +2632,7 @@ class ServingEngine:
             imported_blocks = self.imported_blocks
             handoff_bytes = self.handoff_bytes_shipped
             prefill_chunk_count = self.prefill_chunk_count
+            tenant_stats = {t: dict(b) for t, b in self._tenant_stats.items()}
         occupancy = occupancy_sum / (decode_steps * self.slots) if decode_steps else 0.0
         out = {
             "role": self.role,
@@ -2361,6 +2690,32 @@ class ServingEngine:
                 handoff_executables=self._handoff_traces,
                 import_executables=self._import_traces,
             )
+        if self._tenants is not None:
+            slot_counts = self._tenant_slot_counts()
+            queued: dict[str, int] = {}
+            for r in self._queue:
+                queued[r.tenant] = queued.get(r.tenant, 0) + 1
+            tenants_out = {}
+            for name in sorted(
+                set(self._tenants.names()) | set(tenant_stats) | set(queued)
+            ):
+                spec = self._tenants.spec(name)
+                row = dict(
+                    tenant_stats.get(
+                        name,
+                        {"submitted": 0, "finished": 0, "tokens": 0, "shed": 0,
+                         "preemptions": 0, "rate_limited": 0},
+                    )
+                )
+                row.update(
+                    tenant_class=spec.tenant_class,
+                    weight=spec.weight,
+                    max_slots=spec.max_slots,
+                    active_slots=slot_counts.get(name, 0),
+                    queued=queued.get(name, 0),
+                )
+                tenants_out[name] = row
+            out["tenants"] = tenants_out
         return out
 
     def decode_lowered_text(self) -> str:

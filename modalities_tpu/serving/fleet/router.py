@@ -393,6 +393,11 @@ class FleetRouter:
                 if state.get("deadline_ms")
                 else ""
             )
+            # the tenant id rides every leg too: the worker's per-tenant
+            # scheduling/quotas apply whichever peer a failover lands on
+            tenant_line = (
+                f"X-Tenant-Id: {state['tenant']}\r\n" if state.get("tenant") else ""
+            )
             head = (
                 f"POST {path} HTTP/1.1\r\nHost: {worker.host}\r\n"
                 "Content-Type: application/json\r\n"
@@ -402,6 +407,7 @@ class FleetRouter:
                 f"X-Trace-Id: {state['trace_id']}\r\n"
                 f"X-Trace-Hop: {state['hop']}\r\n"
                 f"{deadline_line}"
+                f"{tenant_line}"
                 f"Content-Length: {len(body_bytes)}\r\nConnection: close\r\n\r\n"
             )
             writer.write(head.encode("latin-1") + body_bytes)
@@ -491,6 +497,7 @@ class FleetRouter:
         state = {
             "forwarded": 0, "headers_sent": False, "trace_id": trace_id, "hop": 0,
             "deadline_ms": (headers or {}).get("x-deadline-ms") or "",
+            "tenant": (headers or {}).get("x-tenant-id") or "",
         }
         legs: list[dict] = []
         t_arrival = time.monotonic()

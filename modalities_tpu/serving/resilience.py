@@ -31,6 +31,17 @@ the serving fleet's counterpart, consumed by the engine scheduler
 - :class:`ProbeBackoff` — jittered exponential backoff for health probes of
   a DEAD worker, so a recovering worker is not hit by a synchronized probe
   herd while healthy peers keep the fixed cadence.
+- **Tenants** — multi-tenant isolation (PR 20). A request's tenant id rides
+  ``X-Tenant-Id`` exactly like the deadline header (folded into the body at
+  every HTTP seam, carried across disagg legs on the handoff record outside
+  the digest); :func:`resolve_tenant` applies the same explicit > env/config
+  default resolution at both ingresses. :class:`TenantRegistry` holds the
+  declared :class:`TenantSpec` rows (class, weight, slot quota, token-rate
+  limit) plus one :class:`TokenBucket` per rate-limited tenant; the engine
+  consumes it for weighted deficit-round-robin admission and burn-aware
+  victim selection, the HTTP layer for per-tenant 429s whose ``Retry-After``
+  is derived from the bucket's actual refill time.
+
 
 Everything here is plain host-side Python: no jitted program changes, so the
 non-deadline serving path keeps its executable pins byte-identical.
@@ -73,6 +84,171 @@ def deadline_expired(arrival_s: float, deadline_ms: Optional[float], now_s: floa
     if deadline_ms is None:
         return False
     return (now_s - max(arrival_s, 0.0)) * 1000.0 >= deadline_ms
+
+
+# header name as read_http_request lowercases it; mirrors "x-deadline-ms"
+TENANT_HEADER = "x-tenant-id"
+
+
+def default_tenant() -> str:
+    """Per-process default tenant id (``MODALITIES_TPU_SERVE_TENANT_DEFAULT``)
+    applied when the client sent none — the single implicit tenant every
+    unlabeled request lands in."""
+    return os.environ.get("MODALITIES_TPU_SERVE_TENANT_DEFAULT", "").strip() or "default"
+
+
+def resolve_tenant(value) -> str:
+    """Client-supplied tenant id (header/body, may be None/blank) or the env
+    default — the same explicit > default resolution as deadlines, applied
+    identically at the HTTP and JSONL ingresses."""
+    if value is None:
+        return default_tenant()
+    name = str(value).strip()
+    return name or default_tenant()
+
+
+class TenantSpec:
+    """One declared tenant: scheduling class, DRR weight, slot quota, and an
+    optional token-rate limit.
+
+    ``tenant_class`` is ``"interactive"`` or ``"bulk"`` — bulk tenants are the
+    preferred victims of every destructive choice (shed, preempt).
+    ``weight`` is the DRR quantum (admissions per round relative to peers).
+    ``max_slots`` caps concurrently held batch slots (None = no quota).
+    ``rate`` is a sustained new-token budget in tokens/second enforced by a
+    :class:`TokenBucket` at the HTTP ingress (None = unlimited); ``burst``
+    is the bucket depth (defaults to one second of rate, floor 1)."""
+
+    CLASSES = ("interactive", "bulk")
+
+    def __init__(
+        self,
+        name: str,
+        tenant_class: str = "interactive",
+        weight: float = 1.0,
+        max_slots: Optional[int] = None,
+        rate: Optional[float] = None,
+        burst: Optional[float] = None,
+    ):
+        if tenant_class not in self.CLASSES:
+            raise ValueError(
+                f"tenant {name!r}: class must be one of {self.CLASSES}, got {tenant_class!r}"
+            )
+        if weight < 1:
+            raise ValueError(f"tenant {name!r}: weight must be >= 1, got {weight}")
+        if max_slots is not None and int(max_slots) < 1:
+            raise ValueError(f"tenant {name!r}: max_slots must be >= 1, got {max_slots}")
+        if rate is not None and float(rate) <= 0:
+            raise ValueError(f"tenant {name!r}: rate must be > 0 tokens/s, got {rate}")
+        self.name = str(name)
+        self.tenant_class = tenant_class
+        self.weight = float(weight)
+        self.max_slots = int(max_slots) if max_slots is not None else None
+        self.rate = float(rate) if rate is not None else None
+        if burst is None:
+            burst = max(self.rate, 1.0) if self.rate is not None else 1.0
+        self.burst = float(burst)
+
+    @property
+    def is_bulk(self) -> bool:
+        return self.tenant_class == "bulk"
+
+
+class TokenBucket:
+    """Token-rate limiter with a refill-derived retry hint.
+
+    ``try_take(n, now)`` withdraws ``n`` tokens or refuses (never partial);
+    ``retry_after_s(n, now)`` is the exact time until ``n`` tokens will have
+    refilled — what the 429's ``Retry-After`` reports instead of a constant.
+    The caller supplies ``now`` (the engine's clock) so fake-clock tests and
+    the real ingress share one code path."""
+
+    def __init__(self, rate: float, burst: float):
+        if rate <= 0 or burst <= 0:
+            raise ValueError(f"TokenBucket needs rate > 0 and burst > 0, got ({rate}, {burst})")
+        self.rate = float(rate)
+        self.burst = float(burst)
+        self.tokens = self.burst
+        self._last = None  # first call pins the clock origin
+        self._lock = threading.Lock()
+
+    def _refill(self, now: float) -> None:
+        if self._last is None:
+            self._last = now
+        elapsed = max(now - self._last, 0.0)
+        self._last = now
+        self.tokens = min(self.burst, self.tokens + elapsed * self.rate)
+
+    def try_take(self, n: float, now: float) -> bool:
+        with self._lock:
+            self._refill(now)
+            if self.tokens >= n:
+                self.tokens -= n
+                return True
+            return False
+
+    def retry_after_s(self, n: float, now: float) -> float:
+        """Seconds until ``n`` tokens are available (0 when they already are).
+        A demand beyond the bucket depth reports the full-burst refill time —
+        finite, so the client retries a smaller request rather than never."""
+        with self._lock:
+            self._refill(now)
+            need = min(n, self.burst) - self.tokens
+            return max(need, 0.0) / self.rate
+
+
+class TenantRegistry:
+    """The declared tenants of one serving process: specs by name plus one
+    rate-limit bucket per tenant that declared a ``rate``.
+
+    Built from the ``tenants:`` config block (``from_config``). Undeclared
+    tenant ids resolve to a default spec (interactive, weight 1, no quota,
+    no rate limit) so an unknown ``X-Tenant-Id`` degrades to best-effort
+    fair treatment instead of an error. Iteration order is sorted by name —
+    the DRR rotation is deterministic."""
+
+    def __init__(self, specs: Optional[dict] = None):
+        self._specs: dict[str, TenantSpec] = dict(specs or {})
+        self._buckets: dict[str, TokenBucket] = {
+            name: TokenBucket(spec.rate, spec.burst)
+            for name, spec in self._specs.items()
+            if spec.rate is not None
+        }
+
+    @classmethod
+    def from_config(cls, block: dict) -> "TenantRegistry":
+        """Parse the ``tenants:`` config block: ``{name: {class, weight,
+        max_slots, rate, burst}}`` with every per-tenant key optional."""
+        specs = {}
+        for name, raw in (block or {}).items():
+            raw = dict(raw or {})
+            unknown = set(raw) - {"class", "weight", "max_slots", "rate", "burst"}
+            if unknown:
+                raise ValueError(f"tenant {name!r}: unknown keys {sorted(unknown)}")
+            specs[str(name)] = TenantSpec(
+                str(name),
+                tenant_class=raw.get("class") or "interactive",
+                weight=float(raw.get("weight") or 1.0),
+                max_slots=raw.get("max_slots"),
+                rate=raw.get("rate"),
+                burst=raw.get("burst"),
+            )
+        return cls(specs)
+
+    def spec(self, name: str) -> TenantSpec:
+        known = self._specs.get(name)
+        return known if known is not None else TenantSpec(name)
+
+    def names(self) -> list[str]:
+        return sorted(self._specs)
+
+    def rate_limit_retry_after_s(self, name: str, tokens: float, now: float) -> Optional[float]:
+        """None when ``tokens`` were admitted (and charged); otherwise the
+        refill-derived seconds until this tenant's bucket can admit them."""
+        bucket = self._buckets.get(name)
+        if bucket is None or bucket.try_take(tokens, now):
+            return None
+        return bucket.retry_after_s(tokens, now)
 
 
 class BrownoutController:

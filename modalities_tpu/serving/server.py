@@ -50,6 +50,7 @@ from __future__ import annotations
 
 import asyncio
 import json
+import math
 import queue
 import threading
 import time
@@ -57,7 +58,11 @@ from http import HTTPStatus
 from typing import Callable, Optional
 
 from modalities_tpu.resilience.faults import fire_sse_torn_if_armed
-from modalities_tpu.serving.resilience import DEADLINE_HEADER, resolve_deadline_ms
+from modalities_tpu.serving.resilience import (
+    DEADLINE_HEADER,
+    TENANT_HEADER,
+    resolve_deadline_ms,
+)
 from modalities_tpu.telemetry import get_active_telemetry, span
 from modalities_tpu.telemetry.metrics import CONTENT_TYPE_LATEST
 
@@ -124,9 +129,18 @@ def json_response_bytes(
     )
 
 
-# overload/drain rejections tell clients when to come back (seconds); fixed
-# and small — the client's own jittered backoff does the decorrelation
+# drain rejections tell clients when to come back (seconds); fixed and small
+# — a draining worker is leaving, clients should failover, not wait it out.
+# Overload (429) rejections instead derive Retry-After from engine state:
+# queue-drain estimate for queue_full/brownout, bucket refill time for a
+# per-tenant rate limit (see `_retry_after_header`).
 RETRY_AFTER_S = "1"
+
+
+def _retry_after_header(seconds: float) -> dict:
+    """Retry-After carries integer seconds on the wire: round the derived
+    wait UP (retrying early just earns another 429), floor 1."""
+    return {"Retry-After": str(max(1, math.ceil(seconds)))}
 
 
 SSE_HEADER_BYTES = (
@@ -275,6 +289,7 @@ class ServingHTTPServer:
                     trace_hop=int(body.get("trace_hop") or 0),
                     deadline_ms=resolve_deadline_ms(body.get("deadline_ms")),
                     priority=int(body.get("priority") or 0),
+                    tenant=self.engine.resolve_submit_tenant(body.get("tenant")),
                 )
                 self._streams[rid] = stream
                 stream.put(("rid", rid))
@@ -392,6 +407,9 @@ class ServingHTTPServer:
                     # the deadline rides like the trace id: header -> body ->
                     # engine; it re-anchors to THIS worker's arrival clock
                     body.setdefault("deadline_ms", headers[DEADLINE_HEADER])
+                if headers and headers.get(TENANT_HEADER):
+                    # the tenant id rides the same seam (body key wins)
+                    body.setdefault("tenant", headers[TENANT_HEADER])
                 prompt = body.get("prompt")
                 if not isinstance(prompt, str) or not prompt:
                     writer.write(
@@ -425,27 +443,39 @@ class ServingHTTPServer:
                     )
                 )
                 return
-            if self._reject_overload(writer):
+            if self._reject_overload(writer, body):
                 return
             stream: queue.Queue = queue.Queue()
             self.submit_stream(body, stream)
             await self._relay_stream(stream, writer)
 
-    def _reject_overload(self, writer: asyncio.StreamWriter) -> bool:
-        """429 + Retry-After when the engine is refusing new work (bounded
-        queue full, or the brownout controller is active). The engine counts
-        the rejection on `serve_shed_total{reason}`."""
+    def _reject_overload(self, writer: asyncio.StreamWriter, body: Optional[dict] = None) -> bool:
+        """429 + Retry-After when the engine is refusing new work: bounded
+        queue full, brownout controller active, or the request's tenant is
+        over its token-rate limit. Retry-After is DERIVED, not constant —
+        queue-drain estimate for global overload, exact bucket refill time
+        for a tenant rate limit. The engine counts the rejection on
+        `serve_shed_total{reason}` (+ `serve_tenant_shed_total{tenant}`)."""
+        tenant = self.engine.resolve_submit_tenant((body or {}).get("tenant"))
         reason = self.engine.overload_reason()
-        if reason is None:
-            return False
+        if reason is not None:
+            retry_after = self.engine.retry_after_s(reason)
+        else:
+            limited = self.engine.tenant_reject_reason(
+                tenant,
+                int((body or {}).get("max_new_tokens") or self.default_max_new_tokens),
+            )
+            if limited is None:
+                return False
+            reason, retry_after = limited
         self.http_rejected += 1
         self._m_http_rejected.inc()
-        self.engine.note_rejected(reason)
+        self.engine.note_rejected(reason, tenant=tenant)
         writer.write(
             json_response_bytes(
                 429,
                 {"error": f"overloaded ({reason}), retry later", "reason": reason},
-                {"Retry-After": RETRY_AFTER_S},
+                _retry_after_header(retry_after),
             )
         )
         return True
@@ -480,6 +510,8 @@ class ServingHTTPServer:
                     body.setdefault("trace_hop", headers.get("x-trace-hop") or 0)
                 if headers and headers.get(DEADLINE_HEADER):
                     body.setdefault("deadline_ms", headers[DEADLINE_HEADER])
+                if headers and headers.get(TENANT_HEADER):
+                    body.setdefault("tenant", headers[TENANT_HEADER])
                 prompt = body.get("prompt")
                 if not isinstance(prompt, str) or not prompt:
                     writer.write(
@@ -499,7 +531,7 @@ class ServingHTTPServer:
                     )
                 )
                 return
-            if self._reject_overload(writer):
+            if self._reject_overload(writer, body):
                 return
             stream: queue.Queue = queue.Queue()
             self.submit_stream(body, stream)

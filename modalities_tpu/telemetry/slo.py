@@ -9,9 +9,13 @@ Objective grammar (one expression string per objective):
     <counter> / <counter> <op> <threshold>  serve_request_errors_total / serve_requests_total <= 0.01
     <gauge|counter> <op> <threshold>        training_goodput_ratio >= 0.85
 
-with ``<op>`` one of ``<  <=  >  >=``. A metric absent from the registry (or
-a histogram/denominator with no observations yet) makes the objective
-*unjudgeable* — skipped, never breaching: booting quiet is not an outage.
+with ``<op>`` one of ``<  <=  >  >=``. Any metric reference may carry a
+Prometheus-style label selector — ``serve_tenant_shed_total{tenant="bulk"} /
+serve_tenant_requests_total{tenant="bulk"} <= 0.05`` — judging exactly that
+series instead of the unlabeled one (per-tenant SLOs ride this). A metric
+absent from the registry (or a histogram/denominator with no observations
+yet) makes the objective *unjudgeable* — skipped, never breaching: booting
+quiet is not an outage.
 
 Live judging: each sampler tick evaluates every objective and feeds the
 verdict into a :class:`BurnRateEvaluator` — breach when the fast window's
@@ -48,9 +52,27 @@ _OPS: dict[str, Callable[[float, float], bool]] = {
 
 _NAME = r"[a-zA-Z_:][a-zA-Z0-9_:]*"
 _NUM = r"[-+]?\d+(?:\.\d+)?(?:[eE][-+]?\d+)?"
-_QUANTILE_RE = re.compile(rf"^({_NAME})\s+p(\d+(?:\.\d+)?)\s*(<=|>=|<|>)\s*({_NUM})$")
-_RATIO_RE = re.compile(rf"^({_NAME})\s*/\s*({_NAME})\s*(<=|>=|<|>)\s*({_NUM})$")
-_VALUE_RE = re.compile(rf"^({_NAME})\s*(<=|>=|<|>)\s*({_NUM})$")
+_SEL = r"(?:\{([^{}]*)\})?"  # optional {label="value", ...} series selector
+_QUANTILE_RE = re.compile(rf"^({_NAME}){_SEL}\s+p(\d+(?:\.\d+)?)\s*(<=|>=|<|>)\s*({_NUM})$")
+_RATIO_RE = re.compile(rf"^({_NAME}){_SEL}\s*/\s*({_NAME}){_SEL}\s*(<=|>=|<|>)\s*({_NUM})$")
+_VALUE_RE = re.compile(rf"^({_NAME}){_SEL}\s*(<=|>=|<|>)\s*({_NUM})$")
+_LABEL_PAIR_RE = re.compile(r'^([a-zA-Z_][a-zA-Z0-9_]*)\s*=\s*"([^"]*)"$')
+
+
+def _parse_selector(inner: Optional[str]) -> dict:
+    """``tenant="bulk", reason="brownout"`` → label kwargs dict (the braces
+    are stripped by the grammar regex; None/empty = no selector)."""
+    if not inner or not inner.strip():
+        return {}
+    labels: dict[str, str] = {}
+    for part in inner.split(","):
+        m = _LABEL_PAIR_RE.match(part.strip())
+        if m is None:
+            raise ValueError(
+                f'bad label selector fragment {part.strip()!r} — expected label="value"'
+            )
+        labels[m.group(1)] = m.group(2)
+    return labels
 
 
 @dataclass
@@ -65,6 +87,8 @@ class Objective:
     threshold: float
     quantile: Optional[float] = None  # kind == "quantile"
     denominator: Optional[str] = None  # kind == "ratio"
+    labels: dict = field(default_factory=dict)  # series selector on `metric`
+    den_labels: dict = field(default_factory=dict)  # selector on `denominator`
     budget: float = 0.01  # allowed bad-sample fraction
     fast_window_s: float = 60.0
     slow_window_s: float = 600.0
@@ -78,26 +102,29 @@ def parse_objective(name: str, expr: str, **opts) -> Objective:
     text = " ".join(str(expr).split())
     m = _QUANTILE_RE.match(text)
     if m:
-        metric, q, op, thr = m.groups()
+        metric, sel, q, op, thr = m.groups()
         if not 0.0 < float(q) < 100.0:
             raise ValueError(f"objective {name!r}: quantile p{q} outside (0, 100)")
         return Objective(
             name=name, expr=text, kind="quantile", metric=metric, op=op,
-            threshold=float(thr), quantile=float(q) / 100.0, **opts,
+            threshold=float(thr), quantile=float(q) / 100.0,
+            labels=_parse_selector(sel), **opts,
         )
     m = _RATIO_RE.match(text)
     if m:
-        num, den, op, thr = m.groups()
+        num, num_sel, den, den_sel, op, thr = m.groups()
         return Objective(
             name=name, expr=text, kind="ratio", metric=num, op=op,
-            threshold=float(thr), denominator=den, **opts,
+            threshold=float(thr), denominator=den,
+            labels=_parse_selector(num_sel), den_labels=_parse_selector(den_sel),
+            **opts,
         )
     m = _VALUE_RE.match(text)
     if m:
-        metric, op, thr = m.groups()
+        metric, sel, op, thr = m.groups()
         return Objective(
             name=name, expr=text, kind="value", metric=metric, op=op,
-            threshold=float(thr), **opts,
+            threshold=float(thr), labels=_parse_selector(sel), **opts,
         )
     raise ValueError(
         f"objective {name!r}: cannot parse {expr!r} — expected "
@@ -112,20 +139,20 @@ def _metric_value(objective: Objective, registry: MetricsRegistry) -> Optional[f
     if metric is None:
         return None
     if objective.kind == "quantile":
-        if not isinstance(metric, Histogram) or metric.count() <= 0:
+        if not isinstance(metric, Histogram) or metric.count(**objective.labels) <= 0:
             return None
-        return metric.quantile(objective.quantile)
+        return metric.quantile(objective.quantile, **objective.labels)
     if objective.kind == "ratio":
         den = registry.get(objective.denominator)
         if den is None:
             return None
-        den_value = den.value()
+        den_value = den.value(**objective.den_labels)
         if den_value <= 0:
             return None
-        return metric.value() / den_value
+        return metric.value(**objective.labels) / den_value
     if not isinstance(metric, (Counter, Gauge)):
         return None
-    if isinstance(metric, Gauge):
+    if isinstance(metric, Gauge) and not objective.labels:
         series = metric.series_snapshot()
         if series and () not in series:
             # labeled-only gauge (per-device headroom, per-executable memscope
@@ -134,7 +161,7 @@ def _metric_value(objective: Objective, registry: MetricsRegistry) -> Optional[f
             # cannot hide behind a healthy sibling.
             worst = max if objective.op in ("<", "<=") else min
             return worst(series.values())
-    return metric.value()
+    return metric.value(**objective.labels)
 
 
 def evaluate_objective(
@@ -349,6 +376,24 @@ def load_slo_spec(source: Union[str, Path, Mapping]) -> tuple[list[Objective], d
     if spec.get("sample_interval_s") is not None:
         options["sample_interval_s"] = float(spec["sample_interval_s"])
     return objectives, options
+
+
+def tenant_objectives(
+    tenant_names: Iterable[str], threshold: float = 0.05
+) -> list[Objective]:
+    """Auto-generated per-tenant SLO objectives (one per declared tenant): the
+    fraction of a tenant's arrivals that were shed stays under `threshold`.
+    Named ``tenant_<name>_error_rate`` — the serving engine reads each one's
+    ``budget_remaining`` to drive burn-aware victim selection, so a tenant the
+    system has already been shedding from is protected next time."""
+    return [
+        parse_objective(
+            f"tenant_{name}_error_rate",
+            f'serve_tenant_shed_total{{tenant="{name}"}} / '
+            f'serve_tenant_requests_total{{tenant="{name}"}} <= {threshold}',
+        )
+        for name in tenant_names
+    ]
 
 
 # ------------------------------------------------- recorded-run evaluation

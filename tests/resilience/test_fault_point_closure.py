@@ -33,6 +33,8 @@ FIRE_SITES = {
     "handoff_corrupt": "fire_handoff_corrupt_if_armed",
     "sse_torn": "fire_sse_torn_if_armed",
     "queue_storm": "fire_queue_storm_if_armed",
+    # multi-tenant isolation (PR 20)
+    "tenant_flood": "fire_tenant_flood_if_armed",
 }
 
 

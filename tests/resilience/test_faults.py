@@ -112,6 +112,7 @@ def test_registry_is_the_documented_set():
         "handoff_corrupt",
         "sse_torn",
         "queue_storm",
+        "tenant_flood",
     )
     assert ENV_VAR == "MODALITIES_TPU_FAULTS"
 

@@ -1,7 +1,8 @@
-"""Serving-side resilience chaos (PR 19): deadline propagation, SLO-driven
-load shedding, retry budgets, circuit breakers, and the five serving fault
-points (serve_worker_hang, serve_slow_decode, handoff_corrupt, sse_torn,
-queue_storm).
+"""Serving-side resilience chaos (PR 19/20): deadline propagation, SLO-driven
+load shedding, retry budgets, circuit breakers, multi-tenant isolation
+(weighted DRR admission, quotas, token-rate 429s, burn-aware victim
+selection), and the six serving fault points (serve_worker_hang,
+serve_slow_decode, handoff_corrupt, sse_torn, queue_storm, tenant_flood).
 
 The flagship scenario is the STORM: a wedged worker plus a queue_storm
 arrival burst must degrade into shedding (429s / finish reason "shed") and
@@ -27,9 +28,13 @@ from modalities_tpu.serving.resilience import (
     CircuitBreaker,
     ProbeBackoff,
     RetryBudget,
+    TenantRegistry,
+    TenantSpec,
+    TokenBucket,
     deadline_expired,
     default_deadline_ms,
     resolve_deadline_ms,
+    resolve_tenant,
 )
 from modalities_tpu.serving.fleet.router import FleetRouter, WorkerHandle
 from modalities_tpu.serving.server import ServingHTTPServer
@@ -380,6 +385,8 @@ def test_http_429_retry_after_under_brownout():
         status, body, headers = _post(server.port, "/generate", {"prompt": "7"})
         assert status == 429
         assert body["reason"] == "brownout_reject"
+        # derived Retry-After (PR 20): the queue already drained to the
+        # brownout floor, so the estimate bottoms out at the 1 s minimum
         assert headers.get("Retry-After") == "1"
         # the slot-holder is untouched by the brownout: exactly-once delivery
         ta.join(timeout=30.0)
@@ -404,6 +411,250 @@ def test_serve_slow_decode_fault_stalls_one_step():
     assert time.monotonic() - t0 >= 0.06
     assert results[rid].finish_reason == "budget"
     assert results[rid].tokens == [4, 5, 6]
+
+
+# ------------------------------------------------ multi-tenant isolation (PR 20)
+
+
+def test_tenant_spec_and_registry_validation():
+    with pytest.raises(ValueError, match="class"):
+        TenantSpec("x", tenant_class="batch")
+    with pytest.raises(ValueError, match="weight"):
+        TenantSpec("x", weight=0)
+    with pytest.raises(ValueError, match="max_slots"):
+        TenantSpec("x", max_slots=0)
+    with pytest.raises(ValueError, match="rate"):
+        TenantSpec("x", rate=0.0)
+    with pytest.raises(ValueError, match="unknown keys"):
+        TenantRegistry.from_config({"x": {"wieght": 2}})
+    reg = TenantRegistry.from_config({
+        "b": {"class": "bulk", "weight": 2, "rate": 5.0},
+        "a": {"max_slots": 3},
+    })
+    assert reg.names() == ["a", "b"]  # sorted: the DRR rotation is deterministic
+    assert reg.spec("b").is_bulk
+    assert reg.spec("b").burst == 5.0  # default burst: one second of rate
+    assert reg.spec("a").max_slots == 3 and reg.spec("a").rate is None
+    # an undeclared tenant degrades to best-effort defaults, not an error
+    ghost = reg.spec("ghost")
+    assert not ghost.is_bulk and ghost.weight == 1.0 and ghost.max_slots is None
+
+
+def test_resolve_tenant_and_engine_seam(monkeypatch):
+    monkeypatch.delenv("MODALITIES_TPU_SERVE_TENANT_DEFAULT", raising=False)
+    assert resolve_tenant(None) == "default"
+    assert resolve_tenant("  ") == "default"
+    assert resolve_tenant(" acme ") == "acme"
+    monkeypatch.setenv("MODALITIES_TPU_SERVE_TENANT_DEFAULT", "team-a")
+    assert resolve_tenant(None) == "team-a"
+    # the shared ingress seam: tenants OFF collapses every id to the implicit
+    # "" tenant (no per-tenant series, the HEAD scheduler); tenants ON resolves
+    assert _engine().resolve_submit_tenant("acme") == ""
+    on = _engine(tenants=TenantRegistry.from_config({"acme": {}}))
+    assert on.resolve_submit_tenant(None) == "team-a"
+    assert on.resolve_submit_tenant("acme") == "acme"
+
+
+def test_token_bucket_refill_and_retry_after():
+    with pytest.raises(ValueError, match="rate > 0"):
+        TokenBucket(0.0, 1.0)
+    bucket = TokenBucket(rate=10.0, burst=20.0)
+    assert bucket.try_take(20.0, now=0.0)  # the full burst fits...
+    assert not bucket.try_take(5.0, now=0.0)  # ...and a refusal never partial-charges
+    assert bucket.retry_after_s(5.0, now=0.0) == 0.5  # exact refill time
+    assert bucket.try_take(5.0, now=0.5)
+    # demand beyond the bucket depth reports the FULL-burst refill, not never
+    assert bucket.retry_after_s(1000.0, now=0.5) == 2.0
+
+
+def test_rate_limit_gate_charges_bucket_and_derives_retry_after():
+    clock = {"t": 0.0}
+    engine = _engine(
+        tenants=TenantRegistry.from_config({"metered": {"rate": 4.0, "burst": 8.0}}),
+        time_fn=lambda: clock["t"],
+    )
+    # two 4-token admissions drain the burst; each one charged the bucket
+    assert engine.tenant_reject_reason("metered", 4) is None
+    assert engine.tenant_reject_reason("metered", 4) is None
+    reason, retry_after = engine.tenant_reject_reason("metered", 4)
+    assert reason == "rate_limited"
+    assert retry_after == 1.0  # 4 tokens at 4 tokens/s
+    clock["t"] = 1.0
+    assert engine.tenant_reject_reason("metered", 4) is None  # refilled
+    # unmetered tenants / tenant-off engines are never throttled
+    assert engine.tenant_reject_reason("ghost", 10_000) is None
+    assert _engine().tenant_reject_reason("metered", 10_000) is None
+    # the HTTP layer charges a 429 to the tenant's shed + rate-limit series
+    engine.note_rejected("rate_limited", tenant="metered")
+    assert engine._m_tenant_rate_limited.value(tenant="metered") == 1
+    assert engine._m_tenant_shed.value(tenant="metered") == 1
+
+
+def test_retry_after_derived_from_queue_state():
+    engine = _engine(max_queue_depth=1)  # 2 slots (_engine default)
+    for i in range(5):
+        engine.submit([3], 1, temperature=0.0, seed=i)
+    # 5 queued over a limit of 1: 5 excess requests / 2-slot drain width
+    assert engine.retry_after_s("queue_full") == 3.0
+    assert engine.retry_after_s("unknown") == 1.0
+    browned = _engine(brownout=BrownoutController(queue_high=4, queue_low=2))
+    for i in range(6):
+        browned.submit([3], 1, temperature=0.0, seed=i)
+    # recovery needs the queue at/below queue_low=2: 4 excess over 2 slots
+    assert browned.retry_after_s("brownout_reject") == 2.0
+    # floor: an already-drained queue never tells the client 0
+    assert _engine(max_queue_depth=8).retry_after_s("queue_full") == 1.0
+
+
+def test_drr_admission_converges_to_weight_ratio():
+    registry = TenantRegistry.from_config(
+        {"gold": {"weight": 3}, "bronze": {"weight": 1}}
+    )
+    engine = _engine(max_batch_slots=1, tenants=registry, time_fn=_tick_clock())
+    rids = {"gold": [], "bronze": []}
+    for i in range(6):
+        rids["gold"].append(
+            engine.submit([3], 1, temperature=0.0, seed=i, tenant="gold")
+        )
+        rids["bronze"].append(
+            engine.submit([5], 1, temperature=0.0, seed=i, tenant="bronze")
+        )
+    results = engine.run()
+    tenant_of = {r: t for t, tenant_rids in rids.items() for r in tenant_rids}
+    order = sorted(results, key=lambda r: results[r].first_token_s)
+    first8 = [tenant_of[r] for r in order[:8]]
+    # bronze banks 1 credit per rotation, gold banks 3: a 3:1 admission ratio
+    assert first8.count("gold") == 6 and first8.count("bronze") == 2
+    # FIFO within a tenant survives the interleave
+    for tenant_rids in rids.values():
+        firsts = [results[r].first_token_s for r in tenant_rids]
+        assert firsts == sorted(firsts)
+    assert all(s is None for s in engine._slot_states)
+
+
+def test_victim_selection_is_burn_aware():
+    budgets = {"inter": 0.1, "bulk": 0.9, "greedy": 0.5}
+    registry = TenantRegistry.from_config({
+        "inter": {"class": "interactive", "weight": 1, "max_slots": 2},
+        "bulk": {"class": "bulk", "weight": 1},
+        "greedy": {"class": "interactive", "weight": 1, "max_slots": 1},
+    })
+    engine = _engine(
+        max_batch_slots=2, tenants=registry,
+        tenant_budget_fn=lambda t: budgets[t],
+    )
+    counts = {"inter": 1, "bulk": 1}
+    total = engine._demand_weight(counts)
+    # a bulk candidate always outranks an under-budget interactive tenant
+    assert engine._victim_key("bulk", counts, total) > engine._victim_key(
+        "inter", counts, total
+    )
+    # ...but an over-quota tenant outranks even bulk
+    counts = {"greedy": 2, "bulk": 1}
+    total = engine._demand_weight(counts)
+    assert engine._victim_key("greedy", counts, total) > engine._victim_key(
+        "bulk", counts, total
+    )
+    # ties inside a class break on the LEAST-burned budget (max remaining)
+    key_fresh = engine._victim_key("bulk", {}, 0.0)
+    budgets["bulk"] = 0.2
+    assert key_fresh > engine._victim_key("bulk", {}, 0.0)
+
+
+def test_http_tenant_rate_limit_429_with_refill_retry_after():
+    """X-Tenant-Id rides the header seam like X-Deadline-Ms: a metered tenant
+    that outruns its token bucket gets a per-tenant 429 whose Retry-After is
+    the bucket's refill time, while other tenants sail through."""
+    engine = _engine(
+        tenants=TenantRegistry.from_config({"metered": {"rate": 0.5, "burst": 4.0}})
+    )
+    server = ServingHTTPServer(
+        engine, encode=lambda s: [int(t) for t in s.split()],
+        decode=lambda ids: " ".join(str(i) for i in ids), port=0,
+    )
+    server.start()
+    try:
+        body = {"prompt": "3", "max_new_tokens": 4}
+        status, _events, _h = _post(
+            server.port, "/generate", body, headers={"X-Tenant-Id": "metered"}
+        )
+        assert status == 200  # charged the full burst, served normally
+        status, err, headers = _post(
+            server.port, "/generate", body, headers={"X-Tenant-Id": "metered"}
+        )
+        assert status == 429 and err["reason"] == "rate_limited"
+        # refill-derived: 4 tokens at 0.5/s is ~8 s, rounded up, never 0
+        assert 1 <= int(headers["Retry-After"]) <= 8
+        # an unmetered tenant is untouched by the neighbor's empty bucket
+        status, _events, _h = _post(
+            server.port, "/generate", body, headers={"X-Tenant-Id": "other"}
+        )
+        assert status == 200
+        assert engine._m_tenant_rate_limited.value(tenant="metered") == 1
+        assert engine.stats()["tenants"]["metered"]["rate_limited"] == 1
+    finally:
+        server.close()
+
+
+def test_tenant_flood_chaos_isolates_the_interactive_tenant():
+    """The PR-20 acceptance flood: tenant_flood amplifies the first submit
+    with 6 bulk-tenant clones while a brownout controller is armed. The DRR
+    scheduler + burn-aware shedder must contain the noisy neighbor: every
+    interactive stream is bitwise identical to its flood-free twin, the
+    interactive tenant is never shed or preempted, ALL sheds land on the
+    bulk tenant (counter-pinned on serve_tenant_shed_total{tenant="bulk"}),
+    the paged pool audit stays exact, and the decode path never recompiles."""
+    cfg = {
+        "interactive": {"class": "interactive", "weight": 4},
+        "bulk": {"class": "bulk", "weight": 1},
+    }
+    reqs = [([3, 4, 5], 3, seed) for seed in range(3)]
+
+    # the flood-free twin first: the reference tokens
+    twin = _paged(tenants=TenantRegistry.from_config(cfg))
+    twin_rids = [
+        twin.submit(p, b, temperature=0.0, seed=s, tenant="interactive")
+        for p, b, s in reqs
+    ]
+    twin_results = twin.run()
+    twin_tokens = [twin_results[rid].tokens for rid in twin_rids]
+
+    arm_faults("tenant_flood@0:6")
+    engine = _paged(
+        tenants=TenantRegistry.from_config(cfg),
+        brownout=BrownoutController(queue_high=4, queue_low=4),
+    )
+    rids = [
+        engine.submit(p, b, temperature=0.0, seed=s, tenant="interactive")
+        for p, b, s in reqs
+    ]
+    results = engine.run()
+    assert len(results) == 9  # 3 interactive + 6 flood clones
+    flood_rids = set(results) - set(rids)
+
+    # every interactive stream: bitwise equal to the twin, finished "budget"
+    for rid, want in zip(rids, twin_tokens):
+        assert results[rid].finish_reason == "budget"
+        assert results[rid].tokens == want
+    # the brownout shed ONLY flood clones: depth 9 -> queue_low 4 = 5 victims
+    shed = {r for r, res in results.items() if res.finish_reason == "shed"}
+    assert shed <= flood_rids and len(shed) == 5
+    assert all(results[r].tokens == [] for r in shed)
+    # counter pin: every shed charged to the bulk tenant, none to interactive
+    assert engine._m_tenant_shed.value(tenant="bulk") == 5
+    assert engine._m_tenant_shed.value(tenant="interactive") == 0
+    assert engine._m_tenant_preempt.value(tenant="interactive") == 0
+    stats = engine.stats()
+    assert stats["shed_requests"] == 5
+    assert stats["tenants"]["interactive"]["shed"] == 0
+    assert stats["tenants"]["interactive"]["finished"] == 3
+    assert stats["tenants"]["bulk"]["shed"] == 5
+    # the pool audit holds and the flood never forced a recompile
+    assert stats["free_blocks"] == stats["num_blocks"]
+    engine._table_state.check()
+    assert all(s is None for s in engine._slot_states)
+    assert stats["decode_executables"] == 1
+    assert stats["prefill_executables"] == 1
 
 
 # ----------------------------------------------------------- the chaos storm

@@ -17,7 +17,11 @@ import pytest
 from click.testing import CliRunner
 
 from modalities_tpu.__main__ import main as cli_main
-from modalities_tpu.serving.analyze import load_serve_records, summarize_serve
+from modalities_tpu.serving.analyze import (
+    format_serve_table,
+    load_serve_records,
+    summarize_serve,
+)
 from modalities_tpu.serving.engine import ServingEngine
 from modalities_tpu.serving.server import ServingHTTPServer
 from modalities_tpu.telemetry import Telemetry, set_active_telemetry
@@ -354,3 +358,45 @@ def test_analyze_serve_tolerates_torn_tail_and_empty_sink(tmp_path):
     summary = summarize_serve(load_serve_records(sink))
     assert summary["requests"] == 1 and summary["finish_reasons"] == {"eod": 1}
     assert summarize_serve([]) == {"requests": 0}
+
+
+def test_summarize_serve_per_tenant_breakdown_and_table():
+    """PR-20: records carrying a `tenant` tag fold into a per-tenant
+    breakdown (requests/errors/sheds/preemptions + TTFT percentiles);
+    untagged records from a tenant-off run fold into the implicit "-" row so
+    mixed sinks still sum to the totals, and a single-tenant-off summary
+    renders NO tenant table at all."""
+    def rec(tenant, reason="eod", ttft=0.02, preemptions=0):
+        r = {"event": "serve_request", "rid": 0, "prompt_len": 2, "tokens": 3,
+             "finish_reason": reason, "truncated": False,
+             "preemptions": preemptions, "arrival_s": 0.0,
+             "queue_wait_s": 0.01, "ttft_s": ttft, "e2e_s": 0.05,
+             "tpot_mean_s": 0.01, "events": []}
+        if tenant is not None:
+            r["tenant"] = tenant
+        return r
+
+    summary = summarize_serve([
+        rec("acme", ttft=0.02),
+        rec("acme", reason="error", ttft=0.08),
+        rec("bulk", reason="shed", ttft=None),
+        rec("bulk", preemptions=2),
+        rec(None),  # tenant-off record in the same sink
+    ])
+    assert set(summary["tenants"]) == {"acme", "bulk", "-"}
+    acme, bulk = summary["tenants"]["acme"], summary["tenants"]["bulk"]
+    assert (acme["requests"], acme["errors"], acme["sheds"]) == (2, 1, 0)
+    assert acme["ttft_p50_s"] == pytest.approx(0.05)
+    assert acme["ttft_p99_s"] <= 0.08
+    assert (bulk["requests"], bulk["sheds"], bulk["preemptions"]) == (2, 1, 2)
+    assert summary["tenants"]["-"]["requests"] == 1
+    # per-tenant rows sum to the run totals (no double counting)
+    assert sum(row["requests"] for row in summary["tenants"].values()) == 5
+
+    table = format_serve_table(summary)
+    tenant_lines = [l for l in table.splitlines() if l.startswith(("acme", "bulk"))]
+    assert len(tenant_lines) == 2 and "tenant" in table
+
+    # a tenant-off sink (only the implicit "-" row) renders no tenant table
+    off = format_serve_table(summarize_serve([rec(None), rec(None)]))
+    assert "tenant" not in off

@@ -446,6 +446,90 @@ def test_scheduler_property_deadlines_and_shedding(model, params, kv_cache):
         assert firsts == sorted(firsts)
 
 
+@pytest.mark.parametrize("kv_cache", ["ring", "paged"])
+def test_scheduler_property_multitenant(model, params, kv_cache):
+    """PR-20 extension of the scheduler property: a TenantRegistry joins the
+    trace on both cache modes. Per-tenant slot quotas are never exceeded,
+    FIFO holds within a (tenant, class), the weighted DRR share shows up
+    under saturation, finish reasons stay legal, and slots/blocks return to
+    pristine (zero leak)."""
+    from modalities_tpu.serving.resilience import TenantRegistry
+
+    registry = TenantRegistry.from_config({
+        "gold": {"class": "interactive", "weight": 3},
+        "silver": {"class": "interactive", "weight": 1, "max_slots": 1},
+        "bulk": {"class": "bulk", "weight": 1},
+    })
+    ticks = {"v": 0.0}
+
+    def clock():
+        ticks["v"] += 0.01
+        return ticks["v"]
+
+    holder = {}
+    quota_violations = []
+
+    def watch(rid, tok):
+        # sampled at every delivered token: the quota must hold mid-flight
+        if holder["eng"]._tenant_active_slots("silver") > 1:
+            quota_violations.append(rid)
+
+    kwargs = dict(max_batch_slots=2, time_fn=clock, tenants=registry,
+                  on_token=watch)
+    if kv_cache == "paged":
+        # pool generous enough that preemption never reorders the trace: the
+        # FIFO-within-tenant check needs admission order == serve order
+        kwargs.update(kv_cache="paged", paged_block_size=4, paged_max_len=24,
+                      paged_num_blocks=24)
+    engine = ServingEngine(model, params, **kwargs)
+    holder["eng"] = engine
+
+    rng = np.random.default_rng(2000)
+    plan = ["gold"] * 8 + ["silver"] * 4 + ["bulk"] * 4
+    rids = {"gold": [], "silver": [], "bulk": []}
+    budgets = {}
+    for i, tenant in enumerate(plan):
+        plen = int(rng.integers(2, 9))
+        prompt = [int(x) for x in rng.integers(0, 127, size=plen)]
+        budget = int(rng.integers(2, 6))
+        # arrival 0 for everyone: the queue is saturated from the first sweep,
+        # so admissions are a pure DRR decision
+        rid = engine.submit(prompt, budget, temperature=0.0, seed=i,
+                            arrival_offset_s=0.0, tenant=tenant)
+        rids[tenant].append(rid)
+        budgets[rid] = budget
+    results = engine.run()
+
+    legal = ("eod", "budget", "capacity") if kv_cache == "ring" else ("eod", "budget")
+    assert sorted(results) == sorted(budgets)
+    for rid, result in results.items():
+        assert result.finish_reason in legal, (rid, result.finish_reason)
+        assert len(result.tokens) <= budgets[rid]
+    # the silver slot quota held at every delivered token
+    assert quota_violations == []
+    # FIFO within each (tenant, class): per-tenant first tokens in rid order
+    assert engine.stats()["preemptions"] == 0
+    for tenant_rids in rids.values():
+        firsts = [results[r].first_token_s for r in tenant_rids]
+        assert firsts == sorted(firsts)
+    # weighted share under saturation: in the first 10 admissions gold
+    # (weight 3) is served well clear of the weight-1 tenants
+    tenant_of = {r: t for t, trids in rids.items() for r in trids}
+    order = sorted(results, key=lambda r: results[r].first_token_s)
+    first10 = [tenant_of[r] for r in order[:10]]
+    assert first10.count("gold") >= 2 * first10.count("bulk")
+    assert first10.count("gold") >= 5
+    # zero leak: slots empty, paged pool tiles exactly, per-tenant stats add up
+    assert all(s is None for s in engine._slot_states)
+    stats = engine.stats()
+    assert sum(row["finished"] for row in stats["tenants"].values()) == len(plan)
+    assert stats["tenants"]["silver"]["active_slots"] == 0
+    if kv_cache == "paged":
+        engine._table_state.check()
+        assert stats["free_blocks"] == stats["num_blocks"]
+        assert engine._table_state.active_requests() == []
+
+
 # ------------------------------------------------------------ mesh sharding
 
 

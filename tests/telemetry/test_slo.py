@@ -20,6 +20,7 @@ from modalities_tpu.telemetry.slo import (
     parse_objective,
     replay_bench_lines_into_registry,
     replay_sink_into_registry,
+    tenant_objectives,
 )
 
 # ---------------------------------------------------------------- grammar
@@ -51,6 +52,45 @@ def test_parse_rejects_garbage_and_out_of_range_quantiles():
         parse_objective("bad", "serve_ttft_seconds p100 < 0.5")
     with pytest.raises(ValueError, match="outside"):
         parse_objective("bad", "serve_ttft_seconds p0 < 0.5")
+
+
+def test_label_selectors_judge_one_series_and_tenant_objectives():
+    """PR-20 grammar: a `{tenant="x"}` selector on any objective form judges
+    exactly that labeled series, and `tenant_objectives` auto-derives one
+    shed-rate objective per declared tenant riding the same grammar."""
+    q = parse_objective("t", 'serve_ttft_seconds{tenant="acme"} p95 < 0.5')
+    assert (q.kind, q.labels) == ("quantile", {"tenant": "acme"})
+    v = parse_objective("slots", 'serve_tenant_active_slots{tenant="acme"} <= 4')
+    assert (v.kind, v.labels) == ("value", {"tenant": "acme"})
+    r = parse_objective(
+        "shed",
+        'serve_tenant_shed_total{tenant="bulk", reason="brownout"} / '
+        'serve_tenant_requests_total{tenant="bulk"} <= 0.05',
+    )
+    assert r.labels == {"tenant": "bulk", "reason": "brownout"}
+    assert r.den_labels == {"tenant": "bulk"}
+
+    reg = MetricsRegistry()
+    shed = reg.counter("serve_tenant_shed_total", "")
+    reqs = reg.counter("serve_tenant_requests_total", "")
+    for _ in range(10):
+        reqs.inc(tenant="bulk")
+        reqs.inc(tenant="acme")
+    for _ in range(6):
+        shed.inc(tenant="bulk")
+
+    objs = tenant_objectives(["bulk", "acme"], threshold=0.05)
+    assert [o.name for o in objs] == [
+        "tenant_bulk_error_rate", "tenant_acme_error_rate",
+    ]
+    by_name = {o.name: o for o in objs}
+    # the flooded tenant breaches ITS objective (6/10 shed), while the quiet
+    # tenant's own series stays green — the whole point of the selector:
+    # one tenant's burn never judges another's
+    ok, value = evaluate_objective(by_name["tenant_bulk_error_rate"], reg)
+    assert ok is False and value == pytest.approx(0.6)
+    ok, value = evaluate_objective(by_name["tenant_acme_error_rate"], reg)
+    assert ok is True and value == 0.0
 
 
 def test_load_slo_spec_from_mapping_and_yaml(tmp_path):

@@ -126,6 +126,46 @@ def test_bench_serve_disagg_smoke_reports_tier_percentiles():
         assert isinstance(out[key], float), (key, out)
 
 
+def test_bench_serve_tenants_smoke_reports_per_tenant_schema():
+    """--tenants smoke: the mixed-tenant replay reports per-tenant TTFT/TPOT
+    percentiles plus shed/preempt counts in the final JSON line (PR 20). The
+    isolation-oracle ratio only runs on the slow full run below."""
+    out = _run("--smoke", "--tenants", "interactive:3:w4,bulk:3:w1", timeout=300)
+    assert out["smoke"] is True
+    assert out["requests"] == 6
+    assert out["decode_executables"] == 1
+    assert set(out["tenants"]) == {"interactive", "bulk"}
+    for name, row in out["tenants"].items():
+        assert row["requests"] == 3, (name, row)
+        for key in ("ttft_p50_ms", "ttft_p99_ms", "tpot_p50_ms", "tpot_p99_ms"):
+            assert isinstance(row[key], float), (name, key, row)
+        assert row["sheds"] == 0 and row["preemptions"] == 0
+    assert out["tenants"]["interactive"]["weight"] == 4
+    assert out["tenants"]["bulk"]["weight"] == 1
+    assert out["interactive_ttft_inflation"] is None
+
+
+@pytest.mark.slow  # flooded run + solo baseline (~2 min CPU); the tenants
+# JSON-line contract stays pinned fast by
+# test_bench_serve_tenants_smoke_reports_per_tenant_schema above
+def test_bench_serve_tenant_isolation_oracle():
+    """ISSUE PR-20 acceptance: with a 40-request bulk flood dumped at t=0 and
+    interactive probes trickling in mid-flood, the interactive tenant's p99
+    TTFT stays within 1.5x its unloaded (solo) baseline — weighted DRR
+    admission plus the bulk slot quota (`:s4` reserves half the decode slots)
+    keep the noisy neighbor from queuing ahead of it. Both arms replay on the
+    deterministic modeled-cost clock (same seed -> same ratio; the FIFO
+    engine on this exact workload inflates ~4.7x)."""
+    out = _run("--tenants", "interactive:8:w4,bulk:40:w1:s4",
+               "--rate", "50", "--max-new", "16", timeout=540)
+    assert set(out["tenants"]) == {"interactive", "bulk"}
+    assert out["tenants"]["interactive"]["requests"] == 8
+    assert out["tenants"]["bulk"]["requests"] == 40
+    assert out["decode_executables"] == 1
+    assert out["interactive_ttft_inflation"] is not None
+    assert out["interactive_ttft_inflation"] <= 1.5, out
+
+
 @pytest.mark.slow  # four modeled engine runs (~2 min CPU); the disagg JSON-line
 # contract stays pinned fast by test_bench_serve_disagg_smoke_reports_tier_
 # percentiles above, and handoff/parity semantics in-process by

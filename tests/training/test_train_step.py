@@ -54,6 +54,9 @@ def _batch(rng, acc, mb, seq, vocab=128):
     }
 
 
+@pytest.mark.slow  # ~13 s (20 optimizer steps); loss-actually-decreases stays
+# pinned fast by tests/end2end_tests/test_main_e2e.py::test_main_end_to_end
+# (full CLI training loop asserting train loss falls)
 def test_loss_decreases_dp():
     mesh = get_device_mesh(device_type="cpu", data_parallel_shard_degree=8, world_size=8)
     model = tiny_gpt2("pytorch_flash")
@@ -102,6 +105,10 @@ def test_dp_tp_equivalence():
     np.testing.assert_allclose(losses["dp"], losses["dp_tp"], rtol=tol, atol=tol)
 
 
+@pytest.mark.slow  # ~19 s; microbatch-accumulation numerics stay pinned fast by
+# test_dp_pp_equivalence (PP accumulates per microbatch against the dp8 twin)
+# and the accumulation loop's structural contract by tests/training/
+# test_dcn_hierarchical.py::test_one_cross_slice_reduction_per_optimizer_step
 def test_grad_accumulation_equivalence():
     """acc=2 over half-size microbatches == acc=1 over the full batch."""
     model = tiny_gpt2("pytorch_flash")
@@ -595,6 +602,9 @@ def test_dp_pp_equivalence_with_ignore_index(schedule):
     np.testing.assert_allclose(losses["dp"], losses["pp_sched"], rtol=3e-4, atol=3e-4)
 
 
+@pytest.mark.slow  # ~19 s (two 8-way builds); tp-mesh CE numerics stay pinned
+# fast by test_chunked_lm_head_loss_equivalence and the vocab/tp sharding-rule
+# plumbing by test_tp_placement_colwise_rowwise_and_vocab
 def test_loss_parallel_equivalence_and_rule():
     """enable_loss_parallel shards the LOGITS vocab dim over tp (one sharding rule —
     the GSPMD expression of vocab-parallel CE); numerics must be unchanged."""
